@@ -1,0 +1,31 @@
+"""Test config: 8 virtual CPU devices + fp64.
+
+The TPU-native substitute for "mpirun -np 8 without a cluster" (SURVEY.md §4):
+force the host platform to expose 8 fake devices so every sharded code path
+runs in CI, and enable x64 so fp64 parity tests against the reference's
+golden values are meaningful.  Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_enable_x64", True)
+# f32 matmuls default to fast-low precision; accuracy assertions in the tests
+# (residual checks) need true f32 accumulation.
+jax.config.update("jax_default_matmul_precision", "highest")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(0)

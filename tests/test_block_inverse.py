@@ -1,0 +1,89 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_jordan.ops import (
+    batched_block_inverse,
+    gauss_jordan_inverse,
+    generate,
+    inf_norm,
+)
+
+
+def test_inverse_matches_numpy(rng):
+    a = jnp.asarray(rng.standard_normal((16, 16)))
+    inv, sing = gauss_jordan_inverse(a)
+    assert not bool(sing)
+    np.testing.assert_allclose(np.asarray(inv), np.linalg.inv(np.asarray(a)),
+                               rtol=1e-10, atol=1e-10)
+
+
+def test_zero_diagonal_requires_pivoting():
+    # |i-j| blocks have zero diagonals; partial pivoting must handle them
+    a = generate("absdiff", (8, 8), jnp.float64)
+    inv, sing = gauss_jordan_inverse(a)
+    assert not bool(sing)
+    np.testing.assert_allclose(np.asarray(a @ inv), np.eye(8), atol=1e-10)
+
+
+def test_singular_flagged():
+    a = jnp.ones((4, 4), dtype=jnp.float64)  # rank 1
+    _, sing = gauss_jordan_inverse(a)
+    assert bool(sing)
+
+
+def test_zero_matrix_flagged():
+    # |norm| < eps path (main.cpp:782 second clause)
+    _, sing = gauss_jordan_inverse(jnp.zeros((4, 4), dtype=jnp.float64))
+    assert bool(sing)
+
+
+def test_relative_threshold_uses_external_scale():
+    # a well-conditioned small block must flag singular when judged against a
+    # huge strip norm — parity with inverse_block(E, F, norm_a, ...) where
+    # norm_a is the whole strip's norm (main.cpp:972,1046)
+    a = jnp.eye(4, dtype=jnp.float64) * 1e-3
+    _, sing_local = gauss_jordan_inverse(a)          # own norm: fine
+    assert not bool(sing_local)
+    _, sing_scaled = gauss_jordan_inverse(a, scale_norm=1e14)
+    assert bool(sing_scaled)
+
+
+@pytest.mark.parametrize("dtype,rtol", [(jnp.float64, 1e-9), (jnp.float32, 1e-3)])
+def test_batched_matches_loop(rng, dtype, rtol):
+    # keep blocks well-conditioned so the fp32 tolerance is meaningful
+    blocks = jnp.asarray(
+        rng.standard_normal((6, 8, 8)) + 4 * np.eye(8), dtype=dtype
+    )
+    invs, sings = batched_block_inverse(blocks)
+    assert invs.shape == (6, 8, 8)
+    assert not bool(sings.any())
+    for b in range(6):
+        np.testing.assert_allclose(
+            np.asarray(blocks[b] @ invs[b]), np.eye(8), atol=rtol
+        )
+
+
+def test_batched_mixed_singular(rng):
+    good = rng.standard_normal((8, 8))
+    bad = np.ones((8, 8))
+    blocks = jnp.asarray(np.stack([good, bad, good]))
+    invs, sings = batched_block_inverse(blocks)
+    assert list(np.asarray(sings)) == [False, True, False]
+    np.testing.assert_allclose(
+        np.asarray(blocks[0] @ invs[0]), np.eye(8), atol=1e-9
+    )
+
+
+def test_hilbert_conditioning_matches_reference_scale():
+    # Reference golden behavior (SURVEY.md §4): Hilbert inverts for n<=8 at
+    # EPS=1e-15, declared singular for n>=10
+    for n, ok in [(4, True), (8, True), (12, False)]:
+        h = generate("hilbert", (n, n), jnp.float64)
+        _, sing = gauss_jordan_inverse(h, eps=1e-15)
+        assert bool(sing) == (not ok), f"n={n}"
+
+
+def test_inf_norm():
+    a = jnp.asarray([[1.0, -2.0], [3.0, 4.0]])
+    assert float(inf_norm(a)) == 7.0

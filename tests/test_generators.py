@@ -1,0 +1,33 @@
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_jordan.ops import generate
+
+
+def test_absdiff_matches_reference_formula():
+    # f(i,j) = |i-j| (main.cpp:47-57)
+    a = np.asarray(generate("absdiff", (5, 5), jnp.float64))
+    expect = np.abs(np.subtract.outer(np.arange(5), np.arange(5)))
+    np.testing.assert_array_equal(a, expect)
+
+
+def test_hilbert_matches_reference_formula():
+    # 1/(i+j+1) (main.cpp:49-51)
+    a = np.asarray(generate("hilbert", (4, 4), jnp.float64))
+    i, j = np.meshgrid(np.arange(4), np.arange(4), indexing="ij")
+    np.testing.assert_allclose(a, 1.0 / (i + j + 1), rtol=1e-14)
+
+
+def test_identity_generator():
+    a = np.asarray(generate("identity", (6, 6), jnp.float32))
+    np.testing.assert_array_equal(a, np.eye(6, dtype=np.float32))
+
+
+def test_offsets_give_shard_views():
+    # a shard generated with offsets equals the corresponding window of the
+    # full matrix — the no-comm per-shard init path (init_matrix analog)
+    full = np.asarray(generate("absdiff", (8, 8), jnp.float64))
+    shard = np.asarray(
+        generate("absdiff", (2, 8), jnp.float64, row_offset=3, col_offset=0)
+    )
+    np.testing.assert_array_equal(shard, full[3:5])

@@ -1,0 +1,76 @@
+"""Unit tests for the row-block-cyclic layout math.
+
+Parity oracle: the reference's closed forms (rows_p_process main.cpp:95-116,
+local_to_global main.cpp:118-123, find_sender main.cpp:521-532), re-derived
+here independently by brute force over the cyclic assignment rule
+"block r -> worker r % p".
+"""
+
+import numpy as np
+import pytest
+
+from tpu_jordan.parallel import layout
+
+
+@pytest.mark.parametrize("n,m", [(1, 1), (7, 3), (12, 4), (100, 7), (1024, 48)])
+def test_num_block_rows(n, m):
+    assert layout.num_block_rows(n, m) == int(np.ceil(n / m))
+
+
+@pytest.mark.parametrize("Nr", [1, 2, 5, 8, 17])
+@pytest.mark.parametrize("p", [1, 2, 3, 8])
+def test_rows_per_worker_bruteforce(Nr, p):
+    for k in range(p):
+        expect = sum(1 for r in range(Nr) if r % p == k)
+        assert layout.rows_per_worker(Nr, p, k) == expect
+    assert sum(layout.rows_per_worker(Nr, p, k) for k in range(p)) == Nr
+
+
+@pytest.mark.parametrize("m,p", [(3, 1), (3, 2), (4, 3), (5, 8)])
+def test_local_to_global_roundtrip(m, p):
+    # every (worker, local row) maps to a distinct global row whose owner is
+    # that worker, matching gi = ((i/m)*p + k)*m + i%m (main.cpp:118-123)
+    seen = set()
+    for k in range(p):
+        for i in range(4 * m):  # 4 local blocks
+            gi = layout.local_to_global(i, m, p, k)
+            assert layout.global_block_owner(gi // m, p) == k
+            assert layout.global_to_local_block(gi // m, p) == i // m
+            assert gi % m == i % m
+            seen.add(gi)
+    assert len(seen) == p * 4 * m
+
+
+@pytest.mark.parametrize("Nr,p", [(1, 1), (5, 2), (8, 3), (3, 8), (16, 8)])
+def test_find_sender_owns_last_block(Nr, p):
+    s = layout.find_sender(Nr, p)
+    assert s == (Nr - 1) % p
+    assert layout.global_block_owner(Nr - 1, p) == s
+
+
+def test_last_block_height():
+    assert layout.last_block_height(10, 3) == 1
+    assert layout.last_block_height(9, 3) == 3
+    assert layout.last_block_height(1024, 48) == 1024 - 48 * 21
+
+
+@pytest.mark.parametrize("n,m,p", [(10, 3, 4), (8, 4, 2), (7, 7, 8)])
+def test_padded_num_blocks(n, m, p):
+    Nr = layout.padded_num_blocks(n, m, p)
+    assert Nr % p == 0
+    assert Nr >= layout.num_block_rows(n, m)
+    assert Nr - p < layout.num_block_rows(n, m) + p  # minimal
+
+
+def test_cyclic_layout_perms():
+    lo = layout.CyclicLayout.create(n=10, m=3, p=2)
+    assert lo.Nr == 4 and lo.N == 12
+    order = lo.cyclic_block_order()
+    # worker 0 stores blocks [0, 2], worker 1 stores [1, 3]
+    assert order == [0, 2, 1, 3]
+    g = np.asarray(layout.cyclic_gather_perm(lo))
+    s = np.asarray(layout.cyclic_scatter_perm(lo))
+    assert list(g) == order
+    # scatter inverts gather
+    x = np.arange(lo.Nr)
+    assert (x[g][s] == x).all()

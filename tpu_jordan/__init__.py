@@ -1,0 +1,12 @@
+"""tpu_jordan — a TPU-native distributed dense linear algebra framework.
+
+Brand-new JAX/XLA/pallas/pjit implementation of everything the MPI reference
+``yusupov1alik/MPI-Jordan-crazy-acceleration`` can do: block Gauss–Jordan
+matrix inversion with condition-based block pivoting, 1D row-block-cyclic
+sharding, ring GEMM, residual verification, matrix generators/file I/O, and
+a CLI — designed for the MXU/ICI, not translated from MPI.
+"""
+
+from . import config, ops, parallel
+
+__version__ = "0.1.0"

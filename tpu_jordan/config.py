@@ -1,0 +1,56 @@
+"""Framework-wide numeric configuration.
+
+The reference hard-codes ``EPS = 1e-15`` (main.cpp:7) as the *relative*
+singularity threshold for fp64: a pivot is singular when
+``|pivot| < EPS * norm(A)`` (main.cpp:782).  TPUs are fp32/bf16-native, so the
+threshold must scale with the working precision; fp64 keeps the reference
+value exactly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Relative singularity thresholds per dtype.  fp64 matches the reference
+# (main.cpp:7); the others keep the same ~4.5x-machine-eps margin.
+_EPS_BY_DTYPE = {
+    np.dtype(np.float64): 1e-15,
+    np.dtype(np.float32): 5e-7,
+    np.dtype(jnp.bfloat16): 4e-2,
+    np.dtype(np.float16): 4e-3,
+}
+
+# Matches MAX_P in the reference (main.cpp:6): pretty-printers show at most
+# this many rows/cols of a matrix corner.
+MAX_PRINT = 10
+
+
+def eps_for(dtype) -> float:
+    """Relative singularity threshold for ``dtype``.
+
+    Mirrors the role of ``EPS`` in the reference (main.cpp:7, used at
+    main.cpp:782), generalized across precisions.
+    """
+    dt = np.dtype(dtype)
+    try:
+        return _EPS_BY_DTYPE[dt]
+    except KeyError:
+        raise ValueError(f"no singularity threshold known for dtype {dt}")
+
+
+def default_block_size(n: int) -> int:
+    """A reasonable MXU-friendly block size for an n x n problem.
+
+    The reference exposes block size as the runtime knob ``m`` (argv) and its
+    fast path needs m % 3 == 0 (main.cpp:158).  On TPU the analogous
+    constraint is alignment to the 128-lane MXU tile, so we pick multiples
+    of 128 (or small powers of two below that for tiny problems).
+    """
+    if n >= 2048:
+        return 256
+    if n >= 512:
+        return 128
+    if n >= 128:
+        return 64
+    return max(8, 1 << max(0, (n // 4).bit_length() - 1))

@@ -1,0 +1,18 @@
+from .block_inverse import batched_block_inverse, gauss_jordan_inverse
+from .generators import GENERATORS, abs_diff, generate, hilbert, identity
+from .norms import block_inf_norms, inf_norm
+from .padding import pad_with_identity, unpad
+
+__all__ = [
+    "GENERATORS",
+    "abs_diff",
+    "batched_block_inverse",
+    "block_inf_norms",
+    "gauss_jordan_inverse",
+    "generate",
+    "hilbert",
+    "identity",
+    "inf_norm",
+    "pad_with_identity",
+    "unpad",
+]

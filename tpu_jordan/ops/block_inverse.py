@@ -1,0 +1,118 @@
+"""Small-block Gauss–Jordan inverse with scalar partial pivoting.
+
+TPU-native rebuild of ``inverse_block`` (main.cpp:746-820): invert an m x m
+block in-place by Gauss–Jordan with column partial pivoting, declaring the
+block singular when a pivot falls below ``eps * norm`` (relative threshold,
+main.cpp:782) or the scale itself vanishes (``|norm| < eps``).
+
+Design notes (TPU-first, not a translation):
+  * the k-loop is a ``lax.fori_loop`` with static shapes; row swap and
+    elimination are masked whole-matrix ops (rank-1 update on the MXU/VPU),
+    never scalar loops;
+  * a singular block does not abort — the flag is carried and division is
+    guarded, so the op stays batchable: ``vmap`` inverts *all* pivot
+    candidates of a block column in one shot (the reference probes them one
+    by one, main.cpp:1039-1066 — batching is the MXU win);
+  * no data-dependent control flow: singular results are garbage values plus
+    a True flag, exactly like the reference's ``return 1``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import eps_for
+from .norms import inf_norm
+
+
+def gauss_jordan_inverse(
+    a: jnp.ndarray,
+    scale_norm: jnp.ndarray | float | None = None,
+    eps: float | None = None,
+):
+    """Invert one m x m block.
+
+    Args:
+      a: (m, m) matrix.
+      scale_norm: relative scale for the singularity threshold.  The
+        reference passes the ∞-norm of the *whole local strip of A*
+        (``norm_a``, main.cpp:972/1046), not of the block — pass that for
+        parity; defaults to ‖a‖∞.
+      eps: relative threshold; defaults to the dtype's (config.eps_for).
+
+    Returns:
+      (inv, singular): the inverse (garbage if singular) and a bool flag.
+    """
+    m = a.shape[-1]
+    dtype = a.dtype
+    if eps is None:
+        eps = eps_for(dtype)
+    if scale_norm is None:
+        scale_norm = inf_norm(a)
+    scale_norm = jnp.asarray(scale_norm, dtype)
+    thresh = jnp.asarray(eps, dtype) * scale_norm
+
+    idx = jnp.arange(m)
+    w = jnp.concatenate([a, jnp.eye(m, dtype=dtype)], axis=1)  # (m, 2m)
+
+    def body(k, carry):
+        w, singular = carry
+        col = lax.dynamic_slice_in_dim(w, k, 1, axis=1)[:, 0]       # (m,)
+        # column partial pivot: argmax |w[i,k]| over i >= k (main.cpp:756-763)
+        cand = jnp.where(idx >= k, jnp.abs(col), jnp.asarray(-1.0, dtype))
+        r = jnp.argmax(cand)
+        # swap rows k and r (masked select; main.cpp:765-781)
+        row_k = jnp.take(w, k, axis=0)
+        row_r = jnp.take(w, r, axis=0)
+        is_k = (idx == k)[:, None]
+        is_r = (idx == r)[:, None]
+        w = jnp.where(is_k, row_r[None, :], jnp.where(is_r, row_k[None, :], w))
+        # singularity gate (main.cpp:782): relative threshold, plus
+        # degenerate-scale case |norm| < eps
+        piv = jnp.take(row_r, k)
+        singular = (
+            singular
+            | (jnp.abs(piv) < thresh)
+            | (jnp.abs(scale_norm) < jnp.asarray(eps, dtype))
+        )
+        safe_piv = jnp.where(piv == 0, jnp.asarray(1, dtype), piv)
+        prow = jnp.take(w, k, axis=0) / safe_piv                    # (2m,)
+        # eliminate above and below (main.cpp:794-817) as one rank-1 update
+        colk = lax.dynamic_slice_in_dim(w, k, 1, axis=1)[:, 0]
+        factors = jnp.where(idx == k, jnp.asarray(0, dtype), colk)  # (m,)
+        w = w - factors[:, None] * prow[None, :]
+        w = jnp.where(is_k, prow[None, :], w)
+        return w, singular
+
+    w, singular = lax.fori_loop(0, m, body, (w, jnp.asarray(False)))
+    return w[:, m:], singular
+
+
+@partial(jax.jit, static_argnames=("eps",))
+def batched_block_inverse(
+    blocks: jnp.ndarray,
+    scale_norm: jnp.ndarray | float | None = None,
+    eps: float | None = None,
+):
+    """Invert a (..., m, m) stack of blocks in one vmapped sweep.
+
+    This is the pivot-candidate probe (main.cpp:1039-1066) turned into a
+    single batched op.  Returns (inverses, singular_flags).
+    """
+    batch_shape = blocks.shape[:-2]
+    m = blocks.shape[-1]
+    flat = blocks.reshape((-1, m, m))
+    if scale_norm is None:
+        inv, sing = jax.vmap(lambda b: gauss_jordan_inverse(b, None, eps))(flat)
+    else:
+        scale = jnp.broadcast_to(
+            jnp.asarray(scale_norm, blocks.dtype), flat.shape[:1]
+        )
+        inv, sing = jax.vmap(
+            lambda b, s: gauss_jordan_inverse(b, s, eps)
+        )(flat, scale)
+    return inv.reshape(batch_shape + (m, m)), sing.reshape(batch_shape)

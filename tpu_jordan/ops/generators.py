@@ -1,0 +1,68 @@
+"""Matrix generators (the reference's ``f`` / ``f_i``, main.cpp:47-64).
+
+The reference fills the distributed matrix from a formula ``f(i, j)`` via
+``init_matrix`` (main.cpp:128-149).  Here generators are jit-friendly
+functions of index grids; ``generate`` materializes any rectangular window,
+so per-shard generation under shard_map needs no communication.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax.numpy as jnp
+from jax import lax
+
+# f(i, j) signature: takes integer index arrays, returns float array.
+GeneratorFn = Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray]
+
+
+def abs_diff(i, j):
+    """Default generator ``f(i,j) = |i - j|`` (main.cpp:47-57).
+
+    Zero diagonal — inverting it *requires* pivoting, which is why the
+    reference uses it as the default fixture.
+    """
+    return jnp.abs(i - j)
+
+
+def hilbert(i, j):
+    """Hilbert matrix ``1 / (i + j + 1)`` (-DHILBERT, main.cpp:49-51).
+
+    Classic ill-conditioned stress test for the singularity threshold.
+    """
+    return 1.0 / (i + j + 1)
+
+
+def identity(i, j):
+    """Identity generator ``f_i`` (main.cpp:59-64)."""
+    return (i == j).astype(jnp.float32)
+
+
+GENERATORS: dict[str, GeneratorFn] = {
+    "absdiff": abs_diff,
+    "hilbert": hilbert,
+    "identity": identity,
+}
+
+
+def generate(
+    fn: GeneratorFn | str,
+    shape: tuple[int, int],
+    dtype=jnp.float32,
+    *,
+    row_offset=0,
+    col_offset=0,
+) -> jnp.ndarray:
+    """Materialize ``fn`` over a window of the global index grid.
+
+    ``row_offset``/``col_offset`` may be traced values, so a shard can build
+    its own piece of the global matrix inside shard_map — the TPU-native
+    replacement for init_matrix's local_to_global walk (main.cpp:128-149).
+    """
+    if isinstance(fn, str):
+        fn = GENERATORS[fn]
+    h, w = shape
+    ii = row_offset + lax.broadcasted_iota(jnp.int32, (h, w), 0)
+    jj = col_offset + lax.broadcasted_iota(jnp.int32, (h, w), 1)
+    return fn(ii, jj).astype(dtype)

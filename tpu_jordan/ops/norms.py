@@ -1,0 +1,20 @@
+"""Infinity norms (norm main.cpp:643-667, block_norm main.cpp:669-683).
+
+The reference uses the max-abs-row-sum norm everywhere: as the relative
+singularity scale, as the pivot-quality metric (norm of the inverse block),
+and for the final residual.  One definition, three call sites — same here.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def inf_norm(a: jnp.ndarray) -> jnp.ndarray:
+    """‖A‖∞ = max_i Σ_j |a_ij| for a 2D matrix (norm, main.cpp:643-667)."""
+    return jnp.max(jnp.sum(jnp.abs(a), axis=-1), axis=-1)
+
+
+def block_inf_norms(blocks: jnp.ndarray) -> jnp.ndarray:
+    """‖·‖∞ of each block in a (..., m, m) stack (block_norm, main.cpp:669-683)."""
+    return jnp.max(jnp.sum(jnp.abs(blocks), axis=-1), axis=-1)

@@ -1,0 +1,35 @@
+"""Identity padding for ragged shapes.
+
+The reference carries a ragged last block (height ``l = n - m*(Nr-1)``,
+main.cpp:133-137) through every kernel via (bl_h, bl_w) arguments
+(get/set, main.cpp:685-728).  On TPU, ragged shapes poison static compilation
+and MXU tiling, so instead we embed A into the top-left of a padded matrix
+
+    A_pad = [[A, 0], [0, I]]
+
+whose inverse is exactly [[A^-1, 0], [0, I]].  The identity tail is also
+inert under the pivoted block elimination: padded diagonal blocks are only
+ever selectable as pivots in padded columns (real rows are zero there), and
+padded rows are zero in every real column, so they are never picked as real
+pivots and the condition-based pivot choice is unchanged.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def pad_with_identity(a: jnp.ndarray, N: int) -> jnp.ndarray:
+    """Embed (n, n) ``a`` into an (N, N) identity-padded matrix."""
+    n = a.shape[-1]
+    if N == n:
+        return a
+    if N < n:
+        raise ValueError(f"cannot pad {n} down to {N}")
+    out = jnp.eye(N, dtype=a.dtype)
+    return out.at[:n, :n].set(a)
+
+
+def unpad(a: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Slice the (n, n) top-left corner back out."""
+    return a[..., :n, :n]

@@ -1,0 +1,27 @@
+from .layout import (
+    CyclicLayout,
+    cyclic_gather_perm,
+    cyclic_scatter_perm,
+    find_sender,
+    global_block_owner,
+    global_to_local_block,
+    last_block_height,
+    local_to_global,
+    num_block_rows,
+    padded_num_blocks,
+    rows_per_worker,
+)
+
+__all__ = [
+    "CyclicLayout",
+    "cyclic_gather_perm",
+    "cyclic_scatter_perm",
+    "find_sender",
+    "global_block_owner",
+    "global_to_local_block",
+    "last_block_height",
+    "local_to_global",
+    "num_block_rows",
+    "padded_num_blocks",
+    "rows_per_worker",
+]
