@@ -3,7 +3,11 @@
 The TPU-native substitute for "mpirun -np 8 without a cluster" (SURVEY.md §4):
 force the host platform to expose 8 fake devices so every sharded code path
 runs in CI, and enable x64 so fp64 parity tests against the reference's
-golden values are meaningful.  Must run before jax is imported anywhere.
+golden values are meaningful.
+
+NOTE: this environment preloads jax at interpreter start (sitecustomize)
+with JAX_PLATFORMS=axon, so env-var mutation alone is too late — the
+platform must be forced through jax.config before any backend initializes.
 """
 
 import os
@@ -17,10 +21,14 @@ if "xla_force_host_platform_device_count" not in _flags:
 
 import jax  # noqa: E402
 
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 # f32 matmuls default to fast-low precision; accuracy assertions in the tests
 # (residual checks) need true f32 accumulation.
 jax.config.update("jax_default_matmul_precision", "highest")
+
+assert jax.default_backend() == "cpu", jax.default_backend()
+assert len(jax.devices()) == 8, jax.devices()
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

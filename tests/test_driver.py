@@ -1,0 +1,110 @@
+"""Tests for the solve driver, file I/O, and CLI (driver.py, io.py,
+__main__.py) — the reference's end-to-end contract (main.cpp:65-93,
+343-519): exit codes, file-error paths, singular-matrix path, residual.
+"""
+
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_jordan import SingularMatrixError, solve
+from tpu_jordan.io import MatrixReadError, read_matrix_file, write_matrix_file
+
+
+class TestIO:
+    def test_roundtrip(self, rng, tmp_path):
+        a = rng.standard_normal((12, 12))
+        path = str(tmp_path / "m.txt")
+        write_matrix_file(path, a)
+        b = read_matrix_file(path, 12)
+        np.testing.assert_allclose(b, a, rtol=1e-15)
+
+    def test_missing_file(self, tmp_path):
+        # Reference -1 "cannot open" (main.cpp:231-237, 390-392).
+        with pytest.raises(FileNotFoundError):
+            read_matrix_file(str(tmp_path / "nope.txt"), 4)
+
+    def test_short_file(self, tmp_path):
+        # Reference -2 "cannot read" (main.cpp:255, 277, 393-394).
+        path = tmp_path / "short.txt"
+        path.write_text("1.0 2.0 3.0")
+        with pytest.raises(MatrixReadError):
+            read_matrix_file(str(path), 4)
+
+    def test_garbage_file(self, tmp_path):
+        path = tmp_path / "bad.txt"
+        path.write_text("hello world this is not a matrix")
+        with pytest.raises(MatrixReadError):
+            read_matrix_file(str(path), 2)
+
+
+class TestSolve:
+    def test_generator_solve(self):
+        res = solve(64, 16, dtype=jnp.float64)
+        assert res.residual < 1e-9
+        assert res.elapsed > 0
+        assert res.gflops > 0
+
+    def test_file_solve(self, rng, tmp_path):
+        a = rng.standard_normal((16, 16))
+        path = str(tmp_path / "a.txt")
+        write_matrix_file(path, a)
+        res = solve(16, 4, file=path, dtype=jnp.float64)
+        np.testing.assert_allclose(
+            np.asarray(res.inverse), np.linalg.inv(a), rtol=1e-8, atol=1e-8
+        )
+        assert res.residual < 1e-10
+
+    def test_singular_raises(self, tmp_path):
+        path = str(tmp_path / "sing.txt")
+        write_matrix_file(path, np.ones((8, 8)))
+        with pytest.raises(SingularMatrixError):
+            solve(8, 4, file=path, dtype=jnp.float64)
+
+    def test_refine_improves_f32(self):
+        raw = solve(128, 32, dtype=jnp.float32)
+        ref = solve(128, 32, dtype=jnp.float32, refine=2)
+        assert ref.residual < raw.residual / 10
+
+
+def run_cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tpu_jordan", *args],
+        capture_output=True, text=True, timeout=300,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "HOME": "/root", "PYTHONPATH": "/root/repo"},
+    )
+
+
+class TestCLI:
+    def test_usage_exit_1(self):
+        # Bad args -> usage + exit 1 (main.cpp:77-85).
+        r = run_cli("0", "0")
+        assert r.returncode == 1
+        assert "usage" in r.stderr + r.stdout
+
+    def test_missing_args_exit_1(self):
+        r = run_cli("64")
+        assert r.returncode == 1
+
+    def test_success_exit_0(self):
+        r = run_cli("64", "16", "--quiet")
+        assert r.returncode == 0, r.stderr
+        assert "glob_time:" in r.stdout
+        assert "residual:" in r.stdout
+
+    def test_file_not_found_exit_2(self):
+        # solve failure -> exit 2 (main.cpp:86-90).
+        r = run_cli("8", "4", "/does/not/exist.txt")
+        assert r.returncode == 2
+        assert "cannot open" in r.stdout
+
+    def test_singular_exit_2(self, tmp_path):
+        path = tmp_path / "sing.txt"
+        write_matrix_file(str(path), np.zeros((4, 4)))
+        r = run_cli("4", "4", str(path), "--dtype", "float64")
+        assert r.returncode == 2
+        assert "singular matrix" in r.stdout

@@ -1,0 +1,84 @@
+"""CLI entry: ``python -m tpu_jordan n m [file]``.
+
+Mirrors the reference's argv contract and exit codes (main.cpp:65-93):
+positional ``n m [file]``, usage message and exit 1 on bad args, exit 2 on
+solve failure (file errors, singular matrix), 0 on success.  Extra
+TPU-relevant knobs are optional flags so the positional contract is intact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import jax.numpy as jnp
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tpu_jordan",
+        usage="python -m tpu_jordan n m [file]",
+        description="Distributed block Gauss-Jordan matrix inversion on TPU.",
+    )
+    ap.add_argument("n", type=int, help="matrix dimension")
+    ap.add_argument("m", type=int, help="pivot block size")
+    ap.add_argument("file", nargs="?", default=None, help="matrix file")
+    ap.add_argument("--dtype", default="float32",
+                    choices=["float32", "float64", "bfloat16"])
+    ap.add_argument("--generator", default="absdiff",
+                    choices=["absdiff", "hilbert"],
+                    help="matrix generator when no file is given "
+                         "(hilbert = the reference's -DHILBERT build)")
+    ap.add_argument("--refine", type=int, default=0,
+                    help="Newton-Schulz refinement steps")
+    ap.add_argument("--quiet", action="store_true")
+    try:
+        args = ap.parse_args(argv)
+        if args.n <= 0 or args.m <= 0:
+            raise ValueError("n and m must be positive")
+    except SystemExit as e:
+        if e.code == 0:      # --help / --version are not usage errors
+            return 0
+        print("usage: python -m tpu_jordan n m [<file>]", file=sys.stderr)
+        return 1
+    except ValueError:
+        # usage error -> exit 1 like the reference (main.cpp:77-85)
+        print("usage: python -m tpu_jordan n m [<file>]", file=sys.stderr)
+        return 1
+
+    if args.dtype == "float64":
+        # fp64 parity path (CPU): JAX demotes to fp32 unless x64 is on.
+        import jax
+
+        jax.config.update("jax_enable_x64", True)
+
+    from .driver import SingularMatrixError, solve
+    from .io import MatrixReadError
+
+    try:
+        result = solve(
+            n=args.n,
+            block_size=args.m,
+            file=args.file,
+            generator=args.generator,
+            dtype=jnp.dtype(args.dtype),
+            refine=args.refine,
+            verbose=not args.quiet,
+        )
+    except FileNotFoundError:
+        print(f"cannot open {args.file}")
+        return 2
+    except MatrixReadError:
+        print(f"cannot read {args.file}")
+        return 2
+    except SingularMatrixError:
+        print("singular matrix")
+        return 2
+    if args.quiet:
+        print(f"glob_time: {result.elapsed:.2f}")
+        print(f"residual: {result.residual:e}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

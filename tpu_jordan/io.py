@@ -1,0 +1,55 @@
+"""Matrix file I/O.
+
+TPU-native replacement for ``read_matrix`` (main.cpp:209-282): the reference's
+file format is n*n whitespace-separated decimal numbers read row-major with
+``fscanf("%lf")``.  The reference scatters block rows over ranks with
+MPI_Send as it reads (main.cpp:244-274); here the host parses the file and
+``jax.device_put`` with a NamedSharding places the shards — the scatter is
+the sharding, not hand-written sends.
+
+Error contract mirrors the reference's collective error codes
+(main.cpp:231-237, 277): -1 "cannot open" → FileNotFoundError, -2 "cannot
+read" → MatrixReadError.
+
+A fast C++ parser for large files lives in ``native/`` (used when built,
+transparent fallback to numpy otherwise).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class MatrixReadError(ValueError):
+    """File exists but does not contain n*n parseable numbers (the
+    reference's -2 "cannot read" path, main.cpp:255, 277)."""
+
+
+def read_matrix_file(path: str, n: int, dtype=np.float64) -> np.ndarray:
+    """Read an (n, n) matrix of whitespace-separated numbers from ``path``.
+
+    Raises FileNotFoundError (reference -1) or MatrixReadError (-2).
+    """
+    try:
+        from .native import parse_matrix_text
+
+        vals = parse_matrix_text(path, n * n)
+    except ImportError:
+        try:
+            with open(path) as fh:
+                tokens = fh.read().split()
+        except OSError as e:
+            raise FileNotFoundError(f"cannot open {path}") from e
+        try:
+            vals = np.array(tokens[: n * n], dtype=np.float64)
+        except ValueError as e:
+            raise MatrixReadError(f"cannot read {path}") from e
+    if vals is None or vals.size < n * n:
+        raise MatrixReadError(f"cannot read {path}")
+    return vals[: n * n].reshape(n, n).astype(dtype)
+
+
+def write_matrix_file(path: str, a: np.ndarray) -> None:
+    """Write a matrix in the reference's format (whitespace-separated,
+    row-major) so our files round-trip through the reference binary."""
+    np.savetxt(path, np.asarray(a), fmt="%.17g")

@@ -1,18 +1,22 @@
 from .block_inverse import batched_block_inverse, gauss_jordan_inverse
 from .generators import GENERATORS, abs_diff, generate, hilbert, identity
+from .jordan import block_jordan_invert
 from .norms import block_inf_norms, inf_norm
 from .padding import pad_with_identity, unpad
+from .residual import residual_inf_norm
 
 __all__ = [
     "GENERATORS",
     "abs_diff",
     "batched_block_inverse",
     "block_inf_norms",
+    "block_jordan_invert",
     "gauss_jordan_inverse",
     "generate",
     "hilbert",
     "identity",
     "inf_norm",
     "pad_with_identity",
+    "residual_inf_norm",
     "unpad",
 ]

@@ -88,7 +88,11 @@ def gauss_jordan_inverse(
         w = jnp.where(is_k, prow[None, :], w)
         return w, singular
 
-    w, singular = lax.fori_loop(0, m, body, (w, jnp.asarray(False)))
+    # The initial flag is derived from the data (non-finite input ⇒
+    # singular) rather than a constant False: correct semantics, and under
+    # shard_map the carry then matches the data's device-varying type.
+    singular0 = ~jnp.all(jnp.isfinite(a))
+    w, singular = lax.fori_loop(0, m, body, (w, singular0))
     return w[:, m:], singular
 
 

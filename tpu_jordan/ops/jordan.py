@@ -1,0 +1,192 @@
+"""Blocked Gauss–Jordan matrix inversion with condition-based block pivoting.
+
+TPU-native rebuild of the reference's core algorithm ``Jordan``
+(main.cpp:953-1204): invert an n x n matrix by block Gauss–Jordan elimination
+over ``Nr`` block columns, choosing as pivot at each step the block of the
+current column whose inverse has the smallest ∞-norm (condition-based
+pivoting, main.cpp:1026-1074), with two-level pivoting — scalar partial
+pivoting *inside* blocks (inverse_block, main.cpp:746-820) and the
+condition-based choice *between* blocks.
+
+Design (TPU-first, per SURVEY.md §7 — not a translation):
+
+  * The whole inversion is ONE jitted ``lax.fori_loop`` over block columns;
+    every step is static-shaped.  Slice offsets that depend on the runtime
+    pivot choice use ``dynamic_slice`` / ``dynamic_update_slice`` — zero host
+    round-trips per step.
+  * The pivot probe inverts *all* ``Nr`` candidate blocks of the column in a
+    single ``vmap`` (the reference probes them serially one by one,
+    main.cpp:1039-1066) — the MXU turns the reference's weakness into a win.
+  * State is the augmented matrix ``W = [A | B]`` with ``B`` starting as I
+    and ending as A⁻¹, exactly the reference's a/b pair (main.cpp:366-370,
+    415).  The elimination sweep is one (N, m) x (m, 2N) matmul per step —
+    large, batched, MXU-shaped — instead of the reference's per-block
+    ``mult_substr_block`` loop (main.cpp:1165-1193).
+  * The row "swap" follows the reference's swap-by-copy trick
+    (main.cpp:1093-1131): the pivot row is lifted into a register copy
+    before slot ``t`` is overwritten, so no third buffer exists.
+  * Ragged tails are handled by identity padding (ops/padding.py), not by
+    carrying (bl_h, bl_w) through every kernel like the reference's
+    get/set (main.cpp:685-728).
+  * Singularity is a carried bool flag (latched when *no* candidate block of
+    some column is invertible, main.cpp:1075-1083), returned to the host —
+    never a mid-graph abort.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import default_block_size, eps_for
+from .block_inverse import batched_block_inverse
+from .norms import block_inf_norms, inf_norm
+from .padding import pad_with_identity, unpad
+
+
+def _jordan_step(t, carry, *, Nr: int, m: int, eps: float, precision,
+                 global_scale: bool, use_pallas: bool):
+    """One super-step of the block elimination (main.cpp:1026-1196)."""
+    W, norm_a, singular = carry
+    N = Nr * m
+    dtype = W.dtype
+
+    # --- PIVOT SEARCH: batch-invert every candidate block of column t
+    # (replaces the serial probe loop, main.cpp:1039-1066).
+    #
+    # Singularity scale: the reference thresholds every inner pivot against
+    # eps * ‖A_strip‖ (main.cpp:782, 972) — fine at fp64, but at fp32 the
+    # global scale (eps * n²/2 for |i−j|) swallows the genuinely O(1)-sized
+    # late Schur-complement pivots and falsely declares large matrices
+    # singular.  Default is therefore the numerically standard *per-block*
+    # relative threshold; `global_scale=True` restores exact reference
+    # semantics (use with fp64).  For block_size == n the two coincide.
+    col_t = lax.dynamic_slice(W, (0, t * m), (N, m))            # (N, m)
+    cands = col_t.reshape(Nr, m, m)
+    if use_pallas:
+        from .pallas_block_inverse import pallas_batched_block_inverse
+
+        invs, sing = pallas_batched_block_inverse(cands, eps)
+    else:
+        scale = norm_a if global_scale else None
+        invs, sing = batched_block_inverse(cands, scale, eps)
+    inv_norms = block_inf_norms(invs)
+
+    # Condition-based selection: argmin ‖block⁻¹‖ over non-singular
+    # candidates in rows >= t — the composite-key argmin that replaces the
+    # custom MPI reduction (pivot_op, main.cpp:729-744, 1074).
+    valid = (jnp.arange(Nr) >= t) & ~sing
+    key = jnp.where(valid, inv_norms, jnp.asarray(jnp.inf, dtype))
+    piv = jnp.argmin(key)
+    singular = singular | ~jnp.any(valid)                       # main.cpp:1075-1083
+    H = jnp.take(invs, piv, axis=0)                             # pivot block inverse
+
+    # --- ROW EXCHANGE: swap block rows t <-> piv.  Like the reference's
+    # swap-by-copy (main.cpp:1093-1131): the pivot row is safe in rows_p
+    # before slot t is overwritten; slot t is rewritten from the normalized
+    # copy below, so only one store per slot happens.
+    rows_t = lax.dynamic_slice(W, (t * m, 0), (m, 2 * N))
+    rows_p = lax.dynamic_slice(W, (piv * m, 0), (m, 2 * N))
+    W = lax.dynamic_update_slice(W, rows_t, (piv * m, 0))
+
+    # --- NORMALIZE the pivot row: prow = H @ row (main.cpp:1133-1159).
+    prow = jnp.matmul(H, rows_p, precision=precision)           # (m, 2N)
+
+    # --- ELIMINATE: W[i, :] -= W[i, t-block] @ prow for every block row
+    # i != t, as ONE (N, m) x (m, 2N) MXU matmul (main.cpp:1165-1193).
+    E = lax.dynamic_slice(W, (0, t * m), (N, m))                # multipliers
+    row_blocks = jnp.arange(N) // m
+    E = jnp.where((row_blocks == t)[:, None], jnp.asarray(0, dtype), E)
+    W = W - jnp.matmul(E, prow, precision=precision)
+    W = lax.dynamic_update_slice(W, prow, (t * m, 0))
+    return W, norm_a, singular
+
+
+def _use_pallas_default(dtype) -> bool:
+    """Pallas probe: TPU backends with fp32 working dtype only (the kernel
+    is fp32; fp64 runs on CPU where the pure-XLA path is fine)."""
+    return (
+        jax.default_backend() not in ("cpu",)
+        and jnp.dtype(dtype) == jnp.float32
+    )
+
+
+@partial(jax.jit, static_argnames=(
+    "block_size", "eps", "precision", "refine", "global_scale", "use_pallas"))
+def block_jordan_invert(
+    a: jnp.ndarray,
+    block_size: int | None = None,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    refine: int = 0,
+    global_scale: bool = False,
+    use_pallas: bool | None = None,
+):
+    """Invert ``a`` by blocked Gauss–Jordan with condition-based pivoting.
+
+    The single-device equivalent of ``Jordan`` (main.cpp:953-1204); the
+    sharded version lives in ``parallel/sharded_jordan.py``.
+
+    Args:
+      a: (n, n) matrix.
+      block_size: pivot block size ``m`` — the reference's runtime tuning
+        knob (argv[2], main.cpp:77).  Defaults to an MXU-friendly size.
+      eps: relative singularity threshold (EPS, main.cpp:7); defaults to the
+        dtype's (config.eps_for).
+      precision: matmul precision for the update sweeps.
+      refine: number of Newton–Schulz refinement steps ``X ← X(2I − AX)``
+        applied to the result.  Each step roughly squares the residual at
+        the cost of two GEMMs.  The reference has no analog (its accuracy
+        comes from fp64 + a lucky op ordering); on TPU this is the standard
+        way to recover fp64-grade residuals from fp32/bf16 arithmetic.
+      global_scale: threshold inner pivots against eps * ‖A‖ of the whole
+        matrix (exact reference semantics, main.cpp:782/972) instead of the
+        per-block norm.  Identical when block_size >= n.
+      use_pallas: run the pivot probe in the VMEM-resident pallas kernel
+        (ops/pallas_block_inverse.py) — 4-6x faster than the XLA probe on
+        TPU.  None = auto (TPU + fp32 + per-block scaling).
+
+    Returns:
+      (inv, singular): the inverse (garbage if singular) and a bool flag —
+      the analog of Jordan's -2 return (main.cpp:1075-1083).
+    """
+    n = a.shape[-1]
+    dtype = a.dtype
+    if block_size is None:
+        block_size = default_block_size(n)
+    m = min(block_size, n)
+    if eps is None:
+        eps = eps_for(dtype)
+
+    # Relative scale for every singularity test: ‖A‖∞ of the *unpadded*
+    # input, computed once — the reference's norm_a (main.cpp:972, 1046).
+    norm_a = inf_norm(a)
+
+    Nr = -(-n // m)
+    N = Nr * m
+    A = pad_with_identity(a, N)
+    W = jnp.concatenate([A, jnp.eye(N, dtype=dtype)], axis=1)   # [A | I]
+
+    if use_pallas is None:
+        use_pallas = (
+            _use_pallas_default(dtype) and not global_scale
+            and m % 8 == 0 and m >= 32
+        )
+    elif use_pallas and global_scale:
+        raise ValueError(
+            "the pallas probe implements per-block singularity scaling only; "
+            "global_scale=True (exact reference semantics) needs the XLA path"
+        )
+    step = partial(_jordan_step, Nr=Nr, m=m, eps=eps, precision=precision,
+                   global_scale=global_scale, use_pallas=use_pallas)
+    W, _, singular = lax.fori_loop(
+        0, Nr, step, (W, norm_a, jnp.asarray(False))
+    )
+    x = unpad(W[:, N:], n)
+    for _ in range(refine):
+        r = jnp.eye(n, dtype=dtype) - jnp.matmul(a, x, precision=precision)
+        x = x + jnp.matmul(x, r, precision=precision)
+    return x, singular

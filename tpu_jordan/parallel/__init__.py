@@ -1,3 +1,6 @@
+from .mesh import AXIS, block_sharding, distributed_init, make_mesh, replicated
+from .ring_gemm import distributed_residual, ring_matmul
+from .sharded_jordan import sharded_jordan_invert
 from .layout import (
     CyclicLayout,
     cyclic_gather_perm,
@@ -13,7 +16,15 @@ from .layout import (
 )
 
 __all__ = [
+    "AXIS",
     "CyclicLayout",
+    "block_sharding",
+    "distributed_init",
+    "distributed_residual",
+    "make_mesh",
+    "replicated",
+    "ring_matmul",
+    "sharded_jordan_invert",
     "cyclic_gather_perm",
     "cyclic_scatter_perm",
     "find_sender",

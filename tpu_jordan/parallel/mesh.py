@@ -1,0 +1,58 @@
+"""Mesh construction and distributed initialization.
+
+TPU-native replacement for the reference's MPI setup (MPI_Init /
+Comm_size / Comm_rank, main.cpp:69-91): a 1D `jax.sharding.Mesh` over all
+devices is the communicator; the worker axis is named "p" to match the
+reference's `p` rank count.  Multi-host TPU-VM slices go through
+`jax.distributed.initialize` (the analog of mpirun wiring up ranks), after
+which `jax.devices()` spans the whole slice and the same mesh code works
+unchanged — ICI carries the per-step collectives, DCN only the host-level
+setup.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AXIS = "p"
+
+
+def distributed_init(**kwargs) -> None:
+    """Initialize multi-host JAX (no-op on a single host).
+
+    The analog of MPI_Init (main.cpp:69) for TPU-VM slices: call once per
+    host process before any device use; coordinator/process wiring comes
+    from the TPU environment.
+    """
+    try:
+        jax.distributed.initialize(**kwargs)
+    except (RuntimeError, ValueError):
+        # Already initialized or single-process environment.
+        pass
+
+
+def make_mesh(num_workers: int | None = None, devices=None) -> Mesh:
+    """A 1D mesh over ``num_workers`` devices, axis "p".
+
+    Replaces MPI_Comm_size/Comm_rank (main.cpp:81-82): the axis size is the
+    worker count; the per-worker index is `lax.axis_index("p")` inside
+    shard_map.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if num_workers is None:
+        num_workers = len(devices)
+    return Mesh(np.asarray(devices[:num_workers]), (AXIS,))
+
+
+def block_sharding(mesh: Mesh) -> NamedSharding:
+    """Sharding for a (Nr, m, cols) block tensor in cyclic storage order:
+    axis 0 split over workers = each worker holds its cyclic blocks
+    contiguously (see parallel/layout.py::CyclicLayout.cyclic_block_order)."""
+    return NamedSharding(mesh, PartitionSpec(AXIS, None, None))
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())

@@ -1,0 +1,201 @@
+"""Distributed block Gauss–Jordan inversion: shard_map over a 1D mesh.
+
+TPU-native rebuild of the reference's distributed `Jordan`
+(main.cpp:953-1204) with its exact communication structure per super-step
+(SURVEY.md §3.2) — but expressed as XLA collectives over a mesh instead of
+MPI:
+
+  reference (per step t)                      this file
+  -------------------------------------       ----------------------------
+  local pivot probe (serial loop,             batched pallas/XLA inverse of
+    main.cpp:1039-1066)                         the worker's candidate blocks
+  MPI_Allreduce custom PivotMin op            two-stage `lax.pmin` on a
+    (main.cpp:729-744, 1000-1024, 1074)         composite (norm, worker) key
+  MPI_Bcast pivot row (main.cpp:1097)         one-hot `lax.psum` of the row
+  MPI_Send/Recv row swap (main.cpp:1100-31)   one-hot `lax.psum` + masked
+                                                dynamic_update_slice
+  local normalize + eliminate                 (bpw*m, m) @ (m, 2N) local
+    (main.cpp:1133-1193)                        MXU matmul
+
+Data layout: the augmented matrix [A | B] lives as a (Nr, m, 2N) block
+tensor in *cyclic storage order* (parallel/layout.py) so that the 1D
+row-block-cyclic distribution of the reference (main.cpp:118-123) is a
+plain contiguous NamedSharding over axis 0.  Worker k's local slot s holds
+global block row s*p + k.
+
+Singularity is the same collective agreement as the reference
+(main.cpp:1075-1083): the flag comes out of the pmin itself, so every
+worker takes the same exit path with zero extra communication.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..config import eps_for
+from ..ops.block_inverse import batched_block_inverse
+from ..ops.norms import block_inf_norms
+from .layout import CyclicLayout, cyclic_gather_perm, cyclic_scatter_perm
+from .mesh import AXIS
+
+
+def _local_step(t, Wloc, singular, *, lay: CyclicLayout, eps, precision,
+                use_pallas: bool):
+    """One super-step on one worker's (bpw, m, 2N) shard."""
+    p, m, bpw = lay.p, lay.m, lay.blocks_per_worker
+    N = lay.N
+    k = lax.axis_index(AXIS)
+    dtype = Wloc.dtype
+    gidx = jnp.arange(bpw) * p + k          # global block row of each slot
+
+    # --- PIVOT PROBE: batch-invert every local candidate block of column t.
+    cands = lax.dynamic_slice(Wloc, (0, 0, t * m), (bpw, m, m))
+    if use_pallas:
+        from ..ops.pallas_block_inverse import pallas_batched_block_inverse
+
+        invs, sing = pallas_batched_block_inverse(cands, eps)
+    else:
+        invs, sing = batched_block_inverse(cands, None, eps)
+    inv_norms = block_inf_norms(invs)
+    valid = (gidx >= t) & ~sing
+    big = jnp.asarray(jnp.inf, dtype)
+    key = jnp.where(valid, inv_norms.astype(dtype), big)
+    slot_best = jnp.argmin(key)
+    my_key = key[slot_best]
+
+    # --- PIVOT REDUCTION: argmin over workers on a composite key — replaces
+    # the custom MPI op (pivot_op main.cpp:729-744, MPI_Op_create
+    # main.cpp:1000-1024, Allreduce main.cpp:1074).  Stage 1: best norm;
+    # stage 2: lowest worker id holding it (deterministic tie-break).
+    kmin = lax.pmin(my_key, AXIS)
+    win_k = lax.pmin(jnp.where(my_key == kmin, k, p), AXIS)
+    singular = singular | ~jnp.isfinite(kmin)   # all-singular (main.cpp:1075-83)
+    i_won = k == win_k
+
+    # Pivot's global block row and its inverse, shared one-hot (the scalar
+    # payload of the reference's custom reduction).
+    g_piv = lax.psum(jnp.where(i_won, gidx[slot_best], 0), AXIS)
+    H = lax.psum(
+        jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0).astype(dtype),
+        AXIS,
+    )
+
+    # --- ROW BROADCASTS: pivot row (Bcast, main.cpp:1097) and current row t
+    # (the Send/Recv half of the swap, main.cpp:1122-1129), both as one-hot
+    # psums riding ICI.
+    safe_best = jnp.where(i_won, slot_best, 0)
+    row_piv = lax.psum(
+        jnp.where(i_won, lax.dynamic_index_in_dim(Wloc, safe_best, 0, False), 0.0),
+        AXIS,
+    )                                          # (m, 2N)
+    own_t = k == (t % p)
+    slot_t = t // p
+    row_t = lax.psum(
+        jnp.where(own_t, lax.dynamic_index_in_dim(Wloc, slot_t, 0, False), 0.0),
+        AXIS,
+    )                                          # (m, 2N)
+
+    # --- SWAP-BY-COPY (main.cpp:1093-1131): pivot owner's slot receives the
+    # old row t; slot t is rewritten below from the normalized pivot row.
+    own_piv = k == (g_piv % p)
+    slot_piv = jnp.where(own_piv, g_piv // p, 0)
+    W_swap = lax.dynamic_update_index_in_dim(Wloc, row_t, slot_piv, 0)
+    Wloc = jnp.where(own_piv, W_swap, Wloc)
+
+    # --- NORMALIZE (all workers, replicated like the reference's work on
+    # the bcast buffer c, main.cpp:1133-1159).
+    prow = jnp.matmul(H, row_piv, precision=precision)    # (m, 2N)
+
+    # --- ELIMINATE (hot loop, main.cpp:1165-1193): one local MXU matmul.
+    E = lax.dynamic_slice(Wloc, (0, 0, t * m), (bpw, m, m))
+    E = jnp.where((gidx == t)[:, None, None], jnp.asarray(0, dtype), E)
+    flatE = E.reshape(bpw * m, m)
+    update = jnp.matmul(flatE, prow, precision=precision)
+    Wloc = Wloc - update.reshape(bpw, m, 2 * N)
+
+    # Row t becomes the normalized pivot row (owner only).
+    W_set = lax.dynamic_update_index_in_dim(Wloc, prow, slot_t, 0)
+    Wloc = jnp.where(own_t, W_set, Wloc)
+    return Wloc, singular
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas"))
+def _sharded_jordan(W, mesh, lay: CyclicLayout, eps, precision, use_pallas):
+    def worker(Wloc):
+        def body(t, carry):
+            Wl, sing = carry
+            return _local_step(t, Wl, sing, lay=lay, eps=eps,
+                               precision=precision, use_pallas=use_pallas)
+
+        # The singular flag mixes in pmin results, which shard_map's
+        # varying-axis typing marks as device-varying — the carry must start
+        # out varying too, and the flag is returned per-worker (any() on the
+        # host gives the collective verdict, identical on every worker).
+        sing0 = lax.pcast(jnp.zeros((1,), jnp.bool_), AXIS, to='varying')
+        Wl, sing = lax.fori_loop(0, lay.Nr, body, (Wloc, sing0))
+        return Wl, sing
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=PartitionSpec(AXIS, None, None),
+        out_specs=(PartitionSpec(AXIS, None, None), PartitionSpec(AXIS)),
+    )(W)
+
+
+def sharded_jordan_invert(
+    a: jnp.ndarray,
+    mesh: Mesh,
+    block_size: int,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    use_pallas: bool | None = None,
+):
+    """Invert (n, n) ``a`` distributed over ``mesh`` axis "p".
+
+    The distributed front end of the framework (reference `solve`+`Jordan`,
+    main.cpp:343-519/953-1204): pads, builds the cyclic block layout,
+    scatters via device_put, runs the sharded elimination, and gathers the
+    inverse back to natural order.
+
+    Returns (inv, singular) like ops.block_jordan_invert.
+    """
+    from ..ops.jordan import _use_pallas_default
+    from ..ops.padding import pad_with_identity, unpad
+
+    n = a.shape[-1]
+    dtype = a.dtype
+    p = mesh.devices.size
+    block_size = min(block_size, n)
+    if eps is None:
+        eps = eps_for(dtype)
+    if use_pallas is None:
+        use_pallas = (
+            _use_pallas_default(dtype)
+            and block_size % 8 == 0 and block_size >= 32
+        )
+
+    lay = CyclicLayout.create(n, block_size, p)
+    N = lay.N
+    A = pad_with_identity(a, N)
+    W = jnp.concatenate([A, jnp.eye(N, dtype=dtype)], axis=1)
+    blocks = W.reshape(lay.Nr, lay.m, 2 * N)
+    # Natural order -> cyclic storage order, then shard axis 0.
+    blocks = jnp.take(blocks, cyclic_gather_perm(lay), axis=0)
+    blocks = jax.device_put(
+        blocks, NamedSharding(mesh, PartitionSpec(AXIS, None, None))
+    )
+
+    out, singular = _sharded_jordan(blocks, mesh, lay, eps, precision,
+                                    use_pallas)
+
+    out = jnp.take(out, cyclic_scatter_perm(lay), axis=0)
+    B = out.reshape(N, 2 * N)[:, N:]
+    return unpad(B, n), singular.any()

@@ -1,0 +1,3 @@
+from .printing import format_corner, print_corner
+
+__all__ = ["format_corner", "print_corner"]

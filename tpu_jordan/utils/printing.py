@@ -1,0 +1,27 @@
+"""Pretty-printing of matrix corners.
+
+Replaces ``print_matrix`` / ``print_row`` (main.cpp:284-341): the reference
+gathers the top-left min(n, MAX_P)-corner to rank 0 and prints it with
+``"%.2f\\t"`` per element.  On TPU the "gather to rank 0" is just reading a
+device array on the host — addressable shards make the corner fetch cheap.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..config import MAX_PRINT
+
+
+def format_corner(a, max_p: int = MAX_PRINT) -> str:
+    """Format the top-left corner like the reference (main.cpp:284-295)."""
+    a = np.asarray(a)
+    nm = min(a.shape[0], max_p)
+    rows = []
+    for i in range(nm):
+        rows.append("".join(f"{float(a[i, j]):.2f}\t" for j in range(nm)))
+    return "\n".join(rows)
+
+
+def print_corner(a, max_p: int = MAX_PRINT) -> None:
+    print(format_corner(a, max_p))
