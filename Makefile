@@ -1,0 +1,37 @@
+# tpu_jordan build/run entry points.
+#
+# Replaces the reference's Makefile (Makefile:1-6: mpicxx -Ofast + clean)
+# with the TPU-native equivalents: a `tpu` run target (the analog of
+# `mpirun -np P ./a.out n m [file]`), the native helper library, tests,
+# and the benchmark.
+
+CXX      ?= g++
+CXXFLAGS ?= -O3 -fPIC -Wall
+N        ?= 4096
+M        ?= 256
+WORKERS  ?= 1
+
+.PHONY: all native tpu test bench clean
+
+all: native
+
+# Native C ABI helpers (fast matrix-file parser; loaded via ctypes).
+native: tpu_jordan/_native.so
+
+tpu_jordan/_native.so: native/matrix_io.cpp
+	$(CXX) $(CXXFLAGS) -shared -o $@ $<
+
+# Run the solver on the TPU (the reference's `mpirun -np P ./a.out n m`).
+# The native build is best-effort: io.py has a transparent Python fallback.
+tpu:
+	-$(MAKE) native
+	python -m tpu_jordan $(N) $(M) --workers $(WORKERS)
+
+test:
+	python -m pytest tests/ -q
+
+bench: native
+	python bench.py
+
+clean:
+	rm -f tpu_jordan/_native.so

@@ -1,0 +1,38 @@
+"""Batched inversion (ops/batched.py) — the vmap capability beyond the
+reference (BASELINE.md north star: batched Jordan solves)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_jordan.ops import batched_jordan_invert
+
+
+class TestBatchedInvert:
+    def test_stack_matches_linalg(self, rng):
+        a = rng.standard_normal((6, 24, 24))
+        inv, sing = batched_jordan_invert(jnp.asarray(a), block_size=8)
+        assert inv.shape == (6, 24, 24)
+        assert not np.asarray(sing).any()
+        np.testing.assert_allclose(
+            np.asarray(inv), np.linalg.inv(a), rtol=1e-8, atol=1e-8
+        )
+
+    def test_nested_batch_dims(self, rng):
+        a = rng.standard_normal((2, 3, 16, 16))
+        inv, sing = batched_jordan_invert(jnp.asarray(a), block_size=8)
+        assert inv.shape == (2, 3, 16, 16)
+        assert sing.shape == (2, 3)
+        np.testing.assert_allclose(
+            np.asarray(inv), np.linalg.inv(a), rtol=1e-8, atol=1e-8
+        )
+
+    def test_per_element_singularity(self, rng):
+        good = rng.standard_normal((8, 8))
+        bad = np.ones((8, 8))
+        a = jnp.asarray(np.stack([good, bad, good]))
+        inv, sing = batched_jordan_invert(a, block_size=4)
+        assert list(np.asarray(sing)) == [False, True, False]
+        np.testing.assert_allclose(
+            np.asarray(inv[0]), np.linalg.inv(good), rtol=1e-8, atol=1e-8
+        )

@@ -69,6 +69,23 @@ class TestSolve:
         ref = solve(128, 32, dtype=jnp.float32, refine=2)
         assert ref.residual < raw.residual / 10
 
+    def test_distributed_solve(self):
+        # workers=8 -> sharded path + ring-GEMM residual, the analog of
+        # mpirun -np 8 (SURVEY.md §4).
+        res = solve(64, 8, dtype=jnp.float64, workers=8)
+        assert res.residual < 1e-9
+
+    def test_distributed_matches_single(self, rng, tmp_path):
+        a = rng.standard_normal((32, 32))
+        path = str(tmp_path / "a.txt")
+        write_matrix_file(path, a)
+        one = solve(32, 8, file=path, dtype=jnp.float64)
+        eight = solve(32, 8, file=path, dtype=jnp.float64, workers=8)
+        np.testing.assert_allclose(
+            np.asarray(eight.inverse), np.asarray(one.inverse),
+            rtol=1e-9, atol=1e-9,
+        )
+
 
 def run_cli(*args):
     return subprocess.run(

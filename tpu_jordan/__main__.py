@@ -24,13 +24,15 @@ def main(argv=None) -> int:
     ap.add_argument("m", type=int, help="pivot block size")
     ap.add_argument("file", nargs="?", default=None, help="matrix file")
     ap.add_argument("--dtype", default="float32",
-                    choices=["float32", "float64", "bfloat16"])
+                    choices=["float32", "float64"])
     ap.add_argument("--generator", default="absdiff",
                     choices=["absdiff", "hilbert"],
                     help="matrix generator when no file is given "
                          "(hilbert = the reference's -DHILBERT build)")
     ap.add_argument("--refine", type=int, default=0,
                     help="Newton-Schulz refinement steps")
+    ap.add_argument("--workers", type=int, default=1,
+                    help="devices in the 1D mesh (the reference's mpirun -np)")
     ap.add_argument("--quiet", action="store_true")
     try:
         args = ap.parse_args(argv)
@@ -63,6 +65,7 @@ def main(argv=None) -> int:
             generator=args.generator,
             dtype=jnp.dtype(args.dtype),
             refine=args.refine,
+            workers=args.workers,
             verbose=not args.quiet,
         )
     except FileNotFoundError:

@@ -51,10 +51,15 @@ def solve(
     generator: str = "absdiff",
     dtype=jnp.float32,
     refine: int = 0,
+    workers: int = 1,
     device=None,
     verbose: bool = False,
 ) -> SolveResult:
     """Invert an n x n matrix from a file or a generator and verify it.
+
+    ``workers > 1`` runs the distributed path: 1D mesh over that many
+    devices, sharded elimination, ring-GEMM residual — the analog of
+    ``mpirun -np workers`` on the reference.
 
     Raises SingularMatrixError like the reference's -2 path
     (main.cpp:435-437); file errors propagate from read_matrix_file.
@@ -75,15 +80,20 @@ def solve(
         print("A")
         print_corner(a)
 
-    # AOT-compile so the timed call measures the executable alone without
-    # running the O(n^3) inversion twice.
-    compiled = block_jordan_invert.lower(
-        a, block_size=block_size, refine=refine
-    ).compile()
-    t0 = time.perf_counter()
-    inv, singular = compiled(a)
-    jax.block_until_ready(inv)
-    elapsed = time.perf_counter() - t0
+    if workers > 1:
+        inv, singular, elapsed = _solve_distributed(
+            a, n, block_size, workers, refine
+        )
+    else:
+        # AOT-compile so the timed call measures the executable alone
+        # without running the O(n^3) inversion twice.
+        compiled = block_jordan_invert.lower(
+            a, block_size=block_size, refine=refine
+        ).compile()
+        t0 = time.perf_counter()
+        inv, singular = compiled(a)
+        jax.block_until_ready(inv)
+        elapsed = time.perf_counter() - t0
 
     if bool(singular):
         raise SingularMatrixError("singular matrix")
@@ -94,9 +104,17 @@ def solve(
         print_corner(inv)
 
     # Re-load A (the reference re-reads/regenerates, main.cpp:463-488) and
-    # verify independently.
+    # verify independently — with the distributed ring GEMM when sharded,
+    # like the reference (main.cpp:490-513).
     a_fresh = load()
-    residual = float(residual_inf_norm(a_fresh, inv))
+    if workers > 1:
+        from .parallel import distributed_residual, make_mesh
+
+        residual = float(distributed_residual(
+            a_fresh, inv, make_mesh(workers), min(block_size, n)
+        ))
+    else:
+        residual = float(residual_inf_norm(a_fresh, inv))
     if verbose:
         print(f"residual: {residual:e}")
 
@@ -108,3 +126,29 @@ def solve(
         block_size=block_size,
         gflops=2.0 * n**3 / elapsed / 1e9,
     )
+
+
+def _solve_distributed(a, n: int, block_size: int, workers: int,
+                       refine: int):
+    """Run the shared sharded front end with a timer around the execution
+    (elimination + gather + refinement, compile excluded)."""
+    from jax import lax
+
+    from .parallel import make_mesh
+    from .parallel.sharded_jordan import (
+        gather_inverse,
+        prepare_sharded_invert,
+    )
+
+    mesh = make_mesh(workers)
+    blocks, lay, run = prepare_sharded_invert(a, mesh, block_size)
+    t0 = time.perf_counter()
+    out, singular = run(blocks)
+    inv = gather_inverse(out, lay, n)
+    eye = jnp.eye(n, dtype=a.dtype)
+    for _ in range(refine):
+        r = eye - jnp.matmul(a, inv, precision=lax.Precision.HIGHEST)
+        inv = inv + jnp.matmul(inv, r, precision=lax.Precision.HIGHEST)
+    jax.block_until_ready(inv)
+    elapsed = time.perf_counter() - t0
+    return inv, singular.any(), elapsed

@@ -1,0 +1,71 @@
+"""ctypes bindings to the native (C++) helpers in ``native/``.
+
+Importing this module raises ImportError when the shared library has not
+been built (``make native``); callers (io.py) fall back to pure Python.
+No pybind11 in this image — plain C ABI + ctypes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_CANDIDATES = [
+    os.path.join(_HERE, "_native.so"),
+    os.path.join(os.path.dirname(_HERE), "native", "_native.so"),
+]
+
+_lib = None
+for _path in _CANDIDATES:
+    if os.path.exists(_path):
+        _lib = ctypes.CDLL(_path)
+        break
+if _lib is None:
+    raise ImportError(
+        "native library not built (run `make native`); using Python fallback"
+    )
+
+_lib.tj_parse_matrix_text.restype = ctypes.c_long
+_lib.tj_parse_matrix_text.argtypes = [
+    ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_double),
+    ctypes.c_long,
+]
+_lib.tj_write_matrix_text.restype = ctypes.c_long
+_lib.tj_write_matrix_text.argtypes = [
+    ctypes.c_char_p,
+    ctypes.POINTER(ctypes.c_double),
+    ctypes.c_long,
+    ctypes.c_long,
+]
+
+
+def parse_matrix_text(path: str, count: int) -> np.ndarray:
+    """Parse up to ``count`` doubles from ``path``.
+
+    Raises FileNotFoundError if the file cannot be opened; returns however
+    many numbers were parseable (io.py turns a short read into the
+    reference's "cannot read" error).
+    """
+    out = np.empty(count, dtype=np.float64)
+    got = _lib.tj_parse_matrix_text(
+        path.encode(), out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        count,
+    )
+    if got < 0:
+        raise FileNotFoundError(f"cannot open {path}")
+    return out[:got]
+
+
+def write_matrix_text(path: str, a: np.ndarray) -> None:
+    a = np.ascontiguousarray(a, dtype=np.float64)
+    rows, cols = a.shape
+    got = _lib.tj_write_matrix_text(
+        path.encode(), a.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        rows, cols,
+    )
+    if got < 0:
+        raise OSError(f"cannot write {path}")

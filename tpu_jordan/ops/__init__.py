@@ -1,3 +1,4 @@
+from .batched import batched_jordan_invert
 from .block_inverse import batched_block_inverse, gauss_jordan_inverse
 from .generators import GENERATORS, abs_diff, generate, hilbert, identity
 from .jordan import block_jordan_invert
@@ -9,6 +10,7 @@ __all__ = [
     "GENERATORS",
     "abs_diff",
     "batched_block_inverse",
+    "batched_jordan_invert",
     "block_inf_norms",
     "block_jordan_invert",
     "gauss_jordan_inverse",

@@ -1,0 +1,51 @@
+"""Batched inversion: invert a stack of matrices in one jitted vmap.
+
+North-star capability beyond the reference (BASELINE.md: "Batched
+512x(2048x2048) Jordan solves (vmap)"): the reference can only invert one
+matrix per program run; here the whole blocked Gauss-Jordan algorithm
+(ops/jordan.py) vmaps over a leading batch axis, so the MXU sees
+batch-stacked matmuls and the pivot probes of every problem in the batch
+run together.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .jordan import block_jordan_invert
+
+
+@partial(jax.jit, static_argnames=(
+    "block_size", "eps", "precision", "refine", "use_pallas"))
+def batched_jordan_invert(
+    a: jnp.ndarray,
+    block_size: int | None = None,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    refine: int = 0,
+    use_pallas: bool | None = None,
+):
+    """Invert a (..., n, n) stack; returns (inverses, singular_flags).
+
+    Each batch element gets independent condition-based pivoting and an
+    independent singularity flag (shaped like the batch).
+    """
+    batch_shape = a.shape[:-2]
+    n = a.shape[-1]
+    flat = a.reshape((-1,) + a.shape[-2:])
+
+    def one(x):
+        return block_jordan_invert(
+            x, block_size=block_size, eps=eps, precision=precision,
+            refine=refine, use_pallas=use_pallas,
+        )
+
+    inv, sing = jax.vmap(one)(flat)
+    return (
+        inv.reshape(batch_shape + (n, n)),
+        sing.reshape(batch_shape),
+    )

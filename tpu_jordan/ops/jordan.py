@@ -31,6 +31,16 @@ Design (TPU-first, per SURVEY.md §7 — not a translation):
   * Singularity is a carried bool flag (latched when *no* candidate block of
     some column is invertible, main.cpp:1075-1083), returned to the host —
     never a mid-graph abort.
+
+Precision policy (measured on v5e): Gauss–Jordan inversion needs faithful
+fp32 products — with bf16-input matmuls (Precision.DEFAULT) the elimination
+error compounds to rel. residual ~35 at n=1024 even on well-conditioned
+random matrices, and bf16x3 (HIGH) still lands at ~3; HIGHEST (bf16x6,
+fp32-faithful) gives ~1e-5.  Runtime is dominated by the pivot probe, not
+the sweeps, so lower precision buys no speed either.  Supported working
+dtypes are therefore fp32 (TPU, optionally + Newton refinement) and fp64
+(CPU); sub-fp32 inputs still run but the probe is internally upcast to
+fp32 and results carry bf16-level accuracy at best.
 """
 
 from __future__ import annotations
@@ -66,12 +76,16 @@ def _jordan_step(t, carry, *, Nr: int, m: int, eps: float, precision,
     # semantics (use with fp64).  For block_size == n the two coincide.
     col_t = lax.dynamic_slice(W, (0, t * m), (N, m))            # (N, m)
     cands = col_t.reshape(Nr, m, m)
+    # The probe always runs in fp32+: inverting blocks in bf16 destroys the
+    # condition estimate (mixed precision = bf16 bulk updates, fp32 probe).
+    probe_dtype = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
+    cands = cands.astype(probe_dtype)
     if use_pallas:
         from .pallas_block_inverse import pallas_batched_block_inverse
 
         invs, sing = pallas_batched_block_inverse(cands, eps)
     else:
-        scale = norm_a if global_scale else None
+        scale = (norm_a.astype(probe_dtype) if global_scale else None)
         invs, sing = batched_block_inverse(cands, scale, eps)
     inv_norms = block_inf_norms(invs)
 
@@ -79,10 +93,10 @@ def _jordan_step(t, carry, *, Nr: int, m: int, eps: float, precision,
     # candidates in rows >= t — the composite-key argmin that replaces the
     # custom MPI reduction (pivot_op, main.cpp:729-744, 1074).
     valid = (jnp.arange(Nr) >= t) & ~sing
-    key = jnp.where(valid, inv_norms, jnp.asarray(jnp.inf, dtype))
+    key = jnp.where(valid, inv_norms, jnp.asarray(jnp.inf, probe_dtype))
     piv = jnp.argmin(key)
     singular = singular | ~jnp.any(valid)                       # main.cpp:1075-1083
-    H = jnp.take(invs, piv, axis=0)                             # pivot block inverse
+    H = jnp.take(invs, piv, axis=0).astype(dtype)               # pivot block inverse
 
     # --- ROW EXCHANGE: swap block rows t <-> piv.  Like the reference's
     # swap-by-copy (main.cpp:1093-1131): the pivot row is safe in rows_p
@@ -106,11 +120,13 @@ def _jordan_step(t, carry, *, Nr: int, m: int, eps: float, precision,
 
 
 def _use_pallas_default(dtype) -> bool:
-    """Pallas probe: TPU backends with fp32 working dtype only (the kernel
-    is fp32; fp64 runs on CPU where the pure-XLA path is fine)."""
+    """Pallas probe: TPU backends with fp32-or-below working dtype (the
+    kernel is fp32 and sub-fp32 probes are upcast; fp64 runs on CPU where
+    the pure-XLA path is fine)."""
     return (
         jax.default_backend() not in ("cpu",)
-        and jnp.dtype(dtype) == jnp.float32
+        and jnp.dtype(dtype).itemsize <= 4
+        and jnp.issubdtype(dtype, jnp.floating)
     )
 
 
@@ -159,7 +175,10 @@ def block_jordan_invert(
         block_size = default_block_size(n)
     m = min(block_size, n)
     if eps is None:
-        eps = eps_for(dtype)
+        # The probe runs in fp32 for sub-fp32 working dtypes, so the
+        # threshold scales with the probe's precision, not the storage's.
+        probe_dt = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
+        eps = eps_for(probe_dt)
 
     # Relative scale for every singularity test: ‖A‖∞ of the *unpadded*
     # input, computed once — the reference's norm_a (main.cpp:972, 1046).

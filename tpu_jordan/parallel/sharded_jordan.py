@@ -55,7 +55,11 @@ def _local_step(t, Wloc, singular, *, lay: CyclicLayout, eps, precision,
     gidx = jnp.arange(bpw) * p + k          # global block row of each slot
 
     # --- PIVOT PROBE: batch-invert every local candidate block of column t.
+    # Runs in fp32 for sub-fp32 working dtypes (same policy as
+    # ops/jordan.py): a bf16 probe destroys the condition estimate.
+    probe_dtype = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
     cands = lax.dynamic_slice(Wloc, (0, 0, t * m), (bpw, m, m))
+    cands = cands.astype(probe_dtype)
     if use_pallas:
         from ..ops.pallas_block_inverse import pallas_batched_block_inverse
 
@@ -64,8 +68,8 @@ def _local_step(t, Wloc, singular, *, lay: CyclicLayout, eps, precision,
         invs, sing = batched_block_inverse(cands, None, eps)
     inv_norms = block_inf_norms(invs)
     valid = (gidx >= t) & ~sing
-    big = jnp.asarray(jnp.inf, dtype)
-    key = jnp.where(valid, inv_norms.astype(dtype), big)
+    big = jnp.asarray(jnp.inf, probe_dtype)
+    key = jnp.where(valid, inv_norms.astype(probe_dtype), big)
     slot_best = jnp.argmin(key)
     my_key = key[slot_best]
 
@@ -150,6 +154,75 @@ def _sharded_jordan(W, mesh, lay: CyclicLayout, eps, precision, use_pallas):
     )(W)
 
 
+def resolve_use_pallas(dtype, block_size: int) -> bool:
+    from ..ops.jordan import _use_pallas_default
+
+    return (
+        _use_pallas_default(dtype)
+        and block_size % 8 == 0 and block_size >= 32
+    )
+
+
+def scatter_augmented(a: jnp.ndarray, lay: CyclicLayout, mesh: Mesh):
+    """Build [A | I], pad, reorder to cyclic storage, shard over the mesh.
+
+    The TPU-native scatter (replaces read_matrix's per-row MPI_Send loop,
+    main.cpp:244-274: the scatter IS the sharding)."""
+    from ..ops.padding import pad_with_identity
+
+    N = lay.N
+    A = pad_with_identity(a, N)
+    W = jnp.concatenate([A, jnp.eye(N, dtype=a.dtype)], axis=1)
+    blocks = W.reshape(lay.Nr, lay.m, 2 * N)
+    blocks = jnp.take(blocks, cyclic_gather_perm(lay), axis=0)
+    return jax.device_put(
+        blocks, NamedSharding(mesh, PartitionSpec(AXIS, None, None))
+    )
+
+
+def gather_inverse(out: jnp.ndarray, lay: CyclicLayout, n: int):
+    """Cyclic storage order -> natural order; slice out B = A^-1."""
+    from ..ops.padding import unpad
+
+    N = lay.N
+    out = jnp.take(out, cyclic_scatter_perm(lay), axis=0)
+    B = out.reshape(N, 2 * N)[:, N:]
+    return unpad(B, n)
+
+
+def prepare_sharded_invert(
+    a: jnp.ndarray,
+    mesh: Mesh,
+    block_size: int,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    use_pallas: bool | None = None,
+):
+    """Resolve defaults, build the layout, scatter: the one front end shared
+    by sharded_jordan_invert and the timing driver.
+
+    Returns (blocks, lay, run) where ``run(blocks)`` is the AOT-compiled
+    sharded elimination returning (out_blocks, singular_per_worker).
+    """
+    n = a.shape[-1]
+    dtype = a.dtype
+    block_size = min(block_size, n)
+    if eps is None:
+        # Match the single-device policy (ops/jordan.py): the probe runs in
+        # fp32 for sub-fp32 working dtypes.
+        probe_dt = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
+        eps = eps_for(probe_dt)
+    if use_pallas is None:
+        use_pallas = resolve_use_pallas(dtype, block_size)
+
+    lay = CyclicLayout.create(n, block_size, mesh.devices.size)
+    blocks = scatter_augmented(a, lay, mesh)
+    run = _sharded_jordan.lower(
+        blocks, mesh, lay, eps, precision, use_pallas
+    ).compile()
+    return blocks, lay, run
+
+
 def sharded_jordan_invert(
     a: jnp.ndarray,
     mesh: Mesh,
@@ -167,35 +240,8 @@ def sharded_jordan_invert(
 
     Returns (inv, singular) like ops.block_jordan_invert.
     """
-    from ..ops.jordan import _use_pallas_default
-    from ..ops.padding import pad_with_identity, unpad
-
-    n = a.shape[-1]
-    dtype = a.dtype
-    p = mesh.devices.size
-    block_size = min(block_size, n)
-    if eps is None:
-        eps = eps_for(dtype)
-    if use_pallas is None:
-        use_pallas = (
-            _use_pallas_default(dtype)
-            and block_size % 8 == 0 and block_size >= 32
-        )
-
-    lay = CyclicLayout.create(n, block_size, p)
-    N = lay.N
-    A = pad_with_identity(a, N)
-    W = jnp.concatenate([A, jnp.eye(N, dtype=dtype)], axis=1)
-    blocks = W.reshape(lay.Nr, lay.m, 2 * N)
-    # Natural order -> cyclic storage order, then shard axis 0.
-    blocks = jnp.take(blocks, cyclic_gather_perm(lay), axis=0)
-    blocks = jax.device_put(
-        blocks, NamedSharding(mesh, PartitionSpec(AXIS, None, None))
+    blocks, lay, run = prepare_sharded_invert(
+        a, mesh, block_size, eps, precision, use_pallas
     )
-
-    out, singular = _sharded_jordan(blocks, mesh, lay, eps, precision,
-                                    use_pallas)
-
-    out = jnp.take(out, cyclic_scatter_perm(lay), axis=0)
-    B = out.reshape(N, 2 * N)[:, N:]
-    return unpad(B, n), singular.any()
+    out, singular = run(blocks)
+    return gather_inverse(out, lay, a.shape[-1]), singular.any()
