@@ -1,0 +1,3 @@
+from .jordan_solver import JordanSolver
+
+__all__ = ["JordanSolver"]

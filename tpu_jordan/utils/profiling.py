@@ -1,0 +1,65 @@
+"""Timing scoreboard and profiler hooks.
+
+The reference's only observability is the max-allreduced MPI_Wtime bracket
+around Jordan printed as glob_time (main.cpp:427-458) plus a flops
+convention of 2n^3.  Here: the same scoreboard (wall seconds + GFLOP/s)
+as a context manager, plus `jax.profiler` trace capture for real kernel-
+level inspection on TPU.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+import jax
+
+
+@dataclass
+class Scoreboard:
+    """Wall-clock + GFLOP/s record (the glob_time analog)."""
+
+    label: str
+    elapsed: float = 0.0
+    flops: float | None = None
+
+    @property
+    def gflops(self) -> float | None:
+        if self.flops is None or self.elapsed <= 0:
+            return None
+        return self.flops / self.elapsed / 1e9
+
+    def report(self) -> str:
+        s = f"glob_time: {self.elapsed:.2f}"
+        if self.gflops is not None:
+            s += f"  ({self.gflops:.1f} GFLOP/s)"
+        return s
+
+
+@contextlib.contextmanager
+def timed(label: str, flops: float | None = None, sync=None):
+    """Time a block; ``sync`` (an array or pytree) is block_until_ready'd
+    before the clock stops, the single-controller analog of the MAX
+    allreduce over per-rank times (main.cpp:455)."""
+    sb = Scoreboard(label, flops=flops)
+    t0 = time.perf_counter()
+    yield sb
+    if sync is not None:
+        jax.block_until_ready(sync)
+    sb.elapsed = time.perf_counter() - t0
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/tpu_jordan_trace"):
+    """Capture a jax.profiler trace (view with TensorBoard/XProf)."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()
+
+
+def invert_flops(n: int) -> float:
+    """The 2n^3 Gauss–Jordan inversion convention used by BASELINE.md."""
+    return 2.0 * float(n) ** 3
