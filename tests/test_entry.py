@@ -1,0 +1,53 @@
+"""The driver contract: entry() compiles; dryrun_multichip really validates
+an n-device mesh (the round-1 failure mode was a silent 1-device mesh)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def test_make_mesh_raises_on_too_few_devices():
+    from tpu_jordan.parallel import make_mesh
+
+    with pytest.raises(ValueError, match="workers"):
+        make_mesh(1024)
+
+
+def test_entry_compiles():
+    import jax
+
+    import __graft_entry__ as g
+
+    fn, args = g.entry()
+    out = jax.jit(fn).lower(*args).compile()(*args)
+    assert out.shape == args[0].shape
+
+
+def test_dryrun_inline_on_8_fake_devices():
+    # conftest forces 8 virtual CPU devices, so the inline path runs and
+    # its internal mesh-size assertion proves 8-way collectives executed.
+    import __graft_entry__ as g
+
+    g._dryrun_impl(8)
+
+
+def test_dryrun_subprocess_path():
+    # The driver calls dryrun_multichip from an arbitrary backend state;
+    # the subprocess fallback must work even when the parent env pins a
+    # different platform.  Exercise the real public entry in a child with
+    # no device-count forcing at all.
+    env = {k: v for k, v in os.environ.items()
+           if "xla_force_host_platform_device_count" not in v.lower()
+           or k != "XLA_FLAGS"}
+    proc = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; g.dryrun_multichip(4)"],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=900,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "1D mesh p=4 ok" in proc.stdout

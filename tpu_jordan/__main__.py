@@ -56,6 +56,7 @@ def main(argv=None) -> int:
 
     from .driver import SingularMatrixError, solve
     from .io import MatrixReadError
+    from .parallel.mesh import MeshSizeError
 
     try:
         result = solve(
@@ -76,6 +77,11 @@ def main(argv=None) -> int:
         return 2
     except SingularMatrixError:
         print("singular matrix")
+        return 2
+    except MeshSizeError as e:
+        # --workers exceeding the device count: the analog of mpirun -np
+        # failing to launch — a runtime error, not a crash.
+        print(e, file=sys.stderr)
         return 2
     if args.quiet:
         print(f"glob_time: {result.elapsed:.2f}")

@@ -19,6 +19,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec
 AXIS = "p"
 
 
+class MeshSizeError(ValueError):
+    """Requested more workers than devices exist — the analog of
+    ``mpirun -np 8`` on 1 slot failing to launch."""
+
+
 def distributed_init(**kwargs) -> None:
     """Initialize multi-host JAX (no-op on a single host).
 
@@ -44,6 +49,13 @@ def make_mesh(num_workers: int | None = None, devices=None) -> Mesh:
         devices = jax.devices()
     if num_workers is None:
         num_workers = len(devices)
+    if num_workers > len(devices):
+        # Never a silent degrade to fewer workers.
+        raise MeshSizeError(
+            f"requested {num_workers} workers but only {len(devices)} "
+            f"device(s) exist (backend={jax.default_backend()!r}); run under "
+            f"a larger slice or pass workers<={len(devices)}"
+        )
     return Mesh(np.asarray(devices[:num_workers]), (AXIS,))
 
 
