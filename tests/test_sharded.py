@@ -76,6 +76,20 @@ class TestShardedJordan:
             np.asarray(inv_d), np.asarray(inv_s), rtol=1e-9, atol=1e-9
         )
 
+    def test_tied_pivots_match_single_device(self, mesh4):
+        # |i-j| has exactly-repeated candidate blocks, so pivot keys tie;
+        # the sharded reduction must resolve ties to the lowest *global*
+        # block row like the single-device argmin, not the lowest worker.
+        from tpu_jordan.ops import block_jordan_invert
+
+        a = generate("absdiff", (96, 96), jnp.float64)
+        inv_d, s_d = sharded_jordan_invert(a, mesh4, 8)
+        inv_s, s_s = block_jordan_invert(a, block_size=8)
+        assert bool(s_d) == bool(s_s) is False
+        np.testing.assert_allclose(
+            np.asarray(inv_d), np.asarray(inv_s), rtol=1e-9, atol=1e-12
+        )
+
     def test_singular_collective_agreement(self, mesh8):
         a = jnp.ones((64, 64), jnp.float64)
         _, sing = sharded_jordan_invert(a, mesh8, 8)

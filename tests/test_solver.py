@@ -1,0 +1,70 @@
+"""JordanSolver model tests: compiled-pipeline reuse, distributed path,
+residual before/after invert, refinement plumbing."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_jordan.models import JordanSolver
+
+
+class TestJordanSolver:
+    def test_single_device(self, rng):
+        s = JordanSolver(n=48, block_size=8, dtype=jnp.float64)
+        a = rng.standard_normal((48, 48))
+        inv, sing = s.invert(a)
+        assert not bool(sing)
+        assert s.residual(a, inv) < 1e-9
+
+    def test_repeated_solves_reuse_executable(self, rng):
+        s = JordanSolver(n=32, block_size=8, dtype=jnp.float64)
+        for _ in range(3):
+            a = rng.standard_normal((32, 32))
+            inv, sing = s.invert(a)
+            assert not bool(sing)
+            assert s.residual(a, inv) < 1e-9
+        assert s._run is not None
+
+    def test_workers4(self, rng):
+        s = JordanSolver(n=64, block_size=8, dtype=jnp.float64, workers=4)
+        a = rng.standard_normal((64, 64))
+        inv, sing = s.invert(a)
+        assert not bool(sing)
+        assert s.residual(a, inv) < 1e-9
+
+    def test_residual_before_invert(self, rng):
+        # Regression: residual() used to crash (mesh only built in
+        # _compile) when called before the first invert on workers>1.
+        s = JordanSolver(n=32, block_size=8, dtype=jnp.float64, workers=4)
+        a = rng.standard_normal((32, 32))
+        inv = np.linalg.inv(a)
+        assert s.residual(a, inv) < 1e-9
+
+    def test_refine_distributed(self, rng):
+        s = JordanSolver(n=64, block_size=8, dtype=jnp.float32,
+                         workers=4, refine=2)
+        a = rng.standard_normal((64, 64)).astype(np.float32)
+        inv, sing = s.invert(a)
+        assert not bool(sing)
+        assert s.residual(a, inv) < 1e-4
+
+    def test_shape_mismatch_raises(self, rng):
+        s = JordanSolver(n=16)
+        with pytest.raises(ValueError, match="expected"):
+            s.invert(rng.standard_normal((8, 8)))
+
+
+def test_distributed_init_single_process_noop():
+    # The analog of MPI_Init must tolerate a single-process environment
+    # (and being called twice) instead of crashing the CLI.
+    from tpu_jordan.parallel.mesh import distributed_init
+
+    distributed_init()
+    distributed_init()
+
+
+def test_cli_distributed_flag():
+    from tpu_jordan.__main__ import main
+
+    assert main(["48", "8", "--distributed", "--quiet"]) == 0

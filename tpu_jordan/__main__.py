@@ -33,6 +33,10 @@ def main(argv=None) -> int:
                     help="Newton-Schulz refinement steps")
     ap.add_argument("--workers", type=int, default=1,
                     help="devices in the 1D mesh (the reference's mpirun -np)")
+    ap.add_argument("--distributed", action="store_true",
+                    help="call jax.distributed.initialize for multi-host "
+                         "TPU slices before any device use (the analog of "
+                         "MPI_Init, main.cpp:69; no-op on a single host)")
     ap.add_argument("--quiet", action="store_true")
     try:
         args = ap.parse_args(argv)
@@ -47,6 +51,14 @@ def main(argv=None) -> int:
         # usage error -> exit 1 like the reference (main.cpp:77-85)
         print("usage: python -m tpu_jordan n m [<file>]", file=sys.stderr)
         return 1
+
+    if args.distributed:
+        # Must run before the first backend use so every host process joins
+        # the same slice-wide device view (mirrors MPI_Init being argv's
+        # first consumer, main.cpp:69).
+        from .parallel.mesh import distributed_init
+
+        distributed_init()
 
     if args.dtype == "float64":
         # fp64 parity path (CPU): JAX demotes to fp32 unless x64 is on.

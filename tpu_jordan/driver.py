@@ -36,12 +36,14 @@ class SingularMatrixError(ArithmeticError):
 
 @dataclass
 class SolveResult:
-    inverse: jax.Array
+    inverse: jax.Array | None
     elapsed: float          # seconds, the reference's glob_time (main.cpp:455-458)
     residual: float         # ‖A·A⁻¹ − I‖∞ (main.cpp:490-513)
     n: int
     block_size: int
     gflops: float           # 2n³ / t, the convention used in BASELINE.md
+    inverse_blocks: jax.Array | None = None  # sharded cyclic blocks (gather=False)
+    layout: object | None = None             # CyclicLayout of inverse_blocks
 
 
 def solve(
@@ -54,12 +56,18 @@ def solve(
     workers: int = 1,
     device=None,
     verbose: bool = False,
+    gather: bool = True,
 ) -> SolveResult:
     """Invert an n x n matrix from a file or a generator and verify it.
 
     ``workers > 1`` runs the distributed path: 1D mesh over that many
     devices, sharded elimination, ring-GEMM residual — the analog of
-    ``mpirun -np workers`` on the reference.
+    ``mpirun -np workers`` on the reference.  When the matrix comes from a
+    generator, every worker builds its own shard on device (init_matrix
+    parity, main.cpp:128-149) and the residual is computed without ever
+    materializing an n×n array on the host; with ``gather=False`` the
+    inverse too stays as sharded cyclic blocks (``result.inverse_blocks``
+    + ``result.layout``), the memory-scaling mode for north-star sizes.
 
     Raises SingularMatrixError like the reference's -2 path
     (main.cpp:435-437); file errors propagate from read_matrix_file.
@@ -72,6 +80,20 @@ def solve(
             host = read_matrix_file(file, n, dtype)
             return jax.device_put(jnp.asarray(host, dtype), device)
         return jax.device_put(generate(generator, (n, n), dtype), device)
+
+    if workers > 1 and file is None:
+        # Fully device-resident: shard-local generation, sharded solve,
+        # distributed residual; zero host-side n×n arrays.
+        return _solve_distributed_generated(
+            n, block_size, workers, generator, dtype, refine, verbose,
+            gather,
+        )
+
+    if not gather:
+        raise ValueError(
+            "gather=False is only supported on the generator-driven "
+            "distributed path (workers > 1 and no file)"
+        )
 
     a = load()
     if verbose:
@@ -128,12 +150,91 @@ def solve(
     )
 
 
+def _solve_distributed_generated(
+    n: int, block_size: int, workers: int, generator: str, dtype,
+    refine: int, verbose: bool, gather: bool,
+):
+    """Generator-driven distributed solve with no host-side n×n arrays.
+
+    The reference analog end to end: init_matrix fills each rank's strip
+    locally (main.cpp:128-149), Jordan runs, A is *regenerated* and the
+    residual MAX-allreduced (main.cpp:463-513) — all of it device-resident
+    here.  Refinement (no reference analog) runs on the gathered inverse
+    and therefore requires ``gather=True``.
+    """
+    from .ops import newton_schulz
+    from .parallel import make_mesh, sharded_generate
+    from .parallel.layout import CyclicLayout
+    from .parallel.ring_gemm import distributed_residual_blocks
+    from .parallel.sharded_jordan import (
+        compile_sharded_jordan,
+        gather_inverse,
+    )
+
+    if refine and not gather:
+        raise ValueError("refine requires gather=True (it runs on the "
+                         "gathered inverse)")
+    mesh = make_mesh(workers)
+    lay = CyclicLayout.create(n, min(block_size, n), workers)
+    W = sharded_generate(generator, lay, mesh, dtype, augmented=True)
+    if verbose:
+        from .utils.printing import print_corner
+
+        print("A")
+        print_corner(generate(generator, (min(n, 10), min(n, 10)), dtype))
+    run = compile_sharded_jordan(W, mesh, lay)
+    t0 = time.perf_counter()
+    out, singular = run(W)
+    jax.block_until_ready(out)
+    elapsed = time.perf_counter() - t0
+    if bool(singular.any()):
+        raise SingularMatrixError("singular matrix")
+
+    inv_blocks = out[:, :, lay.N:]
+    inv = None
+    if gather:
+        inv = gather_inverse(out, lay, n)
+    if refine:
+        a_full = generate(generator, (n, n), dtype)
+        inv = newton_schulz(a_full, inv, refine)
+        from .ops import residual_inf_norm
+
+        residual = float(residual_inf_norm(a_full, inv))
+    else:
+        # Residual against a *freshly regenerated* A (main.cpp:463-488),
+        # fully distributed: only this scalar leaves the mesh.
+        a_blocks = sharded_generate(generator, lay, mesh, dtype,
+                                    augmented=False)
+        residual = float(distributed_residual_blocks(a_blocks, inv_blocks,
+                                                     mesh, lay))
+    if verbose:
+        print(f"glob_time: {elapsed:.2f}")
+        if inv is not None:
+            from .utils.printing import print_corner
+
+            print("inverse matrix:\n")
+            print_corner(inv)
+        print(f"residual: {residual:e}")
+    return SolveResult(
+        inverse=inv,
+        elapsed=elapsed,
+        residual=residual,
+        n=n,
+        block_size=min(block_size, n),
+        gflops=2.0 * n**3 / elapsed / 1e9,
+        inverse_blocks=None if gather else inv_blocks,
+        layout=None if gather else lay,
+    )
+
+
 def _solve_distributed(a, n: int, block_size: int, workers: int,
                        refine: int):
-    """Run the shared sharded front end with a timer around the execution
-    (elimination + gather + refinement, compile excluded)."""
-    from jax import lax
-
+    """Run the shared sharded front end with a timer around the sharded
+    elimination alone (compile, gather and refinement excluded) — the same
+    bracket as the reference's glob_time around Jordan (main.cpp:427-450)
+    and as the generator-driven path, so the two modes report comparable
+    numbers."""
+    from .ops import newton_schulz
     from .parallel import make_mesh
     from .parallel.sharded_jordan import (
         gather_inverse,
@@ -144,11 +245,8 @@ def _solve_distributed(a, n: int, block_size: int, workers: int,
     blocks, lay, run = prepare_sharded_invert(a, mesh, block_size)
     t0 = time.perf_counter()
     out, singular = run(blocks)
-    inv = gather_inverse(out, lay, n)
-    eye = jnp.eye(n, dtype=a.dtype)
-    for _ in range(refine):
-        r = eye - jnp.matmul(a, inv, precision=lax.Precision.HIGHEST)
-        inv = inv + jnp.matmul(inv, r, precision=lax.Precision.HIGHEST)
-    jax.block_until_ready(inv)
+    jax.block_until_ready(out)
     elapsed = time.perf_counter() - t0
+    inv = newton_schulz(a, gather_inverse(out, lay, n), refine)
+    jax.block_until_ready(inv)
     return inv, singular.any(), elapsed

@@ -47,44 +47,41 @@ class JordanSolver:
 
     def _compile(self, a):
         if self.workers > 1:
-            from ..parallel import make_mesh
             from ..parallel.sharded_jordan import prepare_sharded_invert
 
-            self._mesh = make_mesh(self.workers)
             _, self._lay, self._run = prepare_sharded_invert(
-                a, self._mesh, self.block_size
+                a, self._get_mesh(), self.block_size
             )
         else:
             self._run = block_jordan_invert.lower(
                 a, block_size=self.block_size, refine=self.refine
             ).compile()
 
+    def _get_mesh(self):
+        if self._mesh is None:
+            from ..parallel import make_mesh
+
+            self._mesh = make_mesh(self.workers)
+        return self._mesh
+
     def invert(self, a: jnp.ndarray):
         """Invert one (n, n) matrix; returns (inverse, singular)."""
         a = jnp.asarray(a, self.dtype)
         if a.shape != (self.n, self.n):
             raise ValueError(f"expected ({self.n}, {self.n}), got {a.shape}")
+        if self._run is None:
+            self._compile(a)
         if self.workers > 1:
+            from ..ops import newton_schulz
             from ..parallel.sharded_jordan import (
                 gather_inverse,
                 scatter_augmented,
             )
 
-            if self._run is None:
-                self._compile(a)
             blocks = scatter_augmented(a, self._lay, self._mesh)
             out, singular = self._run(blocks)
-            inv, singular = gather_inverse(out, self._lay, self.n), singular.any()
-            if self.refine:
-                from jax import lax
-
-                eye = jnp.eye(self.n, dtype=self.dtype)
-                for _ in range(self.refine):
-                    r = eye - jnp.matmul(a, inv, precision=lax.Precision.HIGHEST)
-                    inv = inv + jnp.matmul(inv, r, precision=lax.Precision.HIGHEST)
-            return inv, singular
-        if self._run is None:
-            self._compile(a)
+            inv = gather_inverse(out, self._lay, self.n)
+            return newton_schulz(a, inv, self.refine), singular.any()
         return self._run(a)
 
     def residual(self, a, inv) -> float:
@@ -93,7 +90,7 @@ class JordanSolver:
             from ..parallel import distributed_residual
 
             return float(distributed_residual(
-                jnp.asarray(a, self.dtype), inv, self._mesh,
+                jnp.asarray(a, self.dtype), inv, self._get_mesh(),
                 min(self.block_size, self.n),
             ))
         return float(residual_inf_norm(jnp.asarray(a, self.dtype), inv))

@@ -4,6 +4,7 @@ from .generators import GENERATORS, abs_diff, generate, hilbert, identity
 from .jordan import block_jordan_invert
 from .norms import block_inf_norms, inf_norm
 from .padding import pad_with_identity, unpad
+from .refine import newton_schulz
 from .residual import residual_inf_norm
 
 __all__ = [
@@ -18,6 +19,7 @@ __all__ = [
     "hilbert",
     "identity",
     "inf_norm",
+    "newton_schulz",
     "pad_with_identity",
     "residual_inf_norm",
     "unpad",

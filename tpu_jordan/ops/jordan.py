@@ -55,6 +55,7 @@ from ..config import default_block_size, eps_for
 from .block_inverse import batched_block_inverse
 from .norms import block_inf_norms, inf_norm
 from .padding import pad_with_identity, unpad
+from .refine import newton_schulz
 
 
 def _jordan_step(t, carry, *, Nr: int, m: int, eps: float, precision,
@@ -205,7 +206,5 @@ def block_jordan_invert(
         0, Nr, step, (W, norm_a, jnp.asarray(False))
     )
     x = unpad(W[:, N:], n)
-    for _ in range(refine):
-        r = jnp.eye(n, dtype=dtype) - jnp.matmul(a, x, precision=precision)
-        x = x + jnp.matmul(x, r, precision=precision)
+    x = newton_schulz(a, x, refine, precision)
     return x, singular

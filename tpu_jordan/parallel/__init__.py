@@ -1,5 +1,17 @@
-from .mesh import AXIS, block_sharding, distributed_init, make_mesh, replicated
-from .ring_gemm import distributed_residual, ring_matmul
+from .generate import sharded_generate
+from .mesh import (
+    AXIS,
+    MeshSizeError,
+    block_sharding,
+    distributed_init,
+    make_mesh,
+    replicated,
+)
+from .ring_gemm import (
+    distributed_residual,
+    distributed_residual_blocks,
+    ring_matmul,
+)
 from .sharded_jordan import sharded_jordan_invert
 from .layout import (
     CyclicLayout,
@@ -18,12 +30,15 @@ from .layout import (
 __all__ = [
     "AXIS",
     "CyclicLayout",
+    "MeshSizeError",
     "block_sharding",
     "distributed_init",
     "distributed_residual",
+    "distributed_residual_blocks",
     "make_mesh",
     "replicated",
     "ring_matmul",
+    "sharded_generate",
     "sharded_jordan_invert",
     "cyclic_gather_perm",
     "cyclic_scatter_perm",

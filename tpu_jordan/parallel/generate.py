@@ -1,0 +1,68 @@
+"""Shard-local matrix generation: each worker builds its own blocks.
+
+Parity with ``init_matrix`` (main.cpp:128-149): the reference fills each
+rank's strip from the generator formula with zero communication, using the
+local→global index walk.  Here every worker of the mesh materializes its
+cyclic block rows of the (padded) global matrix — or of the augmented
+``[A | I]`` tensor — directly on device inside shard_map, so a
+generator-driven solve never materializes an n×n array on the host.  This
+is the front end that makes the 65536-class sizes reachable: host memory
+stays O(1), device memory is the sharded tensor itself.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import PartitionSpec
+
+from ..ops.generators import GENERATORS
+from .layout import CyclicLayout
+from .mesh import AXIS
+
+
+def _local_blocks(k, *, lay: CyclicLayout, fn, dtype, augmented: bool):
+    """Worker ``k``'s (bpw, m, N) blocks of padded A — or (bpw, m, 2N) of
+    [A | I] — generated from global indices (local_to_global semantics,
+    main.cpp:118-123/128-149)."""
+    p, m, bpw, N, n = lay.p, lay.m, lay.blocks_per_worker, lay.N, lay.n
+    gidx = jnp.arange(bpw) * p + k                     # global block rows
+    gi = (gidx[:, None] * m + jnp.arange(m)[None, :])[:, :, None]  # (bpw,m,1)
+    gj = jnp.arange(N)[None, None, :]                  # (1, 1, N)
+    eye = (gi == gj).astype(dtype)                     # (bpw, m, N)
+    vals = jnp.broadcast_to(fn(gi, gj), eye.shape).astype(dtype)
+    # Identity padding (ops/padding.py semantics): outside the n×n window
+    # A continues as I, which inverts to I — no ragged math on device.
+    a_part = jnp.where((gi < n) & (gj < n), vals, eye)
+    if not augmented:
+        return a_part
+    return jnp.concatenate([a_part, eye], axis=2)      # [A | I]
+
+
+@partial(jax.jit, static_argnames=("fn_name", "lay", "mesh", "dtype",
+                                   "augmented"))
+def sharded_generate(fn_name: str, lay: CyclicLayout, mesh,
+                     dtype=jnp.float32, augmented: bool = False):
+    """Generate the cyclic block tensor for ``fn_name`` over ``mesh``.
+
+    Returns a (Nr, m, N) — or (Nr, m, 2N) when ``augmented`` — block tensor
+    in cyclic storage order, sharded over axis 0, built with zero host
+    memory and zero communication.
+    """
+    fn = GENERATORS[fn_name]
+
+    def worker():
+        k = lax.axis_index(AXIS)
+        return _local_blocks(k, lay=lay, fn=fn, dtype=dtype,
+                             augmented=augmented)
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=(),
+        out_specs=PartitionSpec(AXIS, None, None),
+    )()

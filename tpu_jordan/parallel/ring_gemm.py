@@ -70,16 +70,69 @@ def _ring_gemm_blocks(a_blocks, b_blocks, mesh, lay, precision):
     )(a_blocks, b_blocks)
 
 
+def _ring_residual_worker(a_loc, b_loc, *, lay: CyclicLayout, precision):
+    """Local part of ‖A·B − I‖∞: ring-GEMM rows, subtract I, row-sum max.
+
+    The reference keeps the residual local and MAX-allreduces one scalar
+    (main.cpp:504-505); same here — nothing n×n is ever replicated.
+    """
+    p, m, bpw = lay.p, lay.m, lay.blocks_per_worker
+    k = lax.axis_index(AXIS)
+    d = _ring_worker(a_loc, b_loc, lay=lay, precision=precision)
+    # minus_i with cyclic-aware indexing (main.cpp:1206-1224): this
+    # worker's local row (slot, r) is global row (slot*p + k)*m + r.
+    gi = ((jnp.arange(bpw) * p + k)[:, None] * m
+          + jnp.arange(m)[None, :])[:, :, None]          # (bpw, m, 1)
+    gj = jnp.arange(lay.N)[None, None, :]
+    d = d - (gi == gj).astype(d.dtype)
+    local = jnp.max(jnp.sum(jnp.abs(d), axis=2))          # local ∞-norm part
+    return lax.pmax(local, AXIS)[None]                    # (1,) per worker
+
+
+@partial(jax.jit, static_argnames=("mesh", "lay", "precision"))
+def _residual_blocks(a_blocks, b_blocks, mesh, lay, precision):
+    spec = PartitionSpec(AXIS, None, None)
+    out = shard_map(
+        partial(_ring_residual_worker, lay=lay, precision=precision),
+        mesh=mesh,
+        in_specs=(spec, spec),
+        out_specs=PartitionSpec(AXIS),
+    )(a_blocks, b_blocks)
+    return jnp.max(out)
+
+
+def distributed_residual_blocks(
+    a_blocks: jnp.ndarray,
+    inv_blocks: jnp.ndarray,
+    mesh: Mesh,
+    lay: CyclicLayout,
+    precision=lax.Precision.HIGHEST,
+) -> jnp.ndarray:
+    """‖A·A⁻¹ − I‖∞ from cyclic block operands, fully distributed.
+
+    Both operands must be identity-padded (the solve/generate convention:
+    the padded tail of A and of A⁻¹ is I, so the padded product's tail is
+    exactly I and contributes zero residual).  Output is a scalar — the
+    only thing that ever leaves the mesh.
+    """
+    return _residual_blocks(a_blocks, inv_blocks, mesh, lay, precision)
+
+
+def _shard_cyclic(xp, lay: CyclicLayout, mesh: Mesh):
+    """(N, N) padded array -> cyclic-order blocks sharded over the mesh."""
+    blocks = xp.reshape(lay.Nr, lay.m, lay.N)
+    blocks = jnp.take(blocks, cyclic_gather_perm(lay), axis=0)
+    return jax.device_put(
+        blocks, NamedSharding(mesh, PartitionSpec(AXIS, None, None))
+    )
+
+
 def _to_cyclic_blocks(x, lay: CyclicLayout, mesh: Mesh):
     N = lay.N
     xp = x
     if x.shape[-1] != N:
         xp = jnp.zeros((N, N), x.dtype).at[: x.shape[0], : x.shape[1]].set(x)
-    blocks = xp.reshape(lay.Nr, lay.m, N)
-    blocks = jnp.take(blocks, cyclic_gather_perm(lay), axis=0)
-    return jax.device_put(
-        blocks, NamedSharding(mesh, PartitionSpec(AXIS, None, None))
-    )
+    return _shard_cyclic(xp, lay, mesh)
 
 
 def ring_matmul(
@@ -99,6 +152,14 @@ def ring_matmul(
     return d.reshape(lay.N, lay.N)[:n, :n]
 
 
+def _to_identity_padded_blocks(x, lay: CyclicLayout, mesh: Mesh):
+    """Host-array front end for the residual: identity-pad to N, reorder to
+    cyclic storage, shard."""
+    from ..ops.padding import pad_with_identity
+
+    return _shard_cyclic(pad_with_identity(x, lay.N), lay, mesh)
+
+
 def distributed_residual(
     a: jnp.ndarray,
     a_inv: jnp.ndarray,
@@ -107,9 +168,11 @@ def distributed_residual(
     precision=lax.Precision.HIGHEST,
 ) -> jnp.ndarray:
     """‖A·A⁻¹ − I‖∞ with the ring GEMM + minus_i + max-reduce
-    (main.cpp:490-513, minus_i main.cpp:1206-1224, norm main.cpp:643-667)."""
-    from ..ops.norms import inf_norm
+    (main.cpp:490-513, minus_i main.cpp:1206-1224, norm main.cpp:643-667).
 
-    n = a.shape[-1]
-    d = ring_matmul(a, a_inv, mesh, block_size, precision)
-    return inf_norm(d - jnp.eye(n, dtype=d.dtype))
+    Convenience wrapper over ``distributed_residual_blocks`` for host-side
+    operands; the residual itself never materializes anything n×n."""
+    lay = CyclicLayout.create(a.shape[-1], block_size, mesh.devices.size)
+    a_b = _to_identity_padded_blocks(a, lay, mesh)
+    inv_b = _to_identity_padded_blocks(a_inv, lay, mesh)
+    return distributed_residual_blocks(a_b, inv_b, mesh, lay, precision)

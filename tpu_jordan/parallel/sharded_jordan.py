@@ -76,15 +76,19 @@ def _local_step(t, Wloc, singular, *, lay: CyclicLayout, eps, precision,
     # --- PIVOT REDUCTION: argmin over workers on a composite key — replaces
     # the custom MPI op (pivot_op main.cpp:729-744, MPI_Op_create
     # main.cpp:1000-1024, Allreduce main.cpp:1074).  Stage 1: best norm;
-    # stage 2: lowest worker id holding it (deterministic tie-break).
+    # stage 2: lowest *global block row* holding it, so ties resolve exactly
+    # like the single-device argmin (not lowest worker id, which can own a
+    # higher global row).  g_cand values are distinct across workers
+    # (gidx ≡ k mod p), so the winner is unique even when every key is inf.
     kmin = lax.pmin(my_key, AXIS)
-    win_k = lax.pmin(jnp.where(my_key == kmin, k, p), AXIS)
+    g_cand = gidx[slot_best]
+    win_g = lax.pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), AXIS)
     singular = singular | ~jnp.isfinite(kmin)   # all-singular (main.cpp:1075-83)
-    i_won = k == win_k
+    i_won = (my_key == kmin) & (g_cand == win_g)
 
     # Pivot's global block row and its inverse, shared one-hot (the scalar
     # payload of the reference's custom reduction).
-    g_piv = lax.psum(jnp.where(i_won, gidx[slot_best], 0), AXIS)
+    g_piv = lax.psum(jnp.where(i_won, g_cand, 0), AXIS)
     H = lax.psum(
         jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0).astype(dtype),
         AXIS,
@@ -190,6 +194,30 @@ def gather_inverse(out: jnp.ndarray, lay: CyclicLayout, n: int):
     return unpad(B, n)
 
 
+def compile_sharded_jordan(
+    blocks: jnp.ndarray,
+    mesh: Mesh,
+    lay: CyclicLayout,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    use_pallas: bool | None = None,
+):
+    """AOT-compile the sharded elimination for an already-sharded (Nr, m, 2N)
+    block tensor.  Returns ``run`` with ``run(blocks) ->
+    (out_blocks, singular_per_worker)``."""
+    dtype = blocks.dtype
+    if eps is None:
+        # Match the single-device policy (ops/jordan.py): the probe runs in
+        # fp32 for sub-fp32 working dtypes.
+        probe_dt = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
+        eps = eps_for(probe_dt)
+    if use_pallas is None:
+        use_pallas = resolve_use_pallas(dtype, lay.m)
+    return _sharded_jordan.lower(
+        blocks, mesh, lay, eps, precision, use_pallas
+    ).compile()
+
+
 def prepare_sharded_invert(
     a: jnp.ndarray,
     mesh: Mesh,
@@ -198,28 +226,17 @@ def prepare_sharded_invert(
     precision=lax.Precision.HIGHEST,
     use_pallas: bool | None = None,
 ):
-    """Resolve defaults, build the layout, scatter: the one front end shared
-    by sharded_jordan_invert and the timing driver.
+    """Resolve defaults, build the layout, scatter: the host-array front end
+    shared by sharded_jordan_invert and the timing driver.
 
     Returns (blocks, lay, run) where ``run(blocks)`` is the AOT-compiled
     sharded elimination returning (out_blocks, singular_per_worker).
     """
     n = a.shape[-1]
-    dtype = a.dtype
-    block_size = min(block_size, n)
-    if eps is None:
-        # Match the single-device policy (ops/jordan.py): the probe runs in
-        # fp32 for sub-fp32 working dtypes.
-        probe_dt = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
-        eps = eps_for(probe_dt)
-    if use_pallas is None:
-        use_pallas = resolve_use_pallas(dtype, block_size)
-
-    lay = CyclicLayout.create(n, block_size, mesh.devices.size)
+    lay = CyclicLayout.create(n, min(block_size, n), mesh.devices.size)
     blocks = scatter_augmented(a, lay, mesh)
-    run = _sharded_jordan.lower(
-        blocks, mesh, lay, eps, precision, use_pallas
-    ).compile()
+    run = compile_sharded_jordan(blocks, mesh, lay, eps, precision,
+                                 use_pallas)
     return blocks, lay, run
 
 
