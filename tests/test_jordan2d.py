@@ -1,0 +1,187 @@
+"""2D block-cyclic sharded Jordan inversion: parity vs the single-device
+path on 2x4, 4x2, and 2x2 virtual CPU meshes, plus SUMMA residual and
+shard-local 2D generation."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_jordan.ops import block_jordan_invert, generate
+from tpu_jordan.parallel import (
+    CyclicLayout2D,
+    distributed_residual_2d,
+    make_mesh_2d,
+    sharded_generate_2d,
+    sharded_jordan_invert_2d,
+)
+from tpu_jordan.parallel.jordan2d import (
+    gather_inverse_2d,
+    scatter_augmented_2d,
+    split_inverse_blocks_2d,
+)
+
+
+@pytest.fixture(params=[(2, 4), (4, 2), (2, 2)])
+def mesh2d(request):
+    return make_mesh_2d(*request.param)
+
+
+class TestLayout2D:
+    def test_padding_is_lcm_multiple(self):
+        lay = CyclicLayout2D.create(100, 8, 2, 4)   # Nr=13 -> 16
+        assert lay.Nr == 16 and lay.bpr == 8 and lay.bc2 == 8
+
+    def test_perms_are_permutations(self):
+        lay = CyclicLayout2D.create(64, 8, 2, 4)
+        assert sorted(lay.row_perm()) == list(range(lay.Nr))
+        assert sorted(lay.col_perm(2 * lay.Nr)) == list(range(2 * lay.Nr))
+
+
+class TestScatterGather2D:
+    def test_roundtrip(self, rng, mesh2d):
+        pr, pc = mesh2d.devices.shape
+        n, m = 48, 4
+        lay = CyclicLayout2D.create(n, m, pr, pc)
+        a = jnp.asarray(rng.standard_normal((n, n)))
+        W = scatter_augmented_2d(a, lay, mesh2d)
+        assert len(W.sharding.device_set) == pr * pc
+        # gather of the untouched scatter returns B = I
+        got = gather_inverse_2d(W, lay, n)
+        np.testing.assert_array_equal(np.asarray(got), np.eye(n))
+
+
+class TestSharded2DJordan:
+    @pytest.mark.parametrize("n,m", [(48, 4), (64, 8), (50, 8)])
+    def test_matches_single_device(self, rng, mesh2d, n, m):
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float64)
+        inv_d, s_d = sharded_jordan_invert_2d(a, mesh2d, m)
+        inv_s, s_s = block_jordan_invert(a, block_size=m)
+        assert bool(s_d) == bool(s_s) is False
+        np.testing.assert_allclose(
+            np.asarray(inv_d), np.asarray(inv_s), rtol=1e-9, atol=1e-9
+        )
+
+    def test_absdiff_tied_pivots_match(self, mesh2d):
+        a = generate("absdiff", (64, 64), jnp.float64)
+        inv_d, s_d = sharded_jordan_invert_2d(a, mesh2d, 8)
+        inv_s, s_s = block_jordan_invert(a, block_size=8)
+        assert bool(s_d) == bool(s_s) is False
+        np.testing.assert_allclose(
+            np.asarray(inv_d), np.asarray(inv_s), rtol=1e-9, atol=1e-12
+        )
+
+    def test_singular_collective_agreement(self, mesh2d):
+        _, sing = sharded_jordan_invert_2d(
+            jnp.ones((32, 32), jnp.float64), mesh2d, 8
+        )
+        assert bool(sing)
+
+    def test_hilbert(self, mesh2d):
+        a = generate("hilbert", (8, 8), jnp.float64)
+        inv, sing = sharded_jordan_invert_2d(a, mesh2d, 2)
+        assert not bool(sing)
+        res = np.max(np.sum(np.abs(np.asarray(a) @ np.asarray(inv)
+                                   - np.eye(8)), axis=1))
+        assert res < 1e-3
+
+
+class TestGenerate2D:
+    @pytest.mark.parametrize("name", ["absdiff", "hilbert"])
+    def test_matches_host_scatter(self, mesh2d, name):
+        pr, pc = mesh2d.devices.shape
+        n, m = 40, 4
+        lay = CyclicLayout2D.create(n, m, pr, pc)
+        dev = sharded_generate_2d(name, lay, mesh2d, jnp.float64)
+        host = scatter_augmented_2d(
+            generate(name, (n, n), jnp.float64), lay, mesh2d
+        )
+        np.testing.assert_array_equal(np.asarray(dev), np.asarray(host))
+
+    def test_unaugmented_width(self, mesh2d):
+        pr, pc = mesh2d.devices.shape
+        lay = CyclicLayout2D.create(32, 4, pr, pc)
+        dev = sharded_generate_2d("absdiff", lay, mesh2d, jnp.float64,
+                                  augmented=False)
+        assert dev.shape == (lay.Nr, lay.m, lay.N)
+
+
+class TestDriver2D:
+    def test_solve_2d_generator(self):
+        from tpu_jordan.driver import solve
+
+        res = solve(n=64, block_size=8, workers=(2, 4), dtype=jnp.float64)
+        assert res.residual / (64 * 64 / 2) < 1e-12
+        assert res.inverse is not None
+
+    def test_solve_2d_gather_false(self, monkeypatch):
+        import tpu_jordan.driver as drv
+        from tpu_jordan.driver import solve
+
+        def forbid(fn, shape, dtype=jnp.float32, **kw):
+            raise AssertionError(f"host generate({shape}) called")
+
+        monkeypatch.setattr(drv, "generate", forbid)
+        res = solve(n=96, block_size=8, workers=(4, 2), gather=False)
+        assert res.inverse is None
+        assert res.inverse_blocks is not None
+        assert len(res.inverse_blocks.sharding.device_set) == 8
+        assert res.residual / (96 * 96 / 2) < 1e-5
+
+    def test_solve_2d_file(self, rng, tmp_path):
+        from tpu_jordan.driver import solve
+        from tpu_jordan.io import write_matrix_file
+
+        a = rng.standard_normal((48, 48))
+        path = str(tmp_path / "a.txt")
+        write_matrix_file(path, a)
+        res = solve(n=48, block_size=8, workers=(2, 2), file=path,
+                    dtype=jnp.float64)
+        assert res.residual < 1e-9
+
+    def test_cli_2d_workers(self):
+        from tpu_jordan.__main__ import main
+
+        assert main(["64", "8", "--workers", "2x4", "--quiet"]) == 0
+
+
+class TestSummaResidual2D:
+    def test_end_to_end_no_host_matrix(self, mesh2d):
+        # generate -> invert -> split B half -> SUMMA residual, all 2D.
+        pr, pc = mesh2d.devices.shape
+        n, m = 64, 8
+        lay = CyclicLayout2D.create(n, m, pr, pc)
+        from tpu_jordan.parallel.jordan2d import compile_sharded_jordan_2d
+
+        W = sharded_generate_2d("absdiff", lay, mesh2d, jnp.float64)
+        run = compile_sharded_jordan_2d(W, mesh2d, lay)
+        out, singular = run(W)
+        assert not bool(singular.any())
+        inv_b = split_inverse_blocks_2d(out, lay, mesh2d)
+        a_b = sharded_generate_2d("absdiff", lay, mesh2d, jnp.float64,
+                                  augmented=False)
+        res = float(distributed_residual_2d(a_b, inv_b, mesh2d, lay))
+        rel = res / (n * n / 2)
+        assert rel < 1e-12
+
+    def test_matches_dense_residual(self, rng, mesh2d):
+        pr, pc = mesh2d.devices.shape
+        n, m = 32, 4
+        lay = CyclicLayout2D.create(n, m, pr, pc)
+        a = rng.standard_normal((n, n))
+        x = np.linalg.inv(a) + 1e-8 * rng.standard_normal((n, n))
+        from tpu_jordan.ops.padding import pad_with_identity
+
+        def to_blocks(h):
+            hp = pad_with_identity(jnp.asarray(h), lay.N)
+            blocks = hp.reshape(lay.Nr, m, lay.Nr, m)
+            rowp = jnp.asarray(lay.row_perm())
+            colp = jnp.asarray(lay.col_perm(lay.Nr))
+            blocks = jnp.take(jnp.take(blocks, rowp, 0), colp, 2)
+            return blocks.reshape(lay.Nr, m, lay.N)
+
+        got = float(distributed_residual_2d(
+            to_blocks(a), to_blocks(x), mesh2d, lay
+        ))
+        want = float(np.max(np.sum(np.abs(a @ x - np.eye(n)), axis=1)))
+        np.testing.assert_allclose(got, want, rtol=1e-9)

@@ -14,6 +14,14 @@ import sys
 import jax.numpy as jnp
 
 
+def _workers_arg(s: str):
+    """'8' -> 8 workers on a 1D mesh; '2x4' -> a (2, 4) 2D mesh."""
+    if "x" in s:
+        pr, pc = s.split("x", 1)
+        return (int(pr), int(pc))
+    return int(s)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tpu_jordan",
@@ -31,8 +39,11 @@ def main(argv=None) -> int:
                          "(hilbert = the reference's -DHILBERT build)")
     ap.add_argument("--refine", type=int, default=0,
                     help="Newton-Schulz refinement steps")
-    ap.add_argument("--workers", type=int, default=1,
-                    help="devices in the 1D mesh (the reference's mpirun -np)")
+    ap.add_argument("--workers", type=_workers_arg, default=1,
+                    help="devices in the mesh: an integer for the 1D "
+                         "row-cyclic layout (the reference's mpirun -np), "
+                         "or PRxPC (e.g. 2x4) for the 2D block-cyclic "
+                         "layout")
     ap.add_argument("--distributed", action="store_true",
                     help="call jax.distributed.initialize for multi-host "
                          "TPU slices before any device use (the analog of "
@@ -42,6 +53,9 @@ def main(argv=None) -> int:
         args = ap.parse_args(argv)
         if args.n <= 0 or args.m <= 0:
             raise ValueError("n and m must be positive")
+        w = args.workers
+        if (w <= 0 if isinstance(w, int) else w[0] <= 0 or w[1] <= 0):
+            raise ValueError("workers must be positive")
     except SystemExit as e:
         if e.code == 0:      # --help / --version are not usage errors
             return 0

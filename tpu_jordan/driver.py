@@ -62,9 +62,13 @@ def solve(
 
     ``workers > 1`` runs the distributed path: 1D mesh over that many
     devices, sharded elimination, ring-GEMM residual — the analog of
-    ``mpirun -np workers`` on the reference.  When the matrix comes from a
-    generator, every worker builds its own shard on device (init_matrix
-    parity, main.cpp:128-149) and the residual is computed without ever
+    ``mpirun -np workers`` on the reference.  A *tuple* ``workers=(pr, pc)``
+    runs the 2D block-cyclic path instead: both matrix axes sharded over a
+    (pr, pc) mesh, SUMMA residual — per-worker memory O(n²/(pr·pc)), the
+    scaling mode the reference's rows-only layout can't reach
+    (main.cpp:366-370).  When the matrix comes from a generator, every
+    worker builds its own shard on device (init_matrix parity,
+    main.cpp:128-149) and the residual is computed without ever
     materializing an n×n array on the host; with ``gather=False`` the
     inverse too stays as sharded cyclic blocks (``result.inverse_blocks``
     + ``result.layout``), the memory-scaling mode for north-star sizes.
@@ -81,18 +85,21 @@ def solve(
             return jax.device_put(jnp.asarray(host, dtype), device)
         return jax.device_put(generate(generator, (n, n), dtype), device)
 
-    if workers > 1 and file is None:
-        # Fully device-resident: shard-local generation, sharded solve,
-        # distributed residual; zero host-side n×n arrays.
-        return _solve_distributed_generated(
-            n, block_size, workers, generator, dtype, refine, verbose,
-            gather,
+    if isinstance(workers, tuple):
+        return _solve_distributed_core(
+            _Dist2D(workers, n, min(block_size, n)), n, block_size, file,
+            generator, dtype, refine, verbose, gather, load,
+        )
+    if workers > 1:
+        return _solve_distributed_core(
+            _Dist1D(workers, n, min(block_size, n)), n, block_size, file,
+            generator, dtype, refine, verbose, gather, load,
         )
 
     if not gather:
         raise ValueError(
-            "gather=False is only supported on the generator-driven "
-            "distributed path (workers > 1 and no file)"
+            "gather=False is only supported on distributed paths with "
+            "generator input"
         )
 
     a = load()
@@ -102,20 +109,15 @@ def solve(
         print("A")
         print_corner(a)
 
-    if workers > 1:
-        inv, singular, elapsed = _solve_distributed(
-            a, n, block_size, workers, refine
-        )
-    else:
-        # AOT-compile so the timed call measures the executable alone
-        # without running the O(n^3) inversion twice.
-        compiled = block_jordan_invert.lower(
-            a, block_size=block_size, refine=refine
-        ).compile()
-        t0 = time.perf_counter()
-        inv, singular = compiled(a)
-        jax.block_until_ready(inv)
-        elapsed = time.perf_counter() - t0
+    # AOT-compile so the timed call measures the executable alone
+    # without running the O(n^3) inversion twice.
+    compiled = block_jordan_invert.lower(
+        a, block_size=block_size, refine=refine
+    ).compile()
+    t0 = time.perf_counter()
+    inv, singular = compiled(a)
+    jax.block_until_ready(inv)
+    elapsed = time.perf_counter() - t0
 
     if bool(singular):
         raise SingularMatrixError("singular matrix")
@@ -150,39 +152,153 @@ def solve(
     )
 
 
-def _solve_distributed_generated(
-    n: int, block_size: int, workers: int, generator: str, dtype,
-    refine: int, verbose: bool, gather: bool,
-):
-    """Generator-driven distributed solve with no host-side n×n arrays.
+class _Dist1D:
+    """1D row-block-cyclic backend (the reference's own layout,
+    main.cpp:118-123)."""
 
-    The reference analog end to end: init_matrix fills each rank's strip
-    locally (main.cpp:128-149), Jordan runs, A is *regenerated* and the
-    residual MAX-allreduced (main.cpp:463-513) — all of it device-resident
-    here.  Refinement (no reference analog) runs on the gathered inverse
-    and therefore requires ``gather=True``.
+    def __init__(self, workers: int, n: int, m: int):
+        from .parallel import make_mesh
+        from .parallel.layout import CyclicLayout
+
+        self.mesh = make_mesh(workers)
+        self.lay = CyclicLayout.create(n, m, workers)
+
+    def generate_W(self, generator, dtype):
+        from .parallel import sharded_generate
+
+        return sharded_generate(generator, self.lay, self.mesh, dtype,
+                                augmented=True)
+
+    def scatter_W(self, a):
+        from .parallel.sharded_jordan import scatter_augmented
+
+        return scatter_augmented(a, self.lay, self.mesh)
+
+    def compile(self, W):
+        from .parallel.sharded_jordan import compile_sharded_jordan
+
+        return compile_sharded_jordan(W, self.mesh, self.lay)
+
+    def gather(self, out, n):
+        from .parallel.sharded_jordan import gather_inverse
+
+        return gather_inverse(out, self.lay, n)
+
+    def inv_blocks(self, out):
+        return out[:, :, self.lay.N:]
+
+    def generate_a_blocks(self, generator, dtype):
+        from .parallel import sharded_generate
+
+        return sharded_generate(generator, self.lay, self.mesh, dtype,
+                                augmented=False)
+
+    def scatter_a_blocks(self, a):
+        from .parallel.ring_gemm import _to_identity_padded_blocks
+
+        return _to_identity_padded_blocks(a, self.lay, self.mesh)
+
+    def residual(self, a_blocks, inv_blocks):
+        from .parallel.ring_gemm import distributed_residual_blocks
+
+        return distributed_residual_blocks(a_blocks, inv_blocks,
+                                           self.mesh, self.lay)
+
+
+class _Dist2D:
+    """2D block-cyclic backend over a (pr, pc) mesh (SUMMA residual) —
+    per-worker memory O(n²/(pr·pc))."""
+
+    def __init__(self, shape: tuple, n: int, m: int):
+        from .parallel import make_mesh_2d
+        from .parallel.layout import CyclicLayout2D
+
+        pr, pc = shape
+        self.mesh = make_mesh_2d(pr, pc)
+        self.lay = CyclicLayout2D.create(n, m, pr, pc)
+
+    def generate_W(self, generator, dtype):
+        from .parallel.jordan2d import sharded_generate_2d
+
+        return sharded_generate_2d(generator, self.lay, self.mesh, dtype)
+
+    def scatter_W(self, a):
+        from .parallel.jordan2d import scatter_augmented_2d
+
+        return scatter_augmented_2d(a, self.lay, self.mesh)
+
+    def compile(self, W):
+        from .parallel.jordan2d import compile_sharded_jordan_2d
+
+        return compile_sharded_jordan_2d(W, self.mesh, self.lay)
+
+    def gather(self, out, n):
+        from .parallel.jordan2d import gather_inverse_2d
+
+        return gather_inverse_2d(out, self.lay, n)
+
+    def inv_blocks(self, out):
+        from .parallel.jordan2d import split_inverse_blocks_2d
+
+        return split_inverse_blocks_2d(out, self.lay, self.mesh)
+
+    def generate_a_blocks(self, generator, dtype):
+        from .parallel.jordan2d import sharded_generate_2d
+
+        return sharded_generate_2d(generator, self.lay, self.mesh, dtype,
+                                   augmented=False)
+
+    def scatter_a_blocks(self, a):
+        from .parallel.jordan2d import scatter_matrix_2d
+
+        return scatter_matrix_2d(a, self.lay, self.mesh)
+
+    def residual(self, a_blocks, inv_blocks):
+        from .parallel.jordan2d import distributed_residual_2d
+
+        return distributed_residual_2d(a_blocks, inv_blocks, self.mesh,
+                                       self.lay)
+
+
+def _solve_distributed_core(
+    be, n: int, block_size: int, file, generator: str, dtype,
+    refine: int, verbose: bool, gather: bool, load,
+):
+    """The one distributed solve skeleton, shared by the 1D and 2D
+    layouts via the backend adapter ``be``.
+
+    Reference analog end to end: init_matrix fills each rank's strip
+    locally (main.cpp:128-149; our generator path — fully device-resident,
+    zero host n×n arrays), or read_matrix scatters a file from the host
+    (main.cpp:209-282); Jordan runs (timed like glob_time,
+    main.cpp:427-450: elimination only, compile/gather excluded); A is
+    re-read/regenerated and the residual MAX-allreduced with only a scalar
+    leaving the mesh (main.cpp:463-513).  Refinement (no reference analog)
+    runs on the gathered inverse and therefore requires ``gather=True``.
     """
     from .ops import newton_schulz
-    from .parallel import make_mesh, sharded_generate
-    from .parallel.layout import CyclicLayout
-    from .parallel.ring_gemm import distributed_residual_blocks
-    from .parallel.sharded_jordan import (
-        compile_sharded_jordan,
-        gather_inverse,
-    )
 
     if refine and not gather:
         raise ValueError("refine requires gather=True (it runs on the "
                          "gathered inverse)")
-    mesh = make_mesh(workers)
-    lay = CyclicLayout.create(n, min(block_size, n), workers)
-    W = sharded_generate(generator, lay, mesh, dtype, augmented=True)
+    if not gather and file is not None:
+        raise ValueError("gather=False requires generator input")
+
+    a_host = None
+    if file is None:
+        W = be.generate_W(generator, dtype)
+    else:
+        a_host = load()
+        W = be.scatter_W(a_host)
     if verbose:
         from .utils.printing import print_corner
 
         print("A")
-        print_corner(generate(generator, (min(n, 10), min(n, 10)), dtype))
-    run = compile_sharded_jordan(W, mesh, lay)
+        print_corner(a_host if a_host is not None
+                     else generate(generator, (min(n, 10), min(n, 10)),
+                                   dtype))
+
+    run = be.compile(W)
     t0 = time.perf_counter()
     out, singular = run(W)
     jax.block_until_ready(out)
@@ -190,23 +306,21 @@ def _solve_distributed_generated(
     if bool(singular.any()):
         raise SingularMatrixError("singular matrix")
 
-    inv_blocks = out[:, :, lay.N:]
-    inv = None
-    if gather:
-        inv = gather_inverse(out, lay, n)
+    inv = be.gather(out, n) if gather else None
+    inv_b = None if (gather and refine) else be.inv_blocks(out)
+    # Verification source is always *fresh* (re-read / regenerated), never
+    # algorithm state — the reference's reload semantics (main.cpp:463-488).
     if refine:
-        a_full = generate(generator, (n, n), dtype)
+        a_full = load() if file is not None else generate(
+            generator, (n, n), dtype
+        )
         inv = newton_schulz(a_full, inv, refine)
-        from .ops import residual_inf_norm
-
         residual = float(residual_inf_norm(a_full, inv))
     else:
-        # Residual against a *freshly regenerated* A (main.cpp:463-488),
-        # fully distributed: only this scalar leaves the mesh.
-        a_blocks = sharded_generate(generator, lay, mesh, dtype,
-                                    augmented=False)
-        residual = float(distributed_residual_blocks(a_blocks, inv_blocks,
-                                                     mesh, lay))
+        a_b = (be.scatter_a_blocks(load()) if file is not None
+               else be.generate_a_blocks(generator, dtype))
+        residual = float(be.residual(a_b, inv_b))
+
     if verbose:
         print(f"glob_time: {elapsed:.2f}")
         if inv is not None:
@@ -220,33 +334,8 @@ def _solve_distributed_generated(
         elapsed=elapsed,
         residual=residual,
         n=n,
-        block_size=min(block_size, n),
+        block_size=be.lay.m,
         gflops=2.0 * n**3 / elapsed / 1e9,
-        inverse_blocks=None if gather else inv_blocks,
-        layout=None if gather else lay,
+        inverse_blocks=None if gather else inv_b,
+        layout=None if gather else be.lay,
     )
-
-
-def _solve_distributed(a, n: int, block_size: int, workers: int,
-                       refine: int):
-    """Run the shared sharded front end with a timer around the sharded
-    elimination alone (compile, gather and refinement excluded) — the same
-    bracket as the reference's glob_time around Jordan (main.cpp:427-450)
-    and as the generator-driven path, so the two modes report comparable
-    numbers."""
-    from .ops import newton_schulz
-    from .parallel import make_mesh
-    from .parallel.sharded_jordan import (
-        gather_inverse,
-        prepare_sharded_invert,
-    )
-
-    mesh = make_mesh(workers)
-    blocks, lay, run = prepare_sharded_invert(a, mesh, block_size)
-    t0 = time.perf_counter()
-    out, singular = run(blocks)
-    jax.block_until_ready(out)
-    elapsed = time.perf_counter() - t0
-    inv = newton_schulz(a, gather_inverse(out, lay, n), refine)
-    jax.block_until_ready(inv)
-    return inv, singular.any(), elapsed

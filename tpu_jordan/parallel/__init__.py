@@ -1,10 +1,18 @@
 from .generate import sharded_generate
+from .jordan2d import (
+    distributed_residual_2d,
+    sharded_generate_2d,
+    sharded_jordan_invert_2d,
+)
 from .mesh import (
     AXIS,
+    AXIS_C,
+    AXIS_R,
     MeshSizeError,
     block_sharding,
     distributed_init,
     make_mesh,
+    make_mesh_2d,
     replicated,
 )
 from .ring_gemm import (
@@ -15,6 +23,7 @@ from .ring_gemm import (
 from .sharded_jordan import sharded_jordan_invert
 from .layout import (
     CyclicLayout,
+    CyclicLayout2D,
     cyclic_gather_perm,
     cyclic_scatter_perm,
     find_sender,
@@ -29,17 +38,24 @@ from .layout import (
 
 __all__ = [
     "AXIS",
+    "AXIS_C",
+    "AXIS_R",
     "CyclicLayout",
+    "CyclicLayout2D",
     "MeshSizeError",
     "block_sharding",
     "distributed_init",
     "distributed_residual",
+    "distributed_residual_2d",
     "distributed_residual_blocks",
     "make_mesh",
+    "make_mesh_2d",
     "replicated",
     "ring_matmul",
     "sharded_generate",
+    "sharded_generate_2d",
     "sharded_jordan_invert",
+    "sharded_jordan_invert_2d",
     "cyclic_gather_perm",
     "cyclic_scatter_perm",
     "find_sender",

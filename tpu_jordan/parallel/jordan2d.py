@@ -1,0 +1,375 @@
+"""Distributed block Gauss–Jordan inversion on a 2D block-cyclic mesh.
+
+The north-star upgrade over the reference's 1D decomposition: the
+reference shards only rows and replicates every column on every rank
+(len = RpP*m*n strips, main.cpp:366-370), so per-rank memory is
+O(N·2N / p) regardless of p — the wall that makes 32768²+ unreachable.
+Here the augmented matrix [A | B] is sharded over BOTH axes of a
+(pr, pc) mesh in ScaLAPACK-style block-cyclic order: per-worker memory is
+O(N·2N / (pr·pc)).
+
+Communication per super-step t (cf. the reference's
+allreduce + bcast + P2P, SURVEY.md §3.2):
+
+  pivot probe        local batched inverse on the mesh column owning
+                     block column t (others mask to inf)
+  pivot reduction    composite-key `lax.pmin` over BOTH axes
+                     (replaces MPI_Op_create/PivotMin, main.cpp:1000-1074)
+  pivot-row bcast    one-hot `lax.psum` along "pr" — each mesh column
+                     broadcasts its own slice of the row (main.cpp:1097)
+  row swap           one-hot psum of row t along "pr" + masked local write
+                     (swap-by-copy, main.cpp:1100-1131)
+  multiplier bcast   one-hot `lax.psum` of the column-t panel along "pc"
+                     (no 1D analog: columns were replicated there)
+  eliminate          one local (bpr·m, m) x (m, Wc) MXU matmul
+
+Local storage on worker (kr, kc): ``(bpr, m, Wc)`` — row blocks cyclic on
+axis 0 (global block gr = slot*pr + kr), columns stored as bc2 chunks of m
+in cyclic column-block order on axis 2 (global column block of chunk u is
+u*pc + kc).  The global storage array is (Nr, m, 2N) with both axes in
+worker-major cyclic storage order, so a plain NamedSharding
+P("pr", None, "pc") realises the 2D block-cyclic distribution.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..config import eps_for
+from ..ops.block_inverse import batched_block_inverse
+from ..ops.norms import block_inf_norms
+from .layout import CyclicLayout2D
+from .mesh import AXIS_C, AXIS_R
+
+BOTH = (AXIS_R, AXIS_C)
+_SPEC_W = PartitionSpec(AXIS_R, None, AXIS_C)
+
+
+def _probe(cands, eps, use_pallas):
+    if use_pallas:
+        from ..ops.pallas_block_inverse import pallas_batched_block_inverse
+
+        return pallas_batched_block_inverse(cands, eps)
+    return batched_block_inverse(cands, None, eps)
+
+
+def _local_step2d(t, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
+                  use_pallas: bool):
+    """One super-step on one worker's (bpr, m, Wc) shard."""
+    pr, pc, m = lay.pr, lay.pc, lay.m
+    bpr = lay.bpr
+    kr = lax.axis_index(AXIS_R)
+    kc = lax.axis_index(AXIS_C)
+    dtype = Wloc.dtype
+    gr = jnp.arange(bpr) * pr + kr          # global block row of each slot
+
+    # --- PIVOT PROBE on the mesh column owning global column block t.
+    # Everyone probes its local chunk u_t (garbage on non-owners — masked
+    # below); static shapes keep the step jit-compatible.
+    own_c = kc == (t % pc)
+    u_t = t // pc
+    cands = lax.dynamic_slice(Wloc, (0, 0, u_t * m), (bpr, m, m))
+    probe_dtype = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
+    invs, sing = _probe(cands.astype(probe_dtype), eps, use_pallas)
+    inv_norms = block_inf_norms(invs)
+    valid = own_c & (gr >= t) & ~sing
+    big = jnp.asarray(jnp.inf, probe_dtype)
+    key = jnp.where(valid, inv_norms.astype(probe_dtype), big)
+    slot_best = jnp.argmin(key)
+    my_key = key[slot_best]
+    g_cand = gr[slot_best]
+
+    # --- PIVOT REDUCTION over the whole mesh; ties to lowest global row
+    # (same rule as the 1D and single-device paths).
+    kmin = lax.pmin(my_key, BOTH)
+    win_g = lax.pmin(
+        jnp.where(own_c & (my_key == kmin), g_cand, lay.Nr), BOTH
+    )
+    singular = singular | ~jnp.isfinite(kmin)   # all-singular agreement
+    i_won = own_c & (my_key == kmin) & (g_cand == win_g)
+    g_piv = lax.psum(jnp.where(i_won, g_cand, 0), BOTH)
+    H = lax.psum(
+        jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0), BOTH
+    ).astype(dtype)
+
+    # --- ROW BROADCASTS along "pr": each mesh column shares its slice of
+    # the pivot row and of row t (one-hot psums riding ICI).
+    own_piv = kr == (g_piv % pr)
+    slot_piv = jnp.where(own_piv, g_piv // pr, 0)
+    row_piv = lax.psum(
+        jnp.where(own_piv,
+                  lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False), 0.0),
+        AXIS_R,
+    )                                           # (m, Wc)
+    own_t = kr == (t % pr)
+    slot_t = t // pr
+    row_t = lax.psum(
+        jnp.where(own_t,
+                  lax.dynamic_index_in_dim(Wloc, slot_t, 0, False), 0.0),
+        AXIS_R,
+    )                                           # (m, Wc)
+
+    # --- SWAP-BY-COPY (main.cpp:1093-1131): pivot owner's slot receives
+    # the old row t; slot t is rewritten from the normalized pivot row.
+    W_swap = lax.dynamic_update_index_in_dim(Wloc, row_t, slot_piv, 0)
+    Wloc = jnp.where(own_piv, W_swap, Wloc)
+
+    # --- NORMALIZE: one (m, m) x (m, Wc) matmul per worker.
+    prow = jnp.matmul(H, row_piv, precision=precision)
+
+    # --- MULTIPLIER BROADCAST along "pc": the column-t panel (post-swap)
+    # reaches every mesh column.
+    E = lax.dynamic_slice(Wloc, (0, 0, u_t * m), (bpr, m, m))
+    E = lax.psum(jnp.where(own_c, E, jnp.asarray(0, dtype)), AXIS_C)
+    E = jnp.where((gr == t)[:, None, None], jnp.asarray(0, dtype), E)
+
+    # --- ELIMINATE: one local MXU matmul over the whole shard.
+    update = jnp.matmul(E.reshape(bpr * m, m), prow, precision=precision)
+    Wloc = Wloc - update.reshape(Wloc.shape)
+
+    # Row t becomes the normalized pivot row (owning mesh row only).
+    W_set = lax.dynamic_update_index_in_dim(Wloc, prow, slot_t, 0)
+    Wloc = jnp.where(own_t, W_set, Wloc)
+    return Wloc, singular
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas"))
+def _sharded_jordan2d(W, mesh, lay: CyclicLayout2D, eps, precision,
+                      use_pallas):
+    def worker(Wloc):
+        def body(t, carry):
+            Wl, sing = carry
+            return _local_step2d(t, Wl, sing, lay=lay, eps=eps,
+                                 precision=precision, use_pallas=use_pallas)
+
+        sing0 = lax.pcast(jnp.zeros((1, 1), jnp.bool_), BOTH, to='varying')
+        Wl, sing = lax.fori_loop(0, lay.Nr, body, (Wloc, sing0))
+        return Wl, sing
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=_SPEC_W,
+        out_specs=(_SPEC_W, PartitionSpec(AXIS_R, AXIS_C)),
+    )(W)
+
+
+# --- front ends -----------------------------------------------------------
+
+
+def _perms(lay: CyclicLayout2D, ncb: int):
+    rowp = jnp.asarray(lay.row_perm(), jnp.int32)
+    colp = jnp.asarray(lay.col_perm(ncb), jnp.int32)
+    return rowp, colp
+
+
+def _inv_perm(p):
+    inv = jnp.zeros_like(p)
+    return inv.at[p].set(jnp.arange(p.shape[0], dtype=p.dtype))
+
+
+def scatter_augmented_2d(a: jnp.ndarray, lay: CyclicLayout2D, mesh: Mesh):
+    """Host path: build padded [A | I], reorder both axes to cyclic storage
+    order, shard over the 2D mesh."""
+    from ..ops.padding import pad_with_identity
+
+    N = lay.N
+    A = pad_with_identity(a, N)
+    W = jnp.concatenate([A, jnp.eye(N, dtype=a.dtype)], axis=1)  # (N, 2N)
+    blocks = W.reshape(lay.Nr, lay.m, 2 * lay.Nr, lay.m)
+    rowp, colp = _perms(lay, 2 * lay.Nr)
+    blocks = jnp.take(jnp.take(blocks, rowp, axis=0), colp, axis=2)
+    W2 = blocks.reshape(lay.Nr, lay.m, 2 * N)
+    return jax.device_put(W2, NamedSharding(mesh, _SPEC_W))
+
+
+def scatter_matrix_2d(a: jnp.ndarray, lay: CyclicLayout2D, mesh: Mesh):
+    """Host path for an unaugmented N-wide operand (e.g. the residual's A):
+    identity-pad, reorder both axes to cyclic storage, shard."""
+    from ..ops.padding import pad_with_identity
+
+    blocks = pad_with_identity(a, lay.N).reshape(
+        lay.Nr, lay.m, lay.Nr, lay.m
+    )
+    rowp, colp = _perms(lay, lay.Nr)
+    blocks = jnp.take(jnp.take(blocks, rowp, axis=0), colp, axis=2)
+    return jax.device_put(
+        blocks.reshape(lay.Nr, lay.m, lay.N), NamedSharding(mesh, _SPEC_W)
+    )
+
+
+def gather_inverse_2d(out: jnp.ndarray, lay: CyclicLayout2D, n: int):
+    """Cyclic storage order (both axes) -> natural order; slice out A⁻¹."""
+    from ..ops.padding import unpad
+
+    blocks = out.reshape(lay.Nr, lay.m, 2 * lay.Nr, lay.m)
+    rowp, colp = _perms(lay, 2 * lay.Nr)
+    blocks = jnp.take(jnp.take(blocks, _inv_perm(rowp), axis=0),
+                      _inv_perm(colp), axis=2)
+    W = blocks.reshape(lay.N, 2 * lay.N)
+    return unpad(W[:, lay.N:], n)
+
+
+@partial(jax.jit, static_argnames=("fn_name", "lay", "mesh", "dtype",
+                                   "augmented"))
+def sharded_generate_2d(fn_name: str, lay: CyclicLayout2D, mesh: Mesh,
+                        dtype=jnp.float32, augmented: bool = True):
+    """Each worker generates its own 2D-cyclic shard of padded A (or of
+    [A | I]) from global indices — init_matrix parity (main.cpp:128-149)
+    with zero host memory and zero communication."""
+    from ..ops.generators import GENERATORS
+
+    fn = GENERATORS[fn_name]
+    n, m, N = lay.n, lay.m, lay.N
+    ncb = 2 * lay.Nr if augmented else lay.Nr
+    bc = ncb // lay.pc
+
+    def worker():
+        kr = lax.axis_index(AXIS_R)
+        kc = lax.axis_index(AXIS_C)
+        gi = ((jnp.arange(lay.bpr) * lay.pr + kr)[:, None] * m
+              + jnp.arange(m)[None, :])[:, :, None, None]   # (bpr, m, 1, 1)
+        gcb = jnp.arange(bc) * lay.pc + kc                  # global col blocks
+        gj = (gcb[:, None] * m + jnp.arange(m)[None, :])[None, None, :, :]
+        eye_a = (gi == gj).astype(dtype)
+        vals = jnp.broadcast_to(fn(gi, gj), eye_a.shape).astype(dtype)
+        a_part = jnp.where((gi < n) & (gj < n), vals, eye_a)
+        if augmented:
+            eye_b = (gi == (gj - N)).astype(dtype)
+            a_part = jnp.where(gj < N, a_part, eye_b)
+        return a_part.reshape(lay.bpr, m, bc * m)
+
+    return shard_map(
+        worker, mesh=mesh, in_specs=(), out_specs=_SPEC_W,
+    )()
+
+
+@partial(jax.jit, static_argnames=("lay", "mesh"))
+def split_inverse_blocks_2d(out: jnp.ndarray, lay: CyclicLayout2D,
+                            mesh: Mesh):
+    """The B half of the augmented result, still 2D-sharded.
+
+    Nr is a multiple of pc, so every worker's B-part chunks are exactly the
+    last bc1 chunks of its local storage — a local slice, no resharding.
+    """
+    def worker(Wloc):
+        return Wloc[:, :, lay.bc1 * lay.m:]
+
+    return shard_map(
+        worker, mesh=mesh, in_specs=_SPEC_W, out_specs=_SPEC_W,
+    )(out)
+
+
+# --- SUMMA residual -------------------------------------------------------
+
+
+def _summa_residual_worker(a_loc, b_loc, *, lay: CyclicLayout2D, precision):
+    """Local part of ‖A·B − I‖∞ on the 2D layout via SUMMA: at step k the
+    owner mesh column broadcasts A's k-panel along "pc" and the owner mesh
+    row broadcasts B's k-panel along "pr"; one local matmul accumulates.
+    Row sums are psum'd along "pc" (rows are split across mesh columns),
+    then max-reduced — only a scalar leaves the mesh."""
+    pr, pc, m, bpr = lay.pr, lay.pc, lay.m, lay.bpr
+    kr = lax.axis_index(AXIS_R)
+    kc = lax.axis_index(AXIS_C)
+    wc = b_loc.shape[-1]
+
+    def body(kb, d):
+        own_ac = kc == (kb % pc)
+        u = kb // pc
+        a_panel = lax.dynamic_slice(a_loc, (0, 0, u * m), (bpr, m, m))
+        a_panel = lax.psum(jnp.where(own_ac, a_panel, 0.0), AXIS_C)
+        own_br = kr == (kb % pr)
+        s = kb // pr
+        b_panel = lax.psum(
+            jnp.where(own_br,
+                      lax.dynamic_index_in_dim(b_loc, s, 0, False), 0.0),
+            AXIS_R,
+        )                                               # (m, wc)
+        upd = jnp.matmul(a_panel.reshape(bpr * m, m), b_panel,
+                         precision=precision)
+        return d + upd.reshape(bpr, m, wc)
+
+    d0 = lax.pcast(jnp.zeros((bpr, m, wc), a_loc.dtype), BOTH, to='varying')
+    d = lax.fori_loop(0, lay.Nr, body, d0)
+    # minus_i on the 2D-cyclic local indices.
+    gi = ((jnp.arange(bpr) * pr + kr)[:, None] * m
+          + jnp.arange(m)[None, :])[:, :, None]          # (bpr, m, 1)
+    gcb = jnp.arange(wc // m) * pc + kc
+    gj = (gcb[:, None] * m + jnp.arange(m)[None, :]).reshape(-1)[None, None, :]
+    d = d - (gi == gj).astype(d.dtype)
+    rowsum = lax.psum(jnp.sum(jnp.abs(d), axis=2), AXIS_C)   # full row sums
+    return lax.pmax(jnp.max(rowsum), BOTH)[None, None]
+
+
+@partial(jax.jit, static_argnames=("mesh", "lay", "precision"))
+def distributed_residual_2d(a_blocks, inv_blocks, mesh, lay: CyclicLayout2D,
+                            precision=lax.Precision.HIGHEST):
+    """‖A·A⁻¹ − I‖∞ from 2D-cyclic block operands (identity-padded), fully
+    distributed (SUMMA + pmax; reference analog main.cpp:490-513)."""
+    out = shard_map(
+        partial(_summa_residual_worker, lay=lay, precision=precision),
+        mesh=mesh,
+        in_specs=(_SPEC_W, _SPEC_W),
+        out_specs=PartitionSpec(AXIS_R, AXIS_C),
+    )(a_blocks, inv_blocks)
+    return jnp.max(out)
+
+
+# --- public API -----------------------------------------------------------
+
+
+def resolve_use_pallas_2d(dtype, block_size: int) -> bool:
+    from .sharded_jordan import resolve_use_pallas
+
+    return resolve_use_pallas(dtype, block_size)
+
+
+def compile_sharded_jordan_2d(
+    W: jnp.ndarray,
+    mesh: Mesh,
+    lay: CyclicLayout2D,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    use_pallas: bool | None = None,
+):
+    """AOT-compile the 2D elimination; ``run(W) -> (out, singular_grid)``."""
+    dtype = W.dtype
+    if eps is None:
+        probe_dt = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
+        eps = eps_for(probe_dt)
+    if use_pallas is None:
+        use_pallas = resolve_use_pallas_2d(dtype, lay.m)
+    return _sharded_jordan2d.lower(
+        W, mesh, lay, eps, precision, use_pallas
+    ).compile()
+
+
+def sharded_jordan_invert_2d(
+    a: jnp.ndarray,
+    mesh: Mesh,
+    block_size: int,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    use_pallas: bool | None = None,
+):
+    """Invert (n, n) ``a`` over a 2D (pr, pc) mesh; returns (inv, singular).
+
+    The 2D counterpart of ``sharded_jordan_invert``; same semantics
+    (condition-based pivoting, collective singularity agreement), but both
+    matrix axes are sharded so per-worker memory scales with 1/(pr·pc).
+    """
+    n = a.shape[-1]
+    pr, pc = mesh.devices.shape
+    lay = CyclicLayout2D.create(n, min(block_size, n), pr, pc)
+    W = scatter_augmented_2d(a, lay, mesh)
+    run = compile_sharded_jordan_2d(W, mesh, lay, eps, precision, use_pallas)
+    out, singular = run(W)
+    return gather_inverse_2d(out, lay, n), singular.any()

@@ -126,6 +126,61 @@ class CyclicLayout:
                 for s in range(self.blocks_per_worker)]
 
 
+@dataclass(frozen=True)
+class CyclicLayout2D:
+    """2D block-cyclic distribution over a (pr, pc) mesh — the ScaLAPACK
+    layout the 1D design can't reach: rows AND columns of the augmented
+    matrix are sharded, so per-worker memory is O(N·2N/(pr·pc)) instead of
+    the reference's full-width strips (main.cpp:366-370, the memory wall).
+
+    Block (i, j) lives on worker (i % pr, j % pc) at local slot
+    (i // pr, j // pc).  Local storage is (bpr, m, Wc): row blocks
+    worker-cyclic on axis 0, columns stored as bc2 chunks of m in cyclic
+    column-block order on axis 2 (local chunk u ↔ global column block
+    u*pc + kc).
+    """
+
+    n: int           # original matrix dimension
+    m: int           # block size
+    pr: int          # mesh rows
+    pc: int          # mesh cols
+    Nr: int          # padded block-row count (multiple of lcm(pr, pc))
+
+    @classmethod
+    def create(cls, n: int, m: int, pr: int, pc: int) -> "CyclicLayout2D":
+        Nr = num_block_rows(n, m)
+        g = math.lcm(pr, pc)
+        return cls(n=n, m=m, pr=pr, pc=pc, Nr=-(-Nr // g) * g)
+
+    @property
+    def N(self) -> int:
+        return self.Nr * self.m
+
+    @property
+    def bpr(self) -> int:
+        """Row blocks per worker."""
+        return self.Nr // self.pr
+
+    @property
+    def bc2(self) -> int:
+        """Augmented ([A|B]) column-block chunks per worker."""
+        return 2 * self.Nr // self.pc
+
+    @property
+    def bc1(self) -> int:
+        """Column-block chunks per worker for an unaugmented N-wide matrix."""
+        return self.Nr // self.pc
+
+    def col_perm(self, nblocks: int):
+        """Storage order of column blocks: worker-major, slot-minor."""
+        bpw = nblocks // self.pc
+        return [s * self.pc + kc for kc in range(self.pc) for s in range(bpw)]
+
+    def row_perm(self):
+        bpw = self.Nr // self.pr
+        return [s * self.pr + kr for kr in range(self.pr) for s in range(bpw)]
+
+
 def cyclic_gather_perm(layout: CyclicLayout) -> jnp.ndarray:
     """Permutation taking natural block order -> cyclic storage order."""
     return jnp.asarray(layout.cyclic_block_order(), dtype=jnp.int32)

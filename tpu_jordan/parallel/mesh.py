@@ -59,6 +59,29 @@ def make_mesh(num_workers: int | None = None, devices=None) -> Mesh:
     return Mesh(np.asarray(devices[:num_workers]), (AXIS,))
 
 
+AXIS_R = "pr"
+AXIS_C = "pc"
+
+
+def make_mesh_2d(pr: int, pc: int, devices=None) -> Mesh:
+    """A (pr, pc) mesh with axes ("pr", "pc") for the 2D block-cyclic
+    layout (ScaLAPACK-style; the north-star upgrade over the reference's
+    1D rows-only decomposition, main.cpp:118-123)."""
+    if pr <= 0 or pc <= 0:
+        raise MeshSizeError(f"mesh dims must be positive, got {pr}x{pc}")
+    if devices is None:
+        devices = jax.devices()
+    if pr * pc > len(devices):
+        raise MeshSizeError(
+            f"requested a {pr}x{pc} mesh ({pr * pc} workers) but only "
+            f"{len(devices)} device(s) exist "
+            f"(backend={jax.default_backend()!r})"
+        )
+    return Mesh(
+        np.asarray(devices[: pr * pc]).reshape(pr, pc), (AXIS_R, AXIS_C)
+    )
+
+
 def block_sharding(mesh: Mesh) -> NamedSharding:
     """Sharding for a (Nr, m, cols) block tensor in cyclic storage order:
     axis 0 split over workers = each worker holds its cyclic blocks
