@@ -5,62 +5,42 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Baseline (BASELINE.md): the reference MPI code inverts 4096x4096 fp64 at
 ~6.8 GFLOP/s on one CPU core (m=48, its best configuration).  We report
 GFLOP/s (2n^3 / wall) for the same n on one TPU chip and the speedup
-vs that 6.8 GFLOP/s.
+vs that 6.8 GFLOP/s.  The measured path is the in-place blocked
+Gauss-Jordan (ops/jordan_inplace.py) at the tuned block size m=128
+(benchmarks/PHASES.md) — same condition-based pivot rule as the reference.
 
 Timing methodology: this environment tunnels to the TPU with ~100ms RTT and
 a readback-pipelining quirk, so the inversion is repeated K times inside a
-single jitted fori_loop (data-dependent chaining, no host round trips) and
-a scalar is read back once; tunnel RTT is measured separately and
-subtracted.
+single jitted fori_loop (data-dependent chaining, no host round trips),
+a scalar is read back once, and the run is measured at two different K so
+constant offsets (RTT, dispatch) cancel in the slope.
 """
 
 import json
-import time
-
-import numpy as np
 
 
 def main():
-    import jax
     import jax.numpy as jnp
-    from jax import lax
 
-    from tpu_jordan.ops import block_jordan_invert, generate, residual_inf_norm
+    from tpu_jordan.ops import (
+        block_jordan_invert_inplace,
+        generate,
+        inf_norm,
+        residual_inf_norm,
+    )
+    from tpu_jordan.utils.benchmarking import slope_time
 
-    n, m, reps = 4096, 256, 4
+    n, m = 4096, 128
     baseline_gflops = 6.8  # BASELINE.md, 4096x4096 fp64, m=48, 1 CPU core
 
     a = generate("absdiff", (n, n), jnp.float32)
-
-    # Tunnel RTT calibration (scalar round trip).
-    tiny = jax.jit(lambda x: jnp.sum(x) * 0)
-    z = jnp.zeros((8, 8), jnp.float32)
-    np.asarray(tiny(z))
-    ts = []
-    for _ in range(7):
-        t0 = time.perf_counter()
-        np.asarray(tiny(z))
-        ts.append(time.perf_counter() - t0)
-    rtt = float(np.median(ts))
-
-    @jax.jit
-    def many(a):
-        def body(i, v):
-            inv, _ = block_jordan_invert(v, block_size=m)
-            return inv
-        return jnp.sum(lax.fori_loop(0, reps, body, a))
-
-    np.asarray(many(a))  # compile + warm
-    ts = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        np.asarray(many(a))
-        ts.append(time.perf_counter() - t0)
-    per_call = (float(np.median(ts)) - rtt) / reps
+    per_call = slope_time(
+        lambda v: block_jordan_invert_inplace(v, block_size=m)[0],
+        (a,), r1=8, r2=24,
+    )
 
     # Sanity: the result must be a real inverse.
-    inv, sing = block_jordan_invert(a, block_size=m)
-    from tpu_jordan.ops import inf_norm
+    inv, sing = block_jordan_invert_inplace(a, block_size=m)
     rel_res = float(residual_inf_norm(a, inv)) / float(inf_norm(a))
     assert not bool(sing), "benchmark matrix flagged singular"
     assert rel_res < 1e-3, f"benchmark inverse inaccurate: {rel_res}"

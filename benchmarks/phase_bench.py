@@ -1,0 +1,99 @@
+"""Per-phase cost breakdown of the single-chip blocked Jordan inversion.
+
+Times each phase of a super-step in isolation (same shapes as the full
+run) plus the full inversion.
+
+Timing method (tunnel-safe): the op is repeated inside one jitted
+``fori_loop`` with a *dynamic* trip count (one compile) and a real data
+dependency between iterations; each measurement runs at two trip counts
+and reports the slope (t(r2) - t(r1)) / (r2 - r1), so constant offsets —
+tunnel RTT, dispatch, readback — cancel exactly.
+
+Usage: python benchmarks/phase_bench.py [n] [m]
+Writes a markdown table to stdout; numbers live in benchmarks/PHASES.md.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tpu_jordan.utils.benchmarking import slope_time  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from tpu_jordan.ops import block_jordan_invert, generate
+    from tpu_jordan.ops.block_inverse import batched_block_inverse
+    from tpu_jordan.ops.pallas_block_inverse import (
+        pallas_batched_block_inverse,
+    )
+
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 4096
+    m = int(sys.argv[2]) if len(sys.argv) > 2 else 256
+    Nr = n // m
+    print(f"# n={n} m={m} Nr={Nr}")
+
+    rng = np.random.default_rng(0)
+    W = jnp.asarray(rng.standard_normal((n, 2 * n)), jnp.float32)
+    cands = jnp.asarray(rng.standard_normal((Nr, m, m)), jnp.float32)
+    H = jnp.asarray(rng.standard_normal((m, m)), jnp.float32)
+    E = jnp.asarray(rng.standard_normal((n, m)), jnp.float32)
+    prow = jnp.asarray(rng.standard_normal((m, 2 * n)), jnp.float32)
+
+    rows = []
+
+    def phase(name, fn, args):
+        t = slope_time(fn, args)
+        rows.append((name, t * 1e3, Nr * t))
+
+    phase("probe pallas (Nr,m,m)",
+          lambda c: pallas_batched_block_inverse(c)[0], (cands,))
+    phase("probe XLA (Nr,m,m)",
+          lambda c: batched_block_inverse(c, None, None)[0], (cands,))
+    phase("eliminate HIGHEST",
+          lambda W, E, p: W - jnp.matmul(
+              E, p, precision=lax.Precision.HIGHEST), (W, E, prow))
+    phase("eliminate HIGH",
+          lambda W, E, p: W - jnp.matmul(
+              E, p, precision=lax.Precision.HIGH), (W, E, prow))
+    phase("eliminate DEFAULT",
+          lambda W, E, p: W - jnp.matmul(
+              E, p, precision=lax.Precision.DEFAULT), (W, E, prow))
+
+    def slices(W):
+        col = lax.dynamic_slice(W, (0, 37 * 8), (n, m))
+        r1_ = lax.dynamic_slice(W, (5 * m, 0), (m, 2 * n))
+        W = lax.dynamic_update_slice(W, r1_, (2 * m, 0))
+        return W + 0 * jnp.sum(col)
+
+    phase("slice/update traffic", slices, (W,))
+    phase("normalize HIGHEST",
+          lambda H, r: jnp.matmul(H, r, precision=lax.Precision.HIGHEST),
+          (H, prow))
+
+    a = generate("absdiff", (n, n), jnp.float32)
+
+    def full(a):
+        inv, _ = block_jordan_invert(a, block_size=m)
+        return inv
+
+    full_t = slope_time(full, (a,), r1=2, r2=6)
+    rows.append(("FULL inversion", full_t * 1e3, full_t))
+
+    print("| phase | per-step (ms) | x Nr total (s) | % of full |")
+    print("|---|---|---|---|")
+    for name, per_ms, tot in rows:
+        print(f"| {name} | {per_ms:.2f} | {tot:.4f} | "
+              f"{100 * tot / full_t:.0f}% |")
+    gf = 2 * n**3 / full_t / 1e9
+    print(f"\nFULL: {full_t*1e3:.1f} ms = {gf:.0f} GFLOP/s (2n^3 convention)")
+
+
+if __name__ == "__main__":
+    main()

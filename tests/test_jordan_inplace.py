@@ -1,0 +1,70 @@
+"""In-place blocked GJ: parity with the augmented reference implementation
+(same pivot rule, same results to rounding) and with numpy."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_jordan.ops import block_jordan_invert, generate
+from tpu_jordan.ops.jordan_inplace import block_jordan_invert_inplace
+
+
+class TestInplaceJordan:
+    @pytest.mark.parametrize("n,m", [(32, 8), (64, 16), (50, 8), (48, 48)])
+    def test_matches_numpy(self, rng, n, m):
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float64)
+        inv, sing = block_jordan_invert_inplace(a, block_size=m)
+        assert not bool(sing)
+        np.testing.assert_allclose(
+            np.asarray(inv), np.linalg.inv(np.asarray(a)),
+            rtol=1e-9, atol=1e-9,
+        )
+
+    @pytest.mark.parametrize("gen", ["absdiff", "hilbert"])
+    def test_matches_augmented_reference(self, gen):
+        # Same pivot rule => same arithmetic path => results agree tightly.
+        n, m = 64, 8
+        a = generate(gen, (n, n), jnp.float64)
+        if gen == "hilbert":
+            a, n = generate(gen, (8, 8), jnp.float64), 8
+            inv_i, s_i = block_jordan_invert_inplace(a, block_size=2)
+            inv_a, s_a = block_jordan_invert(a, block_size=2)
+        else:
+            inv_i, s_i = block_jordan_invert_inplace(a, block_size=m)
+            inv_a, s_a = block_jordan_invert(a, block_size=m)
+        assert bool(s_i) == bool(s_a) is False
+        np.testing.assert_allclose(
+            np.asarray(inv_i), np.asarray(inv_a), rtol=1e-7, atol=1e-10
+        )
+
+    def test_pivoting_required(self):
+        # |i-j|: zero diagonal, inversion impossible without row pivoting.
+        a = generate("absdiff", (96, 96), jnp.float64)
+        inv, sing = block_jordan_invert_inplace(a, block_size=16)
+        assert not bool(sing)
+        res = np.max(np.abs(np.asarray(a) @ np.asarray(inv) - np.eye(96)))
+        assert res < 1e-8
+
+    def test_singular_flag(self):
+        _, sing = block_jordan_invert_inplace(
+            jnp.ones((32, 32), jnp.float64), block_size=8
+        )
+        assert bool(sing)
+
+    def test_refine(self, rng):
+        a = jnp.asarray(rng.standard_normal((64, 64)), jnp.float32)
+        inv, sing = block_jordan_invert_inplace(a, block_size=16, refine=2)
+        assert not bool(sing)
+        res = np.max(np.abs(np.asarray(a, np.float64)
+                            @ np.asarray(inv, np.float64) - np.eye(64)))
+        assert res < 1e-3
+
+    def test_single_block(self, rng):
+        a = jnp.asarray(rng.standard_normal((16, 16)), jnp.float64)
+        inv, sing = block_jordan_invert_inplace(a, block_size=16)
+        assert not bool(sing)
+        np.testing.assert_allclose(
+            np.asarray(inv), np.linalg.inv(np.asarray(a)),
+            rtol=1e-9, atol=1e-9,
+        )

@@ -46,9 +46,15 @@ def default_block_size(n: int) -> int:
     fast path needs m % 3 == 0 (main.cpp:158).  On TPU the analogous
     constraint is alignment to the 128-lane MXU tile, so we pick multiples
     of 128 (or small powers of two below that for tiny problems).
+
+    Measured on v5e (benchmarks/PHASES.md): m=128 is the throughput sweet
+    spot up to n=4096 (probe cost scales with n²·m, so smaller blocks win);
+    n ≥ 8192 needs m=512 at fp32 — smaller pivot blocks push the late
+    Schur-complement pivots under the fp32 noise floor on ill-conditioned
+    fixtures and the probe (correctly) flags them singular.
     """
-    if n >= 2048:
-        return 256
+    if n >= 8192:
+        return 512
     if n >= 512:
         return 128
     if n >= 128:

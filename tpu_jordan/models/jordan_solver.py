@@ -17,7 +17,7 @@ import jax
 import jax.numpy as jnp
 
 from ..config import default_block_size
-from ..ops import block_jordan_invert, residual_inf_norm
+from ..ops import residual_inf_norm
 
 
 @dataclass
@@ -53,7 +53,9 @@ class JordanSolver:
                 a, self._get_mesh(), self.block_size
             )
         else:
-            self._run = block_jordan_invert.lower(
+            from ..driver import single_device_invert
+
+            self._run = single_device_invert(self.n, self.block_size).lower(
                 a, block_size=self.block_size, refine=self.refine
             ).compile()
 

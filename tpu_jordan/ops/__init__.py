@@ -2,6 +2,7 @@ from .batched import batched_jordan_invert
 from .block_inverse import batched_block_inverse, gauss_jordan_inverse
 from .generators import GENERATORS, abs_diff, generate, hilbert, identity
 from .jordan import block_jordan_invert
+from .jordan_inplace import block_jordan_invert_inplace
 from .norms import block_inf_norms, inf_norm
 from .padding import pad_with_identity, unpad
 from .refine import newton_schulz
@@ -14,6 +15,7 @@ __all__ = [
     "batched_jordan_invert",
     "block_inf_norms",
     "block_jordan_invert",
+    "block_jordan_invert_inplace",
     "gauss_jordan_inverse",
     "generate",
     "hilbert",

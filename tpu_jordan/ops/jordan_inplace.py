@@ -1,0 +1,125 @@
+"""In-place blocked Gauss–Jordan inversion: the single-chip speed path.
+
+Same algorithm semantics as ``ops/jordan.py::block_jordan_invert`` — the
+condition-based block pivoting of the reference's ``Jordan``
+(main.cpp:953-1204), identical pivot choices — but storing only the N×N
+working matrix instead of the augmented [A | B]:
+
+  * the classic in-place Gauss–Jordan update: at step t the eliminated
+    column block is *replaced* by the inverse-building column
+    (``V[:,t] ← −E·H``, ``V[t,t] ← H``), so no B half exists.  Total flops
+    drop from ~4N³ (augmented full-width sweeps) to ~2N³, and per-step HBM
+    traffic halves — both measured as the dominant costs of the augmented
+    version (benchmarks/PHASES.md).
+  * the loop over block columns is UNROLLED (Python loop, one jit trace):
+    every slice offset is static, and the pivot probe at step t inverts
+    only the ``Nr − t`` remaining candidate rows instead of masking all
+    ``Nr`` — half the probe work on average, the other measured hot spot.
+    The reference probes exactly this window too (``i >= start_row``,
+    main.cpp:1039).
+  * row pivoting is physical swaps (as in the reference); in the in-place
+    form the final inverse needs the row-swap history replayed as *column*
+    swaps in reverse order (standard in-place GJ bookkeeping, no reference
+    analog because the reference carries B explicitly).
+
+The augmented ``block_jordan_invert`` remains the reference
+implementation (arbitrary Nr without unrolled-compile cost, global_scale
+parity mode) and the basis of the sharded paths.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import default_block_size, eps_for
+from .block_inverse import batched_block_inverse
+from .jordan import _use_pallas_default
+from .norms import block_inf_norms
+from .padding import pad_with_identity, unpad
+from .refine import newton_schulz
+
+
+@partial(jax.jit, static_argnames=(
+    "block_size", "eps", "precision", "refine", "use_pallas"))
+def block_jordan_invert_inplace(
+    a: jnp.ndarray,
+    block_size: int | None = None,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    refine: int = 0,
+    use_pallas: bool | None = None,
+):
+    """Invert ``a`` by in-place blocked Gauss–Jordan with condition-based
+    pivoting.  Drop-in for ``block_jordan_invert`` (same pivot rule, same
+    (inv, singular) contract); ~2x fewer flops and ~2x less memory
+    traffic.  Compile cost scales with Nr (unrolled) — intended for the
+    headline configurations (Nr ≲ 64)."""
+    n = a.shape[-1]
+    dtype = a.dtype
+    if block_size is None:
+        block_size = default_block_size(n)
+    m = min(block_size, n)
+    if eps is None:
+        probe_dt = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
+        eps = eps_for(probe_dt)
+    Nr = -(-n // m)
+    N = Nr * m
+    V = pad_with_identity(a, N)
+    if use_pallas is None:
+        use_pallas = _use_pallas_default(dtype) and m % 8 == 0 and m >= 32
+    probe_dtype = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
+
+    singular = jnp.asarray(False)
+    rswaps = []
+    for t in range(Nr):
+        nc = Nr - t
+        # --- PROBE the remaining candidate rows only (main.cpp:1039).
+        cands = lax.slice(V, (t * m, t * m), (N, (t + 1) * m))
+        cands = cands.reshape(nc, m, m).astype(probe_dtype)
+        if use_pallas:
+            from .pallas_block_inverse import pallas_batched_block_inverse
+
+            invs, sing = pallas_batched_block_inverse(cands, eps)
+        else:
+            invs, sing = batched_block_inverse(cands, None, eps)
+        key = jnp.where(sing, jnp.asarray(jnp.inf, probe_dtype),
+                        block_inf_norms(invs))
+        rel = jnp.argmin(key)                     # ties -> lowest row
+        singular = singular | jnp.all(sing)
+        H = jnp.take(invs, rel, axis=0).astype(dtype)
+        piv = t + rel
+
+        # --- SWAP block rows t <-> piv (swap-by-copy, main.cpp:1093-1131).
+        rows_t = lax.slice(V, (t * m, 0), ((t + 1) * m, N))
+        rows_p = lax.dynamic_slice(V, (piv * m, 0), (m, N))
+        V = lax.dynamic_update_slice(V, rows_t, (piv * m, 0))
+
+        # --- NORMALIZE + ELIMINATE, in place: B never exists.  The
+        # eliminated column must become the inverse-building column −E·H
+        # (H on the pivot row); setting prow's t-block to H and zeroing
+        # V's t-column first folds that into the one big matmul
+        # (V[:,t] − E·H = −E·H), so no separate column-fix GEMM exists.
+        prow = jnp.matmul(H, rows_p, precision=precision)       # (m, N)
+        prow = prow.at[:, t * m:(t + 1) * m].set(H)
+        E = lax.slice(V, (0, t * m), (N, (t + 1) * m))          # (N, m)
+        E = E.at[t * m:(t + 1) * m].set(jnp.asarray(0, dtype))
+        V = V.at[:, t * m:(t + 1) * m].set(jnp.asarray(0, dtype))
+        V = V - jnp.matmul(E, prow, precision=precision)
+        V = V.at[t * m:(t + 1) * m, :].set(prow)
+        rswaps.append(piv)
+
+    # --- Unscramble: replay row swaps as column swaps in reverse.
+    for t in reversed(range(Nr)):
+        piv = rswaps[t]
+        col_t = lax.slice(V, (0, t * m), (N, (t + 1) * m))
+        col_p = lax.dynamic_slice(V, (0, piv * m), (N, m))
+        V = lax.dynamic_update_slice(V, col_t, (0, piv * m))
+        V = V.at[:, t * m:(t + 1) * m].set(col_p)
+
+    x = unpad(V, n)
+    x = newton_schulz(a, x, refine, precision)
+    return x, singular

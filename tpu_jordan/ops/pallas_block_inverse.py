@@ -148,8 +148,23 @@ def pallas_batched_block_inverse(
     if eps is None:
         eps = eps_for(jnp.float32)
     blocks = blocks.astype(jnp.float32)
-    cg = _chunk_candidates(Nr, m)
-    grid = (Nr // cg,)
+    # Mosaic rejects some small-stack shapes ("Not implemented: Sublane
+    # broadcast" — measured on v5e: cg=1 with m<=256 fails; cg>=2, and
+    # cg=1 with m=512, compile fine).  Padding the stack to a multiple of
+    # 8 with identity blocks (well-conditioned, flags False) keeps cg >= 8
+    # whenever the VMEM cap allows (m <= 256) and cg >= 2 at m = 512; the
+    # outputs are sliced back.  The shrinking-window probe
+    # (ops/jordan_inplace.py) hits every count from Nr down to 1.
+    Nr_pad = max(8, -(-Nr // 8) * 8)
+    if Nr_pad != Nr:
+        eyes = jnp.broadcast_to(jnp.eye(m, dtype=jnp.float32),
+                                (Nr_pad - Nr, m, m))
+        blocks = jnp.concatenate([blocks, eyes], axis=0)
+    cg = _chunk_candidates(Nr_pad, m)
+    # Known-bad Mosaic region (see comment above); unreachable with the
+    # default _W_BUDGET, but guard against shrunken budgets.
+    assert cg >= 2 or m > 256, (cg, m)
+    grid = (Nr_pad // cg,)
 
     inv = pl.pallas_call(
         functools.partial(_gj_probe_kernel, m=m, eps=eps),
@@ -160,9 +175,10 @@ def pallas_batched_block_inverse(
         ],
         out_specs=pl.BlockSpec((cg, m, m), lambda i: (i, 0, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((Nr, m, m), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((Nr_pad, m, m), jnp.float32),
         scratch_shapes=[pltpu.VMEM((cg, m, 2 * m), jnp.float32)],
         interpret=interpret,
     )(blocks)
+    inv = inv[:Nr]
     sing = ~jnp.isfinite(inv).all(axis=(1, 2))
     return inv, sing

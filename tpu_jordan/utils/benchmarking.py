@@ -1,0 +1,50 @@
+"""Tunnel-safe TPU timing.
+
+This environment reaches the TPU through a tunnel with ~100ms RTT and a
+readback-pipelining quirk (small ops can hide entirely inside the RTT
+window).  Trustworthy method: repeat the op inside ONE jitted ``fori_loop``
+with a *dynamic* trip count (one compile serves every rep count) and a
+real data dependency between iterations (so XLA cannot hoist the body),
+read back a scalar, and measure at two trip counts — the reported
+per-iteration time is the slope, so every constant offset (RTT, dispatch,
+readback) cancels exactly.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def slope_time(fn, args, r1: int = 4, r2: int = 12, trials: int = 3):
+    """Per-iteration seconds of ``fn(*args)``, constant offsets cancelled.
+
+    ``fn`` must return an array; its sum is folded back into ``args[0]``
+    (times 1e-30) to chain iterations without changing the computation.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    @jax.jit
+    def many(reps, *args):
+        def body(i, carry):
+            out = fn(*carry)
+            a0 = carry[0] + (1e-30 * jnp.sum(out)).astype(carry[0].dtype)
+            return (a0,) + carry[1:]
+
+        final = lax.fori_loop(0, reps, body, args)
+        return jnp.sum(final[0])
+
+    def measure(reps):
+        np.asarray(many(reps, *args))   # warm (absorbs compile on 1st call)
+        ts = []
+        for _ in range(trials):
+            t0 = time.perf_counter()
+            np.asarray(many(reps, *args))
+            ts.append(time.perf_counter() - t0)
+        return float(np.min(ts))
+
+    t1, t2 = measure(r1), measure(r2)
+    return (t2 - t1) / (r2 - r1)
