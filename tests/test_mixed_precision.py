@@ -1,0 +1,101 @@
+"""Mixed-precision policy: HIGH sweeps + HIGHEST refinement, bf16 dtype
+support, and the precision plumbing through driver/solver/CLI.
+
+Note: on CPU every Precision level is computed identically, so these tests
+pin the *plumbing and contract*; the accuracy ladder itself is measured on
+TPU and recorded in benchmarks/PHASES.md.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_jordan.driver import solve
+from tpu_jordan.models import JordanSolver
+from tpu_jordan.ops import (
+    block_jordan_invert,
+    block_jordan_invert_inplace,
+    generate,
+    inf_norm,
+    residual_inf_norm,
+)
+from tpu_jordan.ops.refine import resolve_precision
+
+
+def test_resolve_precision_mixed():
+    from jax import lax
+
+    p, r = resolve_precision("mixed", 0)
+    assert p == lax.Precision.HIGH and r == 2
+    p, r = resolve_precision("mixed", 5)
+    assert p == lax.Precision.HIGH and r == 5
+    p, r = resolve_precision(lax.Precision.HIGHEST, 1)
+    assert p == lax.Precision.HIGHEST and r == 1
+
+
+@pytest.mark.parametrize("fn", [block_jordan_invert,
+                                block_jordan_invert_inplace])
+def test_mixed_inverts_accurately(rng, fn):
+    a = jnp.asarray(rng.standard_normal((64, 64)), jnp.float32)
+    inv, sing = fn(a, block_size=16, precision="mixed")
+    assert not bool(sing)
+    rel = float(residual_inf_norm(a, inv)) / float(inf_norm(a))
+    assert rel < 1e-5
+
+
+def test_solve_mixed_single_device():
+    res = solve(n=96, block_size=16, precision="mixed")
+    assert res.residual / (96 * 96 / 2) < 1e-5
+
+
+def test_solve_mixed_distributed():
+    res = solve(n=96, block_size=8, workers=4, precision="mixed")
+    assert res.residual / (96 * 96 / 2) < 1e-5
+
+
+def test_solve_mixed_2d():
+    res = solve(n=96, block_size=8, workers=(2, 2), precision="mixed")
+    assert res.residual / (96 * 96 / 2) < 1e-5
+
+
+def test_solver_mixed_forces_refine():
+    s = JordanSolver(n=32, precision="mixed")
+    assert s.refine == 2
+
+
+def test_bfloat16_dtype_end_to_end(rng):
+    # bf16 storage: the probe upcasts to fp32 internally; the result comes
+    # back in bf16.  Accuracy is bf16-grade — assert the loose bound.
+    a = jnp.asarray(rng.standard_normal((64, 64)), jnp.bfloat16)
+    inv, sing = block_jordan_invert(a, block_size=16, refine=2)
+    assert inv.dtype == jnp.bfloat16
+    assert not bool(sing)
+    af = np.asarray(a, np.float64)
+    rel = (np.max(np.sum(np.abs(af @ np.asarray(inv, np.float64)
+                                 - np.eye(64)), axis=1))
+           / np.max(np.sum(np.abs(af), axis=1)))
+    assert rel < 0.1
+
+
+def test_bfloat16_distributed_computes_fp32(rng):
+    # Distributed sub-fp32 must follow the same fp32-compute policy as
+    # the single-device kernels; result comes back bf16-rounded with an
+    # honest (post-rounding) residual.
+    res = solve(n=64, block_size=8, workers=4, dtype=jnp.bfloat16)
+    assert res.inverse.dtype == jnp.bfloat16
+    af = np.asarray(generate("absdiff", (64, 64), jnp.float32), np.float64)
+    rel = res.residual / np.max(np.sum(np.abs(af), axis=1))
+    assert rel < 0.1
+
+
+def test_mixed_gather_false_rejected():
+    with pytest.raises(ValueError, match="mixed"):
+        solve(n=64, block_size=8, workers=4, precision="mixed",
+              gather=False)
+
+
+def test_cli_precision_flag():
+    from tpu_jordan.__main__ import main
+
+    assert main(["64", "16", "--precision", "mixed", "--quiet"]) == 0

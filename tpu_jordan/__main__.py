@@ -32,7 +32,13 @@ def main(argv=None) -> int:
     ap.add_argument("m", type=int, help="pivot block size")
     ap.add_argument("file", nargs="?", default=None, help="matrix file")
     ap.add_argument("--dtype", default="float32",
-                    choices=["float32", "float64"])
+                    choices=["float32", "float64", "bfloat16"])
+    ap.add_argument("--precision", default="highest",
+                    choices=["highest", "high", "default", "mixed"],
+                    help="matmul precision for the elimination sweeps; "
+                         "'mixed' = HIGH sweeps + >=2 HIGHEST "
+                         "Newton-Schulz refinement steps "
+                         "(benchmarks/PHASES.md)")
     ap.add_argument("--generator", default="absdiff",
                     choices=["absdiff", "hilbert"],
                     help="matrix generator when no file is given "
@@ -94,6 +100,7 @@ def main(argv=None) -> int:
             refine=args.refine,
             workers=args.workers,
             verbose=not args.quiet,
+            precision=args.precision,
         )
     except FileNotFoundError:
         print(f"cannot open {args.file}")

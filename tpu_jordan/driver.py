@@ -29,6 +29,11 @@ from .io import read_matrix_file
 from .ops import generate, residual_inf_norm
 
 
+from jax import lax as _lax
+
+from .ops.refine import PRECISIONS as _PRECISIONS
+
+
 class SingularMatrixError(ArithmeticError):
     """No block column had an invertible pivot candidate — the reference's
     collective "singular matrix" exit (main.cpp:1075-1083, 435-437)."""
@@ -57,6 +62,7 @@ def solve(
     device=None,
     verbose: bool = False,
     gather: bool = True,
+    precision: str = "highest",
 ) -> SolveResult:
     """Invert an n x n matrix from a file or a generator and verify it.
 
@@ -73,11 +79,17 @@ def solve(
     inverse too stays as sharded cyclic blocks (``result.inverse_blocks``
     + ``result.layout``), the memory-scaling mode for north-star sizes.
 
+    ``precision``: "highest" (default, fp32-faithful products), "high"
+    (bf16x3 products), or "mixed" (HIGH sweeps + ≥2 HIGHEST Newton–Schulz
+    steps — ~2.7x cheaper sweeps for well-scaled matrices; see
+    benchmarks/PHASES.md for the measured accuracy ladder).
+
     Raises SingularMatrixError like the reference's -2 path
     (main.cpp:435-437); file errors propagate from read_matrix_file.
     """
     if block_size is None:
         block_size = default_block_size(n)
+    prec = _PRECISIONS[precision]
 
     def load():
         if file is not None:
@@ -85,15 +97,21 @@ def solve(
             return jax.device_put(jnp.asarray(host, dtype), device)
         return jax.device_put(generate(generator, (n, n), dtype), device)
 
-    if isinstance(workers, tuple):
+    if isinstance(workers, tuple) or workers > 1:
+        from .ops.refine import resolve_precision
+
+        if precision == "mixed" and not gather:
+            raise ValueError(
+                "precision='mixed' requires gather=True: it implies >=2 "
+                "Newton-Schulz steps, which run on the gathered inverse"
+            )
+        sweep_prec, refine = resolve_precision(prec, refine)
+        be = (_Dist2D(workers, n, min(block_size, n))
+              if isinstance(workers, tuple)
+              else _Dist1D(workers, n, min(block_size, n)))
         return _solve_distributed_core(
-            _Dist2D(workers, n, min(block_size, n)), n, block_size, file,
-            generator, dtype, refine, verbose, gather, load,
-        )
-    if workers > 1:
-        return _solve_distributed_core(
-            _Dist1D(workers, n, min(block_size, n)), n, block_size, file,
-            generator, dtype, refine, verbose, gather, load,
+            be, n, block_size, file, generator, dtype, refine, verbose,
+            gather, load, sweep_prec,
         )
 
     if not gather:
@@ -112,7 +130,7 @@ def solve(
     # AOT-compile so the timed call measures the executable alone
     # without running the O(n^3) inversion twice.
     compiled = single_device_invert(n, block_size).lower(
-        a, block_size=block_size, refine=refine
+        a, block_size=block_size, refine=refine, precision=prec
     ).compile()
     t0 = time.perf_counter()
     inv, singular = compiled(a)
@@ -185,10 +203,11 @@ class _Dist1D:
 
         return scatter_augmented(a, self.lay, self.mesh)
 
-    def compile(self, W):
+    def compile(self, W, precision=_lax.Precision.HIGHEST):
         from .parallel.sharded_jordan import compile_sharded_jordan
 
-        return compile_sharded_jordan(W, self.mesh, self.lay)
+        return compile_sharded_jordan(W, self.mesh, self.lay,
+                                      precision=precision)
 
     def gather(self, out, n):
         from .parallel.sharded_jordan import gather_inverse
@@ -238,10 +257,11 @@ class _Dist2D:
 
         return scatter_augmented_2d(a, self.lay, self.mesh)
 
-    def compile(self, W):
+    def compile(self, W, precision=_lax.Precision.HIGHEST):
         from .parallel.jordan2d import compile_sharded_jordan_2d
 
-        return compile_sharded_jordan_2d(W, self.mesh, self.lay)
+        return compile_sharded_jordan_2d(W, self.mesh, self.lay,
+                                         precision=precision)
 
     def gather(self, out, n):
         from .parallel.jordan2d import gather_inverse_2d
@@ -274,6 +294,7 @@ class _Dist2D:
 def _solve_distributed_core(
     be, n: int, block_size: int, file, generator: str, dtype,
     refine: int, verbose: bool, gather: bool, load,
+    precision=_lax.Precision.HIGHEST,
 ):
     """The one distributed solve skeleton, shared by the 1D and 2D
     layouts via the backend adapter ``be``.
@@ -295,11 +316,18 @@ def _solve_distributed_core(
     if not gather and file is not None:
         raise ValueError("gather=False requires generator input")
 
+    # Sub-fp32 storage dtypes compute in fp32 and round once at the end —
+    # the same policy as the single-device kernels (ops/jordan.py): bf16
+    # elimination state is measured divergent.
+    in_dtype = jnp.dtype(dtype)
+    if in_dtype.itemsize < 4:
+        dtype = jnp.float32
+
     a_host = None
     if file is None:
         W = be.generate_W(generator, dtype)
     else:
-        a_host = load()
+        a_host = jnp.asarray(load(), dtype)
         W = be.scatter_W(a_host)
     if verbose:
         from .utils.printing import print_corner
@@ -309,7 +337,7 @@ def _solve_distributed_core(
                      else generate(generator, (min(n, 10), min(n, 10)),
                                    dtype))
 
-    run = be.compile(W)
+    run = be.compile(W, precision)
     t0 = time.perf_counter()
     out, singular = run(W)
     jax.block_until_ready(out)
@@ -319,18 +347,26 @@ def _solve_distributed_core(
 
     inv = be.gather(out, n) if gather else None
     inv_b = None if (gather and refine) else be.inv_blocks(out)
+    # Round to the storage dtype BEFORE verification, so the reported
+    # residual reflects what the caller actually receives.
+    if in_dtype != dtype:
+        inv = None if inv is None else inv.astype(in_dtype)
+        inv_b = None if inv_b is None else inv_b.astype(in_dtype)
     # Verification source is always *fresh* (re-read / regenerated), never
     # algorithm state — the reference's reload semantics (main.cpp:463-488).
     if refine:
         a_full = load() if file is not None else generate(
             generator, (n, n), dtype
         )
-        inv = newton_schulz(a_full, inv, refine)
+        a_full = jnp.asarray(a_full, dtype)
+        inv = newton_schulz(a_full, jnp.asarray(inv, dtype), refine)
         residual = float(residual_inf_norm(a_full, inv))
+        inv = inv.astype(in_dtype)
     else:
-        a_b = (be.scatter_a_blocks(load()) if file is not None
+        a_b = (be.scatter_a_blocks(jnp.asarray(load(), dtype))
+               if file is not None
                else be.generate_a_blocks(generator, dtype))
-        residual = float(be.residual(a_b, inv_b))
+        residual = float(be.residual(a_b, jnp.asarray(inv_b, dtype)))
 
     if verbose:
         print(f"glob_time: {elapsed:.2f}")

@@ -30,6 +30,7 @@ class JordanSolver:
       dtype: working dtype (fp32 on TPU, fp64 on CPU).
       refine: Newton–Schulz steps applied to every solve.
       workers: >1 distributes over a 1D mesh (``parallel.make_mesh``).
+      precision: "highest" | "high" | "default" | "mixed" (driver.solve).
     """
 
     n: int
@@ -37,26 +38,36 @@ class JordanSolver:
     dtype: Any = jnp.float32
     refine: int = 0
     workers: int = 1
+    precision: str = "highest"
     _run: Any = field(default=None, repr=False)
     _lay: Any = field(default=None, repr=False)
     _mesh: Any = field(default=None, repr=False)
 
     def __post_init__(self):
+        from ..ops.refine import PRECISIONS, resolve_precision
+
         if self.block_size is None:
             self.block_size = default_block_size(self.n)
+        # Resolve the precision policy once: "mixed" implies HIGH sweeps
+        # and bumps refine to the policy minimum.
+        self._sweep_prec, self.refine = resolve_precision(
+            PRECISIONS[self.precision], self.refine
+        )
 
     def _compile(self, a):
         if self.workers > 1:
             from ..parallel.sharded_jordan import prepare_sharded_invert
 
             _, self._lay, self._run = prepare_sharded_invert(
-                a, self._get_mesh(), self.block_size
+                a, self._get_mesh(), self.block_size,
+                precision=self._sweep_prec,
             )
         else:
             from ..driver import single_device_invert
 
             self._run = single_device_invert(self.n, self.block_size).lower(
-                a, block_size=self.block_size, refine=self.refine
+                a, block_size=self.block_size, refine=self.refine,
+                precision=self._sweep_prec,
             ).compile()
 
     def _get_mesh(self):

@@ -32,15 +32,17 @@ Design (TPU-first, per SURVEY.md §7 — not a translation):
     some column is invertible, main.cpp:1075-1083), returned to the host —
     never a mid-graph abort.
 
-Precision policy (measured on v5e): Gauss–Jordan inversion needs faithful
-fp32 products — with bf16-input matmuls (Precision.DEFAULT) the elimination
-error compounds to rel. residual ~35 at n=1024 even on well-conditioned
-random matrices, and bf16x3 (HIGH) still lands at ~3; HIGHEST (bf16x6,
-fp32-faithful) gives ~1e-5.  Runtime is dominated by the pivot probe, not
-the sweeps, so lower precision buys no speed either.  Supported working
-dtypes are therefore fp32 (TPU, optionally + Newton refinement) and fp64
-(CPU); sub-fp32 inputs still run but the probe is internally upcast to
-fp32 and results carry bf16-level accuracy at best.
+Precision policy (measured on v5e, full ladder in benchmarks/PHASES.md):
+Gauss–Jordan elimination needs faithful fp32 products on badly scaled
+fixtures — sub-fp32 products (DEFAULT/HIGH) lose the O(1) Schur
+complements of the O(n²)-magnitude |i−j| matrix outright and the probe
+then (correctly) flags the noise singular.  HIGHEST is therefore the
+default; ``precision="mixed"`` (HIGH sweeps + ≥2 HIGHEST Newton–Schulz
+steps, ops/refine.py) is the opt-in for well-scaled problems where ~2.7x
+cheaper sweeps are worth it.  Sub-fp32 *storage* dtypes (bf16/fp16) are
+supported as in/out formats: compute runs in fp32 and the result is
+rounded once at the end — carrying bf16 state through the elimination
+compounds a rounding injection per step and is measured divergent.
 """
 
 from __future__ import annotations
@@ -55,7 +57,7 @@ from ..config import default_block_size, eps_for
 from .block_inverse import batched_block_inverse
 from .norms import block_inf_norms, inf_norm
 from .padding import pad_with_identity, unpad
-from .refine import newton_schulz
+from .refine import newton_schulz, resolve_precision
 
 
 def _jordan_step(t, carry, *, Nr: int, m: int, eps: float, precision,
@@ -76,11 +78,10 @@ def _jordan_step(t, carry, *, Nr: int, m: int, eps: float, precision,
     # relative threshold; `global_scale=True` restores exact reference
     # semantics (use with fp64).  For block_size == n the two coincide.
     col_t = lax.dynamic_slice(W, (0, t * m), (N, m))            # (N, m)
+    # Sub-fp32 inputs were upcast at entry, so the probe dtype is the
+    # working dtype (fp32/fp64).
     cands = col_t.reshape(Nr, m, m)
-    # The probe always runs in fp32+: inverting blocks in bf16 destroys the
-    # condition estimate (mixed precision = bf16 bulk updates, fp32 probe).
-    probe_dtype = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
-    cands = cands.astype(probe_dtype)
+    probe_dtype = dtype
     if use_pallas:
         from .pallas_block_inverse import pallas_batched_block_inverse
 
@@ -170,16 +171,26 @@ def block_jordan_invert(
       (inv, singular): the inverse (garbage if singular) and a bool flag —
       the analog of Jordan's -2 return (main.cpp:1075-1083).
     """
+    precision, refine = resolve_precision(precision, refine)
     n = a.shape[-1]
+    in_dtype = a.dtype
+    if jnp.dtype(in_dtype).itemsize < 4:
+        # Sub-fp32 storage (bf16/fp16): compute in fp32, round the result
+        # back.  Carrying the elimination itself in bf16 compounds a
+        # rounding injection per super-step and Newton–Schulz cannot
+        # converge on bf16 state — measured divergent.  fp32 compute +
+        # one final rounding is the standard param/compute-dtype split.
+        x, singular = block_jordan_invert(
+            a.astype(jnp.float32), block_size, eps, precision, refine,
+            global_scale, use_pallas,
+        )
+        return x.astype(in_dtype), singular
     dtype = a.dtype
     if block_size is None:
         block_size = default_block_size(n)
     m = min(block_size, n)
     if eps is None:
-        # The probe runs in fp32 for sub-fp32 working dtypes, so the
-        # threshold scales with the probe's precision, not the storage's.
-        probe_dt = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
-        eps = eps_for(probe_dt)
+        eps = eps_for(dtype)
 
     # Relative scale for every singularity test: ‖A‖∞ of the *unpadded*
     # input, computed once — the reference's norm_a (main.cpp:972, 1046).
@@ -206,5 +217,5 @@ def block_jordan_invert(
         0, Nr, step, (W, norm_a, jnp.asarray(False))
     )
     x = unpad(W[:, N:], n)
-    x = newton_schulz(a, x, refine, precision)
+    x = newton_schulz(a, x, refine, lax.Precision.HIGHEST)
     return x, singular

@@ -40,7 +40,7 @@ from .block_inverse import batched_block_inverse
 from .jordan import _use_pallas_default
 from .norms import block_inf_norms
 from .padding import pad_with_identity, unpad
-from .refine import newton_schulz
+from .refine import newton_schulz, resolve_precision
 
 
 @partial(jax.jit, static_argnames=(
@@ -57,21 +57,34 @@ def block_jordan_invert_inplace(
     pivoting.  Drop-in for ``block_jordan_invert`` (same pivot rule, same
     (inv, singular) contract); ~2x fewer flops and ~2x less memory
     traffic.  Compile cost scales with Nr (unrolled) — intended for the
-    headline configurations (Nr ≲ 64)."""
+    headline configurations (Nr ≲ 64).
+
+    ``precision="mixed"`` runs the sweeps at HIGH + ≥2 HIGHEST
+    Newton–Schulz steps (see ops/refine.py::resolve_precision).
+    """
+    precision, refine = resolve_precision(precision, refine)
     n = a.shape[-1]
+    in_dtype = a.dtype
+    if jnp.dtype(in_dtype).itemsize < 4:
+        # Same sub-fp32 policy as block_jordan_invert: fp32 compute, one
+        # final rounding back to the storage dtype.
+        x, singular = block_jordan_invert_inplace(
+            a.astype(jnp.float32), block_size, eps, precision, refine,
+            use_pallas,
+        )
+        return x.astype(in_dtype), singular
     dtype = a.dtype
     if block_size is None:
         block_size = default_block_size(n)
     m = min(block_size, n)
     if eps is None:
-        probe_dt = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
-        eps = eps_for(probe_dt)
+        eps = eps_for(dtype)
     Nr = -(-n // m)
     N = Nr * m
     V = pad_with_identity(a, N)
     if use_pallas is None:
         use_pallas = _use_pallas_default(dtype) and m % 8 == 0 and m >= 32
-    probe_dtype = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
+    probe_dtype = dtype
 
     singular = jnp.asarray(False)
     rswaps = []
@@ -121,5 +134,7 @@ def block_jordan_invert_inplace(
         V = V.at[:, t * m:(t + 1) * m].set(col_p)
 
     x = unpad(V, n)
-    x = newton_schulz(a, x, refine, precision)
+    # Refinement always runs at HIGHEST: its whole job is recovering the
+    # accuracy a cheaper sweep precision gave up.
+    x = newton_schulz(a, x, refine, lax.Precision.HIGHEST)
     return x, singular

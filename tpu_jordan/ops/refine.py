@@ -17,6 +17,34 @@ import jax.numpy as jnp
 from jax import lax
 
 
+# Public name -> policy map for the string API (driver.solve --precision).
+PRECISIONS = {
+    "highest": lax.Precision.HIGHEST,
+    "high": lax.Precision.HIGH,
+    "default": lax.Precision.DEFAULT,
+    "mixed": "mixed",
+}
+
+
+def resolve_precision(precision, refine: int):
+    """Resolve a precision policy to (sweep_precision, refine_steps).
+
+    ``"mixed"`` = elimination sweeps at ``Precision.HIGH`` (bf16x3
+    products, fp32 accumulation) + at least two Newton–Schulz steps at
+    HIGHEST; the pivot probe stays fp32 regardless.  Measured verdict
+    (benchmarks/PHASES.md): a NET LOSS for inversion — one NS step is
+    4n³ flops, 2x the entire 2n³ elimination, so cheaper sweeps can
+    never pay for their own repair; and on badly scaled matrices
+    (|i−j| at n ≥ 1024) sub-fp32 products lose the Schur complements
+    outright and the probe flags the matrix singular.  Kept as an
+    opt-in for experimentation; HIGHEST is the default and is both the
+    fastest-to-accuracy and the most robust policy.
+    """
+    if precision == "mixed":
+        return lax.Precision.HIGH, max(refine, 2)
+    return precision, refine
+
+
 def newton_schulz(
     a: jnp.ndarray,
     x: jnp.ndarray,
