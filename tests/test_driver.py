@@ -125,3 +125,22 @@ class TestCLI:
         r = run_cli("4", "4", str(path), "--dtype", "float64")
         assert r.returncode == 2
         assert "singular matrix" in r.stdout
+
+    def test_float16_exit_0(self):
+        # fp16 storage dtype is a first-class CLI surface (config.py has its
+        # EPS); computes in fp32 and rounds once, like bfloat16.
+        from tpu_jordan.__main__ import main
+
+        assert main(["32", "8", "--dtype", "float16", "--quiet"]) == 0
+
+    def test_no_gather_single_device_exit_1(self):
+        # gather=False requires a distributed generator run -> usage error.
+        from tpu_jordan.__main__ import main
+
+        assert main(["32", "8", "--no-gather", "--quiet"]) == 1
+
+    def test_no_gather_distributed_exit_0(self):
+        from tpu_jordan.__main__ import main
+
+        assert main(["64", "8", "--workers", "4", "--no-gather",
+                     "--quiet"]) == 0

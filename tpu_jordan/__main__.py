@@ -32,7 +32,7 @@ def main(argv=None) -> int:
     ap.add_argument("m", type=int, help="pivot block size")
     ap.add_argument("file", nargs="?", default=None, help="matrix file")
     ap.add_argument("--dtype", default="float32",
-                    choices=["float32", "float64", "bfloat16"])
+                    choices=["float32", "float64", "bfloat16", "float16"])
     ap.add_argument("--precision", default="highest",
                     choices=["highest", "high", "default", "mixed"],
                     help="matmul precision for the elimination sweeps; "
@@ -54,6 +54,12 @@ def main(argv=None) -> int:
                     help="call jax.distributed.initialize for multi-host "
                          "TPU slices before any device use (the analog of "
                          "MPI_Init, main.cpp:69; no-op on a single host)")
+    ap.add_argument("--gather", default=True,
+                    action=argparse.BooleanOptionalAction,
+                    help="--no-gather keeps the inverse as sharded cyclic "
+                         "blocks (distributed generator runs only): the "
+                         "O(n^2/workers) per-device memory mode for "
+                         "north-star sizes")
     ap.add_argument("--quiet", action="store_true")
     try:
         args = ap.parse_args(argv)
@@ -100,6 +106,7 @@ def main(argv=None) -> int:
             refine=args.refine,
             workers=args.workers,
             verbose=not args.quiet,
+            gather=args.gather,
             precision=args.precision,
         )
     except FileNotFoundError:
@@ -116,6 +123,12 @@ def main(argv=None) -> int:
         # failing to launch — a runtime error, not a crash.
         print(e, file=sys.stderr)
         return 2
+    except ValueError as e:
+        # invalid flag combinations (e.g. --no-gather with a file or on the
+        # single-device path) are usage errors -> exit 1 (main.cpp:77-85).
+        # Must come after MatrixReadError/MeshSizeError (both ValueErrors).
+        print(e, file=sys.stderr)
+        return 1
     if args.quiet:
         print(f"glob_time: {result.elapsed:.2f}")
         print(f"residual: {result.residual:e}")

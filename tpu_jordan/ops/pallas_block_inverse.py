@@ -161,9 +161,14 @@ def pallas_batched_block_inverse(
                                 (Nr_pad - Nr, m, m))
         blocks = jnp.concatenate([blocks, eyes], axis=0)
     cg = _chunk_candidates(Nr_pad, m)
-    # Known-bad Mosaic region (see comment above); unreachable with the
-    # default _W_BUDGET, but guard against shrunken budgets.
-    assert cg >= 2 or m > 256, (cg, m)
+    if cg < 2 and m <= 256:
+        # Known-bad Mosaic region (see comment above); unreachable with the
+        # default _W_BUDGET, but guard against shrunken budgets with a real
+        # error (an assert is stripped under python -O).
+        raise NotImplementedError(
+            f"pallas probe: cg={cg} with m={m} hits a known-failing Mosaic "
+            "compile path; increase _W_BUDGET or use the XLA fallback"
+        )
     grid = (Nr_pad // cg,)
 
     inv = pl.pallas_call(

@@ -366,6 +366,15 @@ def sharded_jordan_invert_2d(
     (condition-based pivoting, collective singularity agreement), but both
     matrix axes are sharded so per-worker memory scales with 1/(pr·pc).
     """
+    in_dtype = a.dtype
+    if jnp.dtype(in_dtype).itemsize < 4:
+        # Same sub-fp32 policy as block_jordan_invert (ops/jordan.py): fp32
+        # elimination state, one final rounding back to the storage dtype.
+        inv, singular = sharded_jordan_invert_2d(
+            a.astype(jnp.float32), mesh, block_size, eps, precision,
+            use_pallas,
+        )
+        return inv.astype(in_dtype), singular
     n = a.shape[-1]
     pr, pc = mesh.devices.shape
     lay = CyclicLayout2D.create(n, min(block_size, n), pr, pc)

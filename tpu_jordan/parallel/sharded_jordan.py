@@ -257,6 +257,16 @@ def sharded_jordan_invert(
 
     Returns (inv, singular) like ops.block_jordan_invert.
     """
+    in_dtype = a.dtype
+    if jnp.dtype(in_dtype).itemsize < 4:
+        # Same sub-fp32 policy as block_jordan_invert (ops/jordan.py): fp32
+        # elimination state, one final rounding — bf16 sweeps are measured
+        # divergent (benchmarks/PHASES.md).
+        inv, singular = sharded_jordan_invert(
+            a.astype(jnp.float32), mesh, block_size, eps, precision,
+            use_pallas,
+        )
+        return inv.astype(in_dtype), singular
     blocks, lay, run = prepare_sharded_invert(
         a, mesh, block_size, eps, precision, use_pallas
     )
