@@ -1,0 +1,63 @@
+"""2D distributed in-place (2N³) elimination: parity on the 8-device
+virtual CPU mesh across mesh shapes (VERDICT r2 item #1)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_jordan.ops import block_jordan_invert_inplace, generate
+from tpu_jordan.parallel import make_mesh_2d
+from tpu_jordan.parallel.jordan2d_inplace import (
+    sharded_jordan_invert_inplace_2d,
+)
+
+
+class TestSharded2DInplace:
+    @pytest.mark.parametrize("shape", [(2, 4), (4, 2), (2, 2)])
+    def test_matches_single_device_inplace(self, rng, shape):
+        mesh = make_mesh_2d(*shape)
+        a = jnp.asarray(rng.standard_normal((64, 64)), jnp.float64)
+        inv_d, s_d = sharded_jordan_invert_inplace_2d(a, mesh, 8)
+        inv_s, s_s = block_jordan_invert_inplace(a, block_size=8)
+        assert bool(s_d) == bool(s_s) is False
+        np.testing.assert_allclose(
+            np.asarray(inv_d), np.asarray(inv_s), rtol=1e-9, atol=1e-9
+        )
+
+    def test_matches_linalg_inv(self, rng):
+        mesh = make_mesh_2d(2, 4)
+        a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float64)
+        inv, sing = sharded_jordan_invert_inplace_2d(a, mesh, 8)
+        assert not bool(sing)
+        np.testing.assert_allclose(
+            np.asarray(inv), np.linalg.inv(np.asarray(a)), rtol=1e-7,
+            atol=1e-7,
+        )
+
+    def test_tied_pivots_swaps_cross_mesh_columns(self, rng):
+        # |i-j| forces repeated swaps; with pc=4 the swap partners live on
+        # different mesh columns, exercising the collective unscramble.
+        from tpu_jordan.parallel.jordan2d import sharded_jordan_invert_2d
+
+        mesh = make_mesh_2d(2, 4)
+        a = generate("absdiff", (96, 96), jnp.float64)
+        inv_i, s_i = sharded_jordan_invert_inplace_2d(a, mesh, 8)
+        inv_a, s_a = sharded_jordan_invert_2d(a, mesh, 8)
+        assert bool(s_i) == bool(s_a) is False
+        np.testing.assert_allclose(
+            np.asarray(inv_i), np.asarray(inv_a), rtol=1e-9, atol=1e-12
+        )
+
+    def test_singular_collective_agreement(self):
+        mesh = make_mesh_2d(2, 4)
+        _, sing = sharded_jordan_invert_inplace_2d(
+            jnp.ones((64, 64), jnp.float64), mesh, 8
+        )
+        assert bool(sing)
+
+    def test_sub_fp32_upcast_policy(self, rng):
+        mesh = make_mesh_2d(2, 2)
+        a = jnp.asarray(rng.standard_normal((32, 32)), jnp.bfloat16)
+        inv, sing = sharded_jordan_invert_inplace_2d(a, mesh, 8)
+        assert inv.dtype == jnp.bfloat16
+        assert not bool(sing)

@@ -92,7 +92,7 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_enable_x64", True)
 
-    from .driver import SingularMatrixError, solve
+    from .driver import SingularMatrixError, UsageError, solve
     from .io import MatrixReadError
     from .parallel.mesh import MeshSizeError
 
@@ -123,10 +123,9 @@ def main(argv=None) -> int:
         # failing to launch — a runtime error, not a crash.
         print(e, file=sys.stderr)
         return 2
-    except ValueError as e:
+    except UsageError as e:
         # invalid flag combinations (e.g. --no-gather with a file or on the
-        # single-device path) are usage errors -> exit 1 (main.cpp:77-85).
-        # Must come after MatrixReadError/MeshSizeError (both ValueErrors).
+        # single-device path) -> exit 1 (main.cpp:77-85).
         print(e, file=sys.stderr)
         return 1
     if args.quiet:

@@ -39,6 +39,12 @@ class SingularMatrixError(ArithmeticError):
     collective "singular matrix" exit (main.cpp:1075-1083, 435-437)."""
 
 
+class UsageError(ValueError):
+    """Invalid flag combination (e.g. gather=False without a distributed
+    generator run) — maps to the reference's usage exit code 1
+    (main.cpp:77-85), distinct from internal ValueErrors."""
+
+
 @dataclass
 class SolveResult:
     inverse: jax.Array | None
@@ -101,7 +107,7 @@ def solve(
         from .ops.refine import resolve_precision
 
         if precision == "mixed" and not gather:
-            raise ValueError(
+            raise UsageError(
                 "precision='mixed' requires gather=True: it implies >=2 "
                 "Newton-Schulz steps, which run on the gathered inverse"
             )
@@ -115,7 +121,7 @@ def solve(
         )
 
     if not gather:
-        raise ValueError(
+        raise UsageError(
             "gather=False is only supported on distributed paths with "
             "generator input"
         )
@@ -169,46 +175,72 @@ def single_device_invert(n: int, block_size: int):
     when its unrolled compile cost is reasonable, else the fori_loop
     reference implementation."""
     from .ops import block_jordan_invert, block_jordan_invert_inplace
+    from .parallel.sharded_inplace import MAX_UNROLL_NR
 
     Nr = -(-n // min(block_size, n))
-    return block_jordan_invert_inplace if Nr <= 64 else block_jordan_invert
+    return (block_jordan_invert_inplace if Nr <= MAX_UNROLL_NR
+            else block_jordan_invert)
 
 
 class _Dist1D:
     """1D row-block-cyclic backend (the reference's own layout,
-    main.cpp:118-123)."""
+    main.cpp:118-123).
+
+    Engine selection mirrors ``single_device_invert``: the in-place 2N³
+    elimination (parallel/sharded_inplace.py — half the flops, memory,
+    and collective bytes of the augmented path) whenever its unrolled
+    trace is affordable, else the augmented fori_loop path."""
 
     def __init__(self, workers: int, n: int, m: int):
         from .parallel import make_mesh
         from .parallel.layout import CyclicLayout
+        from .parallel.sharded_inplace import MAX_UNROLL_NR
 
         self.mesh = make_mesh(workers)
         self.lay = CyclicLayout.create(n, m, workers)
+        self.inplace = self.lay.Nr <= MAX_UNROLL_NR
 
     def generate_W(self, generator, dtype):
         from .parallel import sharded_generate
 
         return sharded_generate(generator, self.lay, self.mesh, dtype,
-                                augmented=True)
+                                augmented=not self.inplace)
 
     def scatter_W(self, a):
+        if self.inplace:
+            from .parallel.ring_gemm import _to_identity_padded_blocks
+
+            return _to_identity_padded_blocks(a, self.lay, self.mesh)
         from .parallel.sharded_jordan import scatter_augmented
 
         return scatter_augmented(a, self.lay, self.mesh)
 
     def compile(self, W, precision=_lax.Precision.HIGHEST):
+        if self.inplace:
+            from .parallel.sharded_inplace import (
+                compile_sharded_jordan_inplace,
+            )
+
+            return compile_sharded_jordan_inplace(W, self.mesh, self.lay,
+                                                  precision=precision)
         from .parallel.sharded_jordan import compile_sharded_jordan
 
         return compile_sharded_jordan(W, self.mesh, self.lay,
                                       precision=precision)
 
     def gather(self, out, n):
+        if self.inplace:
+            from .parallel.sharded_inplace import gather_inverse_inplace
+
+            return gather_inverse_inplace(out, self.lay, n)
         from .parallel.sharded_jordan import gather_inverse
 
         return gather_inverse(out, self.lay, n)
 
     def inv_blocks(self, out):
-        return out[:, :, self.lay.N:]
+        # In-place output IS the inverse in cyclic row order; the augmented
+        # output carries it as the B half.
+        return out if self.inplace else out[:, :, self.lay.N:]
 
     def generate_a_blocks(self, generator, dtype):
         from .parallel import sharded_generate
@@ -230,38 +262,62 @@ class _Dist1D:
 
 class _Dist2D:
     """2D block-cyclic backend over a (pr, pc) mesh (SUMMA residual) —
-    per-worker memory O(n²/(pr·pc))."""
+    per-worker memory O(n²/(pr·pc)).
+
+    Engine selection mirrors ``_Dist1D``: the in-place 2N³ elimination
+    (parallel/jordan2d_inplace.py) whenever its unrolled trace is
+    affordable, else the augmented fori_loop path."""
 
     def __init__(self, shape: tuple, n: int, m: int):
         from .parallel import make_mesh_2d
         from .parallel.layout import CyclicLayout2D
+        from .parallel.sharded_inplace import MAX_UNROLL_NR
 
         pr, pc = shape
         self.mesh = make_mesh_2d(pr, pc)
         self.lay = CyclicLayout2D.create(n, m, pr, pc)
+        self.inplace = self.lay.Nr <= MAX_UNROLL_NR
 
     def generate_W(self, generator, dtype):
         from .parallel.jordan2d import sharded_generate_2d
 
-        return sharded_generate_2d(generator, self.lay, self.mesh, dtype)
+        return sharded_generate_2d(generator, self.lay, self.mesh, dtype,
+                                   augmented=not self.inplace)
 
     def scatter_W(self, a):
+        if self.inplace:
+            from .parallel.jordan2d import scatter_matrix_2d
+
+            return scatter_matrix_2d(a, self.lay, self.mesh)
         from .parallel.jordan2d import scatter_augmented_2d
 
         return scatter_augmented_2d(a, self.lay, self.mesh)
 
     def compile(self, W, precision=_lax.Precision.HIGHEST):
+        if self.inplace:
+            from .parallel.jordan2d_inplace import (
+                compile_sharded_jordan_inplace_2d,
+            )
+
+            return compile_sharded_jordan_inplace_2d(W, self.mesh, self.lay,
+                                                     precision=precision)
         from .parallel.jordan2d import compile_sharded_jordan_2d
 
         return compile_sharded_jordan_2d(W, self.mesh, self.lay,
                                          precision=precision)
 
     def gather(self, out, n):
+        if self.inplace:
+            from .parallel.jordan2d_inplace import gather_inverse_inplace_2d
+
+            return gather_inverse_inplace_2d(out, self.lay, n)
         from .parallel.jordan2d import gather_inverse_2d
 
         return gather_inverse_2d(out, self.lay, n)
 
     def inv_blocks(self, out):
+        if self.inplace:
+            return out
         from .parallel.jordan2d import split_inverse_blocks_2d
 
         return split_inverse_blocks_2d(out, self.lay, self.mesh)
@@ -304,10 +360,10 @@ def _solve_distributed_core(
     from .ops import newton_schulz
 
     if refine and not gather:
-        raise ValueError("refine requires gather=True (it runs on the "
+        raise UsageError("refine requires gather=True (it runs on the "
                          "gathered inverse)")
     if not gather and file is not None:
-        raise ValueError("gather=False requires generator input")
+        raise UsageError("gather=False requires generator input")
 
     # Sub-fp32 storage dtypes compute in fp32 and round once at the end —
     # the same policy as the single-device kernels (ops/jordan.py): bf16

@@ -120,3 +120,14 @@ def batched_block_inverse(
             lambda b, s: gauss_jordan_inverse(b, s, eps)
         )(flat, scale)
     return inv.reshape(batch_shape + (m, m)), sing.reshape(batch_shape)
+
+
+def probe_blocks(cands: jnp.ndarray, eps, use_pallas: bool):
+    """The pivot-candidate probe dispatch shared by every elimination
+    engine: VMEM-resident pallas kernel on TPU, vmapped XLA fallback
+    elsewhere.  Returns (inverses, singular_flags)."""
+    if use_pallas:
+        from .pallas_block_inverse import pallas_batched_block_inverse
+
+        return pallas_batched_block_inverse(cands, eps)
+    return batched_block_inverse(cands, None, eps)

@@ -20,6 +20,8 @@ from .ring_gemm import (
     distributed_residual_blocks,
     ring_matmul,
 )
+from .jordan2d_inplace import sharded_jordan_invert_inplace_2d
+from .sharded_inplace import sharded_jordan_invert_inplace
 from .sharded_jordan import sharded_jordan_invert
 from .layout import (
     CyclicLayout,
@@ -56,6 +58,8 @@ __all__ = [
     "sharded_generate_2d",
     "sharded_jordan_invert",
     "sharded_jordan_invert_2d",
+    "sharded_jordan_invert_inplace",
+    "sharded_jordan_invert_inplace_2d",
     "cyclic_gather_perm",
     "cyclic_scatter_perm",
     "find_sender",

@@ -42,21 +42,14 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..config import eps_for
-from ..ops.block_inverse import batched_block_inverse
+from ..ops.block_inverse import probe_blocks as _probe
 from ..ops.norms import block_inf_norms
 from .layout import CyclicLayout2D
 from .mesh import AXIS_C, AXIS_R
+from .upcast import upcast_sub_fp32
 
 BOTH = (AXIS_R, AXIS_C)
 _SPEC_W = PartitionSpec(AXIS_R, None, AXIS_C)
-
-
-def _probe(cands, eps, use_pallas):
-    if use_pallas:
-        from ..ops.pallas_block_inverse import pallas_batched_block_inverse
-
-        return pallas_batched_block_inverse(cands, eps)
-    return batched_block_inverse(cands, None, eps)
 
 
 def _local_step2d(t, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
@@ -352,6 +345,7 @@ def compile_sharded_jordan_2d(
     ).compile()
 
 
+@upcast_sub_fp32
 def sharded_jordan_invert_2d(
     a: jnp.ndarray,
     mesh: Mesh,
@@ -366,15 +360,6 @@ def sharded_jordan_invert_2d(
     (condition-based pivoting, collective singularity agreement), but both
     matrix axes are sharded so per-worker memory scales with 1/(pr·pc).
     """
-    in_dtype = a.dtype
-    if jnp.dtype(in_dtype).itemsize < 4:
-        # Same sub-fp32 policy as block_jordan_invert (ops/jordan.py): fp32
-        # elimination state, one final rounding back to the storage dtype.
-        inv, singular = sharded_jordan_invert_2d(
-            a.astype(jnp.float32), mesh, block_size, eps, precision,
-            use_pallas,
-        )
-        return inv.astype(in_dtype), singular
     n = a.shape[-1]
     pr, pc = mesh.devices.shape
     lay = CyclicLayout2D.create(n, min(block_size, n), pr, pc)

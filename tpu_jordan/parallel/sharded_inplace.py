@@ -1,0 +1,233 @@
+"""Distributed IN-PLACE block Gauss–Jordan over a 1D mesh: the fast path.
+
+Port of the single-chip in-place redesign (ops/jordan_inplace.py) to the
+row-block-cyclic distribution of ``sharded_jordan.py``: the working set is
+the (Nr, m, N) cyclic block tensor of A alone — no augmented ``[A | B]``
+half — so relative to the augmented distributed path every step does
+
+  * half the flops: the eliminate matmul is (bpw·m, m) x (m, N) instead of
+    (m, 2N) → ~2N³ total instead of ~4N³ (the reference's own algorithm is
+    the augmented ~4N³ one, main.cpp:1136-1193; this is a redesign, not a
+    parity loss — pivot choices and the result are identical);
+  * half the collective bytes: two (m, N) one-hot psum row broadcasts
+    instead of two (m, 2N) ones (reference analogs: MPI_Bcast
+    main.cpp:1097 and the Send/Recv swap main.cpp:1122-1129);
+  * half the HBM traffic: the shard read-modify-written each step is
+    (bpw, m, N), not (bpw, m, 2N).
+
+The loop over block-columns is UNROLLED (one jit trace, static offsets) —
+the same trade as the single-chip engine: compile cost grows with Nr, so
+this path is for Nr ≲ 64, which covers every north-star configuration
+(8192² at m=512 is Nr=16).  Unrolling also buys the shrinking-window
+probe *in SPMD form*: at step t the smallest possibly-valid local slot on
+ANY worker is exactly ``t // p`` (worker k's slot s holds global block row
+s·p + k, so s·p + k ≥ t ⟺ s ≥ ceil((t−k)/p), minimized over k < p at
+floor(t/p)), a static bound — each worker probes only its ``bpw − t//p``
+live candidates instead of masking all ``bpw`` (the reference probes the
+same window, main.cpp:1039; the augmented fori_loop path can't shrink a
+traced-shape batch).
+
+In-place bookkeeping: at step t the eliminated column is replaced by the
+inverse-building column (V[:,t] ← −E·H, pivot row ← H·row_piv with H in
+the t-chunk), and the row-swap history is replayed as *column* swaps in
+reverse after the loop.  Columns are fully replicated per worker in the 1D
+layout, so the replay is worker-local — zero communication.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec
+
+from ..config import eps_for
+from ..ops.block_inverse import probe_blocks
+from ..ops.norms import block_inf_norms
+from .layout import CyclicLayout
+from .mesh import AXIS
+from .upcast import upcast_sub_fp32
+
+# Unrolled-trace budget (same bar as the single-chip engine,
+# driver.single_device_invert): beyond this, fall back to the augmented
+# fori_loop path.
+MAX_UNROLL_NR = 64
+
+
+def _step(t: int, Wloc, singular, *, lay: CyclicLayout, eps, precision,
+          use_pallas: bool):
+    """One super-step (static ``t``) on one worker's (bpw, m, N) shard."""
+    p, m, bpw, N = lay.p, lay.m, lay.blocks_per_worker, lay.N
+    k = lax.axis_index(AXIS)
+    dtype = Wloc.dtype
+
+    # --- PIVOT PROBE over the live window only: slots [t//p, bpw).
+    s0 = t // p
+    gidx = jnp.arange(s0, bpw) * p + k          # global block rows probed
+    cands = lax.slice(Wloc, (s0, 0, t * m), (bpw, m, (t + 1) * m))
+    invs, sing = probe_blocks(cands, eps, use_pallas)
+    valid = (gidx >= t) & ~sing                 # at most one stale slot/worker
+    norms = block_inf_norms(invs)
+    key = jnp.where(valid, norms, jnp.asarray(jnp.inf, norms.dtype))
+    slot_best = jnp.argmin(key)
+    my_key = key[slot_best]
+
+    # --- PIVOT REDUCTION: two-stage composite-key pmin, ties to the lowest
+    # global block row (replaces the custom MPI op, main.cpp:729-744,
+    # 1000-1024, 1074).
+    kmin = lax.pmin(my_key, AXIS)
+    g_cand = gidx[slot_best]
+    win_g = lax.pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), AXIS)
+    singular = singular | ~jnp.isfinite(kmin)   # all-singular (main.cpp:1075-83)
+    i_won = (my_key == kmin) & (g_cand == win_g)
+
+    g_piv = lax.psum(jnp.where(i_won, g_cand, 0), AXIS)
+    H = lax.psum(
+        jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0).astype(dtype),
+        AXIS,
+    )
+
+    # --- ROW BROADCASTS (m, N): pivot row and current row t as one-hot
+    # psums (main.cpp:1097 / 1122-1129) — half the bytes of the augmented
+    # path's (m, 2N) rows.
+    safe_best = jnp.where(i_won, slot_best + s0, 0)
+    row_piv = lax.psum(
+        jnp.where(i_won, lax.dynamic_index_in_dim(Wloc, safe_best, 0, False),
+                  0.0),
+        AXIS,
+    )                                           # (m, N)
+    own_t = k == (t % p)
+    slot_t = t // p                             # static (== s0)
+    row_t = lax.psum(
+        jnp.where(own_t, Wloc[slot_t], 0.0), AXIS
+    )                                           # (m, N)
+
+    # --- SWAP-BY-COPY (main.cpp:1093-1131): pivot owner's slot receives
+    # the old row t; slot t is rewritten below from the normalized pivot.
+    # The select is row-granular (one (m, N) slot), not a full-shard
+    # where — each step touches O(m·N) beyond the eliminate matmul.
+    own_piv = k == (g_piv % p)
+    slot_piv = jnp.where(own_piv, g_piv // p, 0)
+    cur_piv = lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False)
+    Wloc = lax.dynamic_update_index_in_dim(
+        Wloc, jnp.where(own_piv, row_t, cur_piv), slot_piv, 0
+    )
+
+    # --- NORMALIZE; the t-chunk becomes H (in-place column replacement:
+    # same fold as ops/jordan_inplace.py — V[:,t] is zeroed so the one
+    # eliminate matmul writes −E·H there).
+    prow = jnp.matmul(H, row_piv, precision=precision)      # (m, N)
+    prow = prow.at[:, t * m:(t + 1) * m].set(H)
+
+    # --- ELIMINATE: every local row (above AND below the pivot — Jordan).
+    E = Wloc[:, :, t * m:(t + 1) * m]                       # (bpw, m, m)
+    loc_g = jnp.arange(bpw) * p + k
+    E = jnp.where((loc_g == t)[:, None, None], jnp.asarray(0, dtype), E)
+    Wloc = Wloc.at[:, :, t * m:(t + 1) * m].set(jnp.asarray(0, dtype))
+    update = jnp.matmul(E.reshape(bpw * m, m), prow, precision=precision)
+    Wloc = Wloc - update.reshape(bpw, m, N)
+
+    # Row t becomes the normalized pivot row (owner only); row-granular
+    # select, same reasoning as the swap above.
+    Wloc = Wloc.at[slot_t].set(jnp.where(own_t, prow, Wloc[slot_t]))
+    return Wloc, singular, g_piv
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas"))
+def _sharded_jordan_inplace(W, mesh, lay: CyclicLayout, eps, precision,
+                            use_pallas):
+    def worker(Wloc):
+        singular = lax.pcast(jnp.asarray(False), AXIS, to='varying')
+        swaps = []
+        for t in range(lay.Nr):
+            Wloc, singular, g_piv = _step(
+                t, Wloc, singular, lay=lay, eps=eps, precision=precision,
+                use_pallas=use_pallas,
+            )
+            swaps.append(g_piv)
+
+        # --- UNSCRAMBLE: row-swap history replayed as column swaps in
+        # reverse (in-place GJ bookkeeping; worker-local — columns are
+        # replicated in the 1D layout).
+        m, N, bpw = lay.m, lay.N, lay.blocks_per_worker
+        for t in reversed(range(lay.Nr)):
+            piv = swaps[t]
+            col_t = Wloc[:, :, t * m:(t + 1) * m]
+            col_p = lax.dynamic_slice(Wloc, (0, 0, piv * m), (bpw, m, m))
+            Wloc = lax.dynamic_update_slice(Wloc, col_t, (0, 0, piv * m))
+            Wloc = Wloc.at[:, :, t * m:(t + 1) * m].set(col_p)
+        return Wloc, singular[None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=PartitionSpec(AXIS, None, None),
+        out_specs=(PartitionSpec(AXIS, None, None), PartitionSpec(AXIS)),
+    )(W)
+
+
+def compile_sharded_jordan_inplace(
+    blocks: jnp.ndarray,
+    mesh: Mesh,
+    lay: CyclicLayout,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    use_pallas: bool | None = None,
+):
+    """AOT-compile the in-place sharded elimination for a (Nr, m, N)
+    identity-padded cyclic block tensor.  ``run(blocks) ->
+    (inverse_blocks, singular_per_worker)`` — the output IS the inverse in
+    cyclic row order (no B half to slice)."""
+    from .sharded_jordan import resolve_use_pallas
+
+    if eps is None:
+        eps = eps_for(blocks.dtype)
+    if use_pallas is None:
+        use_pallas = resolve_use_pallas(blocks.dtype, lay.m)
+    return _sharded_jordan_inplace.lower(
+        blocks, mesh, lay, eps, precision, use_pallas
+    ).compile()
+
+
+def gather_inverse_inplace(out: jnp.ndarray, lay: CyclicLayout, n: int):
+    """Cyclic row order -> natural order; columns are already natural."""
+    from ..ops.padding import unpad
+    from .layout import cyclic_scatter_perm
+
+    out = jnp.take(out, cyclic_scatter_perm(lay), axis=0)
+    return unpad(out.reshape(lay.N, lay.N), n)
+
+
+@upcast_sub_fp32
+def sharded_jordan_invert_inplace(
+    a: jnp.ndarray,
+    mesh: Mesh,
+    block_size: int,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    use_pallas: bool | None = None,
+):
+    """Invert (n, n) ``a`` over the 1D mesh with the in-place engine.
+
+    Drop-in for ``sharded_jordan_invert`` (same pivot rule, same
+    (inv, singular) contract) at ~half the flops, memory, and collective
+    bytes.  Requires ``lay.Nr <= MAX_UNROLL_NR`` (unrolled trace).
+    """
+    from .ring_gemm import _to_identity_padded_blocks
+
+    n = a.shape[-1]
+    lay = CyclicLayout.create(n, min(block_size, n), mesh.devices.size)
+    if lay.Nr > MAX_UNROLL_NR:
+        raise ValueError(
+            f"in-place path unrolls the block-column loop: Nr={lay.Nr} > "
+            f"{MAX_UNROLL_NR}; use sharded_jordan_invert or a larger block"
+        )
+    blocks = _to_identity_padded_blocks(a, lay, mesh)
+    run = compile_sharded_jordan_inplace(blocks, mesh, lay, eps, precision,
+                                         use_pallas)
+    out, singular = run(blocks)
+    return gather_inverse_inplace(out, lay, n), singular.any()

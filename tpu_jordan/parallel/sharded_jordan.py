@@ -39,10 +39,11 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..config import eps_for
-from ..ops.block_inverse import batched_block_inverse
+from ..ops.block_inverse import probe_blocks
 from ..ops.norms import block_inf_norms
 from .layout import CyclicLayout, cyclic_gather_perm, cyclic_scatter_perm
 from .mesh import AXIS
+from .upcast import upcast_sub_fp32
 
 
 def _local_step(t, Wloc, singular, *, lay: CyclicLayout, eps, precision,
@@ -60,12 +61,7 @@ def _local_step(t, Wloc, singular, *, lay: CyclicLayout, eps, precision,
     probe_dtype = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
     cands = lax.dynamic_slice(Wloc, (0, 0, t * m), (bpw, m, m))
     cands = cands.astype(probe_dtype)
-    if use_pallas:
-        from ..ops.pallas_block_inverse import pallas_batched_block_inverse
-
-        invs, sing = pallas_batched_block_inverse(cands, eps)
-    else:
-        invs, sing = batched_block_inverse(cands, None, eps)
+    invs, sing = probe_blocks(cands, eps, use_pallas)
     inv_norms = block_inf_norms(invs)
     valid = (gidx >= t) & ~sing
     big = jnp.asarray(jnp.inf, probe_dtype)
@@ -240,6 +236,7 @@ def prepare_sharded_invert(
     return blocks, lay, run
 
 
+@upcast_sub_fp32
 def sharded_jordan_invert(
     a: jnp.ndarray,
     mesh: Mesh,
@@ -257,16 +254,6 @@ def sharded_jordan_invert(
 
     Returns (inv, singular) like ops.block_jordan_invert.
     """
-    in_dtype = a.dtype
-    if jnp.dtype(in_dtype).itemsize < 4:
-        # Same sub-fp32 policy as block_jordan_invert (ops/jordan.py): fp32
-        # elimination state, one final rounding — bf16 sweeps are measured
-        # divergent (benchmarks/PHASES.md).
-        inv, singular = sharded_jordan_invert(
-            a.astype(jnp.float32), mesh, block_size, eps, precision,
-            use_pallas,
-        )
-        return inv.astype(in_dtype), singular
     blocks, lay, run = prepare_sharded_invert(
         a, mesh, block_size, eps, precision, use_pallas
     )

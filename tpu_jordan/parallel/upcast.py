@@ -1,0 +1,30 @@
+"""Shared sub-fp32 storage-dtype policy for the sharded front ends.
+
+Sub-fp32 (bf16/fp16) elimination state is measured divergent
+(benchmarks/PHASES.md), so every public invert entry computes in fp32 and
+rounds ONCE at the end — the same policy as the single-device kernels
+(ops/jordan.py).  This decorator applies it uniformly so the four sharded
+front ends cannot drift apart.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax.numpy as jnp
+
+
+def upcast_sub_fp32(fn):
+    """Wrap an ``(a, ...) -> (inv, singular)`` invert entry: sub-fp32
+    inputs are upcast to fp32 for the elimination and the result rounded
+    back to the storage dtype."""
+
+    @functools.wraps(fn)
+    def wrapper(a, *args, **kwargs):
+        in_dtype = a.dtype
+        if jnp.dtype(in_dtype).itemsize < 4:
+            inv, singular = fn(a.astype(jnp.float32), *args, **kwargs)
+            return inv.astype(in_dtype), singular
+        return fn(a, *args, **kwargs)
+
+    return wrapper
