@@ -62,14 +62,23 @@ def _local_step2d(t, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
     dtype = Wloc.dtype
     gr = jnp.arange(bpr) * pr + kr          # global block row of each slot
 
-    # --- PIVOT PROBE on the mesh column owning global column block t.
-    # Everyone probes its local chunk u_t (garbage on non-owners — masked
-    # below); static shapes keep the step jit-compatible.
+    # --- PIVOT PROBE on the mesh column owning global column block t ONLY:
+    # the other pc-1 columns take the cheap cond branch straight to the
+    # reduction with all-singular (inf-key) dummies instead of inverting
+    # candidates they would throw away.
     own_c = kc == (t % pc)
     u_t = t // pc
     cands = lax.dynamic_slice(Wloc, (0, 0, u_t * m), (bpr, m, m))
     probe_dtype = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
-    invs, sing = _probe(cands.astype(probe_dtype), eps, use_pallas)
+    cands = cands.astype(probe_dtype)
+
+    def _skip(c):
+        return (jnp.zeros_like(c),
+                lax.pcast(jnp.ones((bpr,), jnp.bool_), BOTH, to='varying'))
+
+    invs, sing = lax.cond(
+        own_c, lambda c: _probe(c, eps, use_pallas), _skip, cands
+    )
     inv_norms = block_inf_norms(invs)
     valid = own_c & (gr >= t) & ~sing
     big = jnp.asarray(jnp.inf, probe_dtype)
