@@ -55,6 +55,116 @@ long tj_parse_matrix_text(const char *path, double *out, long max_count) {
   return count;
 }
 
+// --- Streaming parser -------------------------------------------------
+//
+// Handle-based strip reader for the distributed file-scatter path: the
+// reference's root rank reads ONE block-row buffer at a time and sends it
+// to its owner (main.cpp:242-276), keeping host memory O(n*m).  These
+// entry points give the Python side the same property: open once, pull
+// `count` doubles per call, close.  Chunked fread + strtod; a number that
+// straddles a chunk boundary is carried over to the next refill.
+
+namespace {
+constexpr size_t kChunk = 1 << 20; // 1 MiB read granularity
+
+struct TjStream {
+  FILE *f = nullptr;
+  char *buf = nullptr;   // kChunk + carry headroom + NUL
+  size_t len = 0;        // valid bytes in buf
+  size_t pos = 0;        // parse cursor
+  bool eof = false;
+};
+
+// Ensure the unparsed tail is at the front of the buffer and the buffer
+// is as full as the file allows.  Returns false once fully drained.
+bool tj_refill(TjStream *s) {
+  size_t tail = s->len - s->pos;
+  if (tail > 0)
+    std::memmove(s->buf, s->buf + s->pos, tail);
+  s->len = tail;
+  s->pos = 0;
+  if (!s->eof) {
+    size_t got = std::fread(s->buf + s->len, 1, kChunk, s->f);
+    s->len += got;
+    if (got < kChunk)
+      s->eof = true;
+  }
+  s->buf[s->len] = '\0';
+  return s->len > 0;
+}
+} // namespace
+
+void *tj_stream_open(const char *path) {
+  FILE *f = std::fopen(path, "rb");
+  if (!f)
+    return nullptr;
+  TjStream *s = new TjStream;
+  s->f = f;
+  // Headroom for a carried-over partial token (longest printf %.17g
+  // rendering is ~25 chars; 64 is comfortable).
+  s->buf = (char *)std::malloc(kChunk + 64 + 1);
+  if (!s->buf) {
+    std::fclose(f);
+    delete s;
+    return nullptr;
+  }
+  s->buf[0] = '\0';
+  return s;
+}
+
+// Parse up to `count` doubles into `out`; returns the number parsed
+// (fewer only at end-of-data or on a malformed token).
+long tj_stream_read(void *handle, double *out, long count) {
+  TjStream *s = (TjStream *)handle;
+  long parsed = 0;
+  while (parsed < count) {
+    char *end = nullptr;
+    double v = std::strtod(s->buf + s->pos, &end);
+    if (end == s->buf + s->pos) {
+      // No progress: whitespace-only tail, partial token, or garbage.
+      if (!s->eof || s->pos < s->len) {
+        size_t before = s->len - s->pos;
+        if (!tj_refill(s))
+          break;
+        if (s->eof && s->len == before && before > 0) {
+          // Refill added nothing and strtod still can't move: skip
+          // leading whitespace manually; if a non-numeric token remains,
+          // stop (caller maps the short count to the -2 error).
+          while (s->pos < s->len &&
+                 std::strchr(" \t\r\n", s->buf[s->pos]))
+            s->pos++;
+          if (s->pos < s->len) {
+            char *e2 = nullptr;
+            std::strtod(s->buf + s->pos, &e2);
+            if (e2 == s->buf + s->pos)
+              break;
+          }
+        }
+        continue;
+      }
+      break;
+    }
+    // A token ending exactly at the buffer end may be truncated; refill
+    // and re-parse it whole (unless the file is exhausted).
+    if ((size_t)(end - s->buf) == s->len && !s->eof) {
+      tj_refill(s);
+      continue;
+    }
+    out[parsed++] = v;
+    s->pos = end - s->buf;
+  }
+  return parsed;
+}
+
+void tj_stream_close(void *handle) {
+  TjStream *s = (TjStream *)handle;
+  if (s) {
+    std::fclose(s->f);
+    std::free(s->buf);
+    delete s;
+  }
+}
+
 // Write a matrix in the reference's format (row-major, whitespace
 // separated) so files round-trip through the reference binary.
 long tj_write_matrix_text(const char *path, const double *data, long rows,

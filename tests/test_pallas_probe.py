@@ -9,6 +9,7 @@ Mosaic regression can't silently change pivot choices on hardware.
 import numpy as np
 import pytest
 
+import jax
 import jax.numpy as jnp
 
 from tpu_jordan.ops.block_inverse import batched_block_inverse
@@ -16,9 +17,21 @@ from tpu_jordan.ops import pallas_block_inverse as pbi
 from tpu_jordan.ops.pallas_block_inverse import pallas_batched_block_inverse
 
 
-def _check_parity(blocks_np, eps=None, atol=2e-5):
+# All kernels must keep identical pivot/singularity/poison semantics:
+# "dispatch" resolves to the production kernel (currently the augmented
+# rank-1, the measured fastest), "rank1" forces it explicitly, "panel"
+# and "inplace" are the recorded v2/v3 experiments.
+KERNELS = {
+    "dispatch": pallas_batched_block_inverse,
+    "rank1": pbi.pallas_batched_block_inverse_rank1,
+    "panel": pbi.pallas_batched_block_inverse_panel,
+    "inplace": pbi.pallas_batched_block_inverse_inplace,
+}
+
+
+def _check_parity(blocks_np, eps=None, atol=2e-5, kernel="dispatch"):
     blocks = jnp.asarray(blocks_np, jnp.float32)
-    inv_p, sing_p = pallas_batched_block_inverse(blocks, eps, interpret=True)
+    inv_p, sing_p = KERNELS[kernel](blocks, eps, interpret=True)
     inv_x, sing_x = batched_block_inverse(blocks, None, eps)
     np.testing.assert_array_equal(np.asarray(sing_p), np.asarray(sing_x))
     ok = ~np.asarray(sing_x)
@@ -29,13 +42,16 @@ def _check_parity(blocks_np, eps=None, atol=2e-5):
         )
     return np.asarray(sing_p)
 
-def test_random_stack_matches_xla(rng):
+
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_random_stack_matches_xla(rng, kernel):
     blocks = rng.standard_normal((6, 32, 32))
-    sing = _check_parity(blocks)
+    sing = _check_parity(blocks, kernel=kernel)
     assert not sing.any()
 
 
-def test_singular_and_zero_diagonal_blocks(rng):
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_singular_and_zero_diagonal_blocks(rng, kernel):
     m = 32
     blocks = rng.standard_normal((5, m, m))
     # Exactly singular: duplicate row.
@@ -49,31 +65,39 @@ def test_singular_and_zero_diagonal_blocks(rng):
     blocks[3] = np.abs(i[:, None] - i[None, :]).astype(float)
     # All-zero block: degenerate scale.
     blocks[4] = 0.0
-    sing = _check_parity(blocks)
+    sing = _check_parity(blocks, kernel=kernel)
     assert not sing[0] and not sing[3]
     assert sing[1] and sing[2] and sing[4]
 
 
-def test_poison_path_flags_do_not_leak(rng):
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_poison_path_flags_do_not_leak(rng, kernel):
     # A singular block next to healthy ones: the non-finite poison must be
     # confined to its own block.
     blocks = rng.standard_normal((4, 32, 32))
     blocks[2] = 1.0  # rank 1
     blocks_j = jnp.asarray(blocks, jnp.float32)
-    inv, sing = pallas_batched_block_inverse(blocks_j, interpret=True)
+    inv, sing = KERNELS[kernel](blocks_j, interpret=True)
     assert list(np.asarray(sing)) == [False, False, True, False]
     assert np.isfinite(np.asarray(inv)[[0, 1, 3]]).all()
 
 
-def test_chunked_grid(monkeypatch, rng):
-    # Shrink the VMEM budget so the grid must split the stack into chunks
-    # (cg < num_blocks), exercising _chunk_candidates' divisor logic and
-    # the per-chunk BlockSpec indexing.
+@pytest.mark.parametrize("kernel", KERNELS)
+def test_chunked_grid(monkeypatch, rng, kernel):
+    # Shrink the VMEM budgets (both: the dispatch path resolves to the
+    # panel kernel and its budget, the forced path to the rank-1 budget)
+    # so the grid must split the stack into chunks (cg < num_blocks),
+    # exercising _chunk_candidates' divisor logic and the per-chunk
+    # BlockSpec indexing.
     monkeypatch.setattr(pbi, "_W_BUDGET", 2 * 32 * 64 * 4)   # 2 cands/chunk
+    monkeypatch.setattr(pbi, "_W_BUDGET_PANEL", 2 * 32 * 64 * 4)
+    # The budgets are read at trace time: drop any executable cached by an
+    # earlier test with the same shapes or the patch is a no-op.
+    jax.clear_caches()
     assert pbi._chunk_candidates(6, 32) == 2
     blocks = rng.standard_normal((6, 32, 32))
     blocks[4, 0] = blocks[4, 1]          # one singular block mid-stack
-    sing = _check_parity(blocks)
+    sing = _check_parity(blocks, kernel=kernel)
     assert list(sing) == [False, False, False, False, True, False]
 
 
@@ -85,42 +109,42 @@ def test_chunk_candidates_divisor_property():
             assert cg * m * 2 * m * 4 <= pbi._W_BUDGET or cg == 1
 
 
-class TestPanelKernel:
-    """MXU-blocked panel kernel (VERDICT r3): parity with the rank-1
-    kernel and the XLA reference at production block sizes."""
+class TestProductionSizeParity:
+    """Parity of every kernel with the XLA reference at production block
+    sizes (m=64/128); the small-m tests above use m=32."""
 
     @pytest.mark.parametrize("m", [64, 128])
-    def test_matches_xla(self, rng, m):
-        assert pbi._panel_width(m) == 32
+    @pytest.mark.parametrize("kernel", KERNELS)
+    def test_matches_xla(self, rng, m, kernel):
         blocks = rng.standard_normal((4, m, m))
-        sing = _check_parity(blocks)
+        sing = _check_parity(blocks, kernel=kernel)
         assert not sing.any()
 
-    def test_matches_rank1_kernel(self, rng):
+    @pytest.mark.parametrize("kernel", ["rank1", "panel", "inplace"])
+    def test_matches_dispatch_kernel(self, rng, kernel):
         m = 64
         blocks = jnp.asarray(rng.standard_normal((4, m, m)), jnp.float32)
         inv_p, sing_p = pallas_batched_block_inverse(
             blocks, interpret=True
         )
-        inv_r, sing_r = pbi.pallas_batched_block_inverse_rank1(
-            blocks, interpret=True
-        )
+        inv_r, sing_r = KERNELS[kernel](blocks, interpret=True)
         np.testing.assert_array_equal(np.asarray(sing_p),
                                       np.asarray(sing_r))
         np.testing.assert_allclose(np.asarray(inv_p), np.asarray(inv_r),
                                    rtol=2e-4, atol=2e-5)
 
-    def test_singular_flags_and_zero_diag(self, rng):
+    @pytest.mark.parametrize("kernel", KERNELS)
+    def test_singular_flags_and_zero_diag(self, rng, kernel):
         m = 64
         blocks = rng.standard_normal((4, m, m))
         blocks[1, 5] = blocks[1, 9]          # duplicate row -> singular
         i = np.arange(m)
         blocks[2] = np.abs(i[:, None] - i[None, :]).astype(float)
         blocks[3] = 0.0
-        # The deferred panel update sums in a different order than the
-        # sequential rank-1 path; O(m)-magnitude entries cancel to near
-        # zero, so the absolute floor is a little higher at m=64.
-        sing = _check_parity(blocks, atol=1e-4)
+        # The panel kernel's deferred update sums in a different order
+        # than the sequential paths; O(m)-magnitude entries cancel to
+        # near zero, so the absolute floor is a little higher at m=64.
+        sing = _check_parity(blocks, atol=1e-4, kernel=kernel)
         assert list(sing) == [False, True, False, True]
 
     def test_panel_width_selection(self):
@@ -129,6 +153,9 @@ class TestPanelKernel:
         assert pbi._panel_width(40) == 8
         assert pbi._panel_width(8) is None    # m == b: no split possible
         assert pbi._panel_width(12) is None
+        with pytest.raises(ValueError, match="panel width"):
+            pbi.pallas_batched_block_inverse_panel(
+                jnp.eye(12, dtype=jnp.float32)[None], interpret=True)
 
 
 def test_probe_pivot_ordering_matches(rng):

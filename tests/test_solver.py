@@ -54,6 +54,52 @@ class TestJordanSolver:
         with pytest.raises(ValueError, match="expected"):
             s.invert(rng.standard_normal((8, 8)))
 
+    def test_workers_2d_mesh(self, rng):
+        # VERDICT r2 #8: the solver must accept a (pr, pc) mesh like the
+        # driver does (2D block-cyclic layout, SUMMA residual).
+        s = JordanSolver(n=64, block_size=8, dtype=jnp.float32,
+                         workers=(2, 4))
+        a = rng.standard_normal((64, 64)).astype(np.float32)
+        inv, sing = s.invert(a)
+        assert not bool(sing)
+        assert inv.shape == (64, 64)
+        assert s.residual(a, inv) < 1e-3
+        np.testing.assert_allclose(np.asarray(inv), np.linalg.inv(a),
+                                   rtol=1e-2, atol=1e-3)
+
+    @pytest.mark.parametrize("workers", [4, (2, 2)])
+    def test_no_gather_blocks(self, rng, workers):
+        # gather=False: the inverse stays as sharded cyclic blocks and the
+        # residual is verified without materializing n x n per device.
+        s = JordanSolver(n=64, block_size=8, dtype=jnp.float32,
+                         workers=workers, gather=False)
+        a = rng.standard_normal((64, 64)).astype(np.float32)
+        blocks, sing = s.invert(a)
+        assert not bool(sing)
+        assert s.layout is not None
+        assert blocks.ndim > 2 or blocks.shape != (64, 64)
+        assert s.residual(a, blocks) < 1e-3
+
+    def test_no_gather_single_device_raises(self):
+        from tpu_jordan.driver import UsageError
+
+        with pytest.raises(UsageError, match="gather=False"):
+            JordanSolver(n=16, gather=False)
+
+    def test_refine_no_gather_raises(self):
+        from tpu_jordan.driver import UsageError
+
+        with pytest.raises(UsageError, match="refine"):
+            JordanSolver(n=16, workers=4, refine=2, gather=False)
+
+    def test_sub_fp32_storage_dtype(self, rng):
+        # bf16 storage computes in fp32 and rounds once at the end.
+        s = JordanSolver(n=32, block_size=8, dtype=jnp.bfloat16, workers=4)
+        a = rng.standard_normal((32, 32)).astype(np.float32)
+        inv, sing = s.invert(a)
+        assert inv.dtype == jnp.bfloat16
+        assert not bool(sing)
+
 
 def test_distributed_init_single_process_noop():
     # The analog of MPI_Init must tolerate a single-process environment

@@ -106,15 +106,9 @@ def solve(
     if isinstance(workers, tuple) or workers > 1:
         from .ops.refine import resolve_precision
 
-        if precision == "mixed" and not gather:
-            raise UsageError(
-                "precision='mixed' requires gather=True: it implies >=2 "
-                "Newton-Schulz steps, which run on the gathered inverse"
-            )
+        check_gather_flags(gather, refine, precision)
         sweep_prec, refine = resolve_precision(prec, refine)
-        be = (_Dist2D(workers, n, min(block_size, n))
-              if isinstance(workers, tuple)
-              else _Dist1D(workers, n, min(block_size, n)))
+        be = make_distributed_backend(workers, n, block_size)
         return _solve_distributed_core(
             be, n, block_size, file, generator, dtype, refine, verbose,
             gather, load, sweep_prec,
@@ -167,6 +161,29 @@ def solve(
         block_size=block_size,
         gflops=2.0 * n**3 / elapsed / 1e9,
     )
+
+
+def make_distributed_backend(workers, n: int, block_size: int):
+    """The distributed backend for a workers spec: int p -> 1D row-cyclic,
+    tuple (pr, pc) -> 2D block-cyclic.  Shared by ``solve`` and
+    ``JordanSolver`` so layout policy can't drift between them."""
+    m = min(block_size, n)
+    return (_Dist2D(workers, n, m) if isinstance(workers, tuple)
+            else _Dist1D(workers, n, m))
+
+
+def check_gather_flags(gather: bool, refine: int, precision: str = "highest"):
+    """Flag-compatibility contract for distributed solves, shared by
+    ``solve`` and ``JordanSolver``: refinement (and the 'mixed' policy
+    that implies it) runs on the gathered inverse."""
+    if precision == "mixed" and not gather:
+        raise UsageError(
+            "precision='mixed' requires gather=True: it implies >=2 "
+            "Newton-Schulz steps, which run on the gathered inverse"
+        )
+    if refine and not gather:
+        raise UsageError("refine requires gather=True (it runs on the "
+                         "gathered inverse)")
 
 
 def single_device_invert(n: int, block_size: int):

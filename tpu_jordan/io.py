@@ -53,3 +53,94 @@ def write_matrix_file(path: str, a: np.ndarray) -> None:
     """Write a matrix in the reference's format (whitespace-separated,
     row-major) so our files round-trip through the reference binary."""
     np.savetxt(path, np.asarray(a), fmt="%.17g")
+
+
+class MatrixStripReader:
+    """Incremental row-strip reader: the streaming analog of the
+    reference's root-rank scatter loop (main.cpp:242-276), which reads ONE
+    block-row buffer at a time so host memory stays O(n·m) — never O(n²).
+
+    Uses the native chunked strtod stream when built (``make native``),
+    else a pure-Python chunked tokenizer with the same contract.  Context
+    manager; raises FileNotFoundError / MatrixReadError like
+    ``read_matrix_file``.
+    """
+
+    _CHUNK = 1 << 20
+
+    def __init__(self, path: str, n: int, dtype=np.float64):
+        self.path = path
+        self.n = n
+        self.dtype = dtype
+        self._native = None
+        self._fh = None
+        self._tail = ""
+        self._pending: list[str] = []
+        try:
+            from .native import MatrixStream
+
+            self._native = MatrixStream(path)
+        except ImportError:
+            try:
+                self._fh = open(path)
+            except OSError as e:
+                raise FileNotFoundError(f"cannot open {path}") from e
+
+    def read_rows(self, nrows: int) -> np.ndarray:
+        """Next ``nrows`` full rows as an (nrows, n) array."""
+        count = nrows * self.n
+        if self._native is not None:
+            vals = self._native.read(count)
+        else:
+            vals = self._read_tokens_py(count)
+        if vals.size < count:
+            raise MatrixReadError(f"cannot read {self.path}")
+        return vals.reshape(nrows, self.n).astype(self.dtype)
+
+    def _read_tokens_py(self, count: int) -> np.ndarray:
+        out = np.empty(count, dtype=np.float64)
+        got = 0
+        while got < count:
+            while self._pending and got < count:
+                take = min(count - got, len(self._pending))
+                try:
+                    out[got:got + take] = self._pending[:take]
+                except ValueError as e:
+                    raise MatrixReadError(
+                        f"cannot read {self.path}") from e
+                del self._pending[:take]
+                got += take
+            if got == count:
+                break
+            chunk = self._fh.read(self._CHUNK)
+            if not chunk:
+                # Flush the carried partial token, then EOF.
+                if self._tail:
+                    self._pending = [self._tail]
+                    self._tail = ""
+                    continue
+                break
+            data = self._tail + chunk
+            # A token may straddle the chunk boundary: carry the tail
+            # unless the chunk ends in whitespace.
+            if data[-1].isspace():
+                self._tail = ""
+                self._pending = data.split()
+            else:
+                toks = data.split()
+                self._tail = toks.pop() if toks else ""
+                self._pending = toks
+        return out[:got]
+
+    def close(self):
+        if self._native is not None:
+            self._native.close()
+        if self._fh is not None:
+            self._fh.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

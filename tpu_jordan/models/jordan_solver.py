@@ -6,6 +6,12 @@ The reference re-runs its whole program per matrix (main.cpp:65-93); here
 the compiled executables (single-device or sharded) are cached on the
 solver so repeated solves pay zero retrace/compile cost — the "model" is
 the compiled computation, the "inference" is one inversion.
+
+Distribution mirrors ``driver.solve`` exactly (same backend adapters):
+``workers=p`` runs the 1D row-block-cyclic layout over p devices,
+``workers=(pr, pc)`` the 2D block-cyclic layout over a (pr, pc) mesh, and
+``gather=False`` keeps the inverse as sharded cyclic blocks (the
+memory-scaling mode: nothing n×n ever materializes per device).
 """
 
 from __future__ import annotations
@@ -27,21 +33,28 @@ class JordanSolver:
     Args:
       n: matrix dimension.
       block_size: pivot block size m (default: MXU-friendly for n).
-      dtype: working dtype (fp32 on TPU, fp64 on CPU).
-      refine: Newton–Schulz steps applied to every solve.
-      workers: >1 distributes over a 1D mesh (``parallel.make_mesh``).
+      dtype: storage dtype; sub-fp32 dtypes compute in fp32 and round once
+        at the end (the measured-safe policy, ops/jordan.py).
+      refine: Newton–Schulz steps applied to every solve (requires
+        ``gather=True`` on distributed meshes — refinement runs on the
+        gathered inverse).
+      workers: 1 = single device; int p > 1 = 1D row-cyclic mesh
+        (``parallel.make_mesh``); tuple (pr, pc) = 2D block-cyclic mesh
+        (``parallel.make_mesh_2d``).
       precision: "highest" | "high" | "default" | "mixed" (driver.solve).
+      gather: distributed only — False returns the inverse as sharded
+        cyclic blocks instead of one gathered n×n array.
     """
 
     n: int
     block_size: int | None = None
     dtype: Any = jnp.float32
     refine: int = 0
-    workers: int = 1
+    workers: Any = 1
     precision: str = "highest"
+    gather: bool = True
     _run: Any = field(default=None, repr=False)
-    _lay: Any = field(default=None, repr=False)
-    _mesh: Any = field(default=None, repr=False)
+    _be: Any = field(default=None, repr=False)
 
     def __post_init__(self):
         from ..ops.refine import PRECISIONS, resolve_precision
@@ -53,57 +66,92 @@ class JordanSolver:
         self._sweep_prec, self.refine = resolve_precision(
             PRECISIONS[self.precision], self.refine
         )
+        self._in_dtype = jnp.dtype(self.dtype)
+        # Sub-fp32 storage computes in fp32, rounds once at the end
+        # (same policy as driver._solve_distributed_core).
+        self._work_dtype = (jnp.float32 if self._in_dtype.itemsize < 4
+                            else self._in_dtype)
+        if self._distributed:
+            from ..driver import UsageError, _Dist1D, _Dist2D
 
-    def _compile(self, a):
-        if self.workers > 1:
-            from ..parallel.sharded_jordan import prepare_sharded_invert
+            if self.refine and not self.gather:
+                raise UsageError(
+                    "refine requires gather=True (it runs on the gathered "
+                    "inverse)"
+                )
+            m = min(self.block_size, self.n)
+            self._be = (_Dist2D(self.workers, self.n, m)
+                        if isinstance(self.workers, tuple)
+                        else _Dist1D(self.workers, self.n, m))
+        elif not self.gather:
+            from ..driver import UsageError
 
-            _, self._lay, self._run = prepare_sharded_invert(
-                a, self._get_mesh(), self.block_size,
-                precision=self._sweep_prec,
-            )
+            raise UsageError("gather=False requires a distributed mesh")
+
+    @property
+    def _distributed(self) -> bool:
+        return isinstance(self.workers, tuple) or self.workers > 1
+
+    def _compile(self, sample):
+        if self._distributed:
+            self._run = self._be.compile(sample, self._sweep_prec)
         else:
             from ..driver import single_device_invert
 
             self._run = single_device_invert(self.n, self.block_size).lower(
-                a, block_size=self.block_size, refine=self.refine,
+                sample, block_size=self.block_size, refine=self.refine,
                 precision=self._sweep_prec,
             ).compile()
 
-    def _get_mesh(self):
-        if self._mesh is None:
-            from ..parallel import make_mesh
-
-            self._mesh = make_mesh(self.workers)
-        return self._mesh
-
     def invert(self, a: jnp.ndarray):
-        """Invert one (n, n) matrix; returns (inverse, singular)."""
-        a = jnp.asarray(a, self.dtype)
+        """Invert one (n, n) matrix; returns (inverse, singular).
+
+        With ``gather=False`` the first element is the *sharded cyclic
+        block* representation instead (layout on ``self.layout``).
+        """
+        a = jnp.asarray(a, self._work_dtype)
         if a.shape != (self.n, self.n):
             raise ValueError(f"expected ({self.n}, {self.n}), got {a.shape}")
-        if self._run is None:
-            self._compile(a)
-        if self.workers > 1:
-            from ..ops import newton_schulz
-            from ..parallel.sharded_jordan import (
-                gather_inverse,
-                scatter_augmented,
-            )
+        if not self._distributed:
+            if self._run is None:
+                self._compile(a)
+            inv, singular = self._run(a)
+            return inv.astype(self._in_dtype), singular
 
-            blocks = scatter_augmented(a, self._lay, self._mesh)
-            out, singular = self._run(blocks)
-            inv = gather_inverse(out, self._lay, self.n)
-            return newton_schulz(a, inv, self.refine), singular.any()
-        return self._run(a)
+        W = self._be.scatter_W(a)
+        if self._run is None:
+            self._compile(W)
+        out, singular = self._run(W)
+        singular = singular.any()
+        if not self.gather:
+            return self._be.inv_blocks(out).astype(self._in_dtype), singular
+        inv = self._be.gather(out, self.n)
+        if self.refine:
+            from ..ops import newton_schulz
+
+            inv = newton_schulz(a, inv, self.refine)
+        return inv.astype(self._in_dtype), singular
+
+    @property
+    def layout(self):
+        """The cyclic layout of ``gather=False`` inverse blocks."""
+        return None if self._be is None else self._be.lay
 
     def residual(self, a, inv) -> float:
-        """Independent ‖A·A⁻¹ − I‖∞ verification."""
-        if self.workers > 1:
-            from ..parallel import distributed_residual
+        """Independent ‖A·A⁻¹ − I‖∞ verification.
 
-            return float(distributed_residual(
-                jnp.asarray(a, self.dtype), inv, self._get_mesh(),
-                min(self.block_size, self.n),
-            ))
-        return float(residual_inf_norm(jnp.asarray(a, self.dtype), inv))
+        ``inv`` is whatever ``invert`` returned: an n×n array
+        (``gather=True``, verified with the distributed ring/SUMMA GEMM on
+        distributed meshes) or sharded cyclic blocks (``gather=False``,
+        verified without materializing anything n×n per device).
+        """
+        a = jnp.asarray(a, self._work_dtype)
+        if not self._distributed:
+            return float(residual_inf_norm(a, jnp.asarray(inv, a.dtype)))
+        a_blocks = self._be.scatter_a_blocks(a)
+        if self.gather:
+            inv_blocks = self._be.scatter_a_blocks(
+                jnp.asarray(inv, self._work_dtype))
+        else:
+            inv_blocks = jnp.asarray(inv, self._work_dtype)
+        return float(self._be.residual(a_blocks, inv_blocks))

@@ -41,6 +41,43 @@ _lib.tj_write_matrix_text.argtypes = [
     ctypes.c_long,
     ctypes.c_long,
 ]
+_lib.tj_stream_open.restype = ctypes.c_void_p
+_lib.tj_stream_open.argtypes = [ctypes.c_char_p]
+_lib.tj_stream_read.restype = ctypes.c_long
+_lib.tj_stream_read.argtypes = [
+    ctypes.c_void_p,
+    ctypes.POINTER(ctypes.c_double),
+    ctypes.c_long,
+]
+_lib.tj_stream_close.restype = None
+_lib.tj_stream_close.argtypes = [ctypes.c_void_p]
+
+
+class MatrixStream:
+    """Handle-based streaming parser (tj_stream_*): pull ``count`` doubles
+    at a time with O(chunk) native memory — the scatter path's analog of
+    the reference's per-block-row fscanf loop (main.cpp:242-276)."""
+
+    def __init__(self, path: str):
+        self._h = _lib.tj_stream_open(path.encode())
+        if not self._h:
+            raise FileNotFoundError(f"cannot open {path}")
+
+    def read(self, count: int) -> np.ndarray:
+        out = np.empty(count, dtype=np.float64)
+        got = _lib.tj_stream_read(
+            self._h, out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            count,
+        )
+        return out[:max(got, 0)]
+
+    def close(self):
+        if self._h:
+            _lib.tj_stream_close(self._h)
+            self._h = None
+
+    def __del__(self):
+        self.close()
 
 
 def parse_matrix_text(path: str, count: int) -> np.ndarray:

@@ -42,12 +42,14 @@ _W_BUDGET_PANEL = 1024 * 1024
 
 
 def _chunk_candidates(num_blocks: int, m: int,
-                      budget: int | None = None) -> int:
+                      budget: int | None = None,
+                      width_factor: int = 2) -> int:
     """Candidates per grid program: largest divisor of num_blocks whose
-    augmented stack fits the VMEM budget."""
+    working stack (width_factor * m lanes per candidate) fits the VMEM
+    budget."""
     if budget is None:
         budget = _W_BUDGET      # resolved at call time (tests monkeypatch it)
-    per_cand = m * 2 * m * 4
+    per_cand = m * width_factor * m * 4
     cap = max(1, budget // per_cand)
     cg = min(num_blocks, cap)
     while num_blocks % cg:
@@ -137,6 +139,97 @@ def _gj_probe_kernel(blocks_ref, inv_ref, w_ref, *, m, eps):
         onehot, b, dimension_numbers=(((2,), (1,)), ((0,), (0,))),
         preferred_element_type=f32,
         precision=lax.Precision.HIGHEST,  # 0/1 x fp32 must stay exact, not bf16
+    )
+
+
+def _gj_inplace_kernel(blocks_ref, inv_ref, w_ref, *, m, eps):
+    """Width-m in-place variant of the probe: the production default.
+
+    Same implicit-pivot GJ elimination and singularity semantics as
+    ``_gj_probe_kernel``, but with NO ``[A | I]`` augmentation: the working
+    stack is (cg, m, m) — half the VMEM traffic per elimination pass and
+    twice the candidates per grid program, the two costs the rank-1 kernel
+    is bound by (measured: the probe is VPU-pass-throughput-limited).
+
+    In-place bookkeeping (the scalar analog of ops/jordan_inplace.py):
+    maintain the invariant that live column j holds (T·A)[:, j] and each
+    eliminated column k holds T[:, r_k], where T is the accumulated
+    transform and r_k the pivot row of step k.  Both roles evolve under the
+    SAME uniform rank-1 update ``W ← W − col⊗prow_n`` (pivot row ← prow_n),
+    because T's columns obey exactly the recurrence the B half of the
+    augmented kernel applies; column r_k of T equals e_{r_k} until step k
+    (pivot rows are used once), so the freed column k is overwritten with
+    e_{r_k}'s update ``(1/piv at r_k, −col/piv elsewhere)``.  Final
+    reconstruction: T = W·M and A⁻¹ = Qᵀ·T = M·W·M with
+    M[j, :] = onehot(r_j) — two 0/1 MXU dots.
+    """
+    cg = blocks_ref.shape[0]
+    f32 = jnp.float32
+
+    a = blocks_ref[...]                                   # (cg, m, m)
+    norms1 = jnp.max(jnp.sum(jnp.abs(a), axis=2), axis=1, keepdims=True)
+    norms = norms1 * jnp.ones((cg, m), jnp.float32)       # (cg, m) lane-wide
+    thresh = eps * norms
+
+    w_ref[...] = a
+    row_ids = lax.broadcasted_iota(jnp.int32, (cg, m), 1)  # (cg, m)
+    lane_ids = lax.broadcasted_iota(jnp.int32, (1, 1, m), 2)
+    row_ids3a = lax.broadcasted_iota(jnp.int32, (cg, m, 1), 1)
+
+    def step(k, carry):
+        # Same Mosaic conventions as _gj_probe_kernel: 2D 32-bit carries,
+        # masked-reduction extraction, lane-wide (cg, m) scalars.
+        used, perm, sing = carry
+        w = w_ref[...]
+        col = jnp.sum(jnp.where(lane_ids == k, w, 0.0), axis=2)  # (cg, m)
+        cand = jnp.where(used > 0, -1.0, jnp.abs(col))
+        mx = jnp.max(cand, axis=1, keepdims=True)
+        r = jnp.min(jnp.where(cand == mx, row_ids, m), axis=1,
+                    keepdims=True)                        # (cg, 1) pivot row
+        is_r = row_ids == r                               # (cg, m)
+        is_r3 = row_ids3a == r[:, :, None]                # (cg, m, 1)
+        used = jnp.where(is_r, 1.0, used)
+        perm = jnp.where(row_ids == k, r.astype(jnp.int32), perm)
+        piv = jnp.sum(jnp.where(is_r, col, 0.0), axis=1, keepdims=True)
+        bad = jnp.maximum(
+            jnp.where(jnp.abs(piv) < thresh, 1.0, 0.0),
+            jnp.where(norms < eps, 1.0, 0.0),
+        )
+        sing = jnp.maximum(sing, bad)                     # (cg, m) broadcast
+        safe_piv = jnp.where(piv == 0.0, 1.0, piv)
+        prow = jnp.sum(jnp.where(is_r3, w, 0.0), axis=1)
+        prow = (prow / safe_piv)[:, None, :]              # (cg, 1, m)
+        factors = jnp.where(is_r, 0.0, col)[:, :, None]
+        upd = jnp.where(is_r3, prow, w - factors * prow)
+        # Freed column k := T_new[:, r_k] = e_r + u (1/piv at the pivot
+        # row, −col/piv elsewhere) — fused into the same write pass.
+        ucol = jnp.where(is_r, 1.0 / safe_piv, -col / safe_piv)
+        w_ref[...] = jnp.where(lane_ids == k, ucol[:, :, None], upd)
+        return used, perm, sing
+
+    used0 = jnp.zeros((cg, m), jnp.float32)
+    perm0 = jnp.zeros((cg, m), jnp.int32)
+    sing0 = jnp.zeros((cg, m), jnp.float32)
+    _, perm, sing = lax.fori_loop(0, m, step, (used0, perm0, sing0))
+
+    # Reconstruction + singularity poison (same poison scheme as
+    # _gj_probe_kernel): A⁻¹ = M·W·M, M[j, :] = onehot(perm[j]).  The
+    # poison is applied in place in the scratch ref and the two dots are
+    # staged so at most two (cg, m, m) temporaries are live — a full
+    # expression blows the 16 MB scoped-vmem stack at cg=32, m=128.
+    big = sing * jnp.float32(3.4e38)                      # (cg, m)
+    w_ref[...] = w_ref[...] + (big * big)[:, :, None]
+    col_ids3 = lax.broadcasted_iota(jnp.int32, (cg, m, m), 2)
+    onehot = (col_ids3 == perm[:, :, None].astype(jnp.int32)).astype(f32)
+    bdims = (((2,), (1,)), ((0,), (0,)))
+    mw = jax.lax.dot_general(
+        onehot, w_ref[...], dimension_numbers=bdims,
+        preferred_element_type=f32, precision=lax.Precision.HIGHEST,
+    )
+    w_ref[...] = mw
+    inv_ref[...] = jax.lax.dot_general(
+        w_ref[...], onehot, dimension_numbers=bdims,
+        preferred_element_type=f32, precision=lax.Precision.HIGHEST,
     )
 
 
@@ -262,9 +355,10 @@ def _panel_width(m: int) -> int | None:
 
 
 def _run_probe_kernel(blocks, kernel, m: int, interpret: bool,
-                      budget: int | None = None):
-    """Shared pad/chunk/launch/poison-recover harness for both probe
-    kernels."""
+                      budget: int | None = None, width_factor: int = 2):
+    """Shared pad/chunk/launch/poison-recover harness for the probe
+    kernels (width_factor: lanes of scratch per candidate, in units of
+    m — 2 for the augmented kernels, 1 for the in-place kernel)."""
     Nr = blocks.shape[0]
     # Mosaic rejects some small-stack shapes ("Not implemented: Sublane
     # broadcast" — measured on v5e: cg=1 with m<=256 fails; cg>=2, and
@@ -278,7 +372,7 @@ def _run_probe_kernel(blocks, kernel, m: int, interpret: bool,
         eyes = jnp.broadcast_to(jnp.eye(m, dtype=jnp.float32),
                                 (Nr_pad - Nr, m, m))
         blocks = jnp.concatenate([blocks, eyes], axis=0)
-    cg = _chunk_candidates(Nr_pad, m, budget)
+    cg = _chunk_candidates(Nr_pad, m, budget, width_factor)
     if cg < 2 and m <= 256:
         # Known-bad Mosaic region (see comment above); unreachable with the
         # default _W_BUDGET, but guard against shrunken budgets with a real
@@ -299,7 +393,7 @@ def _run_probe_kernel(blocks, kernel, m: int, interpret: bool,
         out_specs=pl.BlockSpec((cg, m, m), lambda i: (i, 0, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((Nr_pad, m, m), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((cg, m, 2 * m), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((cg, m, width_factor * m), jnp.float32)],
         interpret=interpret,
     )(blocks)
     inv = inv[:Nr]
@@ -317,18 +411,15 @@ def pallas_batched_block_inverse(
 
     Drop-in fast path for ops/block_inverse.py::batched_block_inverse with
     per-block singularity scaling.  Returns (inverses, singular_flags).
-    Dispatches to the MXU-blocked panel kernel when the block size
-    supports it (the rank-1 kernel remains for small/odd m).
+    Dispatches to the augmented rank-1 kernel — measured fastest at m=128
+    (0.52 ms vs 0.85 in-place / 3.5 panel for a 32-candidate stack; the
+    in-place and panel variants stay addressable below as recorded
+    experiments; see benchmarks/PHASES.md "probe kernel shootout").
     """
     Nr, m, _ = blocks.shape
     if eps is None:
         eps = eps_for(jnp.float32)
     blocks = blocks.astype(jnp.float32)
-    b = _panel_width(m)
-    if b is not None:
-        kernel = functools.partial(_gj_panel_kernel, m=m, b=b, eps=eps)
-        return _run_probe_kernel(blocks, kernel, m, interpret,
-                                 _W_BUDGET_PANEL)
     kernel = functools.partial(_gj_probe_kernel, m=m, eps=eps)
     return _run_probe_kernel(blocks, kernel, m, interpret)
 
@@ -339,11 +430,53 @@ def pallas_batched_block_inverse_rank1(
     eps: float | None = None,
     interpret: bool = False,
 ):
-    """The rank-1 (v1) kernel, forced — kept addressable for parity tests
-    and perf comparison against the panel kernel."""
+    """The augmented rank-1 (v1) kernel, forced — currently identical to
+    the dispatch path; kept addressable so perf comparisons against the
+    experimental kernels keep working if the dispatch changes."""
     Nr, m, _ = blocks.shape
     if eps is None:
         eps = eps_for(jnp.float32)
     blocks = blocks.astype(jnp.float32)
     kernel = functools.partial(_gj_probe_kernel, m=m, eps=eps)
     return _run_probe_kernel(blocks, kernel, m, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
+def pallas_batched_block_inverse_inplace(
+    blocks: jnp.ndarray,
+    eps: float | None = None,
+    interpret: bool = False,
+):
+    """The width-m in-place (v3) kernel, forced — despite half the VMEM
+    data per pass it measures ~1.6x SLOWER than the rank-1 kernel at
+    m=128 (Mosaic schedules the narrower passes worse and the extra
+    column-k select adds a pass), so it is not dispatched; kept
+    addressable as a recorded experiment."""
+    Nr, m, _ = blocks.shape
+    if eps is None:
+        eps = eps_for(jnp.float32)
+    blocks = blocks.astype(jnp.float32)
+    kernel = functools.partial(_gj_inplace_kernel, m=m, eps=eps)
+    return _run_probe_kernel(blocks, kernel, m, interpret, width_factor=1)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
+def pallas_batched_block_inverse_panel(
+    blocks: jnp.ndarray,
+    eps: float | None = None,
+    interpret: bool = False,
+):
+    """The MXU-blocked panel (v2) kernel, forced — measured SLOWER than
+    the rank-1 kernel at every production size (its deferred-update
+    temporaries force a 4x smaller VMEM budget, and grid programs
+    serialize), so it is not dispatched; kept addressable as the recorded
+    outcome of the VERDICT r2 #2 experiment."""
+    Nr, m, _ = blocks.shape
+    if eps is None:
+        eps = eps_for(jnp.float32)
+    blocks = blocks.astype(jnp.float32)
+    b = _panel_width(m)
+    if b is None:
+        raise ValueError(f"no panel width divides m={m}")
+    kernel = functools.partial(_gj_panel_kernel, m=m, b=b, eps=eps)
+    return _run_probe_kernel(blocks, kernel, m, interpret, _W_BUDGET_PANEL)

@@ -1,0 +1,116 @@
+"""Streaming file scatter: host memory O(n·m), never O(n²).
+
+The reference's root rank reads ONE block-row buffer at a time and sends
+it straight to its cyclic owner (read_matrix, main.cpp:242-276), so its
+host high-water mark is a single strip.  The round-2 design lost that
+property (io.py parsed the whole file into one n×n host array before a
+full-matrix device_put); these functions restore it TPU-natively:
+
+  * ``MatrixStripReader`` (io.py) pulls one m-row strip per call through
+    the native chunked strtod stream;
+  * each strip is padded/permuted host-side (O(m·N) work) and
+    ``jax.device_put`` straight onto its owner device(s);
+  * per-device shards are assembled ON DEVICE (``jnp.stack`` over
+    committed per-strip arrays), and the global sharded array is formed
+    with ``jax.make_array_from_single_device_arrays`` — no host n×n
+    array ever exists.
+
+The output formats match the host-array scatters exactly
+(ring_gemm._to_identity_padded_blocks / sharded_jordan.scatter_augmented
+for 1D, jordan2d.scatter_matrix_2d / scatter_augmented_2d for 2D), so
+the compiled engines cannot tell the difference — asserted by tests.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from ..io import MatrixStripReader
+from .layout import CyclicLayout, CyclicLayout2D
+from .mesh import AXIS, AXIS_C, AXIS_R
+
+
+def _padded_strip(reader, r: int, lay, dtype, augmented: bool) -> np.ndarray:
+    """Global block-row ``r`` as a host (m, W) strip: file data in the
+    top-left, identity on the padding diagonal, and (augmented only) the
+    B half's identity block — the streaming unit of the scatter."""
+    n, m, N = lay.n, lay.m, lay.N
+    W = 2 * N if augmented else N
+    out = np.zeros((m, W), dtype)
+    g0 = r * m
+    rows = max(0, min(m, n - g0))        # file rows in this block
+    if rows:
+        out[:rows, :n] = reader.read_rows(rows)
+    # Identity padding rows (pad_with_identity semantics): global rows
+    # g >= n carry a 1 at column g.
+    for i in range(rows, m):
+        out[i, g0 + i] = 1
+    if augmented:
+        # B half starts as I: row g carries a 1 at column N + g.
+        for i in range(m):
+            out[i, N + g0 + i] = 1
+    return out
+
+
+def stream_scatter_1d(path: str, lay: CyclicLayout, mesh: Mesh,
+                      dtype=jnp.float32, augmented: bool = False):
+    """File -> (Nr, m, W) cyclic-order blocks sharded over the 1D mesh,
+    one strip of host memory at a time."""
+    dtype = jnp.dtype(dtype)
+    p, bpw = lay.p, lay.blocks_per_worker
+    devices = list(mesh.devices.flat)
+    per_dev: list[list] = [[] for _ in range(p)]
+    with MatrixStripReader(path, lay.n, dtype) as reader:
+        # File order is global block order; owner of block r is r % p at
+        # slot r // p — appending in r-order fills slots in order.
+        for r in range(lay.Nr):
+            strip = _padded_strip(reader, r, lay, dtype, augmented)
+            per_dev[lay.owner(r)].append(
+                jax.device_put(strip, devices[lay.owner(r)]))
+            del strip
+    shards = [jnp.stack(strips) for strips in per_dev]   # on-device (bpw,m,W)
+    W = shards[0].shape[-1]
+    return jax.make_array_from_single_device_arrays(
+        (lay.Nr, lay.m, W),
+        NamedSharding(mesh, PartitionSpec(AXIS, None, None)),
+        shards,
+    )
+
+
+def stream_scatter_2d(path: str, lay: CyclicLayout2D, mesh: Mesh,
+                      dtype=jnp.float32, augmented: bool = False):
+    """File -> (Nr, m, W) blocks, both axes in cyclic storage order,
+    sharded over the (pr, pc) mesh, one strip of host memory at a time."""
+    dtype = jnp.dtype(dtype)
+    pr, pc, m = lay.pr, lay.pc, lay.m
+    ncb = 2 * lay.Nr if augmented else lay.Nr
+    colp = lay.col_perm(ncb)             # storage order of column blocks
+    dev = mesh.devices                   # (pr, pc) array of devices
+    bpr = lay.Nr // pr
+    per_dev: list[list[list]] = [[[] for _ in range(pc)] for _ in range(pr)]
+    with MatrixStripReader(path, lay.n, dtype) as reader:
+        for r in range(lay.Nr):
+            strip = _padded_strip(reader, r, lay, dtype, augmented)
+            # Column blocks to storage order, then split into pc chunks.
+            chunks = strip.reshape(m, ncb, m)[:, colp, :]
+            bc = ncb // pc
+            kr = r % pr
+            for kc in range(pc):
+                piece = np.ascontiguousarray(
+                    chunks[:, kc * bc:(kc + 1) * bc, :].reshape(m, bc * m))
+                per_dev[kr][kc].append(jax.device_put(piece, dev[kr][kc]))
+            del strip, chunks
+    shards = []
+    for kr in range(pr):
+        for kc in range(pc):
+            shards.append(jnp.stack(per_dev[kr][kc]))    # (bpr, m, W/pc)
+    W = ncb * m
+    return jax.make_array_from_single_device_arrays(
+        (lay.Nr, lay.m, W),
+        NamedSharding(mesh, PartitionSpec(AXIS_R, None, AXIS_C)),
+        shards,
+    )
