@@ -1,0 +1,50 @@
+"""Batched-inversion benchmark (the north-star vmap capability,
+BASELINE.md: "Batched 512x(2048x2048) Jordan solves").
+
+Usage: python benchmarks/batched_bench.py [B,n,m ...]
+
+Measures ``ops.batched.batched_jordan_invert`` on the real chip with the
+slope-timing harness and prints one line per config with the 2n³·B flop
+convention.  Results are recorded in benchmarks/PHASES.md.
+"""
+
+import sys
+import time
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_jordan.ops import batched_jordan_invert, residual_inf_norm
+    from tpu_jordan.utils.benchmarking import slope_time
+
+    configs = [(512, 512, 64), (64, 1024, 128), (8, 2048, 128)]
+    if len(sys.argv) > 1:
+        configs = [tuple(map(int, c.split(","))) for c in sys.argv[1:]]
+
+    rng = np.random.default_rng(0)
+    for B, n, m in configs:
+        # Well-scaled gaussian batch (the batched regime's natural
+        # workload; |i−j| is a single fixed matrix, pointless batched).
+        a = jnp.asarray(rng.standard_normal((B, n, n)), jnp.float32)
+        t0 = time.perf_counter()
+        inv, sing = batched_jordan_invert(a, block_size=m)
+        jax.block_until_ready(inv)
+        compile_s = time.perf_counter() - t0
+        nsing = int(jnp.sum(sing))
+        # Residual on one element (upcycled check, not the timed path).
+        rel = float(residual_inf_norm(a[0], inv[0]))
+        per = slope_time(
+            lambda v: batched_jordan_invert(v, block_size=m)[0], (a,),
+            r1=2, r2=6,
+        )
+        gf = 2.0 * n**3 * B / per / 1e9
+        print(f"B={B} n={n} m={m}: {per*1e3:8.1f} ms  {gf:7.0f} GFLOP/s "
+              f"(2n^3B)  residual[0]={rel:.1e}  singular={nsing}/{B} "
+              f"(compile {compile_s:.0f}s)", flush=True)
+
+
+if __name__ == "__main__":
+    main()

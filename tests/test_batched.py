@@ -36,3 +36,41 @@ class TestBatchedInvert:
         np.testing.assert_allclose(
             np.asarray(inv[0]), np.linalg.inv(good), rtol=1e-8, atol=1e-8
         )
+
+    def test_inplace_engine_selected_and_agrees(self, rng, monkeypatch):
+        # Nr <= MAX_UNROLL_NR must route through the vmapped in-place
+        # engine (the 2x-flops win applies to batches too); its results
+        # must match the augmented engine.
+        import tpu_jordan.driver as driver_mod
+        from tpu_jordan.ops.jordan_inplace import block_jordan_invert_inplace
+
+        calls = []
+        orig = driver_mod.single_device_invert
+
+        def spy(n, m):
+            engine = orig(n, m)
+            calls.append(engine is block_jordan_invert_inplace)
+            return engine
+
+        monkeypatch.setattr(driver_mod, "single_device_invert", spy)
+        a = rng.standard_normal((4, 32, 32))
+        inv, sing = batched_jordan_invert(jnp.asarray(a), block_size=8)
+        assert calls and all(calls), "in-place engine was not selected"
+        assert not np.asarray(sing).any()
+        np.testing.assert_allclose(
+            np.asarray(inv), np.linalg.inv(a), rtol=1e-8, atol=1e-8
+        )
+
+    def test_augmented_fallback_large_Nr(self, rng):
+        # Nr > MAX_UNROLL_NR: the fori_loop engine takes over (no
+        # unrolled-trace blowup for many tiny blocks).
+        from tpu_jordan.parallel.sharded_inplace import MAX_UNROLL_NR
+
+        n, m = 2 * (MAX_UNROLL_NR + 2), 2
+        assert -(-n // m) > MAX_UNROLL_NR
+        a = rng.standard_normal((2, n, n))
+        inv, sing = batched_jordan_invert(jnp.asarray(a), block_size=m)
+        assert not np.asarray(sing).any()
+        np.testing.assert_allclose(
+            np.asarray(inv), np.linalg.inv(a), rtol=1e-6, atol=1e-6
+        )

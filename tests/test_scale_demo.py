@@ -1,0 +1,36 @@
+"""Memory-scaling evidence at a non-toy size (VERDICT r2 #9).
+
+The 2D block-cyclic + gather=False mode exists so per-worker memory is
+O(n²/(pr·pc)) — the fix for the reference's replicated-column memory wall
+(main.cpp:366-370).  This test runs it at n=2048 on the 8-device CPU mesh
+and asserts the actual per-device shard bytes, not just the residual.
+"""
+
+import numpy as np
+
+from tpu_jordan.driver import solve
+
+
+def test_2048_2d_no_gather_shard_bytes():
+    n, m, pr, pc = 2048, 128, 2, 4
+    res = solve(n, m, workers=(pr, pc), gather=False)
+    # |i−j| fixture: ‖A‖∞ ≈ n²/2; the reported residual is unnormalized.
+    assert res.residual / (n * n / 2) < 1e-4
+
+    blocks = res.inverse_blocks
+    lay = res.layout
+    assert lay.n == n and lay.m == m
+    N = lay.N
+    # Global representation is (Nr, m, N) — n² numbers total, no
+    # augmented half.
+    assert blocks.shape == (lay.Nr, m, N)
+    shards = blocks.addressable_shards
+    assert len(shards) == pr * pc
+    per_worker = (lay.Nr // pr) * m * (N // pc)
+    full = N * N
+    for s in shards:
+        assert s.data.shape == (lay.Nr // pr, m, N // pc)
+        assert s.data.nbytes == per_worker * 4          # fp32
+    # The point of the mode: each worker holds 1/(pr*pc) of the matrix.
+    assert per_worker * pr * pc == full
+    assert per_worker * 4 == full * 4 // (pr * pc)

@@ -9,6 +9,7 @@ TPU-relevant knobs are optional flags so the positional contract is intact.
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 import jax.numpy as jnp
@@ -57,9 +58,9 @@ def main(argv=None) -> int:
     ap.add_argument("--gather", default=True,
                     action=argparse.BooleanOptionalAction,
                     help="--no-gather keeps the inverse as sharded cyclic "
-                         "blocks (distributed generator runs only): the "
-                         "O(n^2/workers) per-device memory mode for "
-                         "north-star sizes")
+                         "blocks (distributed runs; generator or file "
+                         "input): the O(n^2/workers) per-device memory "
+                         "mode for north-star sizes")
     ap.add_argument("--quiet", action="store_true")
     try:
         args = ap.parse_args(argv)
@@ -77,6 +78,15 @@ def main(argv=None) -> int:
         # usage error -> exit 1 like the reference (main.cpp:77-85)
         print("usage: python -m tpu_jordan n m [<file>]", file=sys.stderr)
         return 1
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # Honor JAX_PLATFORMS even when the interpreter preloaded jax
+        # before the CLI ran (e.g. via sitecustomize, which freezes the
+        # platform choice before the env var can take effect).  A no-op
+        # when they already agree.
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
 
     if args.distributed:
         # Must run before the first backend use so every host process joins
@@ -124,7 +134,7 @@ def main(argv=None) -> int:
         print(e, file=sys.stderr)
         return 2
     except UsageError as e:
-        # invalid flag combinations (e.g. --no-gather with a file or on the
+        # invalid flag combinations (e.g. --no-gather on the
         # single-device path) -> exit 1 (main.cpp:77-85).
         print(e, file=sys.stderr)
         return 1

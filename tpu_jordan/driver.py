@@ -116,8 +116,8 @@ def solve(
 
     if not gather:
         raise UsageError(
-            "gather=False is only supported on distributed paths with "
-            "generator input"
+            "gather=False is only supported on distributed paths "
+            "(workers > 1 or a (pr, pc) tuple)"
         )
 
     a = load()
@@ -270,6 +270,20 @@ class _Dist1D:
 
         return _to_identity_padded_blocks(a, self.lay, self.mesh)
 
+    def stream_W(self, path, dtype, storage_dtype=None):
+        from .parallel.scatter_stream import stream_scatter_1d
+
+        return stream_scatter_1d(path, self.lay, self.mesh, dtype,
+                                 augmented=not self.inplace,
+                                 storage_dtype=storage_dtype)
+
+    def stream_a_blocks(self, path, dtype, storage_dtype=None):
+        from .parallel.scatter_stream import stream_scatter_1d
+
+        return stream_scatter_1d(path, self.lay, self.mesh, dtype,
+                                 augmented=False,
+                                 storage_dtype=storage_dtype)
+
     def residual(self, a_blocks, inv_blocks):
         from .parallel.ring_gemm import distributed_residual_blocks
 
@@ -350,6 +364,20 @@ class _Dist2D:
 
         return scatter_matrix_2d(a, self.lay, self.mesh)
 
+    def stream_W(self, path, dtype, storage_dtype=None):
+        from .parallel.scatter_stream import stream_scatter_2d
+
+        return stream_scatter_2d(path, self.lay, self.mesh, dtype,
+                                 augmented=not self.inplace,
+                                 storage_dtype=storage_dtype)
+
+    def stream_a_blocks(self, path, dtype, storage_dtype=None):
+        from .parallel.scatter_stream import stream_scatter_2d
+
+        return stream_scatter_2d(path, self.lay, self.mesh, dtype,
+                                 augmented=False,
+                                 storage_dtype=storage_dtype)
+
     def residual(self, a_blocks, inv_blocks):
         from .parallel.jordan2d import distributed_residual_2d
 
@@ -367,20 +395,21 @@ def _solve_distributed_core(
 
     Reference analog end to end: init_matrix fills each rank's strip
     locally (main.cpp:128-149; our generator path — fully device-resident,
-    zero host n×n arrays), or read_matrix scatters a file from the host
-    (main.cpp:209-282); Jordan runs (timed like glob_time,
+    zero host n×n arrays), or read_matrix STREAMS a file one block-row
+    strip at a time straight onto the owner devices (main.cpp:242-276
+    semantics: host memory O(n·m), never O(n²) —
+    parallel/scatter_stream.py); Jordan runs (timed like glob_time,
     main.cpp:427-450: elimination only, compile/gather excluded); A is
     re-read/regenerated and the residual MAX-allreduced with only a scalar
     leaving the mesh (main.cpp:463-513).  Refinement (no reference analog)
-    runs on the gathered inverse and therefore requires ``gather=True``.
+    runs on the gathered inverse and therefore requires ``gather=True``
+    (and, for file input, one full host read).
     """
     from .ops import newton_schulz
 
     if refine and not gather:
         raise UsageError("refine requires gather=True (it runs on the "
                          "gathered inverse)")
-    if not gather and file is not None:
-        raise UsageError("gather=False requires generator input")
 
     # Sub-fp32 storage dtypes compute in fp32 and round once at the end —
     # the same policy as the single-device kernels (ops/jordan.py): bf16
@@ -388,18 +417,22 @@ def _solve_distributed_core(
     in_dtype = jnp.dtype(dtype)
     if in_dtype.itemsize < 4:
         dtype = jnp.float32
+    # Sub-fp32 storage quantizes A itself before the fp32 upcast (the
+    # single-device semantics: the matrix being inverted IS the rounded
+    # one) — the streamed strips round per-strip, same result.
+    storage = in_dtype if in_dtype != jnp.dtype(dtype) else None
 
-    a_host = None
     if file is None:
         W = be.generate_W(generator, dtype)
     else:
-        a_host = jnp.asarray(load(), dtype)
-        W = be.scatter_W(a_host)
+        W = be.stream_W(file, dtype, storage)
     if verbose:
+        from .io import read_matrix_corner
         from .utils.printing import print_corner
 
         print("A")
-        print_corner(a_host if a_host is not None
+        print_corner(read_matrix_corner(file, n, dtype)
+                     if file is not None
                      else generate(generator, (min(n, 10), min(n, 10)),
                                    dtype))
 
@@ -432,7 +465,7 @@ def _solve_distributed_core(
         inv = inv.astype(in_dtype)
         residual = float(residual_inf_norm(a_full, inv.astype(dtype)))
     else:
-        a_b = (be.scatter_a_blocks(jnp.asarray(load(), dtype))
+        a_b = (be.stream_a_blocks(file, dtype, storage)
                if file is not None
                else be.generate_a_blocks(generator, dtype))
         residual = float(be.residual(a_b, jnp.asarray(inv_b, dtype)))

@@ -55,6 +55,16 @@ def write_matrix_file(path: str, a: np.ndarray) -> None:
     np.savetxt(path, np.asarray(a), fmt="%.17g")
 
 
+def read_matrix_corner(path: str, n: int, dtype=np.float64,
+                       k: int = 10) -> np.ndarray:
+    """Top-left min(n, k)-corner of the matrix in ``path`` — the
+    print_matrix gather (main.cpp:297-341) without reading past the first
+    k rows (O(n·k) host work, never the whole file)."""
+    k = min(n, k)
+    with MatrixStripReader(path, n, dtype) as reader:
+        return np.ascontiguousarray(reader.read_rows(k)[:, :k])
+
+
 class MatrixStripReader:
     """Incremental row-strip reader: the streaming analog of the
     reference's root-rank scatter loop (main.cpp:242-276), which reads ONE

@@ -3,9 +3,15 @@
 North-star capability beyond the reference (BASELINE.md: "Batched
 512x(2048x2048) Jordan solves (vmap)"): the reference can only invert one
 matrix per program run; here the whole blocked Gauss-Jordan algorithm
-(ops/jordan.py) vmaps over a leading batch axis, so the MXU sees
-batch-stacked matmuls and the pivot probes of every problem in the batch
-run together.
+vmaps over a leading batch axis, so the MXU sees batch-stacked matmuls
+and the pivot probes of every problem in the batch run together.
+
+Engine selection mirrors ``driver.single_device_invert``: the in-place
+2N³ path (ops/jordan_inplace.py) whenever its unrolled trace is
+affordable — its swap bookkeeping is traced values, so it vmaps like any
+other jax code (vmap turns the per-step ``dynamic_slice`` row swaps into
+batched gathers, and the pallas probe's batching rule folds the batch
+axis into the kernel grid) — else the augmented fori_loop path.
 """
 
 from __future__ import annotations
@@ -15,9 +21,6 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-
-from .jordan import block_jordan_invert
-
 
 @partial(jax.jit, static_argnames=(
     "block_size", "eps", "precision", "refine", "use_pallas"))
@@ -34,12 +37,19 @@ def batched_jordan_invert(
     Each batch element gets independent condition-based pivoting and an
     independent singularity flag (shaped like the batch).
     """
+    from ..config import default_block_size
+    from ..driver import single_device_invert
+
     batch_shape = a.shape[:-2]
     n = a.shape[-1]
     flat = a.reshape((-1,) + a.shape[-2:])
 
+    m = min(n, block_size if block_size is not None
+            else default_block_size(n))
+    engine = single_device_invert(n, m)
+
     def one(x):
-        return block_jordan_invert(
+        return engine(
             x, block_size=block_size, eps=eps, precision=precision,
             refine=refine, use_pallas=use_pallas,
         )

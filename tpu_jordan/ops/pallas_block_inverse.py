@@ -39,6 +39,12 @@ _W_BUDGET = 4 * 1024 * 1024
 # update (w read, U@P product, w_ref write), so its per-program stack must
 # be smaller to stay under the 16 MB scoped-vmem limit.
 _W_BUDGET_PANEL = 1024 * 1024
+# The fused (in-place + panel) kernel's stack is width-m, but the
+# in/out blocks, the four (cg, b, m) micro-loop carries, and the deferred
+# dot temporaries are all live against it: measured scoped-vmem is
+# ~0.73 MB per candidate at m=128 (cg=24 needs 17.6 MB), so the stack
+# budget must cap cg at ~20 to stay under the 16 MB limit.
+_W_BUDGET_FUSED = 5 * 1024 * 1024 // 4
 
 
 def _chunk_candidates(num_blocks: int, m: int,
@@ -346,6 +352,157 @@ def _gj_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps):
     )
 
 
+def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps):
+    """The production probe: in-place (width-m) storage + b-wide panel
+    micro-steps + MXU-deferred trailing updates + DEFERRED DIVISIONS.
+
+    Combines the three measured lessons of the kernel shootout
+    (benchmarks/PHASES.md):
+      * width-m storage (no [A | I]): half the stack, so cg stays large
+        and the whole candidate stack fits one grid program at m=128;
+      * per-step VPU work touches only (cg, m, b) panels — the full-width
+        rank-1 passes that bound the v1 kernel (4 x (cg, m, 2m) per step)
+        shrink by ~2m/b; the full-width update rides the MXU once per
+        panel via the composed transform T = E_{b-1}···E_0 = I + U·R;
+      * eliminations are UNNORMALIZED: E_j = I + v_j·e_{r_j}ᵀ with
+        v_j[r_j] = 0, so pivot rows keep their raw scale through the
+        whole elimination and every row is scaled ONCE at the end by the
+        exact division 1/piv_k.  This kills the catastrophic
+        ``u[r] = 1/piv − 1`` representation error of the v2/v3 kernels
+        (relative error ~eps·|piv| in the normalized pivot row) — with
+        raw pivot rows the candidate values seen by later steps are
+        identical to normalized GJ (S_i − (S_i[k]/piv)·S_r), so the
+        pivot sequence is preserved exactly.
+
+    Bookkeeping: live column j holds (T·A)[:, j]; eliminated column k
+    holds T[:, r_k] (both evolve under the same uniform update, so the
+    deferred W += U·(R·W) covers them together); the panel's own freed
+    columns are rebuilt from the Vp chain (Vp[:, j] starts as
+    e_{r_j} + v_j and composes forward) and scattered back with a one-hot
+    MXU dot.  Final: A⁻¹ = D⁻¹·M·W·M with M[k, :] = onehot(r_k) and
+    D = diag(piv_k).
+    """
+    cg = blocks_ref.shape[0]
+    f32 = jnp.float32
+
+    a = blocks_ref[...]                                   # (cg, m, m)
+    norms1 = jnp.max(jnp.sum(jnp.abs(a), axis=2), axis=1, keepdims=True)
+    norms = norms1 * jnp.ones((cg, m), jnp.float32)       # (cg, m) lane-wide
+    thresh = eps * norms
+
+    w_ref[...] = a
+    # Panel state is kept TRANSPOSED — St/Ut/Vpt/R are (cg, b, m) with
+    # matrix rows on the LANE dim — so the micro-loop can be a real
+    # lax.fori_loop: column j of the panel is a dynamic slice on the
+    # sublane dim (legal in Mosaic; dynamic LANE indexing is not), pivot
+    # rows are masked lane reductions, and an unrolled Python loop (whose
+    # per-iteration temporaries Mosaic keeps live — measured 51 MB of
+    # scoped vmem at cg=32, m=128) is avoided entirely.
+    row_ids = lax.broadcasted_iota(jnp.int32, (cg, m), 1)
+    lane_m = lax.broadcasted_iota(jnp.int32, (1, 1, m), 2)
+    sel_rows = lax.broadcasted_iota(jnp.int32, (m, b), 0)
+    sel_cols = lax.broadcasted_iota(jnp.int32, (m, b), 1)
+    rb_ids = lax.broadcasted_iota(jnp.int32, (cg, b, m), 1)
+    lane_bm = lax.broadcasted_iota(jnp.int32, (cg, b, m), 2)
+    bdims = (((2,), (1,)), ((0,), (0,)))                  # (cg,x,k)·(cg,k,y)
+
+    def panel(K, carry):
+        used, perm, sing, pivs = carry                    # (cg, m) each
+        k0 = K * b
+        C = jnp.where(sel_rows == k0 + sel_cols, 1.0, 0.0).astype(f32)
+        # St[j, i] = W[i, k0+j]: one-hot dot (j, cg, i) then a batch-dim
+        # transpose (lane dim untouched — cheap vreg reindexing).
+        St = jnp.transpose(jax.lax.dot_general(
+            C, w_ref[...], dimension_numbers=(((0,), (2,)), ((), ())),
+            preferred_element_type=f32, precision=lax.Precision.HIGHEST,
+        ), (1, 0, 2))                                     # (cg, b, m)
+
+        def micro(j, mc):
+            St, Ut, Vpt, R, used, perm, sing, pivs = mc
+            # Column j of the panel = sublane j of St, via masked reduce
+            # (Mosaic lowers no dynamic_slice on values; the pass is only
+            # (cg, b, m) — b/m-th of a full-width pass).
+            col = jnp.sum(jnp.where(rb_ids == j, St, 0.0), axis=1)
+            cand = jnp.where(used > 0, -1.0, jnp.abs(col))
+            mx = jnp.max(cand, axis=1, keepdims=True)
+            r = jnp.min(jnp.where(cand == mx, row_ids, m), axis=1,
+                        keepdims=True)                    # (cg, 1)
+            is_r = row_ids == r                           # (cg, m)
+            is_rl = lane_bm == r[:, :, None]              # (cg, b, m)
+            used = jnp.where(is_r, 1.0, used)
+            kk = k0 + j
+            perm = jnp.where(row_ids == kk, r.astype(jnp.int32), perm)
+            piv = jnp.sum(jnp.where(is_r, col, 0.0), axis=1, keepdims=True)
+            bad = jnp.maximum(
+                jnp.where(jnp.abs(piv) < thresh, 1.0, 0.0),
+                jnp.where(norms < eps, 1.0, 0.0),
+            )
+            sing = jnp.maximum(sing, bad)
+            safe_piv = jnp.where(piv == 0.0, 1.0, piv)
+            pivs = jnp.where(row_ids == kk,
+                             safe_piv * jnp.ones((cg, m), f32), pivs)
+            v = jnp.where(is_r, 0.0, -col / safe_piv)     # (cg, m)
+            v3 = v[:, None, :]                            # (cg, 1, m)
+            is_j = rb_ids == j                            # (cg, b, m)
+            s_r = jnp.sum(jnp.where(is_rl, St, 0.0), axis=2)   # (cg, b)
+            St = St + s_r[:, :, None] * v3
+            u_r = jnp.sum(jnp.where(is_rl, Ut, 0.0), axis=2)
+            Ut = jnp.where(is_j, Ut + v3, Ut + u_r[:, :, None] * v3)
+            vp_r = jnp.sum(jnp.where(is_rl, Vpt, 0.0), axis=2)
+            newrow = jnp.where(is_r, 1.0, v)[:, None, :]  # e_r + v
+            Vpt = jnp.where(is_j, newrow,
+                            Vpt + vp_r[:, :, None] * v3)
+            R = jnp.where(is_j & is_rl, 1.0, R)
+            return St, Ut, Vpt, R, used, perm, sing, pivs
+
+        z = jnp.zeros((cg, b, m), f32)
+        _, Ut, Vpt, R, used, perm, sing, pivs = lax.fori_loop(
+            0, b, micro, (St, z, z, z, used, perm, sing, pivs))
+
+        # Deferred full-width update W += U·(R·W) (R = RAW pivot-row
+        # selectors); panel slots are rebuilt from Vp instead.  All dots
+        # contract on dim 1 of the transposed state — no lane transposes.
+        P = jax.lax.dot_general(
+            R, w_ref[...], dimension_numbers=bdims,
+            preferred_element_type=f32, precision=lax.Precision.HIGHEST,
+        )                                                 # (cg, b, m)
+        upd = jax.lax.dot_general(
+            Ut, P, dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+            preferred_element_type=f32, precision=lax.Precision.HIGHEST,
+        )                                                 # (cg, m, m)
+        vscat = jax.lax.dot_general(
+            Vpt, C, dimension_numbers=(((1,), (1,)), ((), ())),
+            preferred_element_type=f32, precision=lax.Precision.HIGHEST,
+        )                                                 # (cg, m, m)
+        in_panel = (lane_m >= k0) & (lane_m < k0 + b)
+        w_ref[...] = jnp.where(in_panel, vscat, w_ref[...] + upd)
+        return used, perm, sing, pivs
+
+    used0 = jnp.zeros((cg, m), jnp.float32)
+    perm0 = jnp.zeros((cg, m), jnp.int32)
+    sing0 = jnp.zeros((cg, m), jnp.float32)
+    pivs0 = jnp.ones((cg, m), jnp.float32)
+    _, perm, sing, pivs = lax.fori_loop(0, m // b, panel,
+                                        (used0, perm0, sing0, pivs0))
+
+    # Reconstruction + poison: A⁻¹ = D⁻¹·M·W·M (staged via the scratch
+    # ref so at most two (cg, m, m) temporaries are live at once).
+    big = sing * jnp.float32(3.4e38)                      # (cg, m)
+    w_ref[...] = w_ref[...] + (big * big)[:, :, None]
+    col_ids3 = lax.broadcasted_iota(jnp.int32, (cg, m, m), 2)
+    onehot = (col_ids3 == perm[:, :, None].astype(jnp.int32)).astype(f32)
+    mw = jax.lax.dot_general(
+        onehot, w_ref[...], dimension_numbers=bdims,
+        preferred_element_type=f32, precision=lax.Precision.HIGHEST,
+    )
+    w_ref[...] = mw
+    inv = jax.lax.dot_general(
+        w_ref[...], onehot, dimension_numbers=bdims,
+        preferred_element_type=f32, precision=lax.Precision.HIGHEST,
+    )
+    inv_ref[...] = inv * (1.0 / pivs)[:, :, None]
+
+
 def _panel_width(m: int) -> int | None:
     """Largest supported panel width dividing m (None -> no panel path)."""
     for b in (32, 16, 8):
@@ -411,15 +568,22 @@ def pallas_batched_block_inverse(
 
     Drop-in fast path for ops/block_inverse.py::batched_block_inverse with
     per-block singularity scaling.  Returns (inverses, singular_flags).
-    Dispatches to the augmented rank-1 kernel — measured fastest at m=128
-    (0.52 ms vs 0.85 in-place / 3.5 panel for a 32-candidate stack; the
-    in-place and panel variants stay addressable below as recorded
-    experiments; see benchmarks/PHASES.md "probe kernel shootout").
+    Dispatches to the fused in-place panel kernel when the block size
+    supports a panel split AND the VMEM budget admits >= 2 candidates per
+    grid program (measured: it wins at m <= 256 — 29.7 -> 18.4 ms on the
+    full 4096 m=256 inversion — but fails to compile at m=512 where only
+    cg=1 fits); else the augmented rank-1 kernel.  See benchmarks/PHASES.md
+    "probe kernel shootout".
     """
     Nr, m, _ = blocks.shape
     if eps is None:
         eps = eps_for(jnp.float32)
     blocks = blocks.astype(jnp.float32)
+    b = _panel_width(m)
+    if b is not None and 2 * m * m * 4 <= _W_BUDGET_FUSED:
+        kernel = functools.partial(_gj_fused_panel_kernel, m=m, b=b, eps=eps)
+        return _run_probe_kernel(blocks, kernel, m, interpret,
+                                 _W_BUDGET_FUSED, width_factor=1)
     kernel = functools.partial(_gj_probe_kernel, m=m, eps=eps)
     return _run_probe_kernel(blocks, kernel, m, interpret)
 
@@ -458,6 +622,27 @@ def pallas_batched_block_inverse_inplace(
     blocks = blocks.astype(jnp.float32)
     kernel = functools.partial(_gj_inplace_kernel, m=m, eps=eps)
     return _run_probe_kernel(blocks, kernel, m, interpret, width_factor=1)
+
+
+@functools.partial(jax.jit, static_argnames=("eps", "interpret"))
+def pallas_batched_block_inverse_fused(
+    blocks: jnp.ndarray,
+    eps: float | None = None,
+    interpret: bool = False,
+):
+    """The fused in-place panel (v4) kernel, forced — the production
+    dispatch for panel-splittable m; kept addressable so perf comparisons
+    keep working if the dispatch changes."""
+    Nr, m, _ = blocks.shape
+    if eps is None:
+        eps = eps_for(jnp.float32)
+    blocks = blocks.astype(jnp.float32)
+    b = _panel_width(m)
+    if b is None:
+        raise ValueError(f"no panel width divides m={m}")
+    kernel = functools.partial(_gj_fused_panel_kernel, m=m, b=b, eps=eps)
+    return _run_probe_kernel(blocks, kernel, m, interpret,
+                             _W_BUDGET_FUSED, width_factor=1)
 
 
 @functools.partial(jax.jit, static_argnames=("eps", "interpret"))

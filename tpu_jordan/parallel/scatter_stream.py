@@ -34,17 +34,25 @@ from .layout import CyclicLayout, CyclicLayout2D
 from .mesh import AXIS, AXIS_C, AXIS_R
 
 
-def _padded_strip(reader, r: int, lay, dtype, augmented: bool) -> np.ndarray:
+def _padded_strip(reader, r: int, lay, dtype, augmented: bool,
+                  storage_dtype=None) -> np.ndarray:
     """Global block-row ``r`` as a host (m, W) strip: file data in the
     top-left, identity on the padding diagonal, and (augmented only) the
-    B half's identity block — the streaming unit of the scatter."""
+    B half's identity block — the streaming unit of the scatter.
+
+    ``storage_dtype``: sub-fp32 storage dtypes quantize A itself (the
+    single-device path's semantics: the matrix being inverted IS the
+    rounded one) before the fp32 upcast for computation."""
     n, m, N = lay.n, lay.m, lay.N
     W = 2 * N if augmented else N
     out = np.zeros((m, W), dtype)
     g0 = r * m
     rows = max(0, min(m, n - g0))        # file rows in this block
     if rows:
-        out[:rows, :n] = reader.read_rows(rows)
+        strip = reader.read_rows(rows)
+        if storage_dtype is not None:
+            strip = np.asarray(jnp.asarray(strip, storage_dtype))
+        out[:rows, :n] = strip
     # Identity padding rows (pad_with_identity semantics): global rows
     # g >= n carry a 1 at column g.
     for i in range(rows, m):
@@ -57,7 +65,8 @@ def _padded_strip(reader, r: int, lay, dtype, augmented: bool) -> np.ndarray:
 
 
 def stream_scatter_1d(path: str, lay: CyclicLayout, mesh: Mesh,
-                      dtype=jnp.float32, augmented: bool = False):
+                      dtype=jnp.float32, augmented: bool = False,
+                      storage_dtype=None):
     """File -> (Nr, m, W) cyclic-order blocks sharded over the 1D mesh,
     one strip of host memory at a time."""
     dtype = jnp.dtype(dtype)
@@ -68,7 +77,8 @@ def stream_scatter_1d(path: str, lay: CyclicLayout, mesh: Mesh,
         # File order is global block order; owner of block r is r % p at
         # slot r // p — appending in r-order fills slots in order.
         for r in range(lay.Nr):
-            strip = _padded_strip(reader, r, lay, dtype, augmented)
+            strip = _padded_strip(reader, r, lay, dtype, augmented,
+                                  storage_dtype)
             per_dev[lay.owner(r)].append(
                 jax.device_put(strip, devices[lay.owner(r)]))
             del strip
@@ -82,7 +92,8 @@ def stream_scatter_1d(path: str, lay: CyclicLayout, mesh: Mesh,
 
 
 def stream_scatter_2d(path: str, lay: CyclicLayout2D, mesh: Mesh,
-                      dtype=jnp.float32, augmented: bool = False):
+                      dtype=jnp.float32, augmented: bool = False,
+                      storage_dtype=None):
     """File -> (Nr, m, W) blocks, both axes in cyclic storage order,
     sharded over the (pr, pc) mesh, one strip of host memory at a time."""
     dtype = jnp.dtype(dtype)
@@ -94,7 +105,8 @@ def stream_scatter_2d(path: str, lay: CyclicLayout2D, mesh: Mesh,
     per_dev: list[list[list]] = [[[] for _ in range(pc)] for _ in range(pr)]
     with MatrixStripReader(path, lay.n, dtype) as reader:
         for r in range(lay.Nr):
-            strip = _padded_strip(reader, r, lay, dtype, augmented)
+            strip = _padded_strip(reader, r, lay, dtype, augmented,
+                                  storage_dtype)
             # Column blocks to storage order, then split into pc chunks.
             chunks = strip.reshape(m, ncb, m)[:, colp, :]
             bc = ncb // pc
