@@ -470,12 +470,16 @@ def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps):
             Ut, P, dimension_numbers=(((1,), (1,)), ((0,), (0,))),
             preferred_element_type=f32, precision=lax.Precision.HIGHEST,
         )                                                 # (cg, m, m)
+        # Two staged ref writes (upd dies before vscat is computed): one
+        # combined expression keeps upd+vscat+w live together and blows
+        # the 16 MB scoped-vmem limit at m=512 cg=2 by ~1 MB.
+        w_ref[...] = w_ref[...] + upd                     # panel slots: garbage
         vscat = jax.lax.dot_general(
             Vpt, C, dimension_numbers=(((1,), (1,)), ((), ())),
             preferred_element_type=f32, precision=lax.Precision.HIGHEST,
         )                                                 # (cg, m, m)
         in_panel = (lane_m >= k0) & (lane_m < k0 + b)
-        w_ref[...] = jnp.where(in_panel, vscat, w_ref[...] + upd)
+        w_ref[...] = jnp.where(in_panel, vscat, w_ref[...])
         return used, perm, sing, pivs
 
     used0 = jnp.zeros((cg, m), jnp.float32)
@@ -495,12 +499,14 @@ def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps):
         onehot, w_ref[...], dimension_numbers=bdims,
         preferred_element_type=f32, precision=lax.Precision.HIGHEST,
     )
-    w_ref[...] = mw
-    inv = jax.lax.dot_general(
+    # Row scaling commutes with the right one-hot multiply
+    # (D⁻¹·(M·W)·M = (D⁻¹·M·W)·M): folding it here keeps one fewer
+    # (cg, m, m) temporary live at the final dot.
+    w_ref[...] = mw * (1.0 / pivs)[:, :, None]
+    inv_ref[...] = jax.lax.dot_general(
         w_ref[...], onehot, dimension_numbers=bdims,
         preferred_element_type=f32, precision=lax.Precision.HIGHEST,
     )
-    inv_ref[...] = inv * (1.0 / pivs)[:, :, None]
 
 
 def _panel_width(m: int) -> int | None:
@@ -580,7 +586,12 @@ def pallas_batched_block_inverse(
         eps = eps_for(jnp.float32)
     blocks = blocks.astype(jnp.float32)
     b = _panel_width(m)
-    if b is not None and 2 * m * m * 4 <= _W_BUDGET_FUSED:
+    # m % 128: the transposed panel state puts matrix rows on the lane
+    # dim; Mosaic's layout inference rejects the St/vscat dots' shape
+    # casts for sub-native lane extents (measured: m=64 fails with
+    # "unsupported shape cast", m=128/256 compile).
+    if (b is not None and m % 128 == 0
+            and 2 * m * m * 4 <= _W_BUDGET_FUSED):
         kernel = functools.partial(_gj_fused_panel_kernel, m=m, b=b, eps=eps)
         return _run_probe_kernel(blocks, kernel, m, interpret,
                                  _W_BUDGET_FUSED, width_factor=1)
