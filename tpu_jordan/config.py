@@ -49,12 +49,14 @@ def default_block_size(n: int) -> int:
 
     Measured on v5e (benchmarks/PHASES.md): m=128 is the throughput sweet
     spot up to n=4096 (probe cost scales with n²·m, so smaller blocks win);
-    n ≥ 8192 needs m=512 at fp32 — smaller pivot blocks push the late
-    Schur-complement pivots under the fp32 noise floor on ill-conditioned
-    fixtures and the probe (correctly) flags them singular.
+    n ≥ 8192 needs m=384 at fp32 — smaller pivot blocks (m <= 256) push
+    the late Schur-complement pivots under the fp32 noise floor on
+    ill-conditioned fixtures and the probe (correctly) flags them
+    singular, while m=384 still divides by 128 so the fused-panel probe
+    kernel applies (126 ms vs 177 ms at m=512 for the 8192 inversion).
     """
     if n >= 8192:
-        return 512
+        return 384
     if n >= 512:
         return 128
     if n >= 128:
