@@ -41,9 +41,11 @@ def main(argv=None) -> int:
                          "Newton-Schulz refinement steps "
                          "(benchmarks/PHASES.md)")
     ap.add_argument("--generator", default="absdiff",
-                    choices=["absdiff", "hilbert"],
+                    choices=["absdiff", "hilbert", "rand"],
                     help="matrix generator when no file is given "
-                         "(hilbert = the reference's -DHILBERT build)")
+                         "(hilbert = the reference's -DHILBERT build; "
+                         "rand = deterministic uniform [-1,1), the "
+                         "well-conditioned scale fixture)")
     ap.add_argument("--refine", type=int, default=0,
                     help="Newton-Schulz refinement steps")
     ap.add_argument("--workers", type=_workers_arg, default=1,

@@ -39,10 +39,31 @@ def identity(i, j):
     return (i == j).astype(jnp.float32)
 
 
+def rand_uniform(i, j):
+    """Deterministic pseudo-random uniform in [-1, 1): a stateless integer
+    hash of (i, j) (lowbias32-style avalanche).
+
+    Beyond-reference fixture: the |i−j| matrix's O(n²) dynamic range
+    genuinely exceeds fp32 past n=8192 (its Schur cancellations drown in
+    noise and the probe correctly flags it singular — measured,
+    benchmarks/PHASES.md), so scale demonstrations need a well-conditioned
+    matrix.  Being a pure function of global indices, it generates
+    shard-locally under shard_map with no communication, like every other
+    generator here.
+    """
+    x = (i.astype(jnp.uint32) * jnp.uint32(73856093)) ^ (
+        j.astype(jnp.uint32) * jnp.uint32(19349663))
+    x = (x ^ (x >> 16)) * jnp.uint32(0x7FEB352D)
+    x = (x ^ (x >> 15)) * jnp.uint32(0x846CA68B)
+    x = x ^ (x >> 16)
+    return x.astype(jnp.float32) * jnp.float32(2.0 / 4294967296.0) - 1.0
+
+
 GENERATORS: dict[str, GeneratorFn] = {
     "absdiff": abs_diff,
     "hilbert": hilbert,
     "identity": identity,
+    "rand": rand_uniform,
 }
 
 

@@ -61,7 +61,29 @@ def _local_step(t, Wloc, singular, *, lay: CyclicLayout, eps, precision,
     probe_dtype = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
     cands = lax.dynamic_slice(Wloc, (0, 0, t * m), (bpw, m, m))
     cands = cands.astype(probe_dtype)
-    invs, sing = probe_blocks(cands, eps, use_pallas)
+    half = bpw // 2
+    if half:
+        # Probe-window cut (VERDICT r2 #6, 1D): once every slot of the
+        # lower half is dead (its global rows are all < t, which happens
+        # exactly when t >= half*p), probe only the upper half — the
+        # reference probes exactly the live window too (main.cpp:1039).
+        # Dead slots get identity/True dummies; the gidx >= t mask below
+        # excludes them regardless.  ~Halves average probe flops; the
+        # in-place engines (the Nr <= 64 default) already shrink their
+        # window statically — this covers the large-Nr fallback.
+        def _upper(c):
+            invs_u, sing_u = probe_blocks(c[half:], eps, use_pallas)
+            eye = jnp.broadcast_to(
+                jnp.eye(m, dtype=probe_dtype), (half, m, m))
+            return (jnp.concatenate([eye, invs_u]),
+                    jnp.concatenate([jnp.ones((half,), bool), sing_u]))
+
+        def _full(c):
+            return probe_blocks(c, eps, use_pallas)
+
+        invs, sing = lax.cond(t >= half * p, _upper, _full, cands)
+    else:
+        invs, sing = probe_blocks(cands, eps, use_pallas)
     inv_norms = block_inf_norms(invs)
     valid = (gidx >= t) & ~sing
     big = jnp.asarray(jnp.inf, probe_dtype)
