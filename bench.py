@@ -29,7 +29,7 @@ different K so constant offsets (RTT, dispatch) cancel in the slope.
 import json
 
 
-def _measure(n, m, r1, r2):
+def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2):
     from tpu_jordan.ops import (
         block_jordan_invert_inplace,
         generate,
@@ -40,7 +40,7 @@ def _measure(n, m, r1, r2):
 
     import jax.numpy as jnp
 
-    a = generate("absdiff", (n, n), jnp.float32)
+    a = generate(generator, (n, n), jnp.float32)
     per_call = slope_time(
         lambda v: block_jordan_invert_inplace(v, block_size=m)[0],
         (a,), r1=r1, r2=r2,
@@ -50,7 +50,8 @@ def _measure(n, m, r1, r2):
     inv, sing = block_jordan_invert_inplace(a, block_size=m)
     rel_res = float(residual_inf_norm(a, inv)) / float(inf_norm(a))
     assert not bool(sing), f"benchmark matrix flagged singular (n={n})"
-    assert rel_res < 1e-2, f"benchmark inverse inaccurate: {rel_res} (n={n})"
+    assert rel_res < max_rel, \
+        f"benchmark inverse inaccurate: {rel_res} (n={n})"
     del a, inv
 
     return 2.0 * n**3 / per_call / 1e9, rel_res
@@ -61,6 +62,11 @@ def main():
 
     gf_4096, rel_4096 = _measure(4096, 128, r1=8, r2=24)
     gf_8192, rel_8192 = _measure(8192, 384, r1=3, r2=9)
+    # Scale point: |i−j| genuinely exceeds fp32 at n=16384 (PHASES.md),
+    # so the 16384 row uses the deterministic well-conditioned 'rand'
+    # fixture; its rel residual ~4e-2 is the fp32 eps·n·κ expectation.
+    gf_16384, rel_16384 = _measure(16384, 384, r1=2, r2=5,
+                                   generator="rand", max_rel=2e-1)
 
     print(json.dumps({
         "metric": "invert_4096x4096_f32_gflops",
@@ -70,8 +76,11 @@ def main():
         "extra": {
             "invert_8192x8192_f32_m384_gflops": round(gf_8192, 1),
             "vs_baseline_8192": round(gf_8192 / baseline_gflops, 1),
+            "invert_16384_f32_m384_rand_gflops": round(gf_16384, 1),
+            "vs_baseline_16384": round(gf_16384 / baseline_gflops, 1),
             "rel_residual_4096": f"{rel_4096:.1e}",
             "rel_residual_8192": f"{rel_8192:.1e}",
+            "rel_residual_16384": f"{rel_16384:.1e}",
         },
     }))
 

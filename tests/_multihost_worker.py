@@ -35,8 +35,14 @@ def main():
     assert res.residual / (64 * 64 / 2) < 1e-4, f"1D residual {res.residual}"
     res2 = solve(48, 8, workers=(2, 4), gather=False)
     assert res2.residual / (48 * 48 / 2) < 1e-4, f"2D residual {res2.residual}"
+    # File input: every process streams the shared file and places only
+    # its addressable strips (read_matrix multi-rank parity,
+    # main.cpp:242-276).
+    resf = solve(64, 8, file=sys.argv[4], workers=8, gather=False)
+    assert resf.residual / 32 < 5e-3, f"file residual {resf.residual}"
     print(f"MULTIHOST-OK rank={pid} res1d={res.residual:.2e} "
-          f"res2d={res2.residual:.2e}", flush=True)
+          f"res2d={res2.residual:.2e} resfile={resf.residual:.2e}",
+          flush=True)
 
 
 if __name__ == "__main__":

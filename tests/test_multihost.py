@@ -25,7 +25,15 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def test_two_process_distributed_solve():
+def test_two_process_distributed_solve(tmp_path):
+    import numpy as np
+
+    from tpu_jordan.io import write_matrix_file
+
+    rng = np.random.default_rng(3)
+    mat_path = str(tmp_path / "m64.txt")
+    write_matrix_file(mat_path, rng.standard_normal((64, 64)))
+
     port = _free_port()
     env = os.environ.copy()
     env["JAX_PLATFORMS"] = "cpu"
@@ -34,7 +42,8 @@ def test_two_process_distributed_solve():
     nproc = 2
     procs = [
         subprocess.Popen(
-            [sys.executable, _WORKER, str(i), str(nproc), str(port)],
+            [sys.executable, _WORKER, str(i), str(nproc), str(port),
+             mat_path],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, cwd=_REPO,
         )

@@ -64,6 +64,15 @@ def _padded_strip(reader, r: int, lay, dtype, augmented: bool,
     return out
 
 
+def _skip_strip(reader, r: int, lay) -> None:
+    """Advance the stream past block-row ``r`` without building the padded
+    strip (multi-process: strips owned by other processes still consume
+    file tokens, but need no host buffer or identity fill)."""
+    rows = max(0, min(lay.m, lay.n - r * lay.m))
+    if rows:
+        reader.read_rows(rows)
+
+
 def stream_scatter_1d(path: str, lay: CyclicLayout, mesh: Mesh,
                       dtype=jnp.float32, augmented: bool = False,
                       storage_dtype=None):
@@ -72,18 +81,27 @@ def stream_scatter_1d(path: str, lay: CyclicLayout, mesh: Mesh,
     dtype = jnp.dtype(dtype)
     p, bpw = lay.p, lay.blocks_per_worker
     devices = list(mesh.devices.flat)
+    # Multi-process: every process parses the whole file (the reference's
+    # root rank does too, main.cpp:242-276) but places only the strips
+    # owned by ITS devices; make_array assembles the global array from
+    # each process's addressable shards.
+    pidx = jax.process_index()
     per_dev: list[list] = [[] for _ in range(p)]
     with MatrixStripReader(path, lay.n, dtype) as reader:
         # File order is global block order; owner of block r is r % p at
         # slot r // p — appending in r-order fills slots in order.
         for r in range(lay.Nr):
+            owner = lay.owner(r)
+            if devices[owner].process_index != pidx:
+                _skip_strip(reader, r, lay)
+                continue
             strip = _padded_strip(reader, r, lay, dtype, augmented,
                                   storage_dtype)
-            per_dev[lay.owner(r)].append(
-                jax.device_put(strip, devices[lay.owner(r)]))
+            per_dev[owner].append(jax.device_put(strip, devices[owner]))
             del strip
-    shards = [jnp.stack(strips) for strips in per_dev]   # on-device (bpw,m,W)
-    W = shards[0].shape[-1]
+    shards = [jnp.stack(strips) for strips in per_dev
+              if strips]                                 # (bpw, m, W) each
+    W = (2 if augmented else 1) * lay.N
     return jax.make_array_from_single_device_arrays(
         (lay.Nr, lay.m, W),
         NamedSharding(mesh, PartitionSpec(AXIS, None, None)),
@@ -102,16 +120,22 @@ def stream_scatter_2d(path: str, lay: CyclicLayout2D, mesh: Mesh,
     colp = lay.col_perm(ncb)             # storage order of column blocks
     dev = mesh.devices                   # (pr, pc) array of devices
     bpr = lay.Nr // pr
+    pidx = jax.process_index()           # multi-process: see stream_scatter_1d
     per_dev: list[list[list]] = [[[] for _ in range(pc)] for _ in range(pr)]
     with MatrixStripReader(path, lay.n, dtype) as reader:
         for r in range(lay.Nr):
+            kr = r % pr
+            if all(dev[kr][kc].process_index != pidx for kc in range(pc)):
+                _skip_strip(reader, r, lay)
+                continue
             strip = _padded_strip(reader, r, lay, dtype, augmented,
                                   storage_dtype)
             # Column blocks to storage order, then split into pc chunks.
             chunks = strip.reshape(m, ncb, m)[:, colp, :]
             bc = ncb // pc
-            kr = r % pr
             for kc in range(pc):
+                if dev[kr][kc].process_index != pidx:
+                    continue
                 piece = np.ascontiguousarray(
                     chunks[:, kc * bc:(kc + 1) * bc, :].reshape(m, bc * m))
                 per_dev[kr][kc].append(jax.device_put(piece, dev[kr][kc]))
@@ -119,7 +143,8 @@ def stream_scatter_2d(path: str, lay: CyclicLayout2D, mesh: Mesh,
     shards = []
     for kr in range(pr):
         for kc in range(pc):
-            shards.append(jnp.stack(per_dev[kr][kc]))    # (bpr, m, W/pc)
+            if per_dev[kr][kc]:
+                shards.append(jnp.stack(per_dev[kr][kc]))  # (bpr, m, W/pc)
     W = ncb * m
     return jax.make_array_from_single_device_arrays(
         (lay.Nr, lay.m, W),
