@@ -8,7 +8,7 @@
 CXX      ?= g++
 CXXFLAGS ?= -O3 -fPIC -Wall
 N        ?= 4096
-M        ?= 256
+M        ?= 128
 WORKERS  ?= 1
 
 .PHONY: all native tpu test bench clean
