@@ -18,9 +18,10 @@ from tpu_jordan.ops.pallas_block_inverse import pallas_batched_block_inverse
 
 
 # All kernels must keep identical pivot/singularity/poison semantics:
-# "dispatch" resolves to the production kernel (currently the augmented
-# rank-1, the measured fastest), "rank1" forces it explicitly, "panel"
-# and "inplace" are the recorded v2/v3 experiments.
+# "dispatch" resolves to the production kernel (the fused in-place panel
+# for m % 128 == 0 within budget, rank-1 otherwise — pinned by
+# test_dispatch_policy), "rank1"/"fused" force those two, "panel" and
+# "inplace" are the recorded v2/v3 experiments.
 KERNELS = {
     "dispatch": pallas_batched_block_inverse,
     "rank1": pbi.pallas_batched_block_inverse_rank1,
@@ -182,3 +183,27 @@ def test_probe_pivot_ordering_matches(rng):
     norms_p = np.max(np.sum(np.abs(np.asarray(inv_p)), axis=2), axis=1)
     norms_x = np.max(np.sum(np.abs(np.asarray(inv_x)), axis=2), axis=1)
     assert np.argmin(norms_p) == np.argmin(norms_x)
+
+
+def test_dispatch_policy(monkeypatch):
+    # Pin WHICH kernel each block size dispatches to, so a future budget
+    # or gate change is deliberate: fused needs a panel width, m % 128
+    # == 0, and >= 2 candidates in the stack budget (PHASES.md).
+    seen = {}
+    orig = pbi._run_probe_kernel
+
+    def spy(blocks, kernel, m, interpret, budget=None, width_factor=2):
+        seen[m] = kernel.func.__name__
+        return orig(blocks, kernel, m, interpret, budget, width_factor)
+
+    monkeypatch.setattr(pbi, "_run_probe_kernel", spy)
+    jax.clear_caches()
+    for m in (32, 64, 128, 256, 384, 512):
+        blocks = jnp.eye(m, dtype=jnp.float32)[None]
+        pallas_batched_block_inverse(blocks, interpret=True)
+    assert seen[32] == "_gj_probe_kernel"      # m % 128 != 0
+    assert seen[64] == "_gj_probe_kernel"
+    assert seen[128] == "_gj_fused_panel_kernel"
+    assert seen[256] == "_gj_fused_panel_kernel"
+    assert seen[384] == "_gj_fused_panel_kernel"
+    assert seen[512] == "_gj_probe_kernel"     # only cg=1 fits VMEM

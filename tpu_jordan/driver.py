@@ -40,9 +40,9 @@ class SingularMatrixError(ArithmeticError):
 
 
 class UsageError(ValueError):
-    """Invalid flag combination (e.g. gather=False without a distributed
-    generator run) — maps to the reference's usage exit code 1
-    (main.cpp:77-85), distinct from internal ValueErrors."""
+    """Invalid flag combination (e.g. gather=False on the single-device
+    path, or refine without gather) — maps to the reference's usage exit
+    code 1 (main.cpp:77-85), distinct from internal ValueErrors."""
 
 
 @dataclass
@@ -80,10 +80,12 @@ def solve(
     scaling mode the reference's rows-only layout can't reach
     (main.cpp:366-370).  When the matrix comes from a generator, every
     worker builds its own shard on device (init_matrix parity,
-    main.cpp:128-149) and the residual is computed without ever
-    materializing an n×n array on the host; with ``gather=False`` the
-    inverse too stays as sharded cyclic blocks (``result.inverse_blocks``
-    + ``result.layout``), the memory-scaling mode for north-star sizes.
+    main.cpp:128-149); file input streams one block-row strip at a time
+    straight onto the owner devices (read_matrix parity,
+    main.cpp:242-276) — either way no n×n host array exists on
+    distributed meshes.  With ``gather=False`` the inverse too stays as
+    sharded cyclic blocks (``result.inverse_blocks`` +
+    ``result.layout``), the memory-scaling mode for north-star sizes.
 
     ``precision``: "highest" (default, fp32-faithful products), "high"
     (bf16x3 products), or "mixed" (HIGH sweeps + ≥2 HIGHEST Newton–Schulz
