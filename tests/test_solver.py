@@ -114,3 +114,21 @@ def test_cli_distributed_flag():
     from tpu_jordan.__main__ import main
 
     assert main(["48", "8", "--distributed", "--quiet"]) == 0
+
+
+def test_solver_invert_batch(rng):
+    s = JordanSolver(n=24, block_size=8, dtype=jnp.float32)
+    a = rng.standard_normal((5, 24, 24)).astype(np.float32)
+    inv, sing = s.invert_batch(a)
+    assert inv.shape == (5, 24, 24) and sing.shape == (5,)
+    assert not np.asarray(sing).any()
+    np.testing.assert_allclose(np.asarray(inv), np.linalg.inv(a),
+                               rtol=1e-2, atol=1e-3)
+
+
+def test_solver_invert_batch_distributed_raises():
+    from tpu_jordan.driver import UsageError
+
+    s = JordanSolver(n=16, block_size=8, workers=4)
+    with pytest.raises(UsageError, match="invert_batch"):
+        s.invert_batch(np.zeros((2, 16, 16), np.float32))

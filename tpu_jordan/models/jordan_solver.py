@@ -132,6 +132,29 @@ class JordanSolver:
             inv = newton_schulz(a, inv, self.refine)
         return inv.astype(self._in_dtype), singular
 
+    def invert_batch(self, stack):
+        """Invert a (..., n, n) stack in one vmapped computation
+        (ops/batched.py; the north-star batch capability).  Single-device:
+        for distributed batches, shard the batch axis over a mesh instead.
+        Returns (inverses, singular_flags) shaped like the batch."""
+        if self._distributed:
+            from ..driver import UsageError
+
+            raise UsageError(
+                "invert_batch is single-device; for distributed batches "
+                "shard the batch axis over the mesh")
+        from ..ops import batched_jordan_invert
+
+        a = jnp.asarray(stack, self._work_dtype)
+        if a.shape[-2:] != (self.n, self.n):
+            raise ValueError(
+                f"expected (..., {self.n}, {self.n}), got {a.shape}")
+        inv, sing = batched_jordan_invert(
+            a, block_size=self.block_size, precision=self._sweep_prec,
+            refine=self.refine,
+        )
+        return inv.astype(self._in_dtype), sing
+
     @property
     def layout(self):
         """The cyclic layout of ``gather=False`` inverse blocks."""

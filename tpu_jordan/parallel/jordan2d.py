@@ -76,9 +76,26 @@ def _local_step2d(t, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
         return (jnp.zeros_like(c),
                 lax.pcast(jnp.ones((bpr,), jnp.bool_), BOTH, to='varying'))
 
-    invs, sing = lax.cond(
-        own_c, lambda c: _probe(c, eps, use_pallas), _skip, cands
-    )
+    half = bpr // 2
+    if half:
+        # Row-window cut (the 2D analog of the 1D half-window): once the
+        # lower half's global rows are all < t, probe only the upper
+        # half.  Composes with the owner-column cond below.
+        def _upper(c):
+            invs_u, sing_u = _probe(c[half:], eps, use_pallas)
+            eye = jnp.broadcast_to(
+                jnp.eye(m, dtype=c.dtype), (half, m, m))
+            return (jnp.concatenate([eye, invs_u]),
+                    jnp.concatenate([jnp.ones((half,), bool), sing_u]))
+
+        def _live(c):
+            return lax.cond(t >= half * pr, _upper,
+                            lambda cc: _probe(cc, eps, use_pallas), c)
+    else:
+        def _live(c):
+            return _probe(c, eps, use_pallas)
+
+    invs, sing = lax.cond(own_c, _live, _skip, cands)
     inv_norms = block_inf_norms(invs)
     valid = own_c & (gr >= t) & ~sing
     big = jnp.asarray(jnp.inf, probe_dtype)
