@@ -144,3 +144,40 @@ class TestCLI:
 
         assert main(["64", "8", "--workers", "4", "--no-gather",
                      "--quiet"]) == 0
+
+
+class TestSolveBatch:
+    def test_batch_solve_rand_distinct(self):
+        import numpy as np
+
+        from tpu_jordan.driver import solve_batch
+
+        res = solve_batch(32, 8, batch=3, generator="rand")
+        assert res.inverse.shape == (3, 32, 32)
+        # rand elements are distinct matrices (per-element offsets).
+        assert not np.allclose(np.asarray(res.inverse[0]),
+                               np.asarray(res.inverse[1]))
+        assert res.residual / 16 < 5e-3
+        assert res.gflops > 0
+
+    def test_cli_batch_flag(self):
+        from tpu_jordan.__main__ import main
+
+        assert main(["32", "8", "--batch", "3", "--quiet",
+                     "--generator", "rand"]) == 0
+
+    def test_cli_batch_with_file_is_usage_error(self, tmp_path):
+        import numpy as np
+
+        from tpu_jordan.__main__ import main
+        from tpu_jordan.io import write_matrix_file
+
+        p = str(tmp_path / "m.txt")
+        write_matrix_file(p, np.eye(8))
+        assert main(["8", "4", p, "--batch", "2", "--quiet"]) == 1
+
+    def test_cli_batch_with_workers_is_usage_error(self):
+        from tpu_jordan.__main__ import main
+
+        assert main(["32", "8", "--batch", "2", "--workers", "4",
+                     "--quiet"]) == 1

@@ -63,6 +63,12 @@ def main(argv=None) -> int:
                          "blocks (distributed runs; generator or file "
                          "input): the O(n^2/workers) per-device memory "
                          "mode for north-star sizes")
+    ap.add_argument("--batch", type=int, default=1,
+                    help="invert a batch of B generated matrices in one "
+                         "vmapped computation (the north-star batch "
+                         "capability; generator input only, single "
+                         "device; B distinct matrices via per-element "
+                         "index offsets)")
     ap.add_argument("--quiet", action="store_true")
     try:
         args = ap.parse_args(argv)
@@ -104,23 +110,38 @@ def main(argv=None) -> int:
 
         jax.config.update("jax_enable_x64", True)
 
-    from .driver import SingularMatrixError, UsageError, solve
+    from .driver import SingularMatrixError, UsageError, solve, solve_batch
     from .io import MatrixReadError
     from .parallel.mesh import MeshSizeError
 
     try:
-        result = solve(
-            n=args.n,
-            block_size=args.m,
-            file=args.file,
-            generator=args.generator,
-            dtype=jnp.dtype(args.dtype),
-            refine=args.refine,
-            workers=args.workers,
-            verbose=not args.quiet,
-            gather=args.gather,
-            precision=args.precision,
-        )
+        if args.batch > 1:
+            if args.file is not None or args.workers != 1:
+                raise UsageError(
+                    "--batch requires generator input on a single device")
+            result = solve_batch(
+                n=args.n,
+                block_size=args.m,
+                batch=args.batch,
+                generator=args.generator,
+                dtype=jnp.dtype(args.dtype),
+                refine=args.refine,
+                precision=args.precision,
+                verbose=not args.quiet,
+            )
+        else:
+            result = solve(
+                n=args.n,
+                block_size=args.m,
+                file=args.file,
+                generator=args.generator,
+                dtype=jnp.dtype(args.dtype),
+                refine=args.refine,
+                workers=args.workers,
+                verbose=not args.quiet,
+                gather=args.gather,
+                precision=args.precision,
+            )
     except FileNotFoundError:
         print(f"cannot open {args.file}")
         return 2

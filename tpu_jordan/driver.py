@@ -173,6 +173,61 @@ def solve(
     )
 
 
+def solve_batch(
+    n: int,
+    block_size: int | None = None,
+    batch: int = 1,
+    generator: str = "absdiff",
+    dtype=jnp.float32,
+    refine: int = 0,
+    precision: str = "highest",
+    verbose: bool = False,
+) -> SolveResult:
+    """Invert ``batch`` generated n×n matrices in ONE vmapped computation
+    (the north-star batch capability, ops/batched.py; single device).
+
+    Elements are generated with per-element index offsets (b·n on both
+    axes), which yields distinct matrices for the ``rand`` generator and
+    identical copies for translation-invariant ones like ``absdiff`` —
+    either way an honest throughput measurement.  ``gflops`` uses the
+    2n³·batch convention; ``residual`` is element 0's, and a
+    SingularMatrixError reports how many elements were flagged.
+    """
+    from .ops import batched_jordan_invert, residual_inf_norm as _res
+
+    if block_size is None:
+        block_size = default_block_size(n)
+    prec = _PRECISIONS[precision]
+    a = jnp.stack([
+        generate(generator, (n, n), dtype, row_offset=b * n,
+                 col_offset=b * n)
+        for b in range(batch)
+    ])
+    compiled = batched_jordan_invert.lower(
+        a, block_size=block_size, refine=refine, precision=prec
+    ).compile()
+    t0 = time.perf_counter()
+    inv, singular = compiled(a)
+    jax.block_until_ready(inv)
+    elapsed = time.perf_counter() - t0
+    nsing = int(jnp.sum(singular))
+    if nsing:
+        raise SingularMatrixError(
+            f"singular matrix ({nsing}/{batch} elements flagged)")
+    residual = float(_res(a[0], inv[0]))
+    if verbose:
+        print(f"glob_time: {elapsed:.2f} ({batch} matrices)")
+        print(f"residual[0]: {residual:e}")
+    return SolveResult(
+        inverse=inv,
+        elapsed=elapsed,
+        residual=residual,
+        n=n,
+        block_size=block_size,
+        gflops=2.0 * n**3 * batch / elapsed / 1e9,
+    )
+
+
 def make_distributed_backend(workers, n: int, block_size: int):
     """The distributed backend for a workers spec: int p -> 1D row-cyclic,
     tuple (pr, pc) -> 2D block-cyclic.  Shared by ``solve`` and
