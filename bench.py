@@ -62,26 +62,32 @@ def main():
 
     gf_4096, rel_4096 = _measure(4096, 128, r1=8, r2=24)
     gf_8192, rel_8192 = _measure(8192, 384, r1=3, r2=9)
-    # Scale point: |i−j| genuinely exceeds fp32 at n=16384 (PHASES.md),
-    # so the 16384 row uses the deterministic well-conditioned 'rand'
-    # fixture; its rel residual ~4e-2 is the fp32 eps·n·κ expectation.
-    gf_16384, rel_16384 = _measure(16384, 384, r1=2, r2=5,
-                                   generator="rand", max_rel=2e-1)
+    extra = {
+        "invert_8192x8192_f32_m384_gflops": round(gf_8192, 1),
+        "vs_baseline_8192": round(gf_8192 / baseline_gflops, 1),
+        "rel_residual_4096": f"{rel_4096:.1e}",
+        "rel_residual_8192": f"{rel_8192:.1e}",
+    }
+    # Scale point, best-effort (the two contract configs above must never
+    # be lost to a failure here): |i−j| genuinely exceeds fp32 at
+    # n=16384 (PHASES.md), so this row uses the deterministic
+    # well-conditioned 'rand' fixture; its rel residual ~4e-2 is the
+    # fp32 eps·n·κ expectation.
+    try:
+        gf_16384, rel_16384 = _measure(16384, 384, r1=2, r2=5,
+                                       generator="rand", max_rel=2e-1)
+        extra["invert_16384_f32_m384_rand_gflops"] = round(gf_16384, 1)
+        extra["vs_baseline_16384"] = round(gf_16384 / baseline_gflops, 1)
+        extra["rel_residual_16384"] = f"{rel_16384:.1e}"
+    except Exception as e:                      # noqa: BLE001
+        extra["invert_16384_error"] = str(e)[:200]
 
     print(json.dumps({
         "metric": "invert_4096x4096_f32_gflops",
         "value": round(gf_4096, 1),
         "unit": "GFLOP/s",
         "vs_baseline": round(gf_4096 / baseline_gflops, 1),
-        "extra": {
-            "invert_8192x8192_f32_m384_gflops": round(gf_8192, 1),
-            "vs_baseline_8192": round(gf_8192 / baseline_gflops, 1),
-            "invert_16384_f32_m384_rand_gflops": round(gf_16384, 1),
-            "vs_baseline_16384": round(gf_16384 / baseline_gflops, 1),
-            "rel_residual_4096": f"{rel_4096:.1e}",
-            "rel_residual_8192": f"{rel_8192:.1e}",
-            "rel_residual_16384": f"{rel_16384:.1e}",
-        },
+        "extra": extra,
     }))
 
 
