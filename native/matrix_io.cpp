@@ -10,6 +10,7 @@
 //
 // C ABI only (loaded via ctypes, no pybind11 in this image).
 
+#include <cctype>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
@@ -66,10 +67,11 @@ long tj_parse_matrix_text(const char *path, double *out, long max_count) {
 
 namespace {
 constexpr size_t kChunk = 1 << 20; // 1 MiB read granularity
+constexpr size_t kCarry = 64;      // headroom for a carried partial token
 
 struct TjStream {
   FILE *f = nullptr;
-  char *buf = nullptr;   // kChunk + carry headroom + NUL
+  char *buf = nullptr;   // kChunk + kCarry + NUL
   size_t len = 0;        // valid bytes in buf
   size_t pos = 0;        // parse cursor
   bool eof = false;
@@ -77,6 +79,9 @@ struct TjStream {
 
 // Ensure the unparsed tail is at the front of the buffer and the buffer
 // is as full as the file allows.  Returns false once fully drained.
+// The fread is clamped to the buffer's remaining capacity: callers keep
+// the carried tail <= kCarry, but an oversized tail must degrade to a
+// shorter read, never a heap overflow.
 bool tj_refill(TjStream *s) {
   size_t tail = s->len - s->pos;
   if (tail > 0)
@@ -84,9 +89,11 @@ bool tj_refill(TjStream *s) {
   s->len = tail;
   s->pos = 0;
   if (!s->eof) {
-    size_t got = std::fread(s->buf + s->len, 1, kChunk, s->f);
+    size_t cap = kChunk + kCarry - s->len;
+    size_t want = cap < kChunk ? cap : kChunk;
+    size_t got = want ? std::fread(s->buf + s->len, 1, want, s->f) : 0;
     s->len += got;
-    if (got < kChunk)
+    if (got < want)
       s->eof = true;
   }
   s->buf[s->len] = '\0';
@@ -100,9 +107,9 @@ void *tj_stream_open(const char *path) {
     return nullptr;
   TjStream *s = new TjStream;
   s->f = f;
-  // Headroom for a carried-over partial token (longest printf %.17g
-  // rendering is ~25 chars; 64 is comfortable).
-  s->buf = (char *)std::malloc(kChunk + 64 + 1);
+  // kCarry headroom for a carried-over partial token (longest printf
+  // %.17g rendering is ~25 chars).
+  s->buf = (char *)std::malloc(kChunk + kCarry + 1);
   if (!s->buf) {
     std::fclose(f);
     delete s;
@@ -122,31 +129,34 @@ long tj_stream_read(void *handle, double *out, long count) {
     double v = std::strtod(s->buf + s->pos, &end);
     if (end == s->buf + s->pos) {
       // No progress: whitespace-only tail, partial token, or garbage.
-      if (!s->eof || s->pos < s->len) {
-        size_t before = s->len - s->pos;
+      // Skip whitespace explicitly FIRST so the tail carried into
+      // tj_refill is only ever a (possibly partial) token, never an
+      // unbounded whitespace run — that run used to overflow the
+      // kCarry headroom.
+      while (s->pos < s->len &&
+             std::isspace((unsigned char)s->buf[s->pos]))
+        s->pos++;
+      if (s->pos < s->len) {
+        // Non-whitespace strtod can't advance through: either a token
+        // cut at the chunk boundary (refill and retry) or garbage.
+        if (s->eof || s->len - s->pos > kCarry)
+          break; // unparsable / not a number: caller maps short count
         if (!tj_refill(s))
           break;
-        if (s->eof && s->len == before && before > 0) {
-          // Refill added nothing and strtod still can't move: skip
-          // leading whitespace manually; if a non-numeric token remains,
-          // stop (caller maps the short count to the -2 error).
-          while (s->pos < s->len &&
-                 std::strchr(" \t\r\n", s->buf[s->pos]))
-            s->pos++;
-          if (s->pos < s->len) {
-            char *e2 = nullptr;
-            std::strtod(s->buf + s->pos, &e2);
-            if (e2 == s->buf + s->pos)
-              break;
-          }
-        }
         continue;
       }
-      break;
+      // Pure-whitespace tail: drained, or pull the next chunk.
+      if (s->eof || !tj_refill(s))
+        break;
+      continue;
     }
     // A token ending exactly at the buffer end may be truncated; refill
-    // and re-parse it whole (unless the file is exhausted).
-    if ((size_t)(end - s->buf) == s->len && !s->eof) {
+    // and re-parse it whole (unless the file is exhausted).  The clamped
+    // refill can carry a tail up to the full buffer, so even tokens
+    // longer than kCarry re-parse whole; only a single token filling the
+    // ENTIRE buffer (> 1 MiB) degrades to accepting the split parse.
+    if ((size_t)(end - s->buf) == s->len && !s->eof &&
+        s->len - s->pos < kChunk + kCarry) {
       tj_refill(s);
       continue;
     }

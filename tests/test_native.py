@@ -49,6 +49,97 @@ class TestNativeParser:
         v = native.parse_matrix_text(str(p), 10)
         assert list(v) == [1.5, 2.5]
 
+    def test_stream_long_whitespace_at_chunk_boundary(self, native, rng,
+                                                      tmp_path):
+        # Regression: a >64-byte whitespace run straddling the 1 MiB chunk
+        # boundary used to carry an unbounded tail into tj_refill, whose
+        # unclamped fread then overflowed the 64-byte headroom (heap
+        # corruption).  Build a file whose chunk boundary lands inside a
+        # multi-KiB whitespace run and check native == Python fallback.
+        chunk = 1 << 20
+        vals = rng.standard_normal(64)
+        head = " ".join("%.17g" % v for v in vals[:32])
+        pad = " " * (chunk - len(head) - 100)  # boundary inside the run
+        body = head + pad + " " * 4096 + " ".join(
+            "%.17g" % v for v in vals[32:])
+        p = tmp_path / "ws.txt"
+        p.write_text(body)
+        self._assert_stream_matches_fallback(native, str(p), 64)
+
+    def test_stream_giant_whitespace_run(self, native, tmp_path):
+        # A whitespace run longer than a whole chunk (1.5 MiB) between two
+        # numbers: multiple refills with zero parse progress.
+        p = tmp_path / "giant_ws.txt"
+        p.write_text("1.25" + "\n" * ((1 << 20) + (1 << 19)) + "2.5")
+        self._assert_stream_matches_fallback(native, str(p), 2)
+
+    def test_stream_long_token_at_chunk_boundary(self, native, tmp_path):
+        # A valid 200-digit number straddling the chunk boundary must be
+        # re-parsed whole (carry > 64 bytes), not split or overflowed.
+        chunk = 1 << 20
+        long_num = "0." + "5" * 200
+        head = "1 " * ((chunk - 50) // 2)  # boundary lands inside long_num
+        p = tmp_path / "long_tok.txt"
+        p.write_text(head + long_num + " 3.5")
+        n = len(head) // 2 + 2
+        self._assert_stream_matches_fallback(native, str(p), n)
+
+    def test_stream_garbage_tail_at_chunk_boundary(self, native, tmp_path):
+        # Non-numeric garbage just before the boundary: short count, no
+        # crash, parity with the fallback's error behavior.
+        chunk = 1 << 20
+        head = "2 " * ((chunk - 20) // 2)
+        p = tmp_path / "garbage.txt"
+        p.write_text(head + "certainly_not_a_number " + "4 " * 100)
+        n_good = len(head) // 2
+        s = native.MatrixStream(str(p))
+        try:
+            got = s.read(n_good + 50)
+        finally:
+            s.close()
+        assert got.size == n_good
+        assert all(got == 2.0)
+
+    def test_stream_fuzz_random_whitespace_layout(self, native, rng,
+                                                  tmp_path):
+        # Randomized whitespace/token layout across several chunk
+        # boundaries; native and fallback must agree exactly.
+        parts = []
+        count = 0
+        target = (1 << 20) * 3 + 12345
+        size = 0
+        while size < target:
+            v = rng.standard_normal()
+            tok = "%.17g" % v
+            ws = rng.choice([" ", "\n", "\t", "  \n", " " * 500,
+                             "\r\n" * 40])
+            parts.append(tok + ws)
+            size += len(tok) + len(ws)
+            count += 1
+        p = tmp_path / "fuzz.txt"
+        p.write_text("".join(parts))
+        self._assert_stream_matches_fallback(native, str(p), count)
+
+    @staticmethod
+    def _assert_stream_matches_fallback(native, path, count):
+        from unittest import mock
+
+        from tpu_jordan.io import MatrixStripReader
+        s = native.MatrixStream(path)
+        try:
+            got_native = s.read(count)
+        finally:
+            s.close()
+        # Force the pure-Python branch through the real constructor so the
+        # parity test exercises exactly the production fallback path.
+        with mock.patch.object(native, "MatrixStream",
+                               side_effect=ImportError("forced fallback")):
+            with MatrixStripReader(path, count) as fallback:
+                assert fallback._native is None
+                got_py = fallback._read_tokens_py(count)
+        assert got_native.size == got_py.size == count
+        np.testing.assert_array_equal(got_native, got_py)
+
     def test_io_layer_uses_native(self, native, rng, tmp_path):
         # read_matrix_file must produce identical results whichever parser
         # is active.
