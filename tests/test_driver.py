@@ -181,3 +181,11 @@ class TestSolveBatch:
 
         assert main(["32", "8", "--batch", "2", "--workers", "4",
                      "--quiet"]) == 1
+
+    def test_cli_batch_with_no_gather_is_usage_error(self):
+        # --no-gather has no meaning for the (single-device, gathered)
+        # batch path: reject like every other invalid flag combination.
+        from tpu_jordan.__main__ import main
+
+        assert main(["32", "8", "--batch", "2", "--no-gather",
+                     "--quiet"]) == 1

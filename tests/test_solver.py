@@ -92,6 +92,14 @@ class TestJordanSolver:
         with pytest.raises(UsageError, match="refine"):
             JordanSolver(n=16, workers=4, refine=2, gather=False)
 
+    def test_mixed_precision_no_gather_raises(self):
+        # Same flag contract as driver.solve (shared check_gather_flags):
+        # 'mixed' implies refinement, which needs the gathered inverse.
+        from tpu_jordan.driver import UsageError
+
+        with pytest.raises(UsageError, match="mixed"):
+            JordanSolver(n=16, workers=4, precision="mixed", gather=False)
+
     def test_sub_fp32_storage_dtype(self, rng):
         # bf16 storage computes in fp32 and rounds once at the end.
         s = JordanSolver(n=32, block_size=8, dtype=jnp.bfloat16, workers=4)

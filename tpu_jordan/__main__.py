@@ -116,9 +116,10 @@ def main(argv=None) -> int:
 
     try:
         if args.batch > 1:
-            if args.file is not None or args.workers != 1:
+            if args.file is not None or args.workers != 1 or not args.gather:
                 raise UsageError(
-                    "--batch requires generator input on a single device")
+                    "--batch requires generator input on a single device "
+                    "(gathered output)")
             result = solve_batch(
                 n=args.n,
                 block_size=args.m,

@@ -61,6 +61,19 @@ class JordanSolver:
 
         if self.block_size is None:
             self.block_size = default_block_size(self.n)
+        if self._distributed:
+            # Shared with driver.solve (flag contract + layout policy
+            # can't drift): validate flags BEFORE resolve_precision bumps
+            # refine, exactly like solve does.
+            from ..driver import check_gather_flags, make_distributed_backend
+
+            check_gather_flags(self.gather, self.refine, self.precision)
+            self._be = make_distributed_backend(
+                self.workers, self.n, self.block_size)
+        elif not self.gather:
+            from ..driver import UsageError
+
+            raise UsageError("gather=False requires a distributed mesh")
         # Resolve the precision policy once: "mixed" implies HIGH sweeps
         # and bumps refine to the policy minimum.
         self._sweep_prec, self.refine = resolve_precision(
@@ -71,22 +84,6 @@ class JordanSolver:
         # (same policy as driver._solve_distributed_core).
         self._work_dtype = (jnp.float32 if self._in_dtype.itemsize < 4
                             else self._in_dtype)
-        if self._distributed:
-            from ..driver import UsageError, _Dist1D, _Dist2D
-
-            if self.refine and not self.gather:
-                raise UsageError(
-                    "refine requires gather=True (it runs on the gathered "
-                    "inverse)"
-                )
-            m = min(self.block_size, self.n)
-            self._be = (_Dist2D(self.workers, self.n, m)
-                        if isinstance(self.workers, tuple)
-                        else _Dist1D(self.workers, self.n, m))
-        elif not self.gather:
-            from ..driver import UsageError
-
-            raise UsageError("gather=False requires a distributed mesh")
 
     @property
     def _distributed(self) -> bool:
