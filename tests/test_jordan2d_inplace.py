@@ -61,3 +61,35 @@ class TestSharded2DInplace:
         inv, sing = sharded_jordan_invert_inplace_2d(a, mesh, 8)
         assert inv.dtype == jnp.bfloat16
         assert not bool(sing)
+
+    @pytest.mark.parametrize("pr,pc,n,m", [(2, 4, 128, 16), (4, 2, 128, 16),
+                                           (2, 2, 96, 8)])
+    def test_fori_bitmatches_unrolled(self, rng, pr, pc, n, m):
+        # Traced-t engine vs unrolled trace: identical pivots, identical
+        # bits — including the collective column-swap unscramble.
+        mesh = make_mesh_2d(pr, pc)
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+        x_u, s_u = sharded_jordan_invert_inplace_2d(a, mesh, m, unroll=True)
+        x_f, s_f = sharded_jordan_invert_inplace_2d(a, mesh, m, unroll=False)
+        assert bool(s_u) == bool(s_f)
+        assert bool(jnp.all(x_u == x_f)), "2D fori engine diverged bitwise"
+
+    def test_beyond_unroll_cap(self, rng):
+        # Nr = 68 > MAX_UNROLL_NR runs through the 2D fori engine
+        # (used to raise ValueError; VERDICT r3 item #1).
+        from tpu_jordan.parallel.sharded_inplace import MAX_UNROLL_NR
+
+        n, m = 544, 8
+        assert -(-n // m) > MAX_UNROLL_NR
+        mesh = make_mesh_2d(2, 4)
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float64)
+        inv, sing = sharded_jordan_invert_inplace_2d(a, mesh, m)
+        assert not bool(sing)
+        res = np.max(np.abs(np.asarray(a) @ np.asarray(inv) - np.eye(n)))
+        assert res < 1e-7
+
+    def test_driver_2d_inplace_covers_large_nr(self):
+        from tpu_jordan.driver import _Dist2D
+
+        be = _Dist2D((2, 4), 1024, 8)   # Nr=128 > 64
+        assert be.inplace

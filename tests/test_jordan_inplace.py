@@ -7,7 +7,11 @@ import pytest
 import jax.numpy as jnp
 
 from tpu_jordan.ops import block_jordan_invert, generate
-from tpu_jordan.ops.jordan_inplace import block_jordan_invert_inplace
+from tpu_jordan.ops.jordan_inplace import (
+    block_jordan_invert_inplace,
+    block_jordan_invert_inplace_fori,
+    block_jordan_invert_inplace_grouped,
+)
 
 
 class TestInplaceJordan:
@@ -68,3 +72,99 @@ class TestInplaceJordan:
             np.asarray(inv), np.linalg.inv(np.asarray(a)),
             rtol=1e-9, atol=1e-9,
         )
+
+
+class TestInplaceForiEngine:
+    """The fori_loop in-place engine: bit-identical to the unrolled trace
+    at every Nr (same pivot choices, same arithmetic), and working beyond
+    MAX_UNROLL_NR where the unrolled trace is unaffordable."""
+
+    @pytest.mark.parametrize("n,m", [(32, 8), (64, 16), (50, 8), (48, 48),
+                                     (96, 8)])
+    def test_bitmatch_unrolled(self, rng, n, m):
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+        x_u, s_u = block_jordan_invert_inplace(a, block_size=m)
+        x_f, s_f = block_jordan_invert_inplace_fori(a, block_size=m)
+        assert bool(s_u) == bool(s_f)
+        assert bool(jnp.all(x_u == x_f)), "fori engine diverged bitwise"
+
+    @pytest.mark.parametrize("gen", ["absdiff", "rand"])
+    def test_bitmatch_unrolled_generators(self, gen):
+        a = generate(gen, (96, 96), jnp.float32)
+        x_u, s_u = block_jordan_invert_inplace(a, block_size=16)
+        x_f, s_f = block_jordan_invert_inplace_fori(a, block_size=16)
+        assert bool(s_u) == bool(s_f) is False
+        assert bool(jnp.all(x_u == x_f))
+
+    def test_beyond_unroll_cap(self, rng):
+        # Nr = 68 > MAX_UNROLL_NR = 64: the configuration the unrolled
+        # engine cannot afford (the round-3 gap this engine closes).
+        from tpu_jordan.parallel.sharded_inplace import MAX_UNROLL_NR
+
+        n, m = 544, 8
+        assert -(-n // m) > MAX_UNROLL_NR
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float64)
+        inv, sing = block_jordan_invert_inplace_fori(a, block_size=m)
+        assert not bool(sing)
+        res = np.max(np.abs(np.asarray(a) @ np.asarray(inv) - np.eye(n)))
+        assert res < 1e-7
+
+    def test_singular_flag(self):
+        _, sing = block_jordan_invert_inplace_fori(
+            jnp.ones((32, 32), jnp.float64), block_size=8
+        )
+        assert bool(sing)
+
+    def test_grouped_k1_bitmatches_plain(self, rng):
+        # group=1 is the plain engine with reordered (equivalent) writes:
+        # must be bit-identical.
+        a = jnp.asarray(rng.standard_normal((64, 64)), jnp.float64)
+        x1, _ = block_jordan_invert_inplace(a, block_size=16)
+        x2, _ = block_jordan_invert_inplace_grouped(a, block_size=16,
+                                                    group=1)
+        assert bool(jnp.all(x1 == x2))
+
+    @pytest.mark.parametrize("n,m,k", [(64, 16, 2), (128, 16, 4),
+                                       (128, 32, 4), (96, 16, 3),
+                                       (160, 16, 4), (50, 8, 4),
+                                       (128, 16, 8)])
+    def test_grouped_matches_plain_to_rounding(self, rng, n, m, k):
+        # Delayed group updates change the summation order (one U·P
+        # matmul per group), so parity is to rounding, not bitwise —
+        # the standard blocked-elimination trade.
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float64)
+        x1, s1 = block_jordan_invert_inplace(a, block_size=m)
+        x2, s2 = block_jordan_invert_inplace_grouped(a, block_size=m,
+                                                     group=k)
+        assert bool(s1) == bool(s2) is False
+        np.testing.assert_allclose(np.asarray(x1), np.asarray(x2),
+                                   rtol=1e-9, atol=1e-9)
+        res = np.max(np.abs(np.asarray(a) @ np.asarray(x2) - np.eye(n)))
+        assert res < 1e-9
+
+    @pytest.mark.parametrize("gen", ["absdiff", "rand"])
+    def test_grouped_generators(self, gen):
+        # absdiff: zero diagonal, pivoting + swaps required in every group.
+        a = generate(gen, (128, 128), jnp.float64)
+        x, sing = block_jordan_invert_inplace_grouped(a, block_size=16,
+                                                      group=4)
+        assert not bool(sing)
+        res = np.max(np.abs(np.asarray(a) @ np.asarray(x) - np.eye(128)))
+        assert res < 1e-8
+
+    def test_grouped_singular_flag(self):
+        _, sing = block_jordan_invert_inplace_grouped(
+            jnp.ones((32, 32), jnp.float64), block_size=8, group=4)
+        assert bool(sing)
+
+    def test_driver_routes_large_nr_through_fori(self):
+        # single_device_invert must hand Nr > MAX_UNROLL_NR to the 2N³
+        # fori engine, not the augmented 4N³ fallback.
+        from tpu_jordan.driver import single_device_invert
+        from tpu_jordan.parallel.sharded_inplace import MAX_UNROLL_NR
+
+        eng_small = single_device_invert(64, 16)
+        assert eng_small is block_jordan_invert_inplace
+        n = 8 * (MAX_UNROLL_NR + 4)
+        eng_large = single_device_invert(n, 8)
+        assert eng_large is block_jordan_invert_inplace_fori

@@ -88,11 +88,29 @@ class TestShardedInplace:
         assert inv.dtype == jnp.bfloat16
         assert not bool(sing)
 
-    def test_nr_cap_raises(self, mesh4):
-        with pytest.raises(ValueError, match="unroll"):
-            sharded_jordan_invert_inplace(
-                jnp.eye(512, dtype=jnp.float64), mesh4, 2
-            )
+    @pytest.mark.parametrize("n,m", [(128, 16), (256, 32), (100, 8)])
+    def test_fori_bitmatches_unrolled(self, rng, mesh8, n, m):
+        # The fori_loop engine (traced offsets, full-window masked probe)
+        # must make the same pivot choices and produce bit-identical
+        # results to the unrolled trace.
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+        x_u, s_u = sharded_jordan_invert_inplace(a, mesh8, m, unroll=True)
+        x_f, s_f = sharded_jordan_invert_inplace(a, mesh8, m, unroll=False)
+        assert bool(s_u) == bool(s_f)
+        assert bool(jnp.all(x_u == x_f)), "1D fori engine diverged bitwise"
+
+    def test_beyond_unroll_cap(self, rng, mesh4):
+        # Nr = 68 > MAX_UNROLL_NR: the round-3 ceiling — used to raise
+        # ValueError, now runs through the fori engine.
+        from tpu_jordan.parallel.sharded_inplace import MAX_UNROLL_NR
+
+        n, m = 544, 8
+        assert -(-n // m) > MAX_UNROLL_NR
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float64)
+        inv, sing = sharded_jordan_invert_inplace(a, mesh4, m)
+        assert not bool(sing)
+        res = np.max(np.abs(np.asarray(a) @ np.asarray(inv) - np.eye(n)))
+        assert res < 1e-7
 
 
 class TestDriverEngineSelection:
@@ -102,11 +120,15 @@ class TestDriverEngineSelection:
         be = _Dist1D(4, 64, 8)
         assert be.inplace            # Nr=8 <= MAX_UNROLL_NR
 
-    def test_augmented_fallback_large_nr(self):
-        from tpu_jordan.driver import _Dist1D
+    def test_inplace_covers_large_nr(self):
+        # Nr=128 > MAX_UNROLL_NR used to fall back to the augmented 4N³
+        # path; the 2N³ fori engine now covers it (VERDICT r3 item #1).
+        from tpu_jordan.driver import _Dist1D, solve
 
         be = _Dist1D(4, 1024, 8)     # Nr=128 > 64
-        assert not be.inplace
+        assert be.inplace
+        r = solve(544, 8, workers=4, dtype=jnp.float64)  # Nr=68
+        assert r.residual < 1e-8 * 544
 
     def test_no_gather_solve_uses_inplace_blocks(self):
         # gather=False on the in-place engine: inverse_blocks is the whole

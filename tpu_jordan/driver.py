@@ -253,34 +253,39 @@ def check_gather_flags(gather: bool, refine: int, precision: str = "highest"):
 
 def single_device_invert(n: int, block_size: int):
     """The single-device inversion entry point for a given problem size:
-    the in-place variant (2x fewer flops + traffic, ops/jordan_inplace.py)
-    when its unrolled compile cost is reasonable, else the fori_loop
-    reference implementation."""
-    from .ops import block_jordan_invert, block_jordan_invert_inplace
+    the in-place 2N³ engine always — the unrolled trace (static shrinking
+    probe window) when its compile cost is reasonable, the fori_loop
+    in-place variant beyond (identical results, compile cost independent
+    of Nr).  The augmented ~4N³ ``block_jordan_invert`` remains the
+    reference-parity implementation (global_scale mode), no longer a
+    performance fallback."""
+    from .ops import block_jordan_invert_inplace
+    from .ops.jordan_inplace import block_jordan_invert_inplace_fori
     from .parallel.sharded_inplace import MAX_UNROLL_NR
 
     Nr = -(-n // min(block_size, n))
     return (block_jordan_invert_inplace if Nr <= MAX_UNROLL_NR
-            else block_jordan_invert)
+            else block_jordan_invert_inplace_fori)
 
 
 class _Dist1D:
     """1D row-block-cyclic backend (the reference's own layout,
     main.cpp:118-123).
 
-    Engine selection mirrors ``single_device_invert``: the in-place 2N³
-    elimination (parallel/sharded_inplace.py — half the flops, memory,
-    and collective bytes of the augmented path) whenever its unrolled
-    trace is affordable, else the augmented fori_loop path."""
+    Engine selection mirrors ``single_device_invert``: always the
+    in-place 2N³ elimination (parallel/sharded_inplace.py — half the
+    flops, memory, and collective bytes of the augmented path); its
+    compile fn picks the unrolled trace vs the fori_loop engine by Nr.
+    The augmented path stays addressable by setting ``inplace = False``
+    (reference-parity escape hatch)."""
 
     def __init__(self, workers: int, n: int, m: int):
         from .parallel import make_mesh
         from .parallel.layout import CyclicLayout
-        from .parallel.sharded_inplace import MAX_UNROLL_NR
 
         self.mesh = make_mesh(workers)
         self.lay = CyclicLayout.create(n, m, workers)
-        self.inplace = self.lay.Nr <= MAX_UNROLL_NR
+        self.inplace = True
 
     def generate_W(self, generator, dtype):
         from .parallel import sharded_generate
@@ -360,19 +365,19 @@ class _Dist2D:
     """2D block-cyclic backend over a (pr, pc) mesh (SUMMA residual) —
     per-worker memory O(n²/(pr·pc)).
 
-    Engine selection mirrors ``_Dist1D``: the in-place 2N³ elimination
-    (parallel/jordan2d_inplace.py) whenever its unrolled trace is
-    affordable, else the augmented fori_loop path."""
+    Engine selection mirrors ``_Dist1D``: always the in-place 2N³
+    elimination (parallel/jordan2d_inplace.py); its compile fn picks the
+    unrolled trace vs the fori_loop engine by Nr.  The augmented path
+    stays addressable by setting ``inplace = False``."""
 
     def __init__(self, shape: tuple, n: int, m: int):
         from .parallel import make_mesh_2d
         from .parallel.layout import CyclicLayout2D
-        from .parallel.sharded_inplace import MAX_UNROLL_NR
 
         pr, pc = shape
         self.mesh = make_mesh_2d(pr, pc)
         self.lay = CyclicLayout2D.create(n, m, pr, pc)
-        self.inplace = self.lay.Nr <= MAX_UNROLL_NR
+        self.inplace = True
 
     def generate_W(self, generator, dtype):
         from .parallel.jordan2d import sharded_generate_2d

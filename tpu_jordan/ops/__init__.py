@@ -2,7 +2,11 @@ from .batched import batched_jordan_invert
 from .block_inverse import batched_block_inverse, gauss_jordan_inverse
 from .generators import GENERATORS, abs_diff, generate, hilbert, identity
 from .jordan import block_jordan_invert
-from .jordan_inplace import block_jordan_invert_inplace
+from .jordan_inplace import (
+    block_jordan_invert_inplace,
+    block_jordan_invert_inplace_fori,
+    block_jordan_invert_inplace_grouped,
+)
 from .norms import block_inf_norms, inf_norm
 from .padding import pad_with_identity, unpad
 from .refine import newton_schulz
@@ -16,6 +20,8 @@ __all__ = [
     "block_inf_norms",
     "block_jordan_invert",
     "block_jordan_invert_inplace",
+    "block_jordan_invert_inplace_fori",
+    "block_jordan_invert_inplace_grouped",
     "gauss_jordan_inverse",
     "generate",
     "hilbert",

@@ -131,3 +131,29 @@ def probe_blocks(cands: jnp.ndarray, eps, use_pallas: bool):
 
         return pallas_batched_block_inverse(cands, eps)
     return batched_block_inverse(cands, None, eps)
+
+
+def probe_blocks_half_masked(cands, upper_only, eps, use_pallas: bool):
+    """Half-window probe cut shared by the traced (fori_loop) engines.
+
+    When ``upper_only`` (a traced bool — e.g. ``t >= (window//2)*stride``
+    with the layout's slot stride), probe only the upper half of the
+    candidate window and pad the dead lower half with identity blocks
+    flagged singular, so the downstream inf-key masking excludes them
+    while every branch keeps the same (w, m, m) shape for ``lax.cond``.
+    The unrolled engines shrink the window statically instead; this is
+    the traced-shape substitute (reference probes the live window too,
+    main.cpp:1039)."""
+    w, m = cands.shape[0], cands.shape[-1]
+    half = w // 2
+    if not half:
+        return probe_blocks(cands, eps, use_pallas)
+
+    def _upper(c):
+        invs_u, sing_u = probe_blocks(c[half:], eps, use_pallas)
+        eye = jnp.broadcast_to(jnp.eye(m, dtype=c.dtype), (half, m, m))
+        return (jnp.concatenate([eye, invs_u]),
+                jnp.concatenate([jnp.ones((half,), bool), sing_u]))
+
+    return lax.cond(upper_only, _upper,
+                    lambda c: probe_blocks(c, eps, use_pallas), cands)

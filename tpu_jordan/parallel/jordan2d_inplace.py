@@ -161,6 +161,163 @@ def _unscramble_step(t: int, piv, Wloc, *, lay: CyclicLayout2D):
     )
 
 
+def _step2d_fori(t, Wloc, singular, swaps, *, lay: CyclicLayout2D, eps,
+                 precision, use_pallas: bool):
+    """One super-step with a TRACED ``t`` — the fori_loop body behind
+    ``_sharded_jordan2d_inplace_fori``.  Same arithmetic and pivot
+    choices as ``_step2d``; the probe covers the full slot window with
+    dead slots masked (plus the half-window cut once t >= (bpr//2)*pr),
+    and all chunk offsets go through ``lax.dynamic_slice``."""
+    pr, pc, m, bpr = lay.pr, lay.pc, lay.m, lay.bpr
+    kr = lax.axis_index(AXIS_R)
+    kc = lax.axis_index(AXIS_C)
+    dtype = Wloc.dtype
+    u_t = t // pc                               # owner column's local chunk
+    own_c = kc == (t % pc)
+    gidx = jnp.arange(bpr) * pr + kr            # global block row per slot
+
+    # --- PIVOT PROBE: owner mesh column only, full window masked.
+    from ..ops.block_inverse import probe_blocks_half_masked
+
+    def do_probe(c):
+        return probe_blocks_half_masked(c, t >= (bpr // 2) * pr, eps,
+                                        use_pallas)
+
+    def skip_probe(c):
+        return (jnp.zeros_like(c),
+                lax.pcast(jnp.ones((bpr,), jnp.bool_), BOTH, to='varying'))
+
+    cands = lax.dynamic_slice(Wloc, (0, 0, u_t * m), (bpr, m, m))
+    invs, sing = lax.cond(own_c, do_probe, skip_probe, cands)
+    valid = own_c & (gidx >= t) & ~sing
+    norms = block_inf_norms(invs)
+    key = jnp.where(valid, norms, jnp.asarray(jnp.inf, norms.dtype))
+    slot_best = jnp.argmin(key)
+    my_key = key[slot_best]
+    g_cand = gidx[slot_best]
+
+    # --- PIVOT REDUCTION over the whole mesh (identical to _step2d).
+    kmin = lax.pmin(my_key, BOTH)
+    win_g = lax.pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), BOTH)
+    singular = singular | ~jnp.isfinite(kmin)
+    i_won = own_c & (my_key == kmin) & (g_cand == win_g)
+    g_piv = lax.psum(jnp.where(i_won, g_cand, 0), BOTH)
+    H = lax.psum(
+        jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0), BOTH
+    ).astype(dtype)
+
+    # --- ROW BROADCASTS along "pr": (m, Wc) slices.
+    own_piv = kr == (g_piv % pr)
+    slot_piv = jnp.where(own_piv, g_piv // pr, 0)
+    row_piv = lax.psum(
+        jnp.where(own_piv,
+                  lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False), 0.0),
+        AXIS_R,
+    )                                           # (m, Wc)
+    own_t = kr == (t % pr)
+    slot_t = t // pr
+    row_t = lax.psum(
+        jnp.where(own_t,
+                  lax.dynamic_index_in_dim(Wloc, slot_t, 0, False), 0.0),
+        AXIS_R,
+    )                                           # (m, Wc)
+
+    # --- SWAP-BY-COPY, row-granular.
+    cur_piv = lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False)
+    Wloc = lax.dynamic_update_index_in_dim(
+        Wloc, jnp.where(own_piv, row_t, cur_piv), slot_piv, 0
+    )
+
+    # --- NORMALIZE; the owner column's t-chunk of the pivot row becomes H.
+    prow = jnp.matmul(H, row_piv, precision=precision)      # (m, Wc)
+    prow_H = lax.dynamic_update_slice(prow, H, (0, u_t * m))
+    prow = jnp.where(own_c, prow_H, prow)
+
+    # --- MULTIPLIER BROADCAST along "pc"; owner column zeroes its t-chunk.
+    chunk = lax.dynamic_slice(Wloc, (0, 0, u_t * m), (bpr, m, m))
+    E = lax.psum(jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
+    E = jnp.where((gidx == t)[:, None, None], jnp.asarray(0, dtype), E)
+    Wloc = lax.dynamic_update_slice(
+        Wloc, jnp.where(own_c, jnp.zeros_like(chunk), chunk),
+        (0, 0, u_t * m))
+
+    # --- ELIMINATE: one local MXU matmul over the whole shard.
+    update = jnp.matmul(E.reshape(bpr * m, m), prow, precision=precision)
+    Wloc = Wloc - update.reshape(Wloc.shape)
+
+    # Row t becomes the normalized pivot row (owning mesh row only).
+    cur_t = lax.dynamic_index_in_dim(Wloc, slot_t, 0, False)
+    Wloc = lax.dynamic_update_index_in_dim(
+        Wloc, jnp.where(own_t, prow, cur_t), slot_t, 0
+    )
+    return Wloc, singular, swaps.at[t].set(g_piv.astype(jnp.int32))
+
+
+def _unscramble_step_fori(t, piv, Wloc, *, lay: CyclicLayout2D):
+    """``_unscramble_step`` with a TRACED ``t``: swap global column
+    blocks ``t`` and ``piv`` across the column-sharded layout.  Indices
+    are int32 throughout, incl. literal zeros (x64 would make bare 0
+    int64 against the int32 swap history)."""
+    pc, m, bpr = lay.pc, lay.m, lay.bpr
+    kc = lax.axis_index(AXIS_C)
+    z = jnp.int32(0)
+    t = jnp.asarray(t, jnp.int32)
+    u_t = t // pc
+    own_ct = kc == (t % pc)
+    own_cp = kc == (piv % pc)
+    up = jnp.where(own_cp, piv // pc, z)
+
+    loc_t = lax.dynamic_slice(Wloc, (z, z, u_t * m), (bpr, m, m))
+    col_t = lax.psum(jnp.where(own_ct, loc_t, 0.0), AXIS_C)
+    loc_p = lax.dynamic_slice(Wloc, (z, z, up * m), (bpr, m, m))
+    col_p = lax.psum(jnp.where(own_cp, loc_p, 0.0), AXIS_C)
+    # Chunk-granular writes, same order as the static version: col_t into
+    # piv's chunk first, then col_p into t's chunk.
+    Wloc = lax.dynamic_update_slice(
+        Wloc, jnp.where(own_cp, col_t, loc_p), (z, z, up * m)
+    )
+    cur_t = lax.dynamic_slice(Wloc, (z, z, u_t * m), (bpr, m, m))
+    return lax.dynamic_update_slice(
+        Wloc, jnp.where(own_ct, col_p, cur_t), (z, z, u_t * m)
+    )
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas"))
+def _sharded_jordan2d_inplace_fori(W, mesh, lay: CyclicLayout2D, eps,
+                                   precision, use_pallas):
+    """The 2D in-place engine with both loops as ``lax.fori_loop``s —
+    identical results to ``_sharded_jordan2d_inplace``, compile cost
+    independent of Nr (the MAX_UNROLL_NR ceiling removed)."""
+    def worker(Wloc):
+        def body(t, carry):
+            Wl, sing, swaps = carry
+            return _step2d_fori(t, Wl, sing, swaps, lay=lay, eps=eps,
+                                precision=precision, use_pallas=use_pallas)
+
+        sing0 = lax.pcast(jnp.asarray(False), BOTH, to='varying')
+        swaps0 = lax.pcast(jnp.zeros((lay.Nr,), jnp.int32), BOTH,
+                           to='varying')
+        Wloc, singular, swaps = lax.fori_loop(
+            0, lay.Nr, body, (Wloc, sing0, swaps0))
+
+        def unscramble(i, Wl):
+            # int32 throughout (x64 loop counters are int64; the swap
+            # history is int32 and dynamic_slice rejects mixing).
+            t = jnp.asarray(lay.Nr - 1 - i, jnp.int32)
+            return _unscramble_step_fori(t, swaps[t], Wl, lay=lay)
+
+        Wloc = lax.fori_loop(0, lay.Nr, unscramble, Wloc)
+        return Wloc, singular[None, None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=_SPEC_W,
+        out_specs=(_SPEC_W, PartitionSpec(AXIS_R, AXIS_C)),
+    )(W)
+
+
 @partial(jax.jit,
          static_argnames=("mesh", "lay", "eps", "precision", "use_pallas"))
 def _sharded_jordan2d_inplace(W, mesh, lay: CyclicLayout2D, eps, precision,
@@ -205,17 +362,25 @@ def compile_sharded_jordan_inplace_2d(
     eps: float | None = None,
     precision=lax.Precision.HIGHEST,
     use_pallas: bool | None = None,
+    unroll: bool | None = None,
 ):
     """AOT-compile the 2D in-place elimination for a (Nr, m, N) 2D-cyclic
     identity-padded block tensor.  ``run(W) -> (inverse_blocks,
-    singular_grid)`` — the output IS the inverse in 2D-cyclic order."""
+    singular_grid)`` — the output IS the inverse in 2D-cyclic order.
+
+    ``unroll=None`` picks the unrolled trace for Nr <= MAX_UNROLL_NR and
+    the fori_loop engine beyond — identical results either way."""
     from .jordan2d import resolve_use_pallas_2d
 
     if eps is None:
         eps = eps_for(W.dtype)
     if use_pallas is None:
         use_pallas = resolve_use_pallas_2d(W.dtype, lay.m)
-    return _sharded_jordan2d_inplace.lower(
+    if unroll is None:
+        unroll = lay.Nr <= MAX_UNROLL_NR
+    engine = (_sharded_jordan2d_inplace if unroll
+              else _sharded_jordan2d_inplace_fori)
+    return engine.lower(
         W, mesh, lay, eps, precision, use_pallas
     ).compile()
 
@@ -228,24 +393,20 @@ def sharded_jordan_invert_inplace_2d(
     eps: float | None = None,
     precision=lax.Precision.HIGHEST,
     use_pallas: bool | None = None,
+    unroll: bool | None = None,
 ):
     """Invert (n, n) ``a`` over a 2D (pr, pc) mesh with the in-place
     engine: drop-in for ``sharded_jordan_invert_2d`` at ~half the flops,
-    per-worker memory, and collective bytes.  Requires
-    ``lay.Nr <= MAX_UNROLL_NR`` (unrolled trace)."""
+    per-worker memory, and collective bytes.  Any Nr: the unrolled trace
+    below MAX_UNROLL_NR, the fori_loop engine above (``unroll`` forces a
+    choice)."""
     from .jordan2d import scatter_matrix_2d
 
     n = a.shape[-1]
     pr, pc = mesh.devices.shape
     lay = CyclicLayout2D.create(n, min(block_size, n), pr, pc)
-    if lay.Nr > MAX_UNROLL_NR:
-        raise ValueError(
-            f"in-place path unrolls the block-column loop: Nr={lay.Nr} > "
-            f"{MAX_UNROLL_NR}; use sharded_jordan_invert_2d or a larger "
-            "block"
-        )
     W = scatter_matrix_2d(a, lay, mesh)
     run = compile_sharded_jordan_inplace_2d(W, mesh, lay, eps, precision,
-                                            use_pallas)
+                                            use_pallas, unroll)
     out, singular = run(W)
     return gather_inverse_inplace_2d(out, lay, n), singular.any()

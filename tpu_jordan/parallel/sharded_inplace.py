@@ -52,8 +52,10 @@ from .mesh import AXIS
 from .upcast import upcast_sub_fp32
 
 # Unrolled-trace budget (same bar as the single-chip engine,
-# driver.single_device_invert): beyond this, fall back to the augmented
-# fori_loop path.
+# driver.single_device_invert): beyond this, the fori_loop in-place
+# engine below takes over (same 2N³ algorithm, traced offsets, compile
+# cost independent of Nr) — the augmented ~4N³ path is no longer the
+# large-Nr fallback.
 MAX_UNROLL_NR = 64
 
 
@@ -136,6 +138,129 @@ def _step(t: int, Wloc, singular, *, lay: CyclicLayout, eps, precision,
     return Wloc, singular, g_piv
 
 
+def _step_fori(t, Wloc, singular, swaps, *, lay: CyclicLayout, eps,
+               precision, use_pallas: bool):
+    """One super-step with a TRACED ``t`` on one worker's (bpw, m, N)
+    shard — the fori_loop body behind ``_sharded_jordan_inplace_fori``.
+    Same arithmetic as ``_step`` (identical pivot choices and updates);
+    the probe runs on the full slot window with dead slots masked, plus
+    the half-window ``lax.cond`` cut of the augmented path
+    (sharded_jordan.py::_local_step): once t >= (bpw//2)*p every slot of
+    the lower half is dead, so only the upper half is probed."""
+    p, m, bpw, N = lay.p, lay.m, lay.blocks_per_worker, lay.N
+    k = lax.axis_index(AXIS)
+    dtype = Wloc.dtype
+    gidx = jnp.arange(bpw) * p + k              # global block row per slot
+
+    # --- PIVOT PROBE: full slot window, masked (main.cpp:1039).
+    from ..ops.block_inverse import probe_blocks_half_masked
+
+    cands = lax.dynamic_slice(Wloc, (0, 0, t * m), (bpw, m, m))
+    invs, sing = probe_blocks_half_masked(cands, t >= (bpw // 2) * p,
+                                          eps, use_pallas)
+    valid = (gidx >= t) & ~sing
+    norms = block_inf_norms(invs)
+    key = jnp.where(valid, norms, jnp.asarray(jnp.inf, norms.dtype))
+    slot_best = jnp.argmin(key)
+    my_key = key[slot_best]
+
+    # --- PIVOT REDUCTION (identical to _step).
+    kmin = lax.pmin(my_key, AXIS)
+    g_cand = gidx[slot_best]
+    win_g = lax.pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), AXIS)
+    singular = singular | ~jnp.isfinite(kmin)
+    i_won = (my_key == kmin) & (g_cand == win_g)
+
+    g_piv = lax.psum(jnp.where(i_won, g_cand, 0), AXIS)
+    H = lax.psum(
+        jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0).astype(dtype),
+        AXIS,
+    )
+
+    # --- ROW BROADCASTS (m, N), one-hot psums (main.cpp:1097/1122-1129).
+    safe_best = jnp.where(i_won, slot_best, 0)
+    row_piv = lax.psum(
+        jnp.where(i_won, lax.dynamic_index_in_dim(Wloc, safe_best, 0, False),
+                  0.0),
+        AXIS,
+    )                                           # (m, N)
+    own_t = k == (t % p)
+    slot_t = t // p
+    row_t = lax.psum(
+        jnp.where(own_t, lax.dynamic_index_in_dim(Wloc, slot_t, 0, False),
+                  0.0),
+        AXIS,
+    )                                           # (m, N)
+
+    # --- SWAP-BY-COPY (main.cpp:1093-1131), row-granular.
+    own_piv = k == (g_piv % p)
+    slot_piv = jnp.where(own_piv, g_piv // p, 0)
+    cur_piv = lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False)
+    Wloc = lax.dynamic_update_index_in_dim(
+        Wloc, jnp.where(own_piv, row_t, cur_piv), slot_piv, 0
+    )
+
+    # --- NORMALIZE; the t-chunk becomes H.
+    prow = jnp.matmul(H, row_piv, precision=precision)      # (m, N)
+    prow = lax.dynamic_update_slice(prow, H, (0, t * m))
+
+    # --- ELIMINATE.
+    E = lax.dynamic_slice(Wloc, (0, 0, t * m), (bpw, m, m))
+    E = jnp.where((gidx == t)[:, None, None], jnp.asarray(0, dtype), E)
+    Wloc = lax.dynamic_update_slice(
+        Wloc, jnp.zeros((bpw, m, m), dtype), (0, 0, t * m))
+    update = jnp.matmul(E.reshape(bpw * m, m), prow, precision=precision)
+    Wloc = Wloc - update.reshape(bpw, m, N)
+
+    # Row t becomes the normalized pivot row (owner only); row-granular.
+    cur_t = lax.dynamic_index_in_dim(Wloc, slot_t, 0, False)
+    Wloc = lax.dynamic_update_index_in_dim(
+        Wloc, jnp.where(own_t, prow, cur_t), slot_t, 0
+    )
+    return Wloc, singular, swaps.at[t].set(g_piv.astype(jnp.int32))
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas"))
+def _sharded_jordan_inplace_fori(W, mesh, lay: CyclicLayout, eps, precision,
+                                 use_pallas):
+    """The in-place 1D engine with both loops as ``lax.fori_loop``s:
+    identical pivot choices and results to ``_sharded_jordan_inplace``,
+    compile cost independent of Nr — this is what removes the
+    ``MAX_UNROLL_NR`` ceiling from the 2N³ path (n=16384 at m=128 is
+    Nr=128; 32768²/65536² distributed are Nr >= 64 at every useful m)."""
+    m, N, bpw = lay.m, lay.N, lay.blocks_per_worker
+
+    def worker(Wloc):
+        def body(t, carry):
+            Wl, sing, swaps = carry
+            return _step_fori(t, Wl, sing, swaps, lay=lay, eps=eps,
+                              precision=precision, use_pallas=use_pallas)
+
+        sing0 = lax.pcast(jnp.asarray(False), AXIS, to='varying')
+        swaps0 = lax.pcast(jnp.zeros((lay.Nr,), jnp.int32), AXIS,
+                           to='varying')
+        Wloc, singular, swaps = lax.fori_loop(
+            0, lay.Nr, body, (Wloc, sing0, swaps0))
+
+        # --- UNSCRAMBLE: the composed swap permutation applied as ONE
+        # blocked gather (worker-local — columns are replicated in the
+        # 1D layout).  The literal column-swap replay costs a whole-shard
+        # XLA copy per step (ops/jordan_inplace.py::compose_swap_perm).
+        from ..ops.jordan_inplace import apply_col_perm, compose_swap_perm
+
+        Wloc = apply_col_perm(Wloc, compose_swap_perm(swaps, lay.Nr),
+                              lay.m)
+        return Wloc, singular[None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=PartitionSpec(AXIS, None, None),
+        out_specs=(PartitionSpec(AXIS, None, None), PartitionSpec(AXIS)),
+    )(W)
+
+
 @partial(jax.jit,
          static_argnames=("mesh", "lay", "eps", "precision", "use_pallas"))
 def _sharded_jordan_inplace(W, mesh, lay: CyclicLayout, eps, precision,
@@ -150,16 +275,14 @@ def _sharded_jordan_inplace(W, mesh, lay: CyclicLayout, eps, precision,
             )
             swaps.append(g_piv)
 
-        # --- UNSCRAMBLE: row-swap history replayed as column swaps in
-        # reverse (in-place GJ bookkeeping; worker-local — columns are
-        # replicated in the 1D layout).
-        m, N, bpw = lay.m, lay.N, lay.blocks_per_worker
-        for t in reversed(range(lay.Nr)):
-            piv = swaps[t]
-            col_t = Wloc[:, :, t * m:(t + 1) * m]
-            col_p = lax.dynamic_slice(Wloc, (0, 0, piv * m), (bpw, m, m))
-            Wloc = lax.dynamic_update_slice(Wloc, col_t, (0, 0, piv * m))
-            Wloc = Wloc.at[:, :, t * m:(t + 1) * m].set(col_p)
+        # --- UNSCRAMBLE: the composed swap permutation applied as ONE
+        # blocked gather (worker-local — columns are replicated in the
+        # 1D layout; the literal replay costs a whole-shard copy per
+        # step, ops/jordan_inplace.py::compose_swap_perm).
+        from ..ops.jordan_inplace import apply_col_perm, compose_swap_perm
+
+        Wloc = apply_col_perm(
+            Wloc, compose_swap_perm(jnp.stack(swaps), lay.Nr), lay.m)
         return Wloc, singular[None]
 
     return shard_map(
@@ -177,18 +300,27 @@ def compile_sharded_jordan_inplace(
     eps: float | None = None,
     precision=lax.Precision.HIGHEST,
     use_pallas: bool | None = None,
+    unroll: bool | None = None,
 ):
     """AOT-compile the in-place sharded elimination for a (Nr, m, N)
     identity-padded cyclic block tensor.  ``run(blocks) ->
     (inverse_blocks, singular_per_worker)`` — the output IS the inverse in
-    cyclic row order (no B half to slice)."""
+    cyclic row order (no B half to slice).
+
+    ``unroll=None`` picks the unrolled trace (static shrinking probe
+    window) for Nr <= MAX_UNROLL_NR and the fori_loop engine beyond —
+    identical results either way."""
     from .sharded_jordan import resolve_use_pallas
 
     if eps is None:
         eps = eps_for(blocks.dtype)
     if use_pallas is None:
         use_pallas = resolve_use_pallas(blocks.dtype, lay.m)
-    return _sharded_jordan_inplace.lower(
+    if unroll is None:
+        unroll = lay.Nr <= MAX_UNROLL_NR
+    engine = (_sharded_jordan_inplace if unroll
+              else _sharded_jordan_inplace_fori)
+    return engine.lower(
         blocks, mesh, lay, eps, precision, use_pallas
     ).compile()
 
@@ -210,24 +342,21 @@ def sharded_jordan_invert_inplace(
     eps: float | None = None,
     precision=lax.Precision.HIGHEST,
     use_pallas: bool | None = None,
+    unroll: bool | None = None,
 ):
     """Invert (n, n) ``a`` over the 1D mesh with the in-place engine.
 
     Drop-in for ``sharded_jordan_invert`` (same pivot rule, same
     (inv, singular) contract) at ~half the flops, memory, and collective
-    bytes.  Requires ``lay.Nr <= MAX_UNROLL_NR`` (unrolled trace).
+    bytes.  Any Nr: the unrolled trace below MAX_UNROLL_NR, the
+    fori_loop engine above (``unroll`` forces a choice).
     """
     from .ring_gemm import _to_identity_padded_blocks
 
     n = a.shape[-1]
     lay = CyclicLayout.create(n, min(block_size, n), mesh.devices.size)
-    if lay.Nr > MAX_UNROLL_NR:
-        raise ValueError(
-            f"in-place path unrolls the block-column loop: Nr={lay.Nr} > "
-            f"{MAX_UNROLL_NR}; use sharded_jordan_invert or a larger block"
-        )
     blocks = _to_identity_padded_blocks(a, lay, mesh)
     run = compile_sharded_jordan_inplace(blocks, mesh, lay, eps, precision,
-                                         use_pallas)
+                                         use_pallas, unroll)
     out, singular = run(blocks)
     return gather_inverse_inplace(out, lay, n), singular.any()
