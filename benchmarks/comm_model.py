@@ -1,0 +1,155 @@
+"""Analytic communication/compute model for the distributed in-place
+engines (VERDICT r3 #5): predicts wall time and parallel efficiency for
+the north-star configs on real TPU pods, and sanity-checks itself against
+the measured CPU-mesh runs and the measured single-chip v5e phase model.
+
+Per-super-step collective inventory (counted from the engines — reference
+analogs main.cpp:1074 (custom pivot all-reduce), 1097 (pivot-row bcast),
+1122-1129 (row-swap exchange)):
+
+  1D (parallel/sharded_inplace.py::_step):
+    * 3 scalar pmin/psum (pivot reduction)            — latency only
+    * H psum:        (m, m)        over p
+    * row_piv psum:  (m, N)        over p
+    * row_t psum:    (m, N)        over p
+  2D (parallel/jordan2d_inplace.py::_step2d):
+    * 3 scalar pmin/psum over the whole mesh          — latency only
+    * H psum:        (m, m)        over pr*pc
+    * row_piv psum:  (m, N/pc)     along pr
+    * row_t psum:    (m, N/pc)     along pr
+    * E psum:        (N/pr, m)     along pc
+    plus the 2D unscramble (after the loop): 2 x (N/pr, m) along pc per
+    step.
+
+The one-hot psums are semantically broadcasts but lower as all-reduces;
+ring all-reduce of S bytes over an axis of a chips with W bytes/s
+per-direction links is modeled as T = S*(a-1)/a / W (reduce-scatter +
+all-gather riding both directions).  Scalar collectives are charged
+latency only.
+
+Compute terms per step, calibrated on the measured v5e phase model
+(benchmarks/PHASES.md "Post-fix phase model": 8192 m=256 = 35 ms
+eliminate + 35 ms probe + ~8 ms glue = 78.7 ms):
+  * eliminate: 2*(N/P_row)*m*N flops at the chip's measured fp32 matmul
+    envelope (v5e: 30.7 TF/s), floored by the shard's HBM read-modify-
+    write;
+  * probe: c_probe * live_candidates * m^3 elementwise-pass cost —
+    c_probe calibrated to the same 35 ms (1D probes (Nr-t)/p candidates
+    per worker; 2D probes (Nr-t)/pr on the owner mesh column only, so pc
+    buys no probe time);
+  * glue (swaps, normalize, row writes): 0.5 HBM shard passes.
+
+Chip constants: measured for v5e; v4/v5p matmul envelopes scaled from
+the public bf16 peaks by the v5e-measured fp32-HIGHEST/bf16 ratio
+(30.7/197 ~ 1/6.4), ICI per-link one-directional bandwidths and HBM
+bandwidths from public TPU specs (How to Scale Your Model).  Predictions,
+not measurements — the point is WHERE the collectives start to dominate,
+not 3-digit accuracy.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass(frozen=True)
+class Chip:
+    name: str
+    mxu_f32: float      # fp32-HIGHEST matmul envelope, FLOP/s
+    hbm: float          # bytes/s
+    ici: float          # per-link one-directional bytes/s
+    vpu_scale: float    # probe-rate multiplier vs the v5e calibration
+
+
+# v5e measured; v4/v5p scaled (bf16 peaks 197/275/459 TF/s; HBM
+# 0.81/1.23/2.77 TB/s; ICI links 4.5e10/4.5e10/9e10 B/s).  vpu_scale
+# tracks the clock/lane ratio (~bf16 ratio is MXU-count-driven, the VPU
+# grows less) — held conservative at the HBM ratio.
+V5E = Chip("v5e", 30.7e12, 0.81e12, 4.5e10, 1.0)
+V4 = Chip("v4", 43e12, 1.23e12, 4.5e10, 1.5)
+V5P = Chip("v5p", 72e12, 2.77e12, 9.0e10, 3.4)
+
+LATENCY = 2e-6          # per collective, seconds (ICI hop + launch)
+C_PROBE_V5E = 4.07e-12  # s per candidate-element pass (35 ms @ 8192/256)
+
+
+def _allreduce(S: float, a: int, chip: Chip) -> float:
+    return 0.0 if a == 1 else S * (a - 1) / a / chip.ici + LATENCY
+
+
+def predict(n: int, m: int, pr: int, pc: int, chip: Chip,
+            measured_single: float | None = None):
+    """Returns dict of phase seconds + efficiency for an (pr, pc) mesh
+    (pc=1 -> the 1D row-cyclic engine)."""
+    Nr = -(-n // m)
+    N = Nr * m
+    P = pr * pc
+    c_probe = C_PROBE_V5E / chip.vpu_scale
+
+    elim = probe = comm = glue = 0.0
+    for t in range(Nr):
+        # eliminate: (N/pr rows) x (m) x (N/pc cols) local matmul.
+        fl = 2.0 * (N / pr) * m * (N / pc)
+        rmw = 2.0 * (N / pr) * (N / pc) * 4
+        elim += max(fl / chip.mxu_f32, rmw / chip.hbm)
+        glue += 0.5 * rmw / chip.hbm
+        # probe: live candidates on the probing workers.
+        live = max(1, (Nr - t) // pr)
+        probe += c_probe * live * m**3
+        # collectives.
+        comm += 3 * LATENCY                      # scalar pivot reduction
+        comm += _allreduce(4 * m * m, P, chip)   # H
+        comm += 2 * _allreduce(4 * m * (N / pc), pr, chip)  # row_piv, row_t
+        if pc > 1:
+            comm += _allreduce(4 * (N / pr) * m, pc, chip)  # E panel
+            comm += 2 * _allreduce(4 * (N / pr) * m, pc, chip)  # unscramble
+    total = elim + probe + comm + glue
+    out = {"elim": elim, "probe": probe, "comm": comm, "glue": glue,
+           "total": total}
+    if P == 1:
+        out["efficiency"] = 1.0
+    else:
+        single = (measured_single if measured_single is not None
+                  else predict(n, m, 1, 1, chip)["total"])
+        out["efficiency"] = single / (P * total)
+    return out
+
+
+def _fmt(n, m, pr, pc, chip):
+    r = predict(n, m, pr, pc, chip)
+    mesh = f"{pr}x{pc}" if pc > 1 else f"1D p={pr}"
+    gf = 2.0 * n**3 / r["total"] / 1e9
+    return (f"| {chip.name} {mesh} | {n} | {m} | {r['elim']*1e3:8.1f} | "
+            f"{r['probe']*1e3:8.1f} | {r['comm']*1e3:8.1f} | "
+            f"{r['total']*1e3:8.1f} | {gf:10,.0f} | "
+            f"{r['efficiency']*100:5.0f}% |")
+
+
+def main():
+    print("Sanity: single-chip v5e model vs measured 78.7 ms @ 8192 m=256")
+    r = predict(8192, 256, 1, 1, V5E)
+    print({k: round(v * 1e3, 1) for k, v in r.items() if k != "efficiency"})
+    print()
+    print("| mesh | n | m | elim ms | probe ms | comm ms | total ms "
+          "| GFLOP/s | par.eff |")
+    print("|---|---|---|---|---|---|---|---|---|")
+    rows = [
+        # v4-8 (4 chips) and v5e-8 class, 8192.
+        (8192, 256, 8, 1, V5E),
+        (8192, 256, 2, 4, V5E),
+        (8192, 512, 4, 1, V4),
+        (8192, 512, 2, 2, V4),
+        # v5p-32, 32768 (the 2D north star; 1D shown for contrast).
+        (32768, 512, 32, 1, V5P),
+        (32768, 512, 4, 8, V5P),
+        (32768, 256, 4, 8, V5P),
+        # v5p-64, 65536.
+        (65536, 512, 64, 1, V5P),
+        (65536, 512, 8, 8, V5P),
+    ]
+    for n, m, pr, pc, chip in rows:
+        print(_fmt(n, m, pr, pc, chip))
+
+
+if __name__ == "__main__":
+    main()

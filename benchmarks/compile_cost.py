@@ -1,0 +1,99 @@
+"""Measure trace+compile seconds vs Nr for the in-place engines
+(VERDICT r3 #6): the evidence behind ``MAX_UNROLL_NR`` — the unrolled
+trace's compile cost grows with Nr (every super-step is cloned into the
+graph), the fori_loop engines' does not.
+
+Run on the 8-virtual-device CPU mesh (same environment as the test
+suite); compile cost is a host/XLA property, so CPU numbers are the
+right evidence for the dispatch threshold used on all backends.
+
+Usage:  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python benchmarks/compile_cost.py
+"""
+
+import os
+import time
+
+# This environment preloads jax at interpreter start (sitecustomize), so
+# env mutation alone is too late — force the platform through jax.config
+# before any backend initializes (same dance as tests/conftest.py).
+os.environ["JAX_PLATFORMS"] = "cpu"
+if "xla_force_host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.default_backend() == "cpu", jax.default_backend()
+assert jax.device_count() == 8, jax.device_count()
+
+
+def compile_1d(n, m, unroll):
+    from tpu_jordan.parallel import make_mesh
+    from tpu_jordan.parallel.layout import CyclicLayout
+    from tpu_jordan.parallel.ring_gemm import _to_identity_padded_blocks
+    from tpu_jordan.parallel.sharded_inplace import (
+        compile_sharded_jordan_inplace,
+    )
+    from tpu_jordan.ops import generate
+
+    mesh = make_mesh(8)
+    lay = CyclicLayout.create(n, m, 8)
+    a = generate("absdiff", (n, n), jnp.float32)
+    W = _to_identity_padded_blocks(a, lay, mesh)
+    t0 = time.perf_counter()
+    compile_sharded_jordan_inplace(W, mesh, lay, unroll=unroll)
+    return lay.Nr, time.perf_counter() - t0
+
+
+def compile_2d(n, m, unroll):
+    from tpu_jordan.parallel import make_mesh_2d
+    from tpu_jordan.parallel.layout import CyclicLayout2D
+    from tpu_jordan.parallel.jordan2d import scatter_matrix_2d
+    from tpu_jordan.parallel.jordan2d_inplace import (
+        compile_sharded_jordan_inplace_2d,
+    )
+    from tpu_jordan.ops import generate
+
+    mesh = make_mesh_2d(2, 4)
+    lay = CyclicLayout2D.create(n, m, 2, 4)
+    a = generate("absdiff", (n, n), jnp.float32)
+    W = scatter_matrix_2d(a, lay, mesh)
+    t0 = time.perf_counter()
+    compile_sharded_jordan_inplace_2d(W, mesh, lay, unroll=unroll)
+    return lay.Nr, time.perf_counter() - t0
+
+
+def main():
+    # Fixed m=16 so Nr sweeps via n without huge arrays; compile cost
+    # depends on graph size (Nr), not on n's magnitude.
+    m = 16
+    print("| engine | Nr | unrolled s | fori s |")
+    print("|---|---|---|---|")
+    for Nr in (16, 32, 64, 128):
+        n = Nr * m
+        row = [f"1D p=8", str(Nr)]
+        for unroll in (True, False):
+            if unroll and Nr > 64:
+                row.append("—")
+                continue
+            _, secs = compile_1d(n, m, unroll)
+            row.append(f"{secs:.1f}")
+        print("| " + " | ".join(row) + " |")
+    for Nr in (16, 32, 64, 128):
+        n = Nr * m
+        row = [f"2D 2x4", str(Nr)]
+        for unroll in (True, False):
+            if unroll and Nr > 64:
+                row.append("—")
+                continue
+            _, secs = compile_2d(n, m, unroll)
+            row.append(f"{secs:.1f}")
+        print("| " + " | ".join(row) + " |")
+
+
+if __name__ == "__main__":
+    main()

@@ -90,3 +90,17 @@ def test_hilbert_conditioning_matches_reference_scale():
 def test_inf_norm():
     a = jnp.asarray([[1.0, -2.0], [3.0, 4.0]])
     assert float(inf_norm(a)) == 7.0
+
+
+def test_condition_inf():
+    from tpu_jordan.ops import condition_inf
+
+    # Exact: κ∞(diag(1, 4)) = ‖A‖∞ · ‖A⁻¹‖∞ = 4 · 1 = 4.
+    a = jnp.diag(jnp.asarray([1.0, 4.0]))
+    assert float(condition_inf(a, jnp.diag(jnp.asarray([1.0, 0.25])))) == 4.0
+    # And it matches numpy's ∞-norm condition number on a dense matrix.
+    rng = np.random.default_rng(7)
+    b = jnp.asarray(rng.standard_normal((32, 32)))
+    got = float(condition_inf(b, jnp.asarray(np.linalg.inv(b))))
+    want = np.linalg.cond(np.asarray(b), np.inf)
+    np.testing.assert_allclose(got, want, rtol=1e-10)

@@ -146,6 +146,36 @@ class TestCLI:
                      "--quiet"]) == 0
 
 
+class TestNoGatherCorner:
+    """gather=False verbose runs still print the inverse's corner
+    (main.cpp:459-461 always shows it), assembled from the owning blocks
+    without a global gather."""
+
+    @pytest.mark.parametrize("workers", [4, (2, 2)])
+    def test_corner_matches_gathered_inverse(self, workers):
+        # m=8 < 10 so the printed corner spans two block rows/cols — the
+        # multi-block assembly path, not just a single-block slice.
+        ref = solve(64, 8, workers=workers, dtype=jnp.float64)
+        res = solve(64, 8, workers=workers, dtype=jnp.float64,
+                    gather=False)
+        from tpu_jordan.driver import make_distributed_backend
+
+        be = make_distributed_backend(workers, 64, 8)
+        corner = np.asarray(be.corner(res.inverse_blocks, 64))
+        assert corner.shape == (10, 10)
+        np.testing.assert_allclose(corner, np.asarray(ref.inverse)[:10, :10],
+                                   rtol=1e-12, atol=1e-12)
+
+    def test_verbose_no_gather_prints_corner(self, capsys):
+        solve(32, 8, workers=4, dtype=jnp.float64, gather=False,
+              verbose=True)
+        out = capsys.readouterr().out
+        assert "inverse matrix:" in out
+        # ten tab-separated "%.2f" rows follow, like the reference print.
+        rows = [ln for ln in out.splitlines() if ln.count("\t") >= 9]
+        assert len(rows) >= 10
+
+
 class TestSolveBatch:
     def test_batch_solve_rand_distinct(self):
         import numpy as np

@@ -360,6 +360,11 @@ class _Dist1D:
         return distributed_residual_blocks(a_blocks, inv_blocks,
                                            self.mesh, self.lay)
 
+    def corner(self, inv_blocks, n):
+        from .parallel.sharded_inplace import inverse_corner_1d
+
+        return inverse_corner_1d(inv_blocks, self.lay, n)
+
 
 class _Dist2D:
     """2D block-cyclic backend over a (pr, pc) mesh (SUMMA residual) —
@@ -454,6 +459,11 @@ class _Dist2D:
         return distributed_residual_2d(a_blocks, inv_blocks, self.mesh,
                                        self.lay)
 
+    def corner(self, inv_blocks, n):
+        from .parallel.jordan2d_inplace import inverse_corner_2d
+
+        return inverse_corner_2d(inv_blocks, self.lay, n)
+
 
 def _solve_distributed_core(
     be, n: int, block_size: int, file, generator: str, dtype,
@@ -541,12 +551,14 @@ def _solve_distributed_core(
         residual = float(be.residual(a_b, jnp.asarray(inv_b, dtype)))
 
     if verbose:
-        print(f"glob_time: {elapsed:.2f}")
-        if inv is not None:
-            from .utils.printing import print_corner
+        from .utils.printing import print_corner
 
-            print("inverse matrix:\n")
-            print_corner(inv)
+        print(f"glob_time: {elapsed:.2f}")
+        print("inverse matrix:\n")
+        # gather=False still shows the corner (the reference always
+        # prints it, main.cpp:459-461) — assembled from the owning
+        # blocks alone, never a global gather.
+        print_corner(inv if inv is not None else be.corner(inv_b, n))
         print(f"residual: {residual:e}")
     return SolveResult(
         inverse=inv,

@@ -7,7 +7,7 @@ from .jordan_inplace import (
     block_jordan_invert_inplace_fori,
     block_jordan_invert_inplace_grouped,
 )
-from .norms import block_inf_norms, inf_norm
+from .norms import block_inf_norms, condition_inf, inf_norm
 from .padding import pad_with_identity, unpad
 from .refine import newton_schulz
 from .residual import residual_inf_norm
@@ -18,6 +18,7 @@ __all__ = [
     "batched_block_inverse",
     "batched_jordan_invert",
     "block_inf_norms",
+    "condition_inf",
     "block_jordan_invert",
     "block_jordan_invert_inplace",
     "block_jordan_invert_inplace_fori",

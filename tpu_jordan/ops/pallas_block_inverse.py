@@ -352,7 +352,7 @@ def _gj_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps):
     )
 
 
-def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps):
+def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps, hc=1):
     """The production probe: in-place (width-m) storage + b-wide panel
     micro-steps + MXU-deferred trailing updates + DEFERRED DIVISIONS.
 
@@ -462,24 +462,33 @@ def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps):
         # Deferred full-width update W += U·(R·W) (R = RAW pivot-row
         # selectors); panel slots are rebuilt from Vp instead.  All dots
         # contract on dim 1 of the transposed state — no lane transposes.
-        P = jax.lax.dot_general(
-            R, w_ref[...], dimension_numbers=bdims,
-            preferred_element_type=f32, precision=lax.Precision.HIGHEST,
-        )                                                 # (cg, b, m)
-        upd = jax.lax.dot_general(
-            Ut, P, dimension_numbers=(((1,), (1,)), ((0,), (0,))),
-            preferred_element_type=f32, precision=lax.Precision.HIGHEST,
-        )                                                 # (cg, m, m)
-        # Two staged ref writes (upd dies before vscat is computed): one
-        # combined expression keeps upd+vscat+w live together and blows
-        # the 16 MB scoped-vmem limit at m=512 cg=2 by ~1 MB.
-        w_ref[...] = w_ref[...] + upd                     # panel slots: garbage
-        vscat = jax.lax.dot_general(
-            Vpt, C, dimension_numbers=(((1,), (1,)), ((), ())),
-            preferred_element_type=f32, precision=lax.Precision.HIGHEST,
-        )                                                 # (cg, m, m)
-        in_panel = (lane_m >= k0) & (lane_m < k0 + b)
-        w_ref[...] = jnp.where(in_panel, vscat, w_ref[...])
+        # Both the update and the panel scatter are staged in ``hc``
+        # STATIC column chunks (static lane slices are Mosaic-legal even
+        # though dynamic ones are not): correct because chunk c's P reads
+        # only chunk c's columns of the pre-update W, which no other
+        # chunk's write touches.  hc=1 keeps the tuned m<=256 schedule;
+        # hc=2 at m=512 halves the peak (cg, m, m) temporaries — the
+        # ~1 MB that used to blow the 16 MB scoped-vmem limit at cg=2.
+        for c in range(hc):
+            sl = slice(c * (m // hc), (c + 1) * (m // hc))
+            P = jax.lax.dot_general(
+                R, w_ref[:, :, sl], dimension_numbers=bdims,
+                preferred_element_type=f32, precision=lax.Precision.HIGHEST,
+            )                                             # (cg, b, m/hc)
+            upd = jax.lax.dot_general(
+                Ut, P, dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+                preferred_element_type=f32, precision=lax.Precision.HIGHEST,
+            )                                             # (cg, m, m/hc)
+            w_ref[:, :, sl] = w_ref[:, :, sl] + upd       # panel slots: garbage
+        for c in range(hc):
+            sl = slice(c * (m // hc), (c + 1) * (m // hc))
+            vscat = jax.lax.dot_general(
+                Vpt, C[sl, :], dimension_numbers=(((1,), (1,)), ((), ())),
+                preferred_element_type=f32, precision=lax.Precision.HIGHEST,
+            )                                             # (cg, m, m/hc)
+            lane_c = lane_m[:, :, sl]
+            in_panel = (lane_c >= k0) & (lane_c < k0 + b)
+            w_ref[:, :, sl] = jnp.where(in_panel, vscat, w_ref[:, :, sl])
         return used, perm, sing, pivs
 
     used0 = jnp.zeros((cg, m), jnp.float32)
@@ -495,18 +504,68 @@ def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps):
     w_ref[...] = w_ref[...] + (big * big)[:, :, None]
     col_ids3 = lax.broadcasted_iota(jnp.int32, (cg, m, m), 2)
     onehot = (col_ids3 == perm[:, :, None].astype(jnp.int32)).astype(f32)
-    mw = jax.lax.dot_general(
-        onehot, w_ref[...], dimension_numbers=bdims,
-        preferred_element_type=f32, precision=lax.Precision.HIGHEST,
-    )
-    # Row scaling commutes with the right one-hot multiply
-    # (D⁻¹·(M·W)·M = (D⁻¹·M·W)·M): folding it here keeps one fewer
-    # (cg, m, m) temporary live at the final dot.
-    w_ref[...] = mw * (1.0 / pivs)[:, :, None]
-    inv_ref[...] = jax.lax.dot_general(
-        w_ref[...], onehot, dimension_numbers=bdims,
-        preferred_element_type=f32, precision=lax.Precision.HIGHEST,
-    )
+    if hc == 1:
+        mw = jax.lax.dot_general(
+            onehot, w_ref[...], dimension_numbers=bdims,
+            preferred_element_type=f32, precision=lax.Precision.HIGHEST,
+        )
+        # Row scaling commutes with the right one-hot multiply
+        # (D⁻¹·(M·W)·M = (D⁻¹·M·W)·M): folding it here keeps one fewer
+        # (cg, m, m) temporary live at the final dot.
+        w_ref[...] = mw * (1.0 / pivs)[:, :, None]
+        inv_ref[...] = jax.lax.dot_general(
+            w_ref[...], onehot, dimension_numbers=bdims,
+            preferred_element_type=f32, precision=lax.Precision.HIGHEST,
+        )
+    else:
+        # Column-chunked (hc > 1, the m=512 path): same algebra with the
+        # output block as the intermediate, so the largest temporary is
+        # (cg, m, m/hc) — the full-width mw no longer fits beside
+        # onehot + the refs at m=512 cg=2.
+        scale = (1.0 / pivs)[:, :, None]
+        for c in range(hc):
+            sl = slice(c * (m // hc), (c + 1) * (m // hc))
+            inv_ref[:, :, sl] = jax.lax.dot_general(
+                onehot, w_ref[:, :, sl], dimension_numbers=bdims,
+                preferred_element_type=f32,
+                precision=lax.Precision.HIGHEST,
+            ) * scale                                     # D⁻¹·M·W chunk
+        for c in range(hc):
+            sl = slice(c * (m // hc), (c + 1) * (m // hc))
+            w_ref[:, :, sl] = jax.lax.dot_general(
+                inv_ref[...], onehot[:, :, sl],
+                dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+                preferred_element_type=f32,
+                precision=lax.Precision.HIGHEST,
+            )
+        inv_ref[...] = w_ref[...]
+
+
+def _fused_budget(m: int) -> int:
+    """Per-program stack budget for the fused kernel (m-dependent hook;
+    today a constant — m=512 remains out of the fused kernel's reach:
+    cg=1 is a known-failing Mosaic region (unimplemented multi_reduction)
+    and cg=2 fails to compile even with the hc-chunked staging that
+    removed the diagnosed ~1-3 MB of scoped-VMEM overshoot, so the
+    remaining blocker is not the deferred-stage temporaries; the opaque
+    remote-compile channel hides the specific pass.  m=512 probes ride
+    the rank-1 kernel (measured fine: the m=256 fused configs win the
+    block-size shootout anyway, benchmarks/PHASES.md).
+
+    A 2 MB m=256 budget (cg=8) measured 75.3 -> 53.9 us/candidate on
+    isolated 512-candidate folded-batch stacks, but cg=8 INSIDE the full
+    vmapped engine program fails to compile (reproducibly, while cg=4
+    compiles) — so the probe keeps the proven 1.25 MB/cg=4 everywhere;
+    the cg=8 gain is recorded in PHASES.md as blocked upside."""
+    return _W_BUDGET_FUSED
+
+
+def _fused_hc(m: int) -> int:
+    """Column-chunk count for the fused kernel's deferred stages (kept
+    at 1 for the tuned production sizes; the hc>1 staging is
+    interpret- and TPU-validated at m=128 and ready if a larger-m
+    fused config becomes compilable)."""
+    return 2 if m >= 512 else 1
 
 
 def _panel_width(m: int) -> int | None:
@@ -515,6 +574,16 @@ def _panel_width(m: int) -> int | None:
         if m % b == 0 and m > b:
             return b
     return None
+
+
+# Max grid programs per pallas launch.  Measured on v5e: the fused
+# m=256 kernel compiles at grid 64 and gets an opaque remote-compile
+# failure at grid 128 (the m=128 kernel survives 128) — consistent with
+# a compiler blowup on long sequential grid loops, not VMEM.  Oversized
+# stacks are split into multiple launches of <= cg*_MAX_GRID candidates;
+# all production single-solve probes fit one launch, so this only
+# engages for big folded batches (custom_vmap rule below).
+_MAX_GRID = 64
 
 
 def _run_probe_kernel(blocks, kernel, m: int, interpret: bool,
@@ -544,24 +613,59 @@ def _run_probe_kernel(blocks, kernel, m: int, interpret: bool,
             f"pallas probe: cg={cg} with m={m} hits a known-failing Mosaic "
             "compile path; increase _W_BUDGET or use the XLA fallback"
         )
-    grid = (Nr_pad // cg,)
+    def launch(chunk):
+        return pl.pallas_call(
+            kernel,
+            grid=(chunk.shape[0] // cg,),
+            in_specs=[
+                pl.BlockSpec((cg, m, m), lambda i: (i, 0, 0),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((cg, m, m), lambda i: (i, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct(chunk.shape, jnp.float32),
+            scratch_shapes=[
+                pltpu.VMEM((cg, m, width_factor * m), jnp.float32)],
+            interpret=interpret,
+        )(chunk)
 
-    inv = pl.pallas_call(
-        kernel,
-        grid=grid,
-        in_specs=[
-            pl.BlockSpec((cg, m, m), lambda i: (i, 0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((cg, m, m), lambda i: (i, 0, 0),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((Nr_pad, m, m), jnp.float32),
-        scratch_shapes=[pltpu.VMEM((cg, m, width_factor * m), jnp.float32)],
-        interpret=interpret,
-    )(blocks)
+    per = cg * _MAX_GRID
+    if Nr_pad <= per:
+        inv = launch(blocks)
+    else:
+        # One launch body compiled ONCE and scanned over equal chunks
+        # (multiple distinct fused-kernel custom calls in one program is
+        # a measured-failing compile region; a lax.map body is a single
+        # call).  Pad the stack to a chunk multiple with identity blocks.
+        k = -(-Nr_pad // per)
+        if k * per != Nr_pad:
+            eyes = jnp.broadcast_to(jnp.eye(m, dtype=jnp.float32),
+                                    (k * per - Nr_pad, m, m))
+            blocks = jnp.concatenate([blocks, eyes], axis=0)
+        inv = lax.map(launch, blocks.reshape(k, per, m, m))
+        inv = inv.reshape(k * per, m, m)
     inv = inv[:Nr]
     sing = ~jnp.isfinite(inv).all(axis=(1, 2))
     return inv, sing
+
+
+def _dispatch_probe(blocks, eps, interpret):
+    """The unbatched (single leading stack dim) kernel dispatch."""
+    Nr, m, _ = blocks.shape
+    blocks = blocks.astype(jnp.float32)
+    b = _panel_width(m)
+    # m % 128: the transposed panel state puts matrix rows on the lane
+    # dim; Mosaic's layout inference rejects the St/vscat dots' shape
+    # casts for sub-native lane extents (measured: m=64 fails with
+    # "unsupported shape cast", m=128/256 compile).
+    if (b is not None and m % 128 == 0
+            and 2 * m * m * 4 <= _fused_budget(m)):
+        kernel = functools.partial(_gj_fused_panel_kernel, m=m, b=b,
+                                   eps=eps, hc=_fused_hc(m))
+        return _run_probe_kernel(blocks, kernel, m, interpret,
+                                 _fused_budget(m), width_factor=1)
+    kernel = functools.partial(_gj_probe_kernel, m=m, eps=eps)
+    return _run_probe_kernel(blocks, kernel, m, interpret)
 
 
 @functools.partial(jax.jit, static_argnames=("eps", "interpret"))
@@ -580,23 +684,31 @@ def pallas_batched_block_inverse(
     full 4096 m=256 inversion — but fails to compile at m=512 where only
     cg=1 fits); else the augmented rank-1 kernel.  See benchmarks/PHASES.md
     "probe kernel shootout".
+
+    BATCHING (the root cause of the round-3 "B=64 n=1024 m=256 fails to
+    compile" edge): pallas_call's default vmap rule prepends a grid
+    dimension, and the fused kernel does not survive Mosaic under the
+    multi-dim grid (the rank-1 kernel does).  Every candidate is
+    independent, so a batch IS just a longer stack — the custom_vmap rule
+    below folds any vmapped leading axes into the stack axis and calls
+    the same single-grid-dim kernel, which both compiles everywhere the
+    unbatched kernel does and amortizes launches better.
     """
-    Nr, m, _ = blocks.shape
     if eps is None:
         eps = eps_for(jnp.float32)
-    blocks = blocks.astype(jnp.float32)
-    b = _panel_width(m)
-    # m % 128: the transposed panel state puts matrix rows on the lane
-    # dim; Mosaic's layout inference rejects the St/vscat dots' shape
-    # casts for sub-native lane extents (measured: m=64 fails with
-    # "unsupported shape cast", m=128/256 compile).
-    if (b is not None and m % 128 == 0
-            and 2 * m * m * 4 <= _W_BUDGET_FUSED):
-        kernel = functools.partial(_gj_fused_panel_kernel, m=m, b=b, eps=eps)
-        return _run_probe_kernel(blocks, kernel, m, interpret,
-                                 _W_BUDGET_FUSED, width_factor=1)
-    kernel = functools.partial(_gj_probe_kernel, m=m, eps=eps)
-    return _run_probe_kernel(blocks, kernel, m, interpret)
+
+    @jax.custom_batching.custom_vmap
+    def core(bl):
+        return _dispatch_probe(bl, eps, interpret)
+
+    @core.def_vmap
+    def _fold_rule(axis_size, in_batched, bl):  # noqa: ANN001
+        inv, sing = pallas_batched_block_inverse(
+            bl.reshape((-1,) + bl.shape[-2:]), eps, interpret)
+        return ((inv.reshape(bl.shape), sing.reshape(bl.shape[:-2])),
+                (True, True))
+
+    return core(blocks)
 
 
 @functools.partial(jax.jit, static_argnames=("eps", "interpret"))
@@ -651,9 +763,10 @@ def pallas_batched_block_inverse_fused(
     b = _panel_width(m)
     if b is None:
         raise ValueError(f"no panel width divides m={m}")
-    kernel = functools.partial(_gj_fused_panel_kernel, m=m, b=b, eps=eps)
+    kernel = functools.partial(_gj_fused_panel_kernel, m=m, b=b, eps=eps,
+                               hc=_fused_hc(m))
     return _run_probe_kernel(blocks, kernel, m, interpret,
-                             _W_BUDGET_FUSED, width_factor=1)
+                             _fused_budget(m), width_factor=1)
 
 
 @functools.partial(jax.jit, static_argnames=("eps", "interpret"))

@@ -355,6 +355,36 @@ def gather_inverse_inplace_2d(out: jnp.ndarray, lay: CyclicLayout2D, n: int):
     return unpad(blocks.reshape(lay.N, lay.N), n)
 
 
+def inverse_corner_2d(blocks: jnp.ndarray, lay: CyclicLayout2D, n: int,
+                      max_p: int = 10):
+    """Top-left min(n, max_p) corner of the inverse from its 2D-cyclic
+    blocks — WITHOUT a global gather (the ``gather=False`` verbose print,
+    main.cpp:459-461).
+
+    Global row block ``i`` sits at storage slot ``(i % pr)·bpr + i // pr``
+    and global column block ``j`` at chunk ``(j % pc)·(Nr // pc) + j // pc``
+    (worker-major cyclic order on both axes, layout.py); only the
+    ceil(corner/m)² owning blocks move — O(corner·m²·…) bytes bounded by
+    the corner itself, so O(n²/(pr·pc)) per-worker memory holds.
+    """
+    from .layout import global_block_owner, global_to_local_block
+
+    c = min(n, max_p)
+    nb = -(-c // lay.m)
+    bc = lay.Nr // lay.pc
+    rows = []
+    for i in range(nb):
+        rpos = (global_block_owner(i, lay.pr) * lay.bpr
+                + global_to_local_block(i, lay.pr))
+        rows.append(jnp.concatenate([
+            blocks[rpos, :, cpos * lay.m:(cpos + 1) * lay.m]
+            for j in range(nb)
+            for cpos in (global_block_owner(j, lay.pc) * bc
+                         + global_to_local_block(j, lay.pc),)
+        ], axis=1))
+    return jnp.concatenate(rows, axis=0)[:c, :c]
+
+
 def compile_sharded_jordan_inplace_2d(
     W: jnp.ndarray,
     mesh: Mesh,

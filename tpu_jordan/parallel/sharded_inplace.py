@@ -229,8 +229,6 @@ def _sharded_jordan_inplace_fori(W, mesh, lay: CyclicLayout, eps, precision,
     compile cost independent of Nr — this is what removes the
     ``MAX_UNROLL_NR`` ceiling from the 2N³ path (n=16384 at m=128 is
     Nr=128; 32768²/65536² distributed are Nr >= 64 at every useful m)."""
-    m, N, bpw = lay.m, lay.N, lay.blocks_per_worker
-
     def worker(Wloc):
         def body(t, carry):
             Wl, sing, swaps = carry
@@ -332,6 +330,30 @@ def gather_inverse_inplace(out: jnp.ndarray, lay: CyclicLayout, n: int):
 
     out = jnp.take(out, cyclic_scatter_perm(lay), axis=0)
     return unpad(out.reshape(lay.N, lay.N), n)
+
+
+def inverse_corner_1d(blocks: jnp.ndarray, lay: CyclicLayout, n: int,
+                      max_p: int = 10):
+    """Top-left min(n, max_p) corner of the inverse from its cyclic row
+    blocks — WITHOUT a global gather (the ``gather=False`` verbose print,
+    main.cpp:459-461: the reference always shows the corner even though
+    the full inverse stays distributed).
+
+    Global block row ``r`` sits at storage slot ``(r % p)·bpw + r // p``
+    (worker-major cyclic order, layout.py); only the first
+    ceil(corner/m) blocks' leading columns move — O(corner·m) bytes, so
+    the O(n²/p) per-worker memory contract holds at any scale.
+    """
+    from .layout import global_block_owner, global_to_local_block
+
+    c = min(n, max_p)
+    nb = -(-c // lay.m)
+    parts = [
+        blocks[global_block_owner(r, lay.p) * lay.blocks_per_worker
+               + global_to_local_block(r, lay.p), :, :c]
+        for r in range(nb)
+    ]
+    return jnp.concatenate(parts, axis=0)[:c]
 
 
 @upcast_sub_fp32
