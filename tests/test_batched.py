@@ -37,6 +37,32 @@ class TestBatchedInvert:
             np.asarray(inv[0]), np.linalg.inv(good), rtol=1e-8, atol=1e-8
         )
 
+    def test_large_batch_routes_through_fori_engine(self, rng, monkeypatch):
+        # Large B x many probe shapes is a measured-failing compile
+        # region for the unrolled engine on TPU (PHASES.md "compile
+        # lottery"); the dispatch must route big batches through the
+        # fori engine (one probe shape), and results must agree.
+        import tpu_jordan.ops.batched as batched_mod
+        import tpu_jordan.ops.jordan_inplace as ji
+
+        calls = []
+        orig = ji.block_jordan_invert_inplace_fori
+
+        def spy(x, **kw):
+            calls.append(x.shape)
+            return orig(x, **kw)
+
+        monkeypatch.setattr(ji, "block_jordan_invert_inplace_fori", spy)
+        # Nr = 48/8 = 6 > 4 and B*Nr = 132 >= 128 -> fori route.
+        a = rng.standard_normal((22, 48, 48))
+        inv, sing = batched_mod.batched_jordan_invert(
+            jnp.asarray(a), block_size=8)
+        assert calls, "fori engine was not selected for the large batch"
+        assert not np.asarray(sing).any()
+        np.testing.assert_allclose(
+            np.asarray(inv), np.linalg.inv(a), rtol=1e-6, atol=1e-6
+        )
+
     def test_inplace_engine_selected_and_agrees(self, rng, monkeypatch):
         # Nr <= MAX_UNROLL_NR must route through the vmapped in-place
         # engine (the 2x-flops win applies to batches too); its results

@@ -43,10 +43,26 @@ def batched_jordan_invert(
     batch_shape = a.shape[:-2]
     n = a.shape[-1]
     flat = a.reshape((-1,) + a.shape[-2:])
+    B = flat.shape[0]
 
     m = min(n, block_size if block_size is not None
             else default_block_size(n))
-    engine = single_device_invert(n, m)
+    Nr = -(-n // m)
+    # Engine choice: the unrolled engine's shrinking-window probe emits
+    # Nr DISTINCT pallas shapes; at large B x many-shapes the program
+    # lands in a measured-failing compile region (B=64 at Nr=8 fails,
+    # B=8 at Nr=8 and B=512 at Nr=2 compile — benchmarks/PHASES.md
+    # "compile lottery").  The fori engine reuses ONE probe shape for
+    # every step, so big batches route through it: it compiles
+    # everywhere and measured 3.2 TF/s at 64x2048^2 m=256 where the
+    # unrolled engine cannot compile at all.  Small batches keep the
+    # unrolled engine's cheaper shrinking-window probes.
+    if Nr > 4 and B * Nr >= 128:
+        from .jordan_inplace import block_jordan_invert_inplace_fori
+
+        engine = block_jordan_invert_inplace_fori
+    else:
+        engine = single_device_invert(n, m)
 
     def one(x):
         return engine(

@@ -377,10 +377,13 @@ def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps, hc=1):
     Bookkeeping: live column j holds (T·A)[:, j]; eliminated column k
     holds T[:, r_k] (both evolve under the same uniform update, so the
     deferred W += U·(R·W) covers them together); the panel's own freed
-    columns are rebuilt from the Vp chain (Vp[:, j] starts as
-    e_{r_j} + v_j and composes forward) and scattered back with a one-hot
-    MXU dot.  Final: A⁻¹ = D⁻¹·M·W·M with M[k, :] = onehot(r_k) and
-    D = diag(piv_k).
+    columns are T[:, r_j] = e_{r_j} + U[:, j] directly — column r_j of R
+    is e_j (pivot rows are used once), so T = I + U·R gives the freed
+    column from U and R with NO separate forward-composed chain (the
+    round-3 kernel carried a redundant Vp recurrence for these — two
+    extra (cg, b, m) passes per micro-step; validated rounding-level
+    equal, interpret mode) — scattered back with a one-hot MXU dot.
+    Final: A⁻¹ = D⁻¹·M·W·M with M[k, :] = onehot(r_k), D = diag(piv_k).
     """
     cg = blocks_ref.shape[0]
     f32 = jnp.float32
@@ -391,7 +394,7 @@ def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps, hc=1):
     thresh = eps * norms
 
     w_ref[...] = a
-    # Panel state is kept TRANSPOSED — St/Ut/Vpt/R are (cg, b, m) with
+    # Panel state is kept TRANSPOSED — St/Ut/R are (cg, b, m) with
     # matrix rows on the LANE dim — so the micro-loop can be a real
     # lax.fori_loop: column j of the panel is a dynamic slice on the
     # sublane dim (legal in Mosaic; dynamic LANE indexing is not), pivot
@@ -418,7 +421,7 @@ def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps, hc=1):
         ), (1, 0, 2))                                     # (cg, b, m)
 
         def micro(j, mc):
-            St, Ut, Vpt, R, used, perm, sing, pivs = mc
+            St, Ut, R, used, perm, sing, pivs = mc
             # Column j of the panel = sublane j of St, via masked reduce
             # (Mosaic lowers no dynamic_slice on values; the pass is only
             # (cg, b, m) — b/m-th of a full-width pass).
@@ -448,16 +451,12 @@ def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps, hc=1):
             St = St + s_r[:, :, None] * v3
             u_r = jnp.sum(jnp.where(is_rl, Ut, 0.0), axis=2)
             Ut = jnp.where(is_j, Ut + v3, Ut + u_r[:, :, None] * v3)
-            vp_r = jnp.sum(jnp.where(is_rl, Vpt, 0.0), axis=2)
-            newrow = jnp.where(is_r, 1.0, v)[:, None, :]  # e_r + v
-            Vpt = jnp.where(is_j, newrow,
-                            Vpt + vp_r[:, :, None] * v3)
             R = jnp.where(is_j & is_rl, 1.0, R)
-            return St, Ut, Vpt, R, used, perm, sing, pivs
+            return St, Ut, R, used, perm, sing, pivs
 
         z = jnp.zeros((cg, b, m), f32)
-        _, Ut, Vpt, R, used, perm, sing, pivs = lax.fori_loop(
-            0, b, micro, (St, z, z, z, used, perm, sing, pivs))
+        _, Ut, R, used, perm, sing, pivs = lax.fori_loop(
+            0, b, micro, (St, z, z, used, perm, sing, pivs))
 
         # Deferred full-width update W += U·(R·W) (R = RAW pivot-row
         # selectors); panel slots are rebuilt from Vp instead.  All dots
@@ -480,10 +479,11 @@ def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps, hc=1):
                 preferred_element_type=f32, precision=lax.Precision.HIGHEST,
             )                                             # (cg, m, m/hc)
             w_ref[:, :, sl] = w_ref[:, :, sl] + upd       # panel slots: garbage
+        Vp = Ut + R                       # T[:, r_j] = e_{r_j} + U[:, j]
         for c in range(hc):
             sl = slice(c * (m // hc), (c + 1) * (m // hc))
             vscat = jax.lax.dot_general(
-                Vpt, C[sl, :], dimension_numbers=(((1,), (1,)), ((), ())),
+                Vp, C[sl, :], dimension_numbers=(((1,), (1,)), ((), ())),
                 preferred_element_type=f32, precision=lax.Precision.HIGHEST,
             )                                             # (cg, m, m/hc)
             lane_c = lane_m[:, :, sl]
