@@ -6,12 +6,15 @@ matrix per program run; here the whole blocked Gauss-Jordan algorithm
 vmaps over a leading batch axis, so the MXU sees batch-stacked matmuls
 and the pivot probes of every problem in the batch run together.
 
-Engine selection mirrors ``driver.single_device_invert``: the in-place
-2N³ path (ops/jordan_inplace.py) whenever its unrolled trace is
-affordable — its swap bookkeeping is traced values, so it vmaps like any
-other jax code (vmap turns the per-step ``dynamic_slice`` row swaps into
-batched gathers, and the pallas probe's batching rule folds the batch
-axis into the kernel grid) — else the augmented fori_loop path.
+Engine selection is the in-place 2N³ path always, in one of two forms:
+small batches mirror ``driver.single_device_invert`` (the unrolled
+trace with static shrinking probe windows — its swap bookkeeping is
+traced values, so it vmaps like any other jax code, and the probe's
+custom_vmap rule folds the batch axis into the candidate stack); large
+batches (Nr > 4 and B·Nr >= 128) route through the fori in-place
+engine even though the unrolled trace would be affordable, because its
+single probe shape is what compiles reliably at batch scale
+(benchmarks/PHASES.md "compile lottery").
 """
 
 from __future__ import annotations
