@@ -12,12 +12,15 @@ analogs main.cpp:1074 (custom pivot all-reduce), 1097 (pivot-row bcast),
     * H psum:        (m, m)        over p
     * row_piv psum:  (m, N)        over p
     * row_t psum:    (m, N)        over p
-  2D (parallel/jordan2d_inplace.py::_step2d):
+  2D (parallel/jordan2d_inplace.py::_step2d, round-4 column-parallel
+  probe):
     * 3 scalar pmin/psum over the whole mesh          — latency only
     * H psum:        (m, m)        over pr*pc
     * row_piv psum:  (m, N/pc)     along pr
     * row_t psum:    (m, N/pc)     along pr
-    * E psum:        (N/pr, m)     along pc
+    * chunk/E psum:  (N/pr, m)     along pc   (pre-swap broadcast; serves
+                                               candidates AND multipliers)
+    * swap fix-up:   (m, m)        along pc
     plus the 2D unscramble (after the loop): 2 x (N/pr, m) along pc per
     step.
 
@@ -35,8 +38,8 @@ eliminate + 35 ms probe + ~8 ms glue = 78.7 ms):
     write;
   * probe: c_probe * live_candidates * m^3 elementwise-pass cost —
     c_probe calibrated to the same 35 ms (1D probes (Nr-t)/p candidates
-    per worker; 2D probes (Nr-t)/pr on the owner mesh column only, so pc
-    buys no probe time);
+    per worker; 2D probes (Nr-t)/(pr*pc) since the round-4
+    column-parallel probe splits candidates across mesh columns);
   * glue (swaps, normalize, row writes): 0.5 HBM shard passes.
 
 Chip constants: measured for v5e; v4/v5p matmul envelopes scaled from
@@ -93,15 +96,20 @@ def predict(n: int, m: int, pr: int, pc: int, chip: Chip,
         rmw = 2.0 * (N / pr) * (N / pc) * 4
         elim += max(fl / chip.mxu_f32, rmw / chip.hbm)
         glue += 0.5 * rmw / chip.hbm
-        # probe: live candidates on the probing workers.
-        live = max(1, (Nr - t) // pr)
+        # probe: live candidates on the probing workers.  The round-4
+        # column-parallel probe broadcasts the t-chunk panel along "pc"
+        # (the SAME panel the eliminate needed anyway — bytes unchanged)
+        # and splits candidates across mesh columns, so 2D probe work
+        # divides by pr*pc, not pr.
+        live = max(1, (Nr - t) // P)
         probe += c_probe * live * m**3
         # collectives.
         comm += 3 * LATENCY                      # scalar pivot reduction
         comm += _allreduce(4 * m * m, P, chip)   # H
         comm += 2 * _allreduce(4 * m * (N / pc), pr, chip)  # row_piv, row_t
         if pc > 1:
-            comm += _allreduce(4 * (N / pr) * m, pc, chip)  # E panel
+            comm += _allreduce(4 * (N / pr) * m, pc, chip)  # chunk/E panel
+            comm += _allreduce(4 * m * m, pc, chip)  # swap fix-up (m, m)
             comm += 2 * _allreduce(4 * (N / pr) * m, pc, chip)  # unscramble
     total = elim + probe + comm + glue
     out = {"elim": elim, "probe": probe, "comm": comm, "glue": glue,

@@ -93,3 +93,36 @@ class TestSharded2DInplace:
 
         be = _Dist2D((2, 4), 1024, 8)   # Nr=128 > 64
         assert be.inplace
+
+
+class TestColumnParallelProbe:
+    """The round-4 column-parallel probe: every mesh column probes the
+    slot slice ``s0+kc, s0+kc+pc, ...`` of the broadcast t-chunk panel.
+    These pin the slice-coverage invariant the engines rely on."""
+
+    @pytest.mark.parametrize("bpr,pc", [(8, 4), (8, 3), (5, 2), (7, 4),
+                                        (1, 4), (16, 8)])
+    def test_column_slices_partition_live_window(self, bpr, pc):
+        # Union over kc of {s0+kc+u*pc : u < wnd} ∩ [0, bpr) must cover
+        # [s0, bpr) exactly once, for every live-window start s0 — each
+        # candidate probed by exactly one device.
+        for s0 in range(bpr):
+            wnd = -(-(bpr - s0) // pc)
+            seen = []
+            for kc in range(pc):
+                idx = [s0 + kc + u * pc for u in range(wnd)]
+                seen += [i for i in idx if i < bpr]
+            assert sorted(seen) == list(range(s0, bpr)), (s0, pc, seen)
+
+    def test_fori_half_cut_condition_is_safe(self):
+        # The fori engines probe only the upper half of each column's
+        # slice once t >= (wnd//2)*pc*pr: every slot in the lower half
+        # must then be dead (global row < t) on every device.
+        for bpr, pr, pc in ((8, 2, 4), (8, 4, 2), (6, 2, 2), (16, 2, 8)):
+            wnd = -(-bpr // pc)
+            t = (wnd // 2) * pc * pr    # the earliest t the cut fires at
+            for kc in range(pc):
+                for kr in range(pr):
+                    for u in range(wnd // 2):
+                        g = (kc + u * pc) * pr + kr
+                        assert g < t or wnd // 2 == 0, (bpr, pr, pc, kc, u)

