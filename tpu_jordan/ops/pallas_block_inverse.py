@@ -91,8 +91,8 @@ def _gj_probe_kernel(blocks_ref, inv_ref, w_ref, *, m, eps):
 
     def step(k, carry):
         # Carries are 2D 32-bit (Mosaic cannot legalize bool/1D loop state):
-        # used: (cg, m) f32 0/1; perm: (cg, m) i32; sing: (cg, 1) i32.
-        used, perm, sing = carry
+        # used: (cg, m) f32 0/1; perm: (cg, m) i32; pivs: (cg, m) f32.
+        used, perm, pivs = carry
         w = w_ref[...]
         col = jnp.sum(jnp.where(lane_ids == k, w, 0.0), axis=2)  # (cg, m)
         cand = jnp.where(used > 0, -1.0, jnp.abs(col))
@@ -106,14 +106,12 @@ def _gj_probe_kernel(blocks_ref, inv_ref, w_ref, *, m, eps):
         used = jnp.where(is_r, 1.0, used)
         perm = jnp.where(row_ids == k, r.astype(jnp.int32), perm)
         piv = jnp.sum(jnp.where(is_r, col, 0.0), axis=1, keepdims=True)  # (cg, 1)
-        # f32 0/1 flag arithmetic only, carried lane-wide as (cg, m):
-        # Mosaic crashes on (cg, 1) values that stay live across the loop.
-        bad = jnp.maximum(
-            jnp.where(jnp.abs(piv) < thresh, 1.0, 0.0),
-            jnp.where(norms < eps, 1.0, 0.0),
-        )
-        sing = jnp.maximum(sing, bad)                     # (cg, m) via broadcast
+        # RAW pivot recorded; the |piv| < thresh singularity test runs
+        # once after the loop (same values, 4 fewer ops on the serial
+        # op-latency-bound critical path — see _gj_fused_panel_kernel).
         safe_piv = jnp.where(piv == 0.0, 1.0, piv)
+        pivs = jnp.where(row_ids == k,
+                         piv * jnp.ones((cg, m), f32), pivs)
         # Extract pivot rows (cg, 2m) by masked reduction, normalize.
         prow = jnp.sum(jnp.where(is_r3, w, 0.0), axis=1)
         prow = (prow / safe_piv)[:, None, :]              # (cg, 1, 2m)
@@ -121,12 +119,14 @@ def _gj_probe_kernel(blocks_ref, inv_ref, w_ref, *, m, eps):
         # single read+write pass).
         factors = jnp.where(is_r, 0.0, col)[:, :, None]
         w_ref[...] = jnp.where(is_r3, prow, w - factors * prow)
-        return used, perm, sing
+        return used, perm, pivs
 
     used0 = jnp.zeros((cg, m), jnp.float32)
     perm0 = jnp.zeros((cg, m), jnp.int32)
-    sing0 = jnp.zeros((cg, m), jnp.float32)
-    _, perm, sing = lax.fori_loop(0, m, step, (used0, perm0, sing0))
+    pivs0 = jnp.ones((cg, m), jnp.float32)
+    _, perm, pivs = lax.fori_loop(0, m, step, (used0, perm0, pivs0))
+    badlane = ((jnp.abs(pivs) < thresh) | (norms < eps)).astype(f32)
+    sing = jnp.max(badlane, axis=1, keepdims=True) * jnp.ones((cg, m), f32)
 
     # Unscramble: inverse row k = eliminated row perm[k].  One-hot matmul
     # on the MXU instead of per-row gathers.
@@ -410,7 +410,7 @@ def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps, hc=1):
     bdims = (((2,), (1,)), ((0,), (0,)))                  # (cg,x,k)·(cg,k,y)
 
     def panel(K, carry):
-        used, perm, sing, pivs = carry                    # (cg, m) each
+        used, perm, pivs = carry                          # (cg, m) each
         k0 = K * b
         C = jnp.where(sel_rows == k0 + sel_cols, 1.0, 0.0).astype(f32)
         # St[j, i] = W[i, k0+j]: one-hot dot (j, cg, i) then a batch-dim
@@ -421,7 +421,7 @@ def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps, hc=1):
         ), (1, 0, 2))                                     # (cg, b, m)
 
         def micro(j, mc):
-            St, Ut, R, used, perm, sing, pivs = mc
+            St, Ut, R, used, perm, pivs = mc
             # Column j of the panel = sublane j of St, via masked reduce
             # (Mosaic lowers no dynamic_slice on values; the pass is only
             # (cg, b, m) — b/m-th of a full-width pass).
@@ -436,14 +436,16 @@ def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps, hc=1):
             kk = k0 + j
             perm = jnp.where(row_ids == kk, r.astype(jnp.int32), perm)
             piv = jnp.sum(jnp.where(is_r, col, 0.0), axis=1, keepdims=True)
-            bad = jnp.maximum(
-                jnp.where(jnp.abs(piv) < thresh, 1.0, 0.0),
-                jnp.where(norms < eps, 1.0, 0.0),
-            )
-            sing = jnp.maximum(sing, bad)
+            # Singularity is NOT judged here: the RAW pivot is recorded
+            # and the |piv| < thresh test runs ONCE after the loop — the
+            # stored values are identical to the at-selection-time ones,
+            # and dropping the 4 flag ops from the serial micro-step
+            # cuts its op-latency-bound critical path (measured: the
+            # whole kernel is ~140 ns/vector-op with shape size nearly
+            # irrelevant, so op count IS the probe's cost model).
             safe_piv = jnp.where(piv == 0.0, 1.0, piv)
             pivs = jnp.where(row_ids == kk,
-                             safe_piv * jnp.ones((cg, m), f32), pivs)
+                             piv * jnp.ones((cg, m), f32), pivs)
             v = jnp.where(is_r, 0.0, -col / safe_piv)     # (cg, m)
             v3 = v[:, None, :]                            # (cg, 1, m)
             is_j = rb_ids == j                            # (cg, b, m)
@@ -452,11 +454,11 @@ def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps, hc=1):
             u_r = jnp.sum(jnp.where(is_rl, Ut, 0.0), axis=2)
             Ut = jnp.where(is_j, Ut + v3, Ut + u_r[:, :, None] * v3)
             R = jnp.where(is_j & is_rl, 1.0, R)
-            return St, Ut, R, used, perm, sing, pivs
+            return St, Ut, R, used, perm, pivs
 
         z = jnp.zeros((cg, b, m), f32)
-        _, Ut, R, used, perm, sing, pivs = lax.fori_loop(
-            0, b, micro, (St, z, z, used, perm, sing, pivs))
+        _, Ut, R, used, perm, pivs = lax.fori_loop(
+            0, b, micro, (St, z, z, used, perm, pivs))
 
         # Deferred full-width update W += U·(R·W) (R = RAW pivot-row
         # selectors); panel slots are rebuilt from Vp instead.  All dots
@@ -489,14 +491,21 @@ def _gj_fused_panel_kernel(blocks_ref, inv_ref, w_ref, *, m, b, eps, hc=1):
             lane_c = lane_m[:, :, sl]
             in_panel = (lane_c >= k0) & (lane_c < k0 + b)
             w_ref[:, :, sl] = jnp.where(in_panel, vscat, w_ref[:, :, sl])
-        return used, perm, sing, pivs
+        return used, perm, pivs
 
     used0 = jnp.zeros((cg, m), jnp.float32)
     perm0 = jnp.zeros((cg, m), jnp.int32)
-    sing0 = jnp.zeros((cg, m), jnp.float32)
     pivs0 = jnp.ones((cg, m), jnp.float32)
-    _, perm, sing, pivs = lax.fori_loop(0, m // b, panel,
-                                        (used0, perm0, sing0, pivs0))
+    _, perm, pivs = lax.fori_loop(0, m // b, panel,
+                                  (used0, perm0, pivs0))
+
+    # Deferred singularity judgement (see micro): a candidate is singular
+    # iff any recorded raw pivot fell below the relative threshold, or
+    # the block norm itself is sub-eps; reduced once and broadcast
+    # lane-wide ((cg, 1) is only hazardous as LOOP state).
+    badlane = ((jnp.abs(pivs) < thresh) | (norms < eps)).astype(f32)
+    sing = jnp.max(badlane, axis=1, keepdims=True) * jnp.ones((cg, m), f32)
+    pivs = jnp.where(pivs == 0.0, jnp.float32(1.0), pivs)  # safe final divide
 
     # Reconstruction + poison: A⁻¹ = D⁻¹·M·W·M (staged via the scratch
     # ref so at most two (cg, m, m) temporaries are live at once).

@@ -187,8 +187,12 @@ def _step2d_fori(t, Wloc, singular, swaps, *, lay: CyclicLayout2D, eps,
                  precision, use_pallas: bool):
     """One super-step with a TRACED ``t`` — the fori_loop body behind
     ``_sharded_jordan2d_inplace_fori``.  Same arithmetic and pivot
-    choices as ``_step2d``; the probe covers the full slot window with
-    dead slots masked (plus the half-window cut once t >= (bpr//2)*pr),
+    choices as ``_step2d``; the column-parallel probe covers this
+    column's full slot slice (length wnd = ceil(bpr/pc)) with dead slots
+    masked, plus the half-window cut once ``t >= (wnd//2)*pc*pr`` (the
+    earliest t at which every slot the lower half of ANY column's slice
+    maps to is dead — pinned by
+    tests/test_jordan2d_inplace.py::test_fori_half_cut_condition_is_safe),
     and all chunk offsets go through ``lax.dynamic_slice``."""
     pr, pc, m, bpr = lay.pr, lay.pc, lay.m, lay.bpr
     kr = lax.axis_index(AXIS_R)
