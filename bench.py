@@ -51,17 +51,23 @@ class _Singular(AssertionError):
     pass
 
 
-def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0):
+def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
+             group=0):
     """Returns (gflops, acc) with acc = {rel_residual, kappa,
     predicted_bound[, rel_residual_refine1]}.
 
     ``max_rel=None`` gates at 3× the predicted eps·n·κ∞ bound instead of
     a static tolerance.  ``refine=1`` also reports the residual after one
     Newton–Schulz step (not timed — an accuracy diagnostic, not a perf
-    row).
+    row).  ``group=k`` uses the delayed-group-update engine (the
+    measured winner for well-conditioned fixtures at m=128 once the
+    probe's launch cost dropped — benchmarks/PHASES.md round 4).
     """
+    from functools import partial
+
     from tpu_jordan.ops import (
         block_jordan_invert_inplace,
+        block_jordan_invert_inplace_grouped,
         condition_inf,
         generate,
         inf_norm,
@@ -74,15 +80,17 @@ def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0):
 
     import jax.numpy as jnp
 
+    engine = (partial(block_jordan_invert_inplace_grouped, group=group)
+              if group else block_jordan_invert_inplace)
     a = generate(generator, (n, n), jnp.float32)
     # Invert ONCE before the timing campaign: the knife-edge fallback
     # (_Singular) must fire from this cheap call, not after r2 timed
     # repetitions of a result that would be discarded.
-    inv, sing = block_jordan_invert_inplace(a, block_size=m)
+    inv, sing = engine(a, block_size=m)
     if bool(sing):
         raise _Singular(f"benchmark matrix flagged singular (n={n} m={m})")
     per_call = slope_time(
-        lambda v: block_jordan_invert_inplace(v, block_size=m)[0],
+        lambda v: engine(v, block_size=m)[0],
         (a,), r1=r1, r2=r2,
     )
 
@@ -152,11 +160,24 @@ def main():
     # n=16384 (PHASES.md), so this row uses the deterministic
     # well-conditioned 'rand' fixture and gates at 3x the predicted
     # eps·n·κ∞ bound (VERDICT r3 #3) rather than a loose static rel.
+    # Primary config: the delayed-group-update engine at m=128/k=2 —
+    # measured 396 ms = 22.2 TF/s (72% of the matmul envelope) AND the
+    # better residual (3.0e-3 vs 1.4e-2); falls back to the plain
+    # engine at m=256 if anything about the grouped run fails (its
+    # Nr=128 unrolled trace is the priciest compile in the suite).
     try:
-        gf_16384, acc_16384 = _measure(16384, 256, r1=2, r2=5,
-                                       generator="rand", max_rel=None,
-                                       refine=1)
-        extra["invert_16384_f32_m256_rand_gflops"] = round(gf_16384, 1)
+        try:
+            cfg = "m128_grouped2"
+            gf_16384, acc_16384 = _measure(16384, 128, r1=2, r2=5,
+                                           generator="rand", max_rel=None,
+                                           refine=1, group=2)
+        except Exception as ge:                 # noqa: BLE001
+            extra["invert_16384_grouped_error"] = str(ge)[:200]
+            cfg = "m256"
+            gf_16384, acc_16384 = _measure(16384, 256, r1=2, r2=5,
+                                           generator="rand", max_rel=None,
+                                           refine=1)
+        extra[f"invert_16384_f32_{cfg}_rand_gflops"] = round(gf_16384, 1)
         extra["vs_baseline_16384"] = round(gf_16384 / baseline_gflops, 1)
         for k, v in acc_16384.items():
             extra[f"{k}_16384"] = v
