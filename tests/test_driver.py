@@ -139,6 +139,18 @@ class TestCLI:
 
         assert main(["32", "8", "--no-gather", "--quiet"]) == 1
 
+    def test_sleep_flag_prints_pid_and_delays(self, capsys):
+        # The reference's -DSLEEP attach-a-debugger hook (main.cpp:8,70-72).
+        import os
+        import time
+
+        from tpu_jordan.__main__ import main
+
+        t0 = time.perf_counter()
+        assert main(["16", "8", "--sleep", "1", "--quiet"]) == 0
+        assert time.perf_counter() - t0 >= 1.0
+        assert f"pid {os.getpid()} sleeping 1s" in capsys.readouterr().out
+
     def test_no_gather_distributed_exit_0(self):
         from tpu_jordan.__main__ import main
 

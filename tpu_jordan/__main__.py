@@ -57,6 +57,12 @@ def main(argv=None) -> int:
                     help="call jax.distributed.initialize for multi-host "
                          "TPU slices before any device use (the analog of "
                          "MPI_Init, main.cpp:69; no-op on a single host)")
+    ap.add_argument("--sleep", type=int, default=0, metavar="SECONDS",
+                    help="sleep after printing the pid, before any device "
+                         "work — attach-a-debugger window (the reference's "
+                         "-DSLEEP startup hook, main.cpp:8,70-72; useful "
+                         "for multi-host runs where each process must be "
+                         "attached separately)")
     ap.add_argument("--gather", default=True,
                     action=argparse.BooleanOptionalAction,
                     help="--no-gather keeps the inverse as sharded cyclic "
@@ -77,6 +83,8 @@ def main(argv=None) -> int:
         w = args.workers
         if (w <= 0 if isinstance(w, int) else w[0] <= 0 or w[1] <= 0):
             raise ValueError("workers must be positive")
+        if args.sleep < 0:
+            raise ValueError("--sleep must be non-negative")
     except SystemExit as e:
         if e.code == 0:      # --help / --version are not usage errors
             return 0
@@ -95,6 +103,14 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+    if args.sleep:
+        # The reference's -DSLEEP hook (main.cpp:8,70-72): pause at launch
+        # so a debugger can attach to each process before any real work.
+        import time
+
+        print(f"pid {os.getpid()} sleeping {args.sleep}s", flush=True)
+        time.sleep(args.sleep)
 
     if args.distributed:
         # Must run before the first backend use so every host process joins
