@@ -26,7 +26,7 @@ import jax.numpy as jnp
 
 from .config import default_block_size
 from .io import read_matrix_file
-from .ops import generate, residual_inf_norm
+from .ops import condition_inf, generate, inf_norm, residual_inf_norm
 
 
 from jax import lax as _lax
@@ -55,6 +55,20 @@ class SolveResult:
     gflops: float           # 2n³ / t, the convention used in BASELINE.md
     inverse_blocks: jax.Array | None = None  # sharded cyclic blocks (gather=False)
     layout: object | None = None             # CyclicLayout of inverse_blocks
+    kappa: float | None = None  # κ∞(A) = ‖A‖∞‖A⁻¹‖∞ (ops/norms.condition_inf):
+    #   no reference analog — the accuracy context the residual needs
+    #   (expected rel residual ≈ eps·n·κ∞/‖A‖∞, benchmarks/PHASES.md)
+
+    @property
+    def rel_residual(self) -> float | None:
+        """‖A·X−I‖∞ / ‖A‖∞ — reported on the paths that already hold a
+        FULL A and X (single-device solves, and distributed solves with
+        refinement); None elsewhere — the other distributed branches
+        verify via block-sharded state without materializing the row
+        sums of both full matrices."""
+        return None if self._norm_a is None else self.residual / self._norm_a
+
+    _norm_a: float | None = None             # ‖A‖∞, backing rel_residual
 
 
 def solve(
@@ -160,8 +174,11 @@ def solve(
     # _solve_distributed_core, so this is always the single-device residual).
     a_fresh = load()
     residual = float(residual_inf_norm(a_fresh, inv))
+    norm_a = float(inf_norm(a_fresh))
+    kappa = float(condition_inf(a_fresh, inv))
     if verbose:
         print(f"residual: {residual:e}")
+        print(f"kappa_inf: {kappa:e}")
 
     return SolveResult(
         inverse=inv,
@@ -170,6 +187,8 @@ def solve(
         n=n,
         block_size=block_size,
         gflops=2.0 * n**3 / elapsed / 1e9,
+        kappa=kappa,
+        _norm_a=norm_a,
     )
 
 
@@ -542,6 +561,11 @@ def _solve_distributed_core(
         inv_b = None if inv_b is None else inv_b.astype(in_dtype)
     # Verification source is always *fresh* (re-read / regenerated), never
     # algorithm state — the reference's reload semantics (main.cpp:463-488).
+    # κ∞ is reported ONLY where a full A and full X are both already in
+    # hand — here, the refine branch.  The non-refine branches (gathered
+    # or not) verify via block-sharded state and leave it None rather
+    # than materializing a second full matrix's row sums.
+    kappa = norm_a = None
     if refine:
         a_full = load() if file is not None else generate(
             generator, (n, n), dtype
@@ -552,7 +576,11 @@ def _solve_distributed_core(
         # non-refine branch): the reported number must include the final
         # rounding error of what the caller actually receives.
         inv = inv.astype(in_dtype)
-        residual = float(residual_inf_norm(a_full, inv.astype(dtype)))
+        inv_f = inv.astype(dtype)
+        residual = float(residual_inf_norm(a_full, inv_f))
+        norm_a = float(inf_norm(a_full))
+        kappa = float(condition_inf(a_full, inv_f))
+        del inv_f
     else:
         a_b = (be.stream_a_blocks(file, dtype, storage)
                if file is not None
@@ -569,6 +597,8 @@ def _solve_distributed_core(
         # blocks alone, never a global gather.
         print_corner(inv if inv is not None else be.corner(inv_b, n))
         print(f"residual: {residual:e}")
+        if kappa is not None:
+            print(f"kappa_inf: {kappa:e}")
     return SolveResult(
         inverse=inv,
         elapsed=elapsed,
@@ -578,4 +608,6 @@ def _solve_distributed_core(
         gflops=2.0 * n**3 / elapsed / 1e9,
         inverse_blocks=None if gather else inv_b,
         layout=None if gather else be.lay,
+        kappa=kappa,
+        _norm_a=norm_a,
     )
