@@ -11,16 +11,18 @@ O(N·2N / (pr·pc)).
 Communication per super-step t (cf. the reference's
 allreduce + bcast + P2P, SURVEY.md §3.2):
 
-  pivot probe        local batched inverse on the mesh column owning
-                     block column t (others mask to inf)
+  pivot probe        COLUMN-PARALLEL (round 4): the t-panel broadcast
+                     along "pc" doubles as the eliminate's E, and every
+                     mesh column probes the 1/pc slice of live slots
   pivot reduction    composite-key `lax.pmin` over BOTH axes
                      (replaces MPI_Op_create/PivotMin, main.cpp:1000-1074)
   pivot-row bcast    one-hot `lax.psum` along "pr" — each mesh column
                      broadcasts its own slice of the row (main.cpp:1097)
   row swap           one-hot psum of row t along "pr" + masked local write
                      (swap-by-copy, main.cpp:1100-1131)
-  multiplier bcast   one-hot `lax.psum` of the column-t panel along "pc"
-                     (no 1D analog: columns were replicated there)
+  multiplier fix-up  one (m, m) psum along "pc" (the t-panel broadcast
+                     above doubles as the eliminate's E; the fix-up
+                     patches the swapped slot — no second panel psum)
   eliminate          one local (bpr·m, m) x (m, Wc) MXU matmul
 
 Local storage on worker (kr, kc): ``(bpr, m, Wc)`` — row blocks cyclic on
@@ -42,7 +44,10 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..config import eps_for
-from ..ops.block_inverse import probe_blocks as _probe
+from ..ops.block_inverse import (
+    probe_blocks as _probe,
+    probe_blocks_half_masked,
+)
 from ..ops.norms import block_inf_norms
 from .layout import CyclicLayout2D
 from .mesh import AXIS_C, AXIS_R
@@ -62,56 +67,46 @@ def _local_step2d(t, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
     dtype = Wloc.dtype
     gr = jnp.arange(bpr) * pr + kr          # global block row of each slot
 
-    # --- PIVOT PROBE on the mesh column owning global column block t ONLY:
-    # the other pc-1 columns take the cheap cond branch straight to the
-    # reduction with all-singular (inf-key) dummies instead of inverting
-    # candidates they would throw away.
+    # --- CHUNK BROADCAST along "pc" (pre-swap): the t-column panel is
+    # what the eliminate needs as E anyway, so broadcasting it BEFORE
+    # the probe adds no collective bytes — and lets every mesh column
+    # probe the 1/pc slice of slots ``kc, kc+pc, ...`` instead of pc−1
+    # columns idling through a cond skip (the round-4 column-parallel
+    # probe, same design as jordan2d_inplace._step2d).
     own_c = kc == (t % pc)
     u_t = t // pc
-    cands = lax.dynamic_slice(Wloc, (0, 0, u_t * m), (bpr, m, m))
+    chunk = lax.dynamic_slice(Wloc, (0, 0, u_t * m), (bpr, m, m))
+    chunk_all = lax.psum(
+        jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
+
     probe_dtype = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
-    cands = cands.astype(probe_dtype)
+    wnd = -(-bpr // pc)                         # static slice length
+    idx = kc + jnp.arange(wnd) * pc             # local slots probed here
+    cands = jnp.take(chunk_all, jnp.clip(idx, 0, bpr - 1),
+                     axis=0).astype(probe_dtype)
+    gidx = idx * pr + kr                        # global block rows probed
 
-    def _skip(c):
-        return (jnp.zeros_like(c),
-                lax.pcast(jnp.ones((bpr,), jnp.bool_), BOTH, to='varying'))
+    # Half-window cut via the shared traced-t helper (safety condition
+    # pinned by test_jordan2d_inplace.py::test_fori_half_cut_condition_is_safe).
+    invs, sing = probe_blocks_half_masked(
+        cands, t >= (wnd // 2) * pc * pr, eps, use_pallas)
 
-    half = bpr // 2
-    if half:
-        # Row-window cut (the 2D analog of the 1D half-window): once the
-        # lower half's global rows are all < t, probe only the upper
-        # half.  Composes with the owner-column cond below.
-        def _upper(c):
-            invs_u, sing_u = _probe(c[half:], eps, use_pallas)
-            eye = jnp.broadcast_to(
-                jnp.eye(m, dtype=c.dtype), (half, m, m))
-            return (jnp.concatenate([eye, invs_u]),
-                    jnp.concatenate([jnp.ones((half,), bool), sing_u]))
-
-        def _live(c):
-            return lax.cond(t >= half * pr, _upper,
-                            lambda cc: _probe(cc, eps, use_pallas), c)
-    else:
-        def _live(c):
-            return _probe(c, eps, use_pallas)
-
-    invs, sing = lax.cond(own_c, _live, _skip, cands)
     inv_norms = block_inf_norms(invs)
-    valid = own_c & (gr >= t) & ~sing
+    valid = (idx < bpr) & (gidx >= t) & ~sing
     big = jnp.asarray(jnp.inf, probe_dtype)
     key = jnp.where(valid, inv_norms.astype(probe_dtype), big)
     slot_best = jnp.argmin(key)
     my_key = key[slot_best]
-    g_cand = gr[slot_best]
+    g_cand = gidx[slot_best]
 
     # --- PIVOT REDUCTION over the whole mesh; ties to lowest global row
     # (same rule as the 1D and single-device paths).
     kmin = lax.pmin(my_key, BOTH)
     win_g = lax.pmin(
-        jnp.where(own_c & (my_key == kmin), g_cand, lay.Nr), BOTH
+        jnp.where(my_key == kmin, g_cand, lay.Nr), BOTH
     )
     singular = singular | ~jnp.isfinite(kmin)   # all-singular agreement
-    i_won = own_c & (my_key == kmin) & (g_cand == win_g)
+    i_won = (my_key == kmin) & (g_cand == win_g)
     g_piv = lax.psum(jnp.where(i_won, g_cand, 0), BOTH)
     H = lax.psum(
         jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0), BOTH
@@ -142,10 +137,19 @@ def _local_step2d(t, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
     # --- NORMALIZE: one (m, m) x (m, Wc) matmul per worker.
     prow = jnp.matmul(H, row_piv, precision=precision)
 
-    # --- MULTIPLIER BROADCAST along "pc": the column-t panel (post-swap)
-    # reaches every mesh column.
-    E = lax.dynamic_slice(Wloc, (0, 0, u_t * m), (bpr, m, m))
-    E = lax.psum(jnp.where(own_c, E, jnp.asarray(0, dtype)), AXIS_C)
+    # --- MULTIPLIERS from the pre-swap broadcast + swap fix-up (see
+    # jordan2d_inplace._step2d): the slot that received old row t in the
+    # swap gets row_t's t-chunk via one extra (m, m) psum; the slot now
+    # holding global row t is zeroed (its multiplier is the prow write).
+    row_t_chunk = lax.psum(
+        jnp.where(own_c,
+                  lax.dynamic_slice(row_t, (0, u_t * m), (m, m)), 0.0),
+        AXIS_C,
+    ).astype(dtype)                             # (m, m)
+    cur_Epiv = lax.dynamic_index_in_dim(chunk_all, slot_piv, 0, False)
+    E = lax.dynamic_update_index_in_dim(
+        chunk_all, jnp.where(own_piv, row_t_chunk, cur_Epiv), slot_piv, 0
+    )
     E = jnp.where((gr == t)[:, None, None], jnp.asarray(0, dtype), E)
 
     # --- ELIMINATE: one local MXU matmul over the whole shard.
