@@ -103,7 +103,12 @@ def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
     # fixtures and sizes is 0.1–0.4, so the 3× dynamic gate is ~10–30×
     # tighter than it sounds and fails a genuinely wrong inverse.
     predicted = float(np.finfo(np.float32).eps) * n * kappa / norm_a
-    gate = 3.0 * predicted if max_rel is None else max_rel
+    # The dynamic gate is capped at 0.5: at n=16384 the worst-case
+    # eps·n·κ bound is ~2.5 — trivially satisfiable on its own — and a
+    # rel residual >= 0.5 means ‖I−AX‖ ≈ ‖I‖, i.e. no inverse at all,
+    # whatever κ claims.  The NS contraction check remains the airtight
+    # gate; this ceiling keeps (a) non-vacuous even when refine=0.
+    gate = min(3.0 * predicted, 0.5) if max_rel is None else max_rel
     assert rel_res < gate, (
         f"benchmark inverse inaccurate: rel_residual={rel_res} exceeds "
         f"gate={gate:.3e} (predicted eps*n*kappa={predicted:.3e}, "

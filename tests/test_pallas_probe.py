@@ -185,6 +185,49 @@ def test_probe_pivot_ordering_matches(rng):
     assert np.argmin(norms_p) == np.argmin(norms_x)
 
 
+def test_max_grid_launch_split_matches_single_launch(monkeypatch, rng):
+    # Oversized stacks are split into a lax.map over <= cg*_MAX_GRID
+    # candidate chunks (ADVICE r4: the split path had no regression
+    # test).  Shrinking BOTH the budget (cg=8 per chunk) and _MAX_GRID
+    # (1 chunk per launch) forces a genuine 3-launch split on a 24-stack;
+    # the result must be bitwise identical to the unsplit launch.
+    m = 32
+    blocks = jnp.asarray(rng.standard_normal((24, m, m)), jnp.float32)
+    inv_one, sing_one = pallas_batched_block_inverse(blocks, interpret=True)
+    try:
+        monkeypatch.setattr(pbi, "_W_BUDGET", 8 * m * 2 * m * 4)  # cg=8
+        monkeypatch.setattr(pbi, "_MAX_GRID", 1)
+        jax.clear_caches()
+        # The split must actually engage: per-launch capacity < stack.
+        assert pbi._chunk_candidates(24, m) * pbi._MAX_GRID < 24
+        inv_split, sing_split = pallas_batched_block_inverse(
+            blocks, interpret=True)
+        np.testing.assert_array_equal(np.asarray(sing_one),
+                                      np.asarray(sing_split))
+        np.testing.assert_array_equal(np.asarray(inv_one),
+                                      np.asarray(inv_split))
+    finally:
+        # Executables traced with the patched constants must not leak
+        # into later same-signature calls.
+        jax.clear_caches()
+
+
+def test_fused_kernel_hc2_matches_reference(monkeypatch, rng):
+    # The hc>1 chunked deferred-stage path of the fused kernel only
+    # engages at m >= 512 in production (_fused_hc), where the fused
+    # kernel doesn't currently compile — so nothing exercised it (ADVICE
+    # r4).  Force hc=2 at m=128 and pin parity with the XLA reference.
+    try:
+        monkeypatch.setattr(pbi, "_fused_hc", lambda m: 2)
+        jax.clear_caches()
+        blocks = rng.standard_normal((4, 128, 128))
+        blocks[2, 3] = blocks[2, 11]     # one singular block mid-stack
+        sing = _check_parity(blocks, kernel="fused")
+        assert list(sing) == [False, False, True, False]
+    finally:
+        jax.clear_caches()
+
+
 def test_dispatch_policy(monkeypatch):
     # Pin WHICH kernel each block size dispatches to, so a future budget
     # or gate change is deliberate: fused needs a panel width, m % 128

@@ -712,6 +712,12 @@ def pallas_batched_block_inverse(
 
     @core.def_vmap
     def _fold_rule(axis_size, in_batched, bl):  # noqa: ANN001
+        # With a single operand the rule is only invoked when that
+        # operand is batched (a closed-over constant never reaches the
+        # custom_vmap primitive); the assert documents the fold's
+        # assumption so a future second operand can't silently fold a
+        # non-batch axis.
+        assert in_batched == [True], in_batched
         inv, sing = pallas_batched_block_inverse(
             bl.reshape((-1,) + bl.shape[-2:]), eps, interpret)
         return ((inv.reshape(bl.shape), sing.reshape(bl.shape[:-2])),
