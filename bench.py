@@ -51,8 +51,27 @@ class _Singular(AssertionError):
     pass
 
 
+_RETRYABLE = ("INTERNAL", "remote_compile", "read body", "DEADLINE")
+
+
+def _retry_transient(fn):
+    """One retry on the documented-transient remote-compile failure class
+    (benchmarks/PHASES.md: same program passes minutes later; the round-4
+    headline capture was lost to exactly one such failure — VERDICT r4
+    weak #1).  Anything else — including the knife-edge _Singular — is
+    a real result and propagates immediately."""
+    try:
+        return fn()
+    except _Singular:
+        raise
+    except Exception as e:                      # noqa: BLE001
+        if any(s in str(e) for s in _RETRYABLE):
+            return fn()
+        raise
+
+
 def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
-             group=0):
+             group=0, fori=False):
     """Returns (gflops, acc) with acc = {rel_residual, kappa,
     predicted_bound[, rel_residual_refine1]}.
 
@@ -61,13 +80,17 @@ def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
     Newton–Schulz step (not timed — an accuracy diagnostic, not a perf
     row).  ``group=k`` uses the delayed-group-update engine (the
     measured winner for well-conditioned fixtures at m=128 once the
-    probe's launch cost dropped — benchmarks/PHASES.md round 4).
+    probe's launch cost dropped — benchmarks/PHASES.md round 4);
+    ``fori=True`` takes its fori_loop twin (bit-identical inner
+    arithmetic, compile cost flat in Nr — seconds instead of 88 s at
+    Nr=128, shrinking the transient-failure exposure window).
     """
     from functools import partial
 
     from tpu_jordan.ops import (
         block_jordan_invert_inplace,
         block_jordan_invert_inplace_grouped,
+        block_jordan_invert_inplace_grouped_fori,
         condition_inf,
         generate,
         inf_norm,
@@ -80,8 +103,12 @@ def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
 
     import jax.numpy as jnp
 
-    engine = (partial(block_jordan_invert_inplace_grouped, group=group)
-              if group else block_jordan_invert_inplace)
+    if group:
+        grouped = (block_jordan_invert_inplace_grouped_fori if fori
+                   else block_jordan_invert_inplace_grouped)
+        engine = partial(grouped, group=group)
+    else:
+        engine = block_jordan_invert_inplace
     a = generate(generator, (n, n), jnp.float32)
     # Invert ONCE before the timing campaign: the knife-edge fallback
     # (_Singular) must fire from this cheap call, not after r2 timed
@@ -144,14 +171,17 @@ def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
 def main():
     baseline_gflops = 6.8  # BASELINE.md: reference fp64, m=48, 1 CPU core
 
-    gf_4096, acc_4096 = _measure(4096, 128, r1=8, r2=24)
+    gf_4096, acc_4096 = _retry_transient(
+        lambda: _measure(4096, 128, r1=8, r2=24))
     # 8192 row: m=256 (round-4 tuned), m=384 knife-edge fallback.
     m_8192 = 256
     try:
-        gf_8192, acc_8192 = _measure(8192, m_8192, r1=3, r2=9)
+        gf_8192, acc_8192 = _retry_transient(
+            lambda: _measure(8192, m_8192, r1=3, r2=9))
     except _Singular:
         m_8192 = 384
-        gf_8192, acc_8192 = _measure(8192, m_8192, r1=3, r2=9)
+        gf_8192, acc_8192 = _retry_transient(
+            lambda: _measure(8192, m_8192, r1=3, r2=9))
     extra = {
         f"invert_8192x8192_f32_m{m_8192}_gflops": round(gf_8192, 1),
         "vs_baseline_8192": round(gf_8192 / baseline_gflops, 1),
@@ -167,27 +197,29 @@ def main():
     # eps·n·κ∞ bound (VERDICT r3 #3) rather than a loose static rel.
     # Primary config: the delayed-group-update engine at m=128/k=2 —
     # measured 396 ms = 22.2 TF/s (72% of the matmul envelope) AND the
-    # better residual (3.0e-3 vs 1.4e-2); falls back to the plain
-    # engine at m=256 if anything about the grouped run fails (its
-    # Nr=128 unrolled trace is the priciest compile in the suite).
-    try:
+    # better residual (3.0e-3 vs 1.4e-2).  Capture ladder (VERDICT r4
+    # weak #1: the best engine must be the number of record): each tier
+    # retries once on the transient remote-compile failure class; tier 2
+    # is the grouped-fori twin whose seconds-flat compile shrinks the
+    # flake window ~40x; tier 3 is the plain engine at m=256.
+    tiers = [
+        ("m128_grouped2", 128, dict(group=2)),
+        ("m128_grouped2_fori", 128, dict(group=2, fori=True)),
+        ("m256_plain", 256, dict()),
+    ]
+    for cfg, mm, kw in tiers:
         try:
-            cfg = "m128_grouped2"
-            gf_16384, acc_16384 = _measure(16384, 128, r1=2, r2=5,
-                                           generator="rand", max_rel=None,
-                                           refine=1, group=2)
+            gf_16384, acc_16384 = _retry_transient(
+                lambda: _measure(16384, mm, r1=2, r2=5, generator="rand",
+                                 max_rel=None, refine=1, **kw))
         except Exception as ge:                 # noqa: BLE001
-            extra["invert_16384_grouped_error"] = str(ge)[:200]
-            cfg = "m256"
-            gf_16384, acc_16384 = _measure(16384, 256, r1=2, r2=5,
-                                           generator="rand", max_rel=None,
-                                           refine=1)
+            extra[f"invert_16384_{cfg}_error"] = str(ge)[:200]
+            continue
         extra[f"invert_16384_f32_{cfg}_rand_gflops"] = round(gf_16384, 1)
         extra["vs_baseline_16384"] = round(gf_16384 / baseline_gflops, 1)
         for k, v in acc_16384.items():
             extra[f"{k}_16384"] = v
-    except Exception as e:                      # noqa: BLE001
-        extra["invert_16384_error"] = str(e)[:200]
+        break
 
     print(json.dumps({
         "metric": "invert_4096x4096_f32_gflops",

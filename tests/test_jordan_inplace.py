@@ -11,6 +11,7 @@ from tpu_jordan.ops.jordan_inplace import (
     block_jordan_invert_inplace,
     block_jordan_invert_inplace_fori,
     block_jordan_invert_inplace_grouped,
+    block_jordan_invert_inplace_grouped_fori,
 )
 
 
@@ -156,6 +157,68 @@ class TestInplaceForiEngine:
         _, sing = block_jordan_invert_inplace_grouped(
             jnp.ones((32, 32), jnp.float64), block_size=8, group=4)
         assert bool(sing)
+
+    @pytest.mark.parametrize("n,m,k", [(64, 16, 2), (128, 16, 4),
+                                       (96, 16, 4),   # tail group (Nr=6, k=4)
+                                       (160, 16, 4),  # tail group (Nr=10)
+                                       (50, 8, 4),    # ragged n + tail
+                                       (128, 16, 8)])
+    def test_grouped_fori_bitmatches_grouped(self, rng, n, m, k):
+        # The fori grouped engine runs the same per-step arithmetic as
+        # the unrolled grouped engine (the probe's masked full window
+        # computes each candidate independently), so results bit-match.
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float64)
+        x_u, s_u = block_jordan_invert_inplace_grouped(a, block_size=m,
+                                                       group=k)
+        x_f, s_f = block_jordan_invert_inplace_grouped_fori(a, block_size=m,
+                                                            group=k)
+        assert bool(s_u) == bool(s_f) is False
+        assert bool(jnp.all(x_u == x_f)), "grouped fori diverged bitwise"
+
+    @pytest.mark.parametrize("gen", ["absdiff", "rand"])
+    def test_grouped_fori_generators(self, gen):
+        # absdiff: zero diagonal — pivoting + cross-group swaps required.
+        a = generate(gen, (128, 128), jnp.float64)
+        x_u, s_u = block_jordan_invert_inplace_grouped(a, block_size=16,
+                                                       group=4)
+        x_f, s_f = block_jordan_invert_inplace_grouped_fori(
+            a, block_size=16, group=4)
+        assert bool(s_u) == bool(s_f) is False
+        assert bool(jnp.all(x_u == x_f))
+
+    def test_grouped_fori_beyond_unroll_cap(self, rng):
+        # Nr = 68 > MAX_UNROLL_NR: the configuration whose unrolled
+        # grouped trace is unaffordable (88 s at Nr=128 on TPU) — the
+        # gap this engine closes (VERDICT r4 #2).
+        from tpu_jordan.parallel.sharded_inplace import MAX_UNROLL_NR
+
+        n, m = 544, 8
+        assert -(-n // m) > MAX_UNROLL_NR
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float64)
+        inv, sing = block_jordan_invert_inplace_grouped_fori(
+            a, block_size=m, group=4)
+        assert not bool(sing)
+        res = np.max(np.abs(np.asarray(a) @ np.asarray(inv) - np.eye(n)))
+        assert res < 1e-7
+
+    def test_grouped_fori_singular_flag(self):
+        _, sing = block_jordan_invert_inplace_grouped_fori(
+            jnp.ones((32, 32), jnp.float64), block_size=8, group=4)
+        assert bool(sing)
+
+    def test_grouped_fori_bitmatches_grouped_on_singular_input(self):
+        # All-singular probe windows: the masked argmin must fall back to
+        # the unrolled engine's benign self-swap (piv=t), keeping the
+        # engines bit-identical even where the output is invalid.
+        a = jnp.ones((32, 32), jnp.float64)
+        x_u, s_u = block_jordan_invert_inplace_grouped(a, block_size=8,
+                                                       group=4)
+        x_f, s_f = block_jordan_invert_inplace_grouped_fori(a, block_size=8,
+                                                            group=4)
+        assert bool(s_u) and bool(s_f)
+        nz = jnp.isfinite(x_u) & jnp.isfinite(x_f)
+        assert bool(jnp.all(jnp.where(nz, x_u == x_f, True)))
+        assert bool(jnp.all(jnp.isfinite(x_u) == jnp.isfinite(x_f)))
 
     def test_driver_routes_large_nr_through_fori(self):
         # single_device_invert must hand Nr > MAX_UNROLL_NR to the 2N³

@@ -6,6 +6,7 @@ from .jordan_inplace import (
     block_jordan_invert_inplace,
     block_jordan_invert_inplace_fori,
     block_jordan_invert_inplace_grouped,
+    block_jordan_invert_inplace_grouped_fori,
 )
 from .norms import block_inf_norms, condition_inf, inf_norm
 from .padding import pad_with_identity, unpad
@@ -23,6 +24,7 @@ __all__ = [
     "block_jordan_invert_inplace",
     "block_jordan_invert_inplace_fori",
     "block_jordan_invert_inplace_grouped",
+    "block_jordan_invert_inplace_grouped_fori",
     "gauss_jordan_inverse",
     "generate",
     "hilbert",
