@@ -81,21 +81,48 @@ def _allreduce(S: float, a: int, chip: Chip) -> float:
 
 
 def predict(n: int, m: int, pr: int, pc: int, chip: Chip,
-            measured_single: float | None = None):
+            measured_single: float | None = None, group: int = 1):
     """Returns dict of phase seconds + efficiency for an (pr, pc) mesh
-    (pc=1 -> the 1D row-cyclic engine)."""
+    (pc=1 -> the 1D row-cyclic engine).
+
+    ``group=k > 1`` models the delayed-group-update engines
+    (parallel/sharded_inplace.py::_gstep, jordan2d_inplace.py::_gstep2d):
+      * the trailing shard rewrite happens ONCE per group (HBM
+        read-modify-write divided by k; matmul flops unchanged but the
+        contraction dim is k·m — modeled at the same envelope,
+        conservative: the measured single-chip win at 16384 came
+        precisely from this term);
+      * eager side updates add 2·rows·(j·m)·m flops for the probed
+        column and 2·m·(j·m)·(N/pc) for the pivot row at inner position
+        j (avg j = (k−1)/2) — the few-% tax the single-chip engine pays;
+      * the two (m, N/pc) row psums + the (m, m) swap fix-up fuse into
+        ONE stacked (2m, N/pc + k·m + m) psum along "pr": same bytes to
+        first order, ~half the per-step collective LATENCY rounds — the
+        term that dominates the v5p projections.
+    """
     Nr = -(-n // m)
     N = Nr * m
     P = pr * pc
+    k = max(1, min(group, Nr))
     c_probe = C_PROBE_V5E / chip.vpu_scale
 
     elim = probe = comm = glue = 0.0
     for t in range(Nr):
-        # eliminate: (N/pr rows) x (m) x (N/pc cols) local matmul.
+        j = t % k                                # position within group
         fl = 2.0 * (N / pr) * m * (N / pc)
         rmw = 2.0 * (N / pr) * (N / pc) * 4
-        elim += max(fl / chip.mxu_f32, rmw / chip.hbm)
-        glue += 0.5 * rmw / chip.hbm
+        if k == 1:
+            elim += max(fl / chip.mxu_f32, rmw / chip.hbm)
+            glue += 0.5 * rmw / chip.hbm
+        else:
+            # Trailing update amortized over the group; eager side
+            # updates (column + pivot row) charged per step.
+            elim += max(fl / chip.mxu_f32, rmw / k / chip.hbm)
+            eager = (2.0 * (N / pr) * (j * m) * m
+                     + 2.0 * m * (j * m) * (N / pc))
+            elim += eager / chip.mxu_f32
+            # Row/chunk-granular per-step writes instead of a shard pass.
+            glue += (0.5 * rmw / k + 3 * 4 * m * (N / pc)) / chip.hbm
         # probe: live candidates on the probing workers.  The round-4
         # column-parallel probe broadcasts the t-chunk panel along "pc"
         # (the SAME panel the eliminate needed anyway — bytes unchanged)
@@ -106,10 +133,16 @@ def predict(n: int, m: int, pr: int, pc: int, chip: Chip,
         # collectives.
         comm += 3 * LATENCY                      # scalar pivot reduction
         comm += _allreduce(4 * m * m, P, chip)   # H
-        comm += 2 * _allreduce(4 * m * (N / pc), pr, chip)  # row_piv, row_t
+        if k == 1:
+            comm += 2 * _allreduce(4 * m * (N / pc), pr, chip)  # both rows
+        else:
+            # ONE stacked psum: both rows + their U rows + the t-block.
+            comm += _allreduce(
+                4 * 2 * m * ((N / pc) + k * m + m), pr, chip)
         if pc > 1:
             comm += _allreduce(4 * (N / pr) * m, pc, chip)  # chunk/E panel
-            comm += _allreduce(4 * m * m, pc, chip)  # swap fix-up (m, m)
+            if k == 1:
+                comm += _allreduce(4 * m * m, pc, chip)  # swap fix-up
             comm += 2 * _allreduce(4 * (N / pr) * m, pc, chip)  # unscramble
     total = elim + probe + comm + glue
     out = {"elim": elim, "probe": probe, "comm": comm, "glue": glue,
@@ -123,9 +156,11 @@ def predict(n: int, m: int, pr: int, pc: int, chip: Chip,
     return out
 
 
-def _fmt(n, m, pr, pc, chip):
-    r = predict(n, m, pr, pc, chip)
+def _fmt(n, m, pr, pc, chip, group=1):
+    r = predict(n, m, pr, pc, chip, group=group)
     mesh = f"{pr}x{pc}" if pc > 1 else f"1D p={pr}"
+    if group > 1:
+        mesh += f" k={group}"
     gf = 2.0 * n**3 / r["total"] / 1e9
     return (f"| {chip.name} {mesh} | {n} | {m} | {r['elim']*1e3:8.1f} | "
             f"{r['probe']*1e3:8.1f} | {r['comm']*1e3:8.1f} | "
@@ -137,26 +172,36 @@ def main():
     print("Sanity: single-chip v5e model vs measured 78.7 ms @ 8192 m=256")
     r = predict(8192, 256, 1, 1, V5E)
     print({k: round(v * 1e3, 1) for k, v in r.items() if k != "efficiency"})
+    print("Grouped sanity: v5e single-chip 16384 m=128 k=2 "
+          "(measured 396 ms)")
+    r = predict(16384, 128, 1, 1, V5E, group=2)
+    print({k: round(v * 1e3, 1) for k, v in r.items() if k != "efficiency"})
     print()
     print("| mesh | n | m | elim ms | probe ms | comm ms | total ms "
           "| GFLOP/s | par.eff |")
     print("|---|---|---|---|---|---|---|---|---|")
     rows = [
-        # v4-8 (4 chips) and v5e-8 class, 8192.
-        (8192, 256, 8, 1, V5E),
-        (8192, 256, 2, 4, V5E),
-        (8192, 512, 4, 1, V4),
-        (8192, 512, 2, 2, V4),
+        # v4-8 (4 chips) and v5e-8 class, 8192 (plain vs grouped).
+        (8192, 256, 8, 1, V5E, 1),
+        (8192, 256, 8, 1, V5E, 4),
+        (8192, 256, 2, 4, V5E, 1),
+        (8192, 256, 2, 4, V5E, 4),
+        (8192, 512, 4, 1, V4, 1),
+        (8192, 512, 2, 2, V4, 1),
         # v5p-32, 32768 (the 2D north star; 1D shown for contrast).
-        (32768, 512, 32, 1, V5P),
-        (32768, 512, 4, 8, V5P),
-        (32768, 256, 4, 8, V5P),
+        (32768, 512, 32, 1, V5P, 1),
+        (32768, 512, 32, 1, V5P, 4),
+        (32768, 512, 4, 8, V5P, 1),
+        (32768, 512, 4, 8, V5P, 4),
+        (32768, 256, 4, 8, V5P, 4),
         # v5p-64, 65536.
-        (65536, 512, 64, 1, V5P),
-        (65536, 512, 8, 8, V5P),
+        (65536, 512, 64, 1, V5P, 1),
+        (65536, 512, 8, 8, V5P, 1),
+        (65536, 512, 8, 8, V5P, 4),
+        (65536, 256, 8, 8, V5P, 4),
     ]
-    for n, m, pr, pc, chip in rows:
-        print(_fmt(n, m, pr, pc, chip))
+    for n, m, pr, pc, chip, g in rows:
+        print(_fmt(n, m, pr, pc, chip, g))
 
 
 if __name__ == "__main__":

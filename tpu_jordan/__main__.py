@@ -48,6 +48,18 @@ def main(argv=None) -> int:
                          "well-conditioned scale fixture)")
     ap.add_argument("--refine", type=int, default=0,
                     help="Newton-Schulz refinement steps")
+    ap.add_argument("--engine", default="auto",
+                    choices=["auto", "inplace", "grouped", "augmented"],
+                    help="elimination engine: 'auto' = the conservative "
+                         "in-place 2N^3 default; 'grouped' = delayed "
+                         "group updates, the measured winner for "
+                         "well-conditioned matrices at n >= 8192 with "
+                         "m=128 (driver.resolve_engine documents the "
+                         "measured dispatch policy); 'augmented' = the "
+                         "4N^3 reference-parity path")
+    ap.add_argument("--group", type=int, default=0,
+                    help="panels per delayed-group update (implies "
+                         "--engine grouped when > 1; grouped default 2)")
     ap.add_argument("--workers", type=_workers_arg, default=1,
                     help="devices in the mesh: an integer for the 1D "
                          "row-cyclic layout (the reference's mpirun -np), "
@@ -136,6 +148,13 @@ def main(argv=None) -> int:
                 raise UsageError(
                     "--batch requires generator input on a single device "
                     "(gathered output)")
+            if args.engine != "auto" or args.group > 1:
+                # Batched grouped is a measured negative result
+                # (benchmarks/PHASES.md): vmapped eager side updates cost
+                # more than the thin-matmul penalty they remove at
+                # batch-relevant n.
+                raise UsageError("--batch uses the batched engine; "
+                                 "--engine/--group do not apply")
             result = solve_batch(
                 n=args.n,
                 block_size=args.m,
@@ -158,6 +177,8 @@ def main(argv=None) -> int:
                 verbose=not args.quiet,
                 gather=args.gather,
                 precision=args.precision,
+                engine=args.engine,
+                group=args.group,
             )
     except FileNotFoundError:
         print(f"cannot open {args.file}")

@@ -71,6 +71,50 @@ class SolveResult:
     _norm_a: float | None = None             # ‖A‖∞, backing rel_residual
 
 
+ENGINES = ("auto", "inplace", "grouped", "augmented")
+
+
+def resolve_engine(engine: str, group: int):
+    """Shared engine/group flag contract (solve, JordanSolver, CLI).
+
+    Returns the resolved ``(engine, group)`` pair: "auto" keeps the
+    conservative default (the plain in-place 2N³ engine) unless
+    ``group > 1`` explicitly opts into the delayed-group-update engine;
+    "grouped" defaults ``group`` to the measured-best k=2.
+
+    Measured dispatch guidance (benchmarks/PHASES.md round 4, v5e fp32):
+    for WELL-CONDITIONED matrices at n >= 8192, ``engine="grouped"`` with
+    block_size=128, group=2 is the fastest configuration (22.2 TF/s at
+    16384² — 72% of the chip's matmul envelope — vs 20.3 for the plain
+    engine at its best m); at n <= 4096, or on ill-conditioned inputs
+    where small pivot blocks sit under the fp32 noise floor (the |i−j|
+    fixture at n >= 8192 with m <= 256), the plain engine at the
+    default block size remains the right choice — which is why "auto"
+    does not select grouped on its own.
+    """
+    if engine not in ENGINES:
+        raise UsageError(f"unknown engine {engine!r}; choose from "
+                         f"{'/'.join(ENGINES)}")
+    if group < 0:
+        raise UsageError("group must be >= 0")
+    if group == 1:
+        # group=1 IS the plain in-place engine (one panel per "group");
+        # honoring it silently as k=2 — or running the plain engine
+        # under the grouped label — would misreport the configuration.
+        raise UsageError("group=1 is the plain in-place engine; use "
+                         "engine='inplace' (or group >= 2)")
+    if group > 1 and engine == "inplace":
+        raise UsageError("group > 1 requires engine='grouped' (or 'auto')")
+    if group > 1 and engine == "augmented":
+        raise UsageError("the augmented reference-parity engine has no "
+                         "grouped variant")
+    if engine == "grouped":
+        return "grouped", (group if group > 1 else 2)
+    if engine == "auto" and group > 1:
+        return "grouped", group
+    return engine, 0
+
+
 def solve(
     n: int,
     block_size: int | None = None,
@@ -83,6 +127,8 @@ def solve(
     verbose: bool = False,
     gather: bool = True,
     precision: str = "highest",
+    engine: str = "auto",
+    group: int = 0,
 ) -> SolveResult:
     """Invert an n x n matrix from a file or a generator and verify it.
 
@@ -106,12 +152,18 @@ def solve(
     steps — ~2.7x cheaper sweeps for well-scaled matrices; see
     benchmarks/PHASES.md for the measured accuracy ladder).
 
+    ``engine``/``group`` select the elimination engine (resolve_engine:
+    "auto" | "inplace" | "grouped" | "augmented"; the measured dispatch
+    policy lives in its docstring).  Engines differ in speed and
+    summation order only — same pivot rule, same results to rounding.
+
     Raises SingularMatrixError like the reference's -2 path
     (main.cpp:435-437); file errors propagate from read_matrix_file.
     """
     if block_size is None:
         block_size = default_block_size(n)
     prec = _PRECISIONS[precision]
+    engine, group = resolve_engine(engine, group)
 
     def load():
         if file is not None:
@@ -124,7 +176,7 @@ def solve(
 
         check_gather_flags(gather, refine, precision)
         sweep_prec, refine = resolve_precision(prec, refine)
-        be = make_distributed_backend(workers, n, block_size)
+        be = make_distributed_backend(workers, n, block_size, engine, group)
         return _solve_distributed_core(
             be, n, block_size, file, generator, dtype, refine, verbose,
             gather, load, sweep_prec,
@@ -150,7 +202,7 @@ def solve(
     # working matrix — the difference between fitting and OOM at
     # n >= 16384 (4 GB per n=32768 fp32 buffer on a 16 GB chip).
     compiled = jax.jit(
-        single_device_invert(n, block_size),
+        single_device_invert(n, block_size, engine, group),
         static_argnames=("block_size", "refine", "precision"),
         donate_argnums=(0,),
     ).lower(
@@ -256,13 +308,18 @@ def solve_batch(
     )
 
 
-def make_distributed_backend(workers, n: int, block_size: int):
+def make_distributed_backend(workers, n: int, block_size: int,
+                             engine: str = "auto", group: int = 0):
     """The distributed backend for a workers spec: int p -> 1D row-cyclic,
     tuple (pr, pc) -> 2D block-cyclic.  Shared by ``solve`` and
-    ``JordanSolver`` so layout policy can't drift between them."""
+    ``JordanSolver`` so layout policy can't drift between them.
+    ``engine``/``group`` must already be resolved (resolve_engine)."""
     m = min(block_size, n)
-    return (_Dist2D(workers, n, m) if isinstance(workers, tuple)
-            else _Dist1D(workers, n, m))
+    be = (_Dist2D(workers, n, m) if isinstance(workers, tuple)
+          else _Dist1D(workers, n, m))
+    be.inplace = engine != "augmented"
+    be.group = group
+    return be
 
 
 def check_gather_flags(gather: bool, refine: int, precision: str = "highest"):
@@ -279,20 +336,47 @@ def check_gather_flags(gather: bool, refine: int, precision: str = "highest"):
                          "gathered inverse)")
 
 
-def single_device_invert(n: int, block_size: int):
-    """The single-device inversion entry point for a given problem size:
-    the in-place 2N³ engine always — the unrolled trace (static shrinking
-    probe window) when its compile cost is reasonable, the fori_loop
-    in-place variant beyond (identical results, compile cost independent
-    of Nr).  The augmented ~4N³ ``block_jordan_invert`` remains the
-    reference-parity implementation (global_scale mode), no longer a
-    performance fallback."""
+def single_device_invert(n: int, block_size: int, engine: str = "auto",
+                         group: int = 0):
+    """The single-device inversion entry point for a given problem size
+    and (resolved) engine choice.
+
+    "auto"/"inplace": the in-place 2N³ engine — the unrolled trace
+    (static shrinking probe window) when its compile cost is reasonable,
+    the fori_loop variant beyond (identical results, compile cost
+    independent of Nr).  "grouped": the delayed-group-update engine
+    (same dispatch by Nr; the measured large-n winner — see
+    resolve_engine's docstring for the dispatch policy).  "augmented":
+    the ~4N³ reference-parity implementation (global_scale mode)."""
+
     from .ops import block_jordan_invert_inplace
-    from .ops.jordan_inplace import block_jordan_invert_inplace_fori
+    from .ops.jordan_inplace import (
+        block_jordan_invert_inplace_fori,
+        block_jordan_invert_inplace_grouped,
+        block_jordan_invert_inplace_grouped_fori,
+    )
     from .parallel.sharded_inplace import MAX_UNROLL_NR
 
     Nr = -(-n // min(block_size, n))
-    return (block_jordan_invert_inplace if Nr <= MAX_UNROLL_NR
+    unroll = Nr <= MAX_UNROLL_NR
+    if engine == "augmented":
+        from .ops import block_jordan_invert
+
+        return block_jordan_invert
+    if group > 1:
+        eng = (block_jordan_invert_inplace_grouped if unroll
+               else block_jordan_invert_inplace_grouped_fori)
+
+        def fn(a, block_size=None, refine=0,
+               precision=_lax.Precision.HIGHEST):
+            return eng(a, block_size=block_size, refine=refine,
+                       precision=precision, group=group)
+
+        # Callers .lower() the result (solve, JordanSolver) — hand them
+        # a jitted callable like the plain branches do.
+        return jax.jit(fn, static_argnames=("block_size", "refine",
+                                            "precision"))
+    return (block_jordan_invert_inplace if unroll
             else block_jordan_invert_inplace_fori)
 
 
@@ -314,6 +398,7 @@ class _Dist1D:
         self.mesh = make_mesh(workers)
         self.lay = CyclicLayout.create(n, m, workers)
         self.inplace = True
+        self.group = 0
 
     def generate_W(self, generator, dtype):
         from .parallel import sharded_generate
@@ -337,7 +422,8 @@ class _Dist1D:
             )
 
             return compile_sharded_jordan_inplace(W, self.mesh, self.lay,
-                                                  precision=precision)
+                                                  precision=precision,
+                                                  group=self.group)
         from .parallel.sharded_jordan import compile_sharded_jordan
 
         return compile_sharded_jordan(W, self.mesh, self.lay,
@@ -411,6 +497,7 @@ class _Dist2D:
         self.mesh = make_mesh_2d(pr, pc)
         self.lay = CyclicLayout2D.create(n, m, pr, pc)
         self.inplace = True
+        self.group = 0
 
     def generate_W(self, generator, dtype):
         from .parallel.jordan2d import sharded_generate_2d
@@ -434,7 +521,8 @@ class _Dist2D:
             )
 
             return compile_sharded_jordan_inplace_2d(W, self.mesh, self.lay,
-                                                     precision=precision)
+                                                     precision=precision,
+                                                     group=self.group)
         from .parallel.jordan2d import compile_sharded_jordan_2d
 
         return compile_sharded_jordan_2d(W, self.mesh, self.lay,

@@ -44,6 +44,10 @@ class JordanSolver:
       precision: "highest" | "high" | "default" | "mixed" (driver.solve).
       gather: distributed only — False returns the inverse as sharded
         cyclic blocks instead of one gathered n×n array.
+      engine/group: elimination engine selection (driver.resolve_engine:
+        "auto" | "inplace" | "grouped" | "augmented"; its docstring
+        carries the measured dispatch policy — grouped m=128 k=2 wins
+        for well-conditioned matrices at n >= 8192).
     """
 
     n: int
@@ -53,14 +57,18 @@ class JordanSolver:
     workers: Any = 1
     precision: str = "highest"
     gather: bool = True
+    engine: str = "auto"
+    group: int = 0
     _run: Any = field(default=None, repr=False)
     _be: Any = field(default=None, repr=False)
 
     def __post_init__(self):
+        from ..driver import resolve_engine
         from ..ops.refine import PRECISIONS, resolve_precision
 
         if self.block_size is None:
             self.block_size = default_block_size(self.n)
+        self.engine, self.group = resolve_engine(self.engine, self.group)
         if self._distributed:
             # Shared with driver.solve (flag contract + layout policy
             # can't drift): validate flags BEFORE resolve_precision bumps
@@ -69,7 +77,8 @@ class JordanSolver:
 
             check_gather_flags(self.gather, self.refine, self.precision)
             self._be = make_distributed_backend(
-                self.workers, self.n, self.block_size)
+                self.workers, self.n, self.block_size, self.engine,
+                self.group)
         elif not self.gather:
             from ..driver import UsageError
 
@@ -95,7 +104,9 @@ class JordanSolver:
         else:
             from ..driver import single_device_invert
 
-            self._run = single_device_invert(self.n, self.block_size).lower(
+            self._run = single_device_invert(
+                self.n, self.block_size, self.engine, self.group,
+            ).lower(
                 sample, block_size=self.block_size, refine=self.refine,
                 precision=self._sweep_prec,
             ).compile()

@@ -322,6 +322,280 @@ def _unscramble_step_fori(t, piv, Wloc, *, lay: CyclicLayout2D):
     )
 
 
+def _gstep2d(t, j: int, Wloc, Uloc, Ploc, singular, *, lay: CyclicLayout2D,
+             eps, precision, use_pallas: bool):
+    """One inner step of a delayed-group-update group on one worker's
+    (bpr, m, Wc) 2D shard — the 2D port of sharded_inplace.py::_gstep
+    (reference hot loop main.cpp:1136-1194).
+
+    ``t`` may be a Python int (unrolled: static probe window) or a
+    traced int32 (fori: masked window + half cut); ``j`` is static.
+
+    Grouped state on the 2D layout: ``Uloc`` (bpr, m, kg·m) pending
+    panel multipliers, row-sharded along "pr" and REPLICATED along "pc"
+    (it is exactly the E-panel the plain step already broadcasts along
+    "pc" every step — the grouped engine keeps it for the whole group);
+    ``Ploc`` (kg·m, Wc) finalized pivot rows, column-sharded like W and
+    replicated along "pr".  The group-end trailing update is therefore
+    one LOCAL (bpr·m, kg·m) x (kg·m, Wc) matmul — zero communication.
+
+    Collective accounting vs the plain ``_step2d``: the two (m, Wc) row
+    psums along "pr" and the (m, m) swap fix-up fuse into ONE stacked
+    (2m, Wc + kg·m + m) psum (carrying both rows, their U rows, and the
+    eager chunk's t-block); the chunk psum along "pc" and the pivot
+    reduction stay as-is.
+    """
+    pr, pc, m, bpr = lay.pr, lay.pc, lay.m, lay.bpr
+    static_t = isinstance(t, int)
+    kr = lax.axis_index(AXIS_R)
+    kc = lax.axis_index(AXIS_C)
+    dtype = Wloc.dtype
+    Wc = Wloc.shape[-1]
+    Uw = Uloc.shape[-1]
+    z = jnp.int32(0)
+    tt = jnp.asarray(t, jnp.int32)
+    u_t = tt // pc                              # owner column's local chunk
+    own_c = kc == (tt % pc)
+
+    # --- EAGER CHUNK (owner column) + BROADCAST along "pc": W's t-chunk
+    # minus pending panels, on all rows (Jordan updates finalized rows
+    # too, so U's column j needs every row's eager value).
+    chunk = lax.dynamic_slice(Wloc, (z, z, u_t * m), (bpr, m, m))
+    if j:
+        Ptc = lax.dynamic_slice(Ploc, (z, u_t * m), (j * m, m))
+        chunk = chunk - jnp.matmul(
+            Uloc[:, :, :j * m].reshape(bpr * m, j * m), Ptc,
+            precision=precision).reshape(bpr, m, m)
+    chunk_all = lax.psum(
+        jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
+
+    # --- COLUMN-PARALLEL PROBE (round-4 design): this column's slice of
+    # the live window (main.cpp:1039).
+    if static_t:
+        s0 = t // pr
+        wnd = -(-(bpr - s0) // pc)
+        idx = s0 + kc + jnp.arange(wnd) * pc
+        cands = jnp.take(chunk_all, jnp.clip(idx, 0, bpr - 1), axis=0)
+        invs, sing = probe_blocks(cands, eps, use_pallas)
+    else:
+        from ..ops.block_inverse import probe_blocks_half_masked
+
+        wnd = -(-bpr // pc)
+        idx = kc + jnp.arange(wnd) * pc
+        cands = jnp.take(chunk_all, jnp.clip(idx, 0, bpr - 1), axis=0)
+        invs, sing = probe_blocks_half_masked(
+            cands, tt >= (wnd // 2) * pc * pr, eps, use_pallas)
+    gidx = idx * pr + kr
+    valid = (idx < bpr) & (gidx >= tt) & ~sing
+    norms = block_inf_norms(invs)
+    key = jnp.where(valid, norms, jnp.asarray(jnp.inf, norms.dtype))
+    slot_best = jnp.argmin(key)
+    my_key = key[slot_best]
+    g_cand = gidx[slot_best]
+
+    # --- PIVOT REDUCTION over the whole mesh + the all-singular pin
+    # (H := 0, g_piv := t — both flavors stay bit-equal on singular
+    # inputs; the flags make the output invalid anyway).
+    kmin = lax.pmin(my_key, BOTH)
+    finite = jnp.isfinite(kmin)
+    win_g = lax.pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), BOTH)
+    singular = singular | ~finite
+    i_won = (my_key == kmin) & (g_cand == win_g) & finite
+    g_piv = lax.psum(jnp.where(i_won, g_cand, 0), BOTH)
+    g_piv = jnp.where(finite, g_piv, tt.astype(g_piv.dtype))
+    H = lax.psum(
+        jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0), BOTH
+    ).astype(dtype)
+
+    # --- STACKED ROW BROADCAST along "pr": one (2m, Wc + Uw + m) psum
+    # carrying [pivot stale row | its U row | 0] and [row t | its U row
+    # | eager chunk t-block] (main.cpp:1097 / 1122-1129, fused).  The
+    # rows are COLUMN-SHARDED, so each mesh column's row-owner (kr ==
+    # row % pr) contributes its own column slice and the psum runs along
+    # "pr" only; U rows and the chunk t-block are replicated along "pc",
+    # so the same masking delivers them to every column without double
+    # counting.
+    own_piv_r = kr == (g_piv % pr)
+    slot_piv = jnp.where(own_piv_r, g_piv // pr, 0)
+    own_t_r = kr == (tt % pr)
+    slot_t = tt // pr
+    row1 = jnp.concatenate([
+        lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False),
+        lax.dynamic_index_in_dim(Uloc, slot_piv, 0, False),
+        jnp.zeros((m, m), dtype),
+    ], axis=1)
+    row2 = jnp.concatenate([
+        lax.dynamic_index_in_dim(Wloc, slot_t, 0, False),
+        lax.dynamic_index_in_dim(Uloc, slot_t, 0, False),
+        lax.dynamic_index_in_dim(chunk_all, slot_t, 0, False),
+    ], axis=1)
+    stacked = lax.psum(jnp.concatenate([
+        jnp.where(own_piv_r, row1, 0.0),
+        jnp.where(own_t_r, row2, 0.0),
+    ], axis=0), AXIS_R)                         # (2m, Wc + Uw + m)
+    row_piv = stacked[:m, :Wc]
+    u_p = stacked[:m, Wc:Wc + Uw]
+    row_t = stacked[m:, :Wc]
+    u_t_row = stacked[m:, Wc:Wc + Uw]
+    col_t_blk = stacked[m:, Wc + Uw:]
+
+    # --- SWAP-BY-COPY: piv's mesh row receives old row t in W, U, and
+    # the eager chunk; the eager chunk's row t is zeroed (its multiplier
+    # is the prow write).  Row-granular selects.
+    cur = lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False)
+    Wloc = lax.dynamic_update_index_in_dim(
+        Wloc, jnp.where(own_piv_r, row_t, cur), slot_piv, 0)
+    cur = lax.dynamic_index_in_dim(Uloc, slot_piv, 0, False)
+    Uloc = lax.dynamic_update_index_in_dim(
+        Uloc, jnp.where(own_piv_r, u_t_row, cur), slot_piv, 0)
+    cur = lax.dynamic_index_in_dim(chunk_all, slot_piv, 0, False)
+    chunk_all = lax.dynamic_update_index_in_dim(
+        chunk_all, jnp.where(own_piv_r, col_t_blk, cur), slot_piv, 0)
+    cur = lax.dynamic_index_in_dim(chunk_all, slot_t, 0, False)
+    chunk_all = lax.dynamic_update_index_in_dim(
+        chunk_all, jnp.where(own_t_r, jnp.zeros_like(cur), cur), slot_t, 0)
+
+    # --- EAGER PIVOT ROW + NORMALIZE; owner column's t-chunk becomes H.
+    if j:
+        row_piv = row_piv - jnp.matmul(u_p[:, :j * m], Ploc[:j * m],
+                                       precision=precision)
+    prow = jnp.matmul(H, row_piv, precision=precision)      # (m, Wc)
+    prow_H = lax.dynamic_update_slice(prow, H, (z, u_t * m))
+    prow = jnp.where(own_c, prow_H, prow)
+
+    # --- BOOKKEEPING (grouped invariants): zero W's t-chunk and Ploc's
+    # pending rows' t-chunk (owner column), finalize row t, record the
+    # panel.
+    cur_chunk = lax.dynamic_slice(Wloc, (z, z, u_t * m), (bpr, m, m))
+    Wloc = lax.dynamic_update_slice(
+        Wloc, jnp.where(own_c, jnp.zeros_like(cur_chunk), cur_chunk),
+        (z, z, u_t * m))
+    if j:
+        cur_p = lax.dynamic_slice(Ploc, (z, u_t * m), (j * m, m))
+        Ploc = lax.dynamic_update_slice(
+            Ploc, jnp.where(own_c, jnp.zeros_like(cur_p), cur_p),
+            (z, u_t * m))
+    cur = lax.dynamic_index_in_dim(Wloc, slot_t, 0, False)
+    Wloc = lax.dynamic_update_index_in_dim(
+        Wloc, jnp.where(own_t_r, prow, cur), slot_t, 0)
+    cur = lax.dynamic_index_in_dim(Uloc, slot_t, 0, False)
+    Uloc = lax.dynamic_update_index_in_dim(
+        Uloc, jnp.where(own_t_r, jnp.zeros_like(cur), cur), slot_t, 0)
+    Uloc = Uloc.at[:, :, j * m:(j + 1) * m].set(chunk_all)
+    Ploc = Ploc.at[j * m:(j + 1) * m].set(prow)
+    return Wloc, Uloc, Ploc, singular, g_piv
+
+
+def _group_end_2d(Wloc, Uloc, Ploc, precision):
+    """One fat LOCAL trailing matmul per group: U is replicated along
+    "pc", P column-sharded — no collective."""
+    bpr, m, Wc = Wloc.shape
+    upd = jnp.matmul(Uloc.reshape(bpr * m, -1), Ploc, precision=precision)
+    return Wloc - upd.reshape(bpr, m, Wc)
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas",
+                          "group"))
+def _sharded_jordan2d_inplace_grouped(W, mesh, lay: CyclicLayout2D, eps,
+                                      precision, use_pallas, group):
+    """The 2D in-place engine with delayed group updates, unrolled trace.
+    Same pivot rule and contract as ``_sharded_jordan2d_inplace``;
+    parity with the plain engines is to rounding (grouped summation
+    order)."""
+    kgrp = max(1, min(group, lay.Nr))
+
+    def worker(Wloc):
+        bpr, m, Wc = lay.bpr, lay.m, lay.N // lay.pc
+        singular = lax.pcast(jnp.asarray(False), BOTH, to='varying')
+        swaps = []
+        for t0 in range(0, lay.Nr, kgrp):
+            kg = min(kgrp, lay.Nr - t0)
+            Uloc = lax.pcast(jnp.zeros((bpr, m, kg * m), Wloc.dtype),
+                             BOTH, to='varying')
+            Ploc = lax.pcast(jnp.zeros((kg * m, Wc), Wloc.dtype),
+                             BOTH, to='varying')
+            for j in range(kg):
+                Wloc, Uloc, Ploc, singular, g_piv = _gstep2d(
+                    t0 + j, j, Wloc, Uloc, Ploc, singular, lay=lay,
+                    eps=eps, precision=precision, use_pallas=use_pallas)
+                swaps.append(g_piv)
+            Wloc = _group_end_2d(Wloc, Uloc, Ploc, precision)
+        for t in reversed(range(lay.Nr)):
+            Wloc = _unscramble_step(t, swaps[t], Wloc, lay=lay)
+        return Wloc, singular[None, None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=_SPEC_W,
+        out_specs=(_SPEC_W, PartitionSpec(AXIS_R, AXIS_C)),
+    )(W)
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas",
+                          "group"))
+def _sharded_jordan2d_inplace_grouped_fori(W, mesh, lay: CyclicLayout2D,
+                                           eps, precision, use_pallas,
+                                           group):
+    """The grouped 2D engine with the group loop as a ``lax.fori_loop``
+    (compile cost flat in Nr; the inner ``group`` steps are the only
+    unrolled region).  A trailing partial group runs unrolled after the
+    loop."""
+    kgrp = max(1, min(group, lay.Nr))
+    G, tail = divmod(lay.Nr, kgrp)
+
+    def worker(Wloc):
+        bpr, m, Wc = lay.bpr, lay.m, lay.N // lay.pc
+        dtype = Wloc.dtype
+        step = partial(_gstep2d, lay=lay, eps=eps, precision=precision,
+                       use_pallas=use_pallas)
+
+        def body(g, carry):
+            Wl, sing, swaps = carry
+            t0 = (g * kgrp).astype(jnp.int32)
+            Ul = lax.pcast(jnp.zeros((bpr, m, kgrp * m), dtype),
+                           BOTH, to='varying')
+            Pl = lax.pcast(jnp.zeros((kgrp * m, Wc), dtype),
+                           BOTH, to='varying')
+            for j in range(kgrp):
+                Wl, Ul, Pl, sing, g_piv = step(t0 + j, j, Wl, Ul, Pl, sing)
+                swaps = swaps.at[t0 + j].set(g_piv.astype(jnp.int32))
+            return _group_end_2d(Wl, Ul, Pl, precision), sing, swaps
+
+        sing0 = lax.pcast(jnp.asarray(False), BOTH, to='varying')
+        swaps0 = lax.pcast(jnp.zeros((lay.Nr,), jnp.int32), BOTH,
+                           to='varying')
+        Wloc, singular, swaps = lax.fori_loop(
+            0, G, body, (Wloc, sing0, swaps0))
+
+        if tail:
+            Ul = lax.pcast(jnp.zeros((bpr, m, tail * m), dtype),
+                           BOTH, to='varying')
+            Pl = lax.pcast(jnp.zeros((tail * m, Wc), dtype),
+                           BOTH, to='varying')
+            for j in range(tail):
+                Wloc, Ul, Pl, singular, g_piv = step(
+                    jnp.int32(G * kgrp + j), j, Wloc, Ul, Pl, singular)
+                swaps = swaps.at[G * kgrp + j].set(g_piv.astype(jnp.int32))
+            Wloc = _group_end_2d(Wloc, Ul, Pl, precision)
+
+        def unscramble(i, Wl):
+            t = jnp.asarray(lay.Nr - 1 - i, jnp.int32)
+            return _unscramble_step_fori(t, swaps[t], Wl, lay=lay)
+
+        Wloc = lax.fori_loop(0, lay.Nr, unscramble, Wloc)
+        return Wloc, singular[None, None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=_SPEC_W,
+        out_specs=(_SPEC_W, PartitionSpec(AXIS_R, AXIS_C)),
+    )(W)
+
+
 @partial(jax.jit,
          static_argnames=("mesh", "lay", "eps", "precision", "use_pallas"))
 def _sharded_jordan2d_inplace_fori(W, mesh, lay: CyclicLayout2D, eps,
@@ -433,13 +707,17 @@ def compile_sharded_jordan_inplace_2d(
     precision=lax.Precision.HIGHEST,
     use_pallas: bool | None = None,
     unroll: bool | None = None,
+    group: int = 0,
 ):
     """AOT-compile the 2D in-place elimination for a (Nr, m, N) 2D-cyclic
     identity-padded block tensor.  ``run(W) -> (inverse_blocks,
     singular_grid)`` — the output IS the inverse in 2D-cyclic order.
 
     ``unroll=None`` picks the unrolled trace for Nr <= MAX_UNROLL_NR and
-    the fori_loop engine beyond — identical results either way."""
+    the fori_loop engine beyond — identical results either way.
+    ``group=k > 1`` takes the delayed-group-update engines (one fat
+    local trailing matmul per group, fused stacked row psum per step;
+    parity with the plain engines is to rounding)."""
     from .jordan2d import resolve_use_pallas_2d
 
     if eps is None:
@@ -448,6 +726,12 @@ def compile_sharded_jordan_inplace_2d(
         use_pallas = resolve_use_pallas_2d(W.dtype, lay.m)
     if unroll is None:
         unroll = lay.Nr <= MAX_UNROLL_NR
+    if group and group > 1:
+        engine = (_sharded_jordan2d_inplace_grouped if unroll
+                  else _sharded_jordan2d_inplace_grouped_fori)
+        return engine.lower(
+            W, mesh, lay, eps, precision, use_pallas, group
+        ).compile()
     engine = (_sharded_jordan2d_inplace if unroll
               else _sharded_jordan2d_inplace_fori)
     return engine.lower(
@@ -464,12 +748,14 @@ def sharded_jordan_invert_inplace_2d(
     precision=lax.Precision.HIGHEST,
     use_pallas: bool | None = None,
     unroll: bool | None = None,
+    group: int = 0,
 ):
     """Invert (n, n) ``a`` over a 2D (pr, pc) mesh with the in-place
     engine: drop-in for ``sharded_jordan_invert_2d`` at ~half the flops,
     per-worker memory, and collective bytes.  Any Nr: the unrolled trace
     below MAX_UNROLL_NR, the fori_loop engine above (``unroll`` forces a
-    choice)."""
+    choice).  ``group=k > 1`` selects the delayed-group-update engines
+    (rounding-level parity with the plain engines)."""
     from .jordan2d import scatter_matrix_2d
 
     n = a.shape[-1]
@@ -477,6 +763,6 @@ def sharded_jordan_invert_inplace_2d(
     lay = CyclicLayout2D.create(n, min(block_size, n), pr, pc)
     W = scatter_matrix_2d(a, lay, mesh)
     run = compile_sharded_jordan_inplace_2d(W, mesh, lay, eps, precision,
-                                            use_pallas, unroll)
+                                            use_pallas, unroll, group)
     out, singular = run(W)
     return gather_inverse_inplace_2d(out, lay, n), singular.any()

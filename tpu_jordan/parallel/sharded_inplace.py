@@ -45,7 +45,7 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec
 
 from ..config import eps_for
-from ..ops.block_inverse import probe_blocks
+from ..ops.block_inverse import probe_blocks, probe_blocks_half_masked
 from ..ops.norms import block_inf_norms
 from .layout import CyclicLayout
 from .mesh import AXIS
@@ -220,6 +220,269 @@ def _step_fori(t, Wloc, singular, swaps, *, lay: CyclicLayout, eps,
     return Wloc, singular, swaps.at[t].set(g_piv.astype(jnp.int32))
 
 
+def _gstep(t, j: int, Wloc, Uloc, P, singular, *, lay: CyclicLayout, eps,
+           precision, use_pallas: bool):
+    """One inner step of a delayed-group-update group on one worker's
+    (bpw, m, N) shard (the 1D port of ops/jordan_inplace.py::
+    _grouped_step; reference hot loop main.cpp:1136-1194).
+
+    ``t`` may be a Python int (the unrolled engine: static shrinking
+    probe window) or a traced int32 (the fori engine: masked full-window
+    probe with the half cut) — every other op is identical, so the two
+    flavors bit-match.  ``j`` (position within the group) is static.
+
+    State beyond the plain step: ``Uloc`` (bpw, m, kg·m) holds the local
+    rows of the pending panel multipliers (swapped together with W rows
+    — pending contributions follow the physical row), ``P`` (kg·m, N)
+    the finalized pivot rows, replicated per worker (computed
+    redundantly from the same psum'd broadcasts, the SPMD analog of the
+    single-chip engine's P).
+
+    Collective accounting (the grouped comm win): ONE stacked
+    (2m, N + kg·m + m) psum carries the pivot row + its U row, row t +
+    its U row, and the eager column's t-block — where the plain step
+    pays two separate (m, N) psum rounds (+ H) — so per step the
+    grouped engine does 3 pmin/psum scalar rounds + 1 H psum + 1 fat
+    row psum instead of the plain engine's 2 thin ones; the trailing
+    update needs no communication at all (U rows local, P replicated).
+    """
+    p, m, bpw, N = lay.p, lay.m, lay.blocks_per_worker, lay.N
+    static_t = isinstance(t, int)
+    k = lax.axis_index(AXIS)
+    dtype = Wloc.dtype
+    Uw = Uloc.shape[-1]
+    z = jnp.int32(0)
+    tt = jnp.asarray(t, jnp.int32)
+
+    # --- EAGER CANDIDATE COLUMN on all slots: W[:, t] minus pending
+    # panels (finalized rows included — Jordan eliminates above the
+    # pivot too, so U's column j needs every row's eager value).
+    col = lax.dynamic_slice(Wloc, (z, z, tt * m), (bpw, m, m))
+    if j:
+        Ptc = lax.dynamic_slice(P, (z, tt * m), (j * m, m))
+        col = col - jnp.matmul(
+            Uloc[:, :, :j * m].reshape(bpw * m, j * m), Ptc,
+            precision=precision).reshape(bpw, m, m)
+
+    # --- PROBE (main.cpp:1039): static shrinking window [t//p, bpw) for
+    # the unrolled flavor, masked full window + half cut for the fori one.
+    if static_t:
+        s0 = t // p
+        invs, sing = probe_blocks(col[s0:], eps, use_pallas)
+        gidx = jnp.arange(s0, bpw) * p + k
+    else:
+        s0 = 0
+        invs, sing = probe_blocks_half_masked(col, tt >= (bpw // 2) * p,
+                                              eps, use_pallas)
+        gidx = jnp.arange(bpw) * p + k
+    valid = (gidx >= tt) & ~sing
+    norms = block_inf_norms(invs)
+    key = jnp.where(valid, norms, jnp.asarray(jnp.inf, norms.dtype))
+    slot_best = jnp.argmin(key)
+    my_key = key[slot_best]
+
+    # --- PIVOT REDUCTION (identical to _step), plus the all-singular
+    # pin: when no candidate anywhere is invertible, H := 0 and
+    # g_piv := t (a benign self-swap), so both flavors stay bit-equal
+    # even on singular inputs (the flags make the output invalid anyway).
+    kmin = lax.pmin(my_key, AXIS)
+    finite = jnp.isfinite(kmin)
+    g_cand = gidx[slot_best]
+    win_g = lax.pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), AXIS)
+    singular = singular | ~finite
+    i_won = (my_key == kmin) & (g_cand == win_g) & finite
+    g_piv = lax.psum(jnp.where(i_won, g_cand, 0), AXIS)
+    g_piv = jnp.where(finite, g_piv, tt.astype(g_piv.dtype))
+    H = lax.psum(
+        jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0).astype(dtype),
+        AXIS,
+    )
+
+    # --- STACKED ROW BROADCAST: one (2m, N + Uw + m) psum carrying
+    # [pivot stale row | its U row | 0] and [row t | its U row | eager
+    # col t-block] (main.cpp:1097 / 1122-1129 analogs, fused).
+    own_t = k == (tt % p)
+    slot_t = tt // p
+    safe_best = jnp.where(i_won, slot_best + s0, 0)
+    row1 = jnp.concatenate([
+        lax.dynamic_index_in_dim(Wloc, safe_best, 0, False),
+        lax.dynamic_index_in_dim(Uloc, safe_best, 0, False),
+        jnp.zeros((m, m), dtype),
+    ], axis=1)
+    row2 = jnp.concatenate([
+        lax.dynamic_index_in_dim(Wloc, slot_t, 0, False),
+        lax.dynamic_index_in_dim(Uloc, slot_t, 0, False),
+        lax.dynamic_index_in_dim(col, slot_t, 0, False),
+    ], axis=1)
+    stacked = lax.psum(jnp.concatenate([
+        jnp.where(i_won, row1, 0.0),
+        jnp.where(own_t, row2, 0.0),
+    ], axis=0), AXIS)                            # (2m, N + Uw + m)
+    row_piv = stacked[:m, :N]
+    u_p = stacked[:m, N:N + Uw]
+    row_t = stacked[m:, :N]
+    u_t = stacked[m:, N:N + Uw]
+    col_t_blk = stacked[m:, N + Uw:]
+
+    # --- SWAP-BY-COPY (main.cpp:1093-1131): pivot owner's slot receives
+    # old row t in W, U, and the eager column; row t's slot is rewritten
+    # below from the normalized pivot.  Row-granular selects throughout.
+    own_piv = k == (g_piv % p)
+    slot_piv = jnp.where(own_piv, g_piv // p, 0)
+    cur = lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False)
+    Wloc = lax.dynamic_update_index_in_dim(
+        Wloc, jnp.where(own_piv, row_t, cur), slot_piv, 0)
+    cur = lax.dynamic_index_in_dim(Uloc, slot_piv, 0, False)
+    Uloc = lax.dynamic_update_index_in_dim(
+        Uloc, jnp.where(own_piv, u_t, cur), slot_piv, 0)
+    cur = lax.dynamic_index_in_dim(col, slot_piv, 0, False)
+    col = lax.dynamic_update_index_in_dim(
+        col, jnp.where(own_piv, col_t_blk, cur), slot_piv, 0)
+    # Zero the eager column's row t (its multiplier is the prow write).
+    cur = lax.dynamic_index_in_dim(col, slot_t, 0, False)
+    col = lax.dynamic_update_index_in_dim(
+        col, jnp.where(own_t, jnp.zeros_like(cur), cur), slot_t, 0)
+
+    # --- EAGER PIVOT ROW + NORMALIZE; the t-chunk becomes H.
+    if j:
+        row_piv = row_piv - jnp.matmul(u_p[:, :j * m], P[:j * m],
+                                       precision=precision)
+    prow = jnp.matmul(H, row_piv, precision=precision)      # (m, N)
+    prow = lax.dynamic_update_slice(prow, H, (z, tt * m))
+
+    # --- BOOKKEEPING (the grouped engine's invariants,
+    # ops/jordan_inplace.py): zero W's t-column and P's pending rows'
+    # t-chunk, finalize row t, record the panel.
+    Wloc = lax.dynamic_update_slice(
+        Wloc, jnp.zeros((bpw, m, m), dtype), (z, z, tt * m))
+    if j:
+        P = lax.dynamic_update_slice(
+            P, jnp.zeros((j * m, m), dtype), (z, tt * m))
+    cur = lax.dynamic_index_in_dim(Wloc, slot_t, 0, False)
+    Wloc = lax.dynamic_update_index_in_dim(
+        Wloc, jnp.where(own_t, prow, cur), slot_t, 0)
+    cur = lax.dynamic_index_in_dim(Uloc, slot_t, 0, False)
+    Uloc = lax.dynamic_update_index_in_dim(
+        Uloc, jnp.where(own_t, jnp.zeros_like(cur), cur), slot_t, 0)
+    Uloc = Uloc.at[:, :, j * m:(j + 1) * m].set(col)
+    P = P.at[j * m:(j + 1) * m].set(prow)
+    return Wloc, Uloc, P, singular, g_piv
+
+
+def _group_end(Wloc, Uloc, P, precision):
+    """The one fat trailing update per group: (bpw·m, kg·m) x (kg·m, N)
+    local MXU matmul — no communication (U rows are local, P is
+    replicated)."""
+    bpw, m, N = Wloc.shape
+    upd = jnp.matmul(Uloc.reshape(bpw * m, -1), P, precision=precision)
+    return Wloc - upd.reshape(bpw, m, N)
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas",
+                          "group"))
+def _sharded_jordan_inplace_grouped(W, mesh, lay: CyclicLayout, eps,
+                                    precision, use_pallas, group):
+    """The 1D in-place engine with delayed group updates, unrolled trace
+    (static shrinking probe windows).  Same pivot rule and contract as
+    ``_sharded_jordan_inplace``; per-group it applies ONE fat trailing
+    matmul instead of ``group`` thin ones and fuses the per-step row
+    broadcasts into one stacked psum (see ``_gstep``)."""
+    kgrp = max(1, min(group, lay.Nr))
+
+    def worker(Wloc):
+        bpw, m, N = lay.blocks_per_worker, lay.m, lay.N
+        singular = lax.pcast(jnp.asarray(False), AXIS, to='varying')
+        swaps = []
+        for t0 in range(0, lay.Nr, kgrp):
+            kg = min(kgrp, lay.Nr - t0)
+            Uloc = lax.pcast(jnp.zeros((bpw, m, kg * m), Wloc.dtype),
+                             AXIS, to='varying')
+            P = lax.pcast(jnp.zeros((kg * m, N), Wloc.dtype),
+                          AXIS, to='varying')
+            for j in range(kg):
+                Wloc, Uloc, P, singular, g_piv = _gstep(
+                    t0 + j, j, Wloc, Uloc, P, singular, lay=lay, eps=eps,
+                    precision=precision, use_pallas=use_pallas)
+                swaps.append(g_piv)
+            Wloc = _group_end(Wloc, Uloc, P, precision)
+
+        from ..ops.jordan_inplace import apply_col_perm, compose_swap_perm
+
+        Wloc = apply_col_perm(
+            Wloc, compose_swap_perm(jnp.stack(swaps), lay.Nr), lay.m)
+        return Wloc, singular[None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=PartitionSpec(AXIS, None, None),
+        out_specs=(PartitionSpec(AXIS, None, None), PartitionSpec(AXIS)),
+    )(W)
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas",
+                          "group"))
+def _sharded_jordan_inplace_grouped_fori(W, mesh, lay: CyclicLayout, eps,
+                                         precision, use_pallas, group):
+    """The grouped 1D engine with the group loop as a ``lax.fori_loop``
+    (compile cost flat in Nr; the inner ``group`` steps are the only
+    unrolled region) — the distributed twin of
+    ops/jordan_inplace.py::block_jordan_invert_inplace_grouped_fori.
+    A trailing partial group runs unrolled after the loop."""
+    kgrp = max(1, min(group, lay.Nr))
+    G, tail = divmod(lay.Nr, kgrp)
+
+    def worker(Wloc):
+        bpw, m, N = lay.blocks_per_worker, lay.m, lay.N
+        dtype = Wloc.dtype
+        step = partial(_gstep, lay=lay, eps=eps, precision=precision,
+                       use_pallas=use_pallas)
+
+        def body(g, carry):
+            Wl, sing, swaps = carry
+            t0 = (g * kgrp).astype(jnp.int32)
+            Ul = lax.pcast(jnp.zeros((bpw, m, kgrp * m), dtype),
+                           AXIS, to='varying')
+            P = lax.pcast(jnp.zeros((kgrp * m, N), dtype),
+                          AXIS, to='varying')
+            for j in range(kgrp):
+                Wl, Ul, P, sing, g_piv = step(t0 + j, j, Wl, Ul, P, sing)
+                swaps = swaps.at[t0 + j].set(g_piv.astype(jnp.int32))
+            return _group_end(Wl, Ul, P, precision), sing, swaps
+
+        sing0 = lax.pcast(jnp.asarray(False), AXIS, to='varying')
+        swaps0 = lax.pcast(jnp.zeros((lay.Nr,), jnp.int32), AXIS,
+                           to='varying')
+        Wloc, singular, swaps = lax.fori_loop(
+            0, G, body, (Wloc, sing0, swaps0))
+
+        if tail:
+            Ul = lax.pcast(jnp.zeros((bpw, m, tail * m), dtype),
+                           AXIS, to='varying')
+            P = lax.pcast(jnp.zeros((tail * m, N), dtype),
+                          AXIS, to='varying')
+            for j in range(tail):
+                Wloc, Ul, P, singular, g_piv = step(
+                    jnp.int32(G * kgrp + j), j, Wloc, Ul, P, singular)
+                swaps = swaps.at[G * kgrp + j].set(g_piv.astype(jnp.int32))
+            Wloc = _group_end(Wloc, Ul, P, precision)
+
+        from ..ops.jordan_inplace import apply_col_perm, compose_swap_perm
+
+        Wloc = apply_col_perm(Wloc, compose_swap_perm(swaps, lay.Nr),
+                              lay.m)
+        return Wloc, singular[None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=PartitionSpec(AXIS, None, None),
+        out_specs=(PartitionSpec(AXIS, None, None), PartitionSpec(AXIS)),
+    )(W)
+
+
 @partial(jax.jit,
          static_argnames=("mesh", "lay", "eps", "precision", "use_pallas"))
 def _sharded_jordan_inplace_fori(W, mesh, lay: CyclicLayout, eps, precision,
@@ -299,6 +562,7 @@ def compile_sharded_jordan_inplace(
     precision=lax.Precision.HIGHEST,
     use_pallas: bool | None = None,
     unroll: bool | None = None,
+    group: int = 0,
 ):
     """AOT-compile the in-place sharded elimination for a (Nr, m, N)
     identity-padded cyclic block tensor.  ``run(blocks) ->
@@ -307,7 +571,10 @@ def compile_sharded_jordan_inplace(
 
     ``unroll=None`` picks the unrolled trace (static shrinking probe
     window) for Nr <= MAX_UNROLL_NR and the fori_loop engine beyond —
-    identical results either way."""
+    identical results either way.  ``group=k > 1`` takes the delayed-
+    group-update engines instead (one fat trailing matmul and one
+    stacked row psum per step — the measured single-chip winner at
+    large n, ported; parity with the plain engines is to rounding)."""
     from .sharded_jordan import resolve_use_pallas
 
     if eps is None:
@@ -316,6 +583,12 @@ def compile_sharded_jordan_inplace(
         use_pallas = resolve_use_pallas(blocks.dtype, lay.m)
     if unroll is None:
         unroll = lay.Nr <= MAX_UNROLL_NR
+    if group and group > 1:
+        engine = (_sharded_jordan_inplace_grouped if unroll
+                  else _sharded_jordan_inplace_grouped_fori)
+        return engine.lower(
+            blocks, mesh, lay, eps, precision, use_pallas, group
+        ).compile()
     engine = (_sharded_jordan_inplace if unroll
               else _sharded_jordan_inplace_fori)
     return engine.lower(
@@ -365,13 +638,16 @@ def sharded_jordan_invert_inplace(
     precision=lax.Precision.HIGHEST,
     use_pallas: bool | None = None,
     unroll: bool | None = None,
+    group: int = 0,
 ):
     """Invert (n, n) ``a`` over the 1D mesh with the in-place engine.
 
     Drop-in for ``sharded_jordan_invert`` (same pivot rule, same
     (inv, singular) contract) at ~half the flops, memory, and collective
     bytes.  Any Nr: the unrolled trace below MAX_UNROLL_NR, the
-    fori_loop engine above (``unroll`` forces a choice).
+    fori_loop engine above (``unroll`` forces a choice).  ``group=k > 1``
+    selects the delayed-group-update engines (k panels per trailing
+    matmul; rounding-level parity with the plain engines).
     """
     from .ring_gemm import _to_identity_padded_blocks
 
@@ -379,6 +655,6 @@ def sharded_jordan_invert_inplace(
     lay = CyclicLayout.create(n, min(block_size, n), mesh.devices.size)
     blocks = _to_identity_padded_blocks(a, lay, mesh)
     run = compile_sharded_jordan_inplace(blocks, mesh, lay, eps, precision,
-                                         use_pallas, unroll)
+                                         use_pallas, unroll, group)
     out, singular = run(blocks)
     return gather_inverse_inplace(out, lay, n), singular.any()
