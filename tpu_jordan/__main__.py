@@ -148,7 +148,7 @@ def main(argv=None) -> int:
                 raise UsageError(
                     "--batch requires generator input on a single device "
                     "(gathered output)")
-            if args.engine != "auto" or args.group > 1:
+            if args.engine != "auto" or args.group != 0:
                 # Batched grouped is a measured negative result
                 # (benchmarks/PHASES.md): vmapped eager side updates cost
                 # more than the thin-matmul penalty they remove at

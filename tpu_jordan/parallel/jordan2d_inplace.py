@@ -46,9 +46,101 @@ from .upcast import upcast_sub_fp32
 BOTH = (AXIS_R, AXIS_C)
 _SPEC_W = PartitionSpec(AXIS_R, None, AXIS_C)
 
+PROBE_LAYOUTS = ("auto", "column", "owner")
+
+
+def resolve_probe_layout(probe_layout: str) -> bool:
+    """Per-backend probe layout switch (VERDICT r4 weak #6) -> probe_cols.
+
+    "column" (True): the round-4 column-parallel probe — every mesh
+    column probes a 1/pc slice of the broadcast t-chunk panel.  Right
+    for REAL chips, where probe cost is candidate-proportional (the
+    measured TPU regime): probe time scales with pr·pc.
+
+    "owner" (False): the round-3 owner-column probe — only the mesh
+    column owning chunk t probes (a ``lax.cond`` skips the rest).
+    Right for the shared-core virtual CPU mesh, where the probe's
+    sequential small-block loop is batch-INSENSITIVE: pc probe
+    invocations on shared silicon cost ~pc× more wall time than one
+    (measured ~27% on the 2×4 mesh — benchmarks/PHASES.md round-4
+    footnote), the exact opposite of real hardware.
+
+    "auto": column on TPU, owner elsewhere.  Pivot choices are bitwise
+    identical either way — every candidate is probed by exactly one
+    device from the same broadcast values (pinned by the 2D parity
+    suite's cross-layout test).
+    """
+    if probe_layout not in PROBE_LAYOUTS:
+        raise ValueError(f"probe_layout {probe_layout!r}: choose from "
+                         f"{'/'.join(PROBE_LAYOUTS)}")
+    if probe_layout == "auto":
+        return jax.default_backend() == "tpu"
+    return probe_layout == "column"
+
+
+def _probe_candidates(chunk_all, tt, *, lay: CyclicLayout2D, eps,
+                      use_pallas: bool, probe_cols: bool,
+                      static_s0: int | None):
+    """The 2D pivot probe under either layout.
+
+    Returns ``(invs, sing, idx)`` where ``idx`` are the local slots of
+    ``chunk_all`` THIS worker probed (clipped; callers mask
+    ``idx < bpr``).  ``static_s0`` is the unrolled engines' static live
+    window start (t // pr), or None for the traced engines (full window
+    + the half cut).  With ``probe_cols=False`` non-owner mesh columns
+    skip the batched inverse entirely (identity blocks flagged
+    singular, masked out by the caller's validity test)."""
+    pr, pc, m, bpr = lay.pr, lay.pc, lay.m, lay.bpr
+    kc = lax.axis_index(AXIS_C)
+    if probe_cols:
+        if static_s0 is not None:
+            wnd = -(-(bpr - static_s0) // pc)
+            idx = static_s0 + kc + jnp.arange(wnd) * pc
+            cands = jnp.take(chunk_all, jnp.clip(idx, 0, bpr - 1), axis=0)
+            invs, sing = probe_blocks(cands, eps, use_pallas)
+        else:
+            from ..ops.block_inverse import probe_blocks_half_masked
+
+            wnd = -(-bpr // pc)
+            idx = kc + jnp.arange(wnd) * pc
+            cands = jnp.take(chunk_all, jnp.clip(idx, 0, bpr - 1), axis=0)
+            invs, sing = probe_blocks_half_masked(
+                cands, tt >= (wnd // 2) * pc * pr, eps, use_pallas)
+        return invs, sing, idx
+
+    own_c = kc == (tt % pc)
+    # ``+ 0 * kc`` stamps the slot vector with the "pc" varying tag the
+    # downstream whole-mesh collectives require (every worker's value is
+    # numerically identical).
+    if static_s0 is not None:
+        idx = static_s0 + jnp.arange(bpr - static_s0) + 0 * kc
+        cands = chunk_all[static_s0:]
+        probe = partial(probe_blocks, eps=eps, use_pallas=use_pallas)
+    else:
+        from ..ops.block_inverse import probe_blocks_half_masked
+
+        idx = jnp.arange(bpr) + 0 * kc
+        cands = chunk_all
+        probe = partial(probe_blocks_half_masked,
+                        upper_only=tt >= (bpr // 2) * pr, eps=eps,
+                        use_pallas=use_pallas)
+
+    def skip(c):
+        # Identity blocks flagged singular; the never-taken where joins
+        # the constants with c's device-varying type so both cond
+        # branches agree under shard_map's varying-type check.
+        w = c.shape[0]
+        eye = jnp.broadcast_to(jnp.eye(m, dtype=c.dtype), (w, m, m))
+        f = jnp.zeros((), bool)
+        return (jnp.where(f, c, eye),
+                jnp.where(f, c[:, 0, 0] == 0, True))
+
+    invs, sing = lax.cond(own_c, probe, skip, cands)
+    return invs, sing, idx
+
 
 def _step2d(t: int, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
-            use_pallas: bool):
+            use_pallas: bool, probe_cols: bool = True):
     """One super-step (static ``t``) on one worker's (bpr, m, Wc) shard.
 
     COLUMN-PARALLEL PROBE (round 4): the t-chunk panel is broadcast along
@@ -76,11 +168,10 @@ def _step2d(t: int, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
     chunk_all = lax.psum(
         jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
 
-    # --- PIVOT PROBE: this column's slice of the live window.
-    wnd = -(-(bpr - s0) // pc)                  # static slice length
-    idx = s0 + kc + jnp.arange(wnd) * pc        # local slots probed here
-    cands = jnp.take(chunk_all, jnp.clip(idx, 0, bpr - 1), axis=0)
-    invs, sing = probe_blocks(cands, eps, use_pallas)
+    # --- PIVOT PROBE (layout per resolve_probe_layout).
+    invs, sing, idx = _probe_candidates(
+        chunk_all, jnp.int32(t), lay=lay, eps=eps, use_pallas=use_pallas,
+        probe_cols=probe_cols, static_s0=s0)
     gidx = idx * pr + kr                        # global block rows probed
     valid = (idx < bpr) & (gidx >= t) & ~sing
     norms = block_inf_norms(invs)
@@ -184,7 +275,7 @@ def _unscramble_step(t: int, piv, Wloc, *, lay: CyclicLayout2D):
 
 
 def _step2d_fori(t, Wloc, singular, swaps, *, lay: CyclicLayout2D, eps,
-                 precision, use_pallas: bool):
+                 precision, use_pallas: bool, probe_cols: bool = True):
     """One super-step with a TRACED ``t`` — the fori_loop body behind
     ``_sharded_jordan2d_inplace_fori``.  Same arithmetic and pivot
     choices as ``_step2d``; the column-parallel probe covers this
@@ -207,17 +298,11 @@ def _step2d_fori(t, Wloc, singular, swaps, *, lay: CyclicLayout2D, eps,
     chunk_all = lax.psum(
         jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
 
-    # --- PIVOT PROBE: this column's slice of the full window, masked
-    # (traced t), with the half-window cut once every slot the lower
-    # half of ANY column's slice can map to is dead (slot j < wnd//2 has
-    # local index <= (wnd//2·pc − 1), global row < wnd//2·pc·pr <= t).
-    from ..ops.block_inverse import probe_blocks_half_masked
-
-    wnd = -(-bpr // pc)                         # static slice length
-    idx = kc + jnp.arange(wnd) * pc             # local slots probed here
-    cands = jnp.take(chunk_all, jnp.clip(idx, 0, bpr - 1), axis=0)
-    invs, sing = probe_blocks_half_masked(
-        cands, t >= (wnd // 2) * pc * pr, eps, use_pallas)
+    # --- PIVOT PROBE (layout per resolve_probe_layout; traced t ->
+    # masked full window with the half cut).
+    invs, sing, idx = _probe_candidates(
+        chunk_all, t, lay=lay, eps=eps, use_pallas=use_pallas,
+        probe_cols=probe_cols, static_s0=None)
     gidx = idx * pr + kr                        # global block rows probed
     valid = (idx < bpr) & (gidx >= t) & ~sing
     norms = block_inf_norms(invs)
@@ -323,7 +408,7 @@ def _unscramble_step_fori(t, piv, Wloc, *, lay: CyclicLayout2D):
 
 
 def _gstep2d(t, j: int, Wloc, Uloc, Ploc, singular, *, lay: CyclicLayout2D,
-             eps, precision, use_pallas: bool):
+             eps, precision, use_pallas: bool, probe_cols: bool = True):
     """One inner step of a delayed-group-update group on one worker's
     (bpr, m, Wc) 2D shard — the 2D port of sharded_inplace.py::_gstep
     (reference hot loop main.cpp:1136-1194).
@@ -369,22 +454,10 @@ def _gstep2d(t, j: int, Wloc, Uloc, Ploc, singular, *, lay: CyclicLayout2D,
     chunk_all = lax.psum(
         jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
 
-    # --- COLUMN-PARALLEL PROBE (round-4 design): this column's slice of
-    # the live window (main.cpp:1039).
-    if static_t:
-        s0 = t // pr
-        wnd = -(-(bpr - s0) // pc)
-        idx = s0 + kc + jnp.arange(wnd) * pc
-        cands = jnp.take(chunk_all, jnp.clip(idx, 0, bpr - 1), axis=0)
-        invs, sing = probe_blocks(cands, eps, use_pallas)
-    else:
-        from ..ops.block_inverse import probe_blocks_half_masked
-
-        wnd = -(-bpr // pc)
-        idx = kc + jnp.arange(wnd) * pc
-        cands = jnp.take(chunk_all, jnp.clip(idx, 0, bpr - 1), axis=0)
-        invs, sing = probe_blocks_half_masked(
-            cands, tt >= (wnd // 2) * pc * pr, eps, use_pallas)
+    # --- PIVOT PROBE (layout per resolve_probe_layout; main.cpp:1039).
+    invs, sing, idx = _probe_candidates(
+        chunk_all, tt, lay=lay, eps=eps, use_pallas=use_pallas,
+        probe_cols=probe_cols, static_s0=(t // pr if static_t else None))
     gidx = idx * pr + kr
     valid = (idx < bpr) & (gidx >= tt) & ~sing
     norms = block_inf_norms(invs)
@@ -496,9 +569,10 @@ def _group_end_2d(Wloc, Uloc, Ploc, precision):
 
 @partial(jax.jit,
          static_argnames=("mesh", "lay", "eps", "precision", "use_pallas",
-                          "group"))
+                          "group", "probe_cols"))
 def _sharded_jordan2d_inplace_grouped(W, mesh, lay: CyclicLayout2D, eps,
-                                      precision, use_pallas, group):
+                                      precision, use_pallas, group,
+                                      probe_cols=True):
     """The 2D in-place engine with delayed group updates, unrolled trace.
     Same pivot rule and contract as ``_sharded_jordan2d_inplace``;
     parity with the plain engines is to rounding (grouped summation
@@ -518,7 +592,8 @@ def _sharded_jordan2d_inplace_grouped(W, mesh, lay: CyclicLayout2D, eps,
             for j in range(kg):
                 Wloc, Uloc, Ploc, singular, g_piv = _gstep2d(
                     t0 + j, j, Wloc, Uloc, Ploc, singular, lay=lay,
-                    eps=eps, precision=precision, use_pallas=use_pallas)
+                    eps=eps, precision=precision, use_pallas=use_pallas,
+                    probe_cols=probe_cols)
                 swaps.append(g_piv)
             Wloc = _group_end_2d(Wloc, Uloc, Ploc, precision)
         for t in reversed(range(lay.Nr)):
@@ -535,10 +610,10 @@ def _sharded_jordan2d_inplace_grouped(W, mesh, lay: CyclicLayout2D, eps,
 
 @partial(jax.jit,
          static_argnames=("mesh", "lay", "eps", "precision", "use_pallas",
-                          "group"))
+                          "group", "probe_cols"))
 def _sharded_jordan2d_inplace_grouped_fori(W, mesh, lay: CyclicLayout2D,
                                            eps, precision, use_pallas,
-                                           group):
+                                           group, probe_cols=True):
     """The grouped 2D engine with the group loop as a ``lax.fori_loop``
     (compile cost flat in Nr; the inner ``group`` steps are the only
     unrolled region).  A trailing partial group runs unrolled after the
@@ -550,7 +625,7 @@ def _sharded_jordan2d_inplace_grouped_fori(W, mesh, lay: CyclicLayout2D,
         bpr, m, Wc = lay.bpr, lay.m, lay.N // lay.pc
         dtype = Wloc.dtype
         step = partial(_gstep2d, lay=lay, eps=eps, precision=precision,
-                       use_pallas=use_pallas)
+                       use_pallas=use_pallas, probe_cols=probe_cols)
 
         def body(g, carry):
             Wl, sing, swaps = carry
@@ -597,9 +672,10 @@ def _sharded_jordan2d_inplace_grouped_fori(W, mesh, lay: CyclicLayout2D,
 
 
 @partial(jax.jit,
-         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas"))
+         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas",
+                          "probe_cols"))
 def _sharded_jordan2d_inplace_fori(W, mesh, lay: CyclicLayout2D, eps,
-                                   precision, use_pallas):
+                                   precision, use_pallas, probe_cols=True):
     """The 2D in-place engine with both loops as ``lax.fori_loop``s —
     identical results to ``_sharded_jordan2d_inplace``, compile cost
     independent of Nr (the MAX_UNROLL_NR ceiling removed)."""
@@ -607,7 +683,8 @@ def _sharded_jordan2d_inplace_fori(W, mesh, lay: CyclicLayout2D, eps,
         def body(t, carry):
             Wl, sing, swaps = carry
             return _step2d_fori(t, Wl, sing, swaps, lay=lay, eps=eps,
-                                precision=precision, use_pallas=use_pallas)
+                                precision=precision, use_pallas=use_pallas,
+                                probe_cols=probe_cols)
 
         sing0 = lax.pcast(jnp.asarray(False), BOTH, to='varying')
         swaps0 = lax.pcast(jnp.zeros((lay.Nr,), jnp.int32), BOTH,
@@ -633,16 +710,17 @@ def _sharded_jordan2d_inplace_fori(W, mesh, lay: CyclicLayout2D, eps,
 
 
 @partial(jax.jit,
-         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas"))
+         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas",
+                          "probe_cols"))
 def _sharded_jordan2d_inplace(W, mesh, lay: CyclicLayout2D, eps, precision,
-                              use_pallas):
+                              use_pallas, probe_cols=True):
     def worker(Wloc):
         singular = lax.pcast(jnp.asarray(False), BOTH, to='varying')
         swaps = []
         for t in range(lay.Nr):
             Wloc, singular, g_piv = _step2d(
                 t, Wloc, singular, lay=lay, eps=eps, precision=precision,
-                use_pallas=use_pallas,
+                use_pallas=use_pallas, probe_cols=probe_cols,
             )
             swaps.append(g_piv)
         for t in reversed(range(lay.Nr)):
@@ -708,6 +786,7 @@ def compile_sharded_jordan_inplace_2d(
     use_pallas: bool | None = None,
     unroll: bool | None = None,
     group: int = 0,
+    probe_layout: str = "auto",
 ):
     """AOT-compile the 2D in-place elimination for a (Nr, m, N) 2D-cyclic
     identity-padded block tensor.  ``run(W) -> (inverse_blocks,
@@ -726,16 +805,17 @@ def compile_sharded_jordan_inplace_2d(
         use_pallas = resolve_use_pallas_2d(W.dtype, lay.m)
     if unroll is None:
         unroll = lay.Nr <= MAX_UNROLL_NR
+    probe_cols = resolve_probe_layout(probe_layout)
     if group and group > 1:
         engine = (_sharded_jordan2d_inplace_grouped if unroll
                   else _sharded_jordan2d_inplace_grouped_fori)
         return engine.lower(
-            W, mesh, lay, eps, precision, use_pallas, group
+            W, mesh, lay, eps, precision, use_pallas, group, probe_cols
         ).compile()
     engine = (_sharded_jordan2d_inplace if unroll
               else _sharded_jordan2d_inplace_fori)
     return engine.lower(
-        W, mesh, lay, eps, precision, use_pallas
+        W, mesh, lay, eps, precision, use_pallas, probe_cols
     ).compile()
 
 
@@ -749,6 +829,7 @@ def sharded_jordan_invert_inplace_2d(
     use_pallas: bool | None = None,
     unroll: bool | None = None,
     group: int = 0,
+    probe_layout: str = "auto",
 ):
     """Invert (n, n) ``a`` over a 2D (pr, pc) mesh with the in-place
     engine: drop-in for ``sharded_jordan_invert_2d`` at ~half the flops,
@@ -763,6 +844,7 @@ def sharded_jordan_invert_inplace_2d(
     lay = CyclicLayout2D.create(n, min(block_size, n), pr, pc)
     W = scatter_matrix_2d(a, lay, mesh)
     run = compile_sharded_jordan_inplace_2d(W, mesh, lay, eps, precision,
-                                            use_pallas, unroll, group)
+                                            use_pallas, unroll, group,
+                                            probe_layout)
     out, singular = run(W)
     return gather_inverse_inplace_2d(out, lay, n), singular.any()
