@@ -245,15 +245,23 @@ class TestColumnParallelProbe:
                 seen += [i for i in idx if i < bpr]
             assert sorted(seen) == list(range(s0, bpr)), (s0, pc, seen)
 
-    def test_fori_half_cut_condition_is_safe(self):
-        # The fori engines probe only the upper half of each column's
-        # slice once t >= (wnd//2)*pc*pr: every slot in the lower half
-        # must then be dead (global row < t) on every device.
-        for bpr, pr, pc in ((8, 2, 4), (8, 4, 2), (6, 2, 2), (16, 2, 8)):
-            wnd = -(-bpr // pc)
-            t = (wnd // 2) * pc * pr    # the earliest t the cut fires at
-            for kc in range(pc):
-                for kr in range(pr):
-                    for u in range(wnd // 2):
-                        g = (kc + u * pc) * pr + kr
-                        assert g < t or wnd // 2 == 0, (bpr, pr, pc, kc, u)
+    def test_quarter_ladder_skipped_slots_are_dead(self):
+        # probe_blocks_quarter_masked skips the first
+        # qi = clip((t // stride) // q, 0, 3) quarters (q = w // 4) of
+        # the candidate window.  Safety invariant, exhaustively: every
+        # skipped slot's smallest possible global row is < t, at every
+        # step, for each call layout's stride (1 single-chip, p 1D,
+        # pr owner-2D, pc·pr column-2D — slot i of the column slice
+        # covers rows (kc + i·pc)·pr + kr >= i·pc·pr).
+        for w, stride in ((128, 1), (16, 4), (8, 2), (12, 3), (16, 8)):
+            if w < 8:
+                continue
+            q = w // 4
+            for t in range(w * stride):
+                qi = min(max((t // stride) // q, 0), 3)
+                for i in range(qi * q):
+                    # slot i's global rows are >= i*stride and the slot
+                    # is skipped — it must be dead: i*stride + anything
+                    # the layout adds stays < t only if i < t // stride.
+                    assert i < t // stride, (w, stride, t, i)
+                    assert i * stride + (stride - 1) < t, (w, stride, t, i)

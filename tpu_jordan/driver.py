@@ -307,6 +307,21 @@ def solve_batch(
     )
 
 
+def _blocks_inf_norm(blocks, row_order, m: int, n: int):
+    """‖·‖∞ straight from an (Nr, m, N) block tensor: row abs-sums
+    (summing the STORED columns of a row IS the full row abs-sum —
+    column storage order is a permutation, and identity-pad columns
+    contribute 0 to real rows), masked to real rows via the layout's
+    row storage order (identity-pad rows sum to exactly 1 and must not
+    cap a small true norm).  Runs on the sharded array — one O(n²/P)
+    pass per worker plus a scalar max collective; nothing n×n
+    materializes (the κ∞ path for gather=False solves)."""
+    order = jnp.asarray(row_order, jnp.int32)
+    gi = order[:, None] * m + jnp.arange(m)[None, :]
+    sums = jnp.sum(jnp.abs(blocks), axis=-1)
+    return jnp.max(jnp.where(gi < n, sums, 0.0))
+
+
 def make_distributed_backend(workers, n: int, block_size: int,
                              engine: str = "auto", group: int = 0):
     """The distributed backend for a workers spec: int p -> 1D row-cyclic,
@@ -487,17 +502,8 @@ class _Dist1D:
                                            self.mesh, self.lay)
 
     def inf_norm_blocks(self, blocks):
-        """‖·‖∞ straight from the (Nr, m, N) cyclic block tensor: row
-        abs-sums (column storage order is irrelevant to a row sum), real
-        rows only (identity-pad rows sum to exactly 1 and must not cap a
-        small true norm).  Runs on the sharded array — one O(n²/p) pass
-        per worker plus a scalar max collective; nothing n×n
-        materializes (the κ∞ path for gather=False solves)."""
-        lay = self.lay
-        order = jnp.asarray(lay.cyclic_block_order(), jnp.int32)
-        gi = order[:, None] * lay.m + jnp.arange(lay.m)[None, :]
-        sums = jnp.sum(jnp.abs(blocks), axis=-1)
-        return jnp.max(jnp.where(gi < lay.n, sums, 0.0))
+        return _blocks_inf_norm(blocks, self.lay.cyclic_block_order(),
+                                self.lay.m, self.lay.n)
 
     def corner(self, inv_blocks, n):
         from .parallel.sharded_inplace import inverse_corner_1d
@@ -601,16 +607,8 @@ class _Dist2D:
                                        self.lay)
 
     def inf_norm_blocks(self, blocks):
-        """‖·‖∞ from the (Nr, m, N) 2D-cyclic block tensor: summing the
-        stored columns of a row IS the full row abs-sum (column storage
-        is a permutation; identity-pad columns contribute 0 to real
-        rows), masked to real rows (2D row storage order is row_perm).
-        One O(n²/(pr·pc)) pass per worker + a scalar max collective."""
-        lay = self.lay
-        order = jnp.asarray(lay.row_perm(), jnp.int32)
-        gi = order[:, None] * lay.m + jnp.arange(lay.m)[None, :]
-        sums = jnp.sum(jnp.abs(blocks), axis=-1)
-        return jnp.max(jnp.where(gi < lay.n, sums, 0.0))
+        return _blocks_inf_norm(blocks, self.lay.row_perm(), self.lay.m,
+                                self.lay.n)
 
     def corner(self, inv_blocks, n):
         from .parallel.jordan2d_inplace import inverse_corner_2d

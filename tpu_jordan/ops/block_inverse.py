@@ -133,6 +133,51 @@ def probe_blocks(cands: jnp.ndarray, eps, use_pallas: bool):
     return batched_block_inverse(cands, None, eps)
 
 
+def probe_blocks_quarter_masked(cands, t, stride: int, eps,
+                                use_pallas: bool):
+    """Quarter-window probe ladder for the traced (fori_loop) engines.
+
+    Like ``probe_blocks_half_masked`` but with FOUR window sizes: at
+    step ``t`` (global units; ``stride`` converts a window slot to its
+    smallest global row, e.g. p for the 1D layout, 1 single-chip) every
+    slot below ``t // stride`` is dead, so the ladder probes only the
+    trailing w, 3w/4, w/2, or w/4 slots — on TPU the probe's grid
+    programs are the cost (per-program cost is flat), so the ladder
+    recovers most of the unrolled engines' static-shrinking-window
+    advantage (measured: the half cut alone leaves the grouped-fori
+    engine ~9-19% behind unrolled at 8192-16384).  Dead slots are padded
+    with identity blocks flagged singular, keeping every branch's output
+    (w, m, m).
+
+    Four distinct probe shapes in one XLA program is within the
+    measured-safe region on this backend (the half cut already ships
+    two; A/B'd on chip before adoption — benchmarks/PHASES.md round 5).
+    """
+    w, m = cands.shape[0], cands.shape[-1]
+    if w < 8:
+        return probe_blocks(cands, eps, use_pallas)
+    q = w // 4
+
+    def mk(start: int):
+        def branch(c):
+            invs_u, sing_u = probe_blocks(c[start:], eps, use_pallas)
+            if not start:
+                return invs_u, sing_u
+            eye = jnp.broadcast_to(jnp.eye(m, dtype=c.dtype),
+                                   (start, m, m))
+            return (jnp.concatenate([eye, invs_u]),
+                    jnp.concatenate([jnp.ones((start,), bool), sing_u]))
+
+        return branch
+
+    # Quarter index: how many leading quarters are entirely dead.  Slot
+    # s covers global rows >= s*stride, so quarter [q*i, q*(i+1)) is
+    # dead iff t >= q*(i+1)*stride... conservatively: slots below
+    # t // stride are dead; leading dead quarters = (t // stride) // q.
+    qi = jnp.clip((t // stride) // q, 0, 3)
+    return lax.switch(qi, [mk(0), mk(q), mk(2 * q), mk(3 * q)], cands)
+
+
 def probe_blocks_half_masked(cands, upper_only, eps, use_pallas: bool):
     """Half-window probe cut shared by the traced (fori_loop) engines.
 

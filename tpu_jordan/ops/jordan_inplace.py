@@ -316,18 +316,18 @@ def block_jordan_invert_inplace_grouped(
 
 
 def _grouped_step(t, j: int, V, U, P, singular, swaps, *, Nr: int, N: int,
-                  m: int, eps, precision, use_pallas: bool, half: int):
+                  m: int, eps, precision, use_pallas: bool):
     """One inner elimination step of a delayed-group-update group.
 
     ``t`` may be a traced int32 (the fori_loop engine) or a Python int
     (the unrolled tail group); ``j`` (position within the group) is
     always static.  Arithmetic is identical to the unrolled grouped
-    engine's inner loop — the probe just runs on the full masked window
-    (with the half-window ``lax.cond`` cut) instead of a statically
-    shrunk one, which changes launch shapes but not any per-candidate
-    value, so results bit-match the unrolled engine.
+    engine's inner loop — the probe just runs on the masked window
+    (quarter ladder, probe_blocks_quarter_masked) instead of a
+    statically shrunk one, which changes launch shapes but not any
+    per-candidate value, so results bit-match the unrolled engine.
     """
-    from .block_inverse import probe_blocks_half_masked
+    from .block_inverse import probe_blocks_quarter_masked
 
     dtype = V.dtype
     t = jnp.asarray(t, jnp.int32)
@@ -342,9 +342,9 @@ def _grouped_step(t, j: int, V, U, P, singular, swaps, *, Nr: int, N: int,
             U[:, :j * m], lax.dynamic_slice(P, (z, t * m), (j * m, m)),
             precision=precision)
 
-    # --- PROBE the full masked window (main.cpp:1039).
-    invs, sing = probe_blocks_half_masked(
-        col.reshape(Nr, m, m), t >= half, eps, use_pallas)
+    # --- PROBE the masked window, quarter ladder (main.cpp:1039).
+    invs, sing = probe_blocks_quarter_masked(
+        col.reshape(Nr, m, m), t, 1, eps, use_pallas)
     valid = (gidx >= t) & ~sing
     norms = block_inf_norms(invs)
     key = jnp.where(valid, norms, jnp.asarray(jnp.inf, norms.dtype))
@@ -440,10 +440,9 @@ def block_jordan_invert_inplace_grouped_fori(
     V = pad_with_identity(a, N)
     if use_pallas is None:
         use_pallas = _use_pallas_default(dtype) and m % 8 == 0 and m >= 32
-    half = Nr // 2
     G, tail = divmod(Nr, k)
     step = partial(_grouped_step, Nr=Nr, N=N, m=m, eps=eps,
-                   precision=precision, use_pallas=use_pallas, half=half)
+                   precision=precision, use_pallas=use_pallas)
 
     def body(g, carry):
         V, singular, swaps = carry
@@ -495,12 +494,11 @@ def block_jordan_invert_inplace_fori(
     Differences from the unrolled engine, all trace-compatibility driven:
       * slice offsets use the traced ``t`` via ``lax.dynamic_slice`` (the
         augmented ``ops/jordan.py`` engine's own pattern);
-      * the probe runs on the full Nr-candidate column with dead rows
-        masked to inf keys — plus the half-window ``lax.cond`` cut of the
-        augmented sharded path (probe only rows [Nr//2, Nr) once
-        t >= Nr//2), ~0.75x the full-probe flops on average (the unrolled
-        engine's static shrinking window is ~0.5x; the reference probes
-        the live window too, main.cpp:1039);
+      * the probe runs on the masked candidate column shrunk by the
+        quarter-window ladder (probe_blocks_quarter_masked: a lax.switch
+        over window sizes Nr, 3Nr/4, Nr/2, Nr/4 — ~0.63x the full-probe
+        launches on average vs the unrolled engine's static ~0.5x; the
+        reference probes the live window too, main.cpp:1039);
       * the row-swap history is carried as an (Nr,) int32 array and
         replayed by a second fori_loop.
     """
@@ -524,18 +522,17 @@ def block_jordan_invert_inplace_fori(
     V = pad_with_identity(a, N)
     if use_pallas is None:
         use_pallas = _use_pallas_default(dtype) and m % 8 == 0 and m >= 32
-    from .block_inverse import probe_blocks_half_masked
+    from .block_inverse import probe_blocks_quarter_masked
 
-    half = Nr // 2
     gidx = jnp.arange(Nr)
     rowblk = jnp.arange(N) // m
 
     def body(t, carry):
         V, singular, swaps = carry
-        # --- PROBE (full column, dead rows masked; main.cpp:1039).
+        # --- PROBE (masked window, quarter ladder; main.cpp:1039).
         col = lax.dynamic_slice(V, (0, t * m), (N, m)).reshape(Nr, m, m)
-        invs, sing = probe_blocks_half_masked(col, t >= half, eps,
-                                              use_pallas)
+        invs, sing = probe_blocks_quarter_masked(col, t, 1, eps,
+                                                 use_pallas)
         valid = (gidx >= t) & ~sing
         key = jnp.where(valid, block_inf_norms(invs),
                         jnp.asarray(jnp.inf, dtype))

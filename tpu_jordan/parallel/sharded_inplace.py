@@ -45,7 +45,8 @@ from jax import shard_map
 from jax.sharding import Mesh, PartitionSpec
 
 from ..config import eps_for
-from ..ops.block_inverse import probe_blocks, probe_blocks_half_masked
+from ..ops.block_inverse import (probe_blocks,
+                                 probe_blocks_quarter_masked)
 from ..ops.norms import block_inf_norms
 from .layout import CyclicLayout
 from .mesh import AXIS
@@ -143,21 +144,19 @@ def _step_fori(t, Wloc, singular, swaps, *, lay: CyclicLayout, eps,
     """One super-step with a TRACED ``t`` on one worker's (bpw, m, N)
     shard — the fori_loop body behind ``_sharded_jordan_inplace_fori``.
     Same arithmetic as ``_step`` (identical pivot choices and updates);
-    the probe runs on the full slot window with dead slots masked, plus
-    the half-window ``lax.cond`` cut of the augmented path
-    (sharded_jordan.py::_local_step): once t >= (bpw//2)*p every slot of
-    the lower half is dead, so only the upper half is probed."""
+    the probe runs on the masked slot window shrunk by the
+    quarter-window ladder (probe_blocks_quarter_masked, stride p —
+    deadness pinned by tests/test_jordan2d_inplace.py::
+    test_quarter_ladder_skipped_slots_are_dead)."""
     p, m, bpw, N = lay.p, lay.m, lay.blocks_per_worker, lay.N
     k = lax.axis_index(AXIS)
     dtype = Wloc.dtype
     gidx = jnp.arange(bpw) * p + k              # global block row per slot
 
-    # --- PIVOT PROBE: full slot window, masked (main.cpp:1039).
-    from ..ops.block_inverse import probe_blocks_half_masked
-
+    # --- PIVOT PROBE: masked slot window, quarter ladder
+    # (main.cpp:1039).
     cands = lax.dynamic_slice(Wloc, (0, 0, t * m), (bpw, m, m))
-    invs, sing = probe_blocks_half_masked(cands, t >= (bpw // 2) * p,
-                                          eps, use_pallas)
+    invs, sing = probe_blocks_quarter_masked(cands, t, p, eps, use_pallas)
     valid = (gidx >= t) & ~sing
     norms = block_inf_norms(invs)
     key = jnp.where(valid, norms, jnp.asarray(jnp.inf, norms.dtype))
@@ -272,8 +271,8 @@ def _gstep(t, j: int, Wloc, Uloc, P, singular, *, lay: CyclicLayout, eps,
         gidx = jnp.arange(s0, bpw) * p + k
     else:
         s0 = 0
-        invs, sing = probe_blocks_half_masked(col, tt >= (bpw // 2) * p,
-                                              eps, use_pallas)
+        invs, sing = probe_blocks_quarter_masked(col, tt, p, eps,
+                                                 use_pallas)
         gidx = jnp.arange(bpw) * p + k
     valid = (gidx >= tt) & ~sing
     norms = block_inf_norms(invs)
