@@ -168,6 +168,34 @@ def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
     return 2.0 * n**3 / per_call / 1e9, acc
 
 
+def _capture_ladder(extra, n, tiers, r1, r2, baseline_gflops):
+    """Run a scale row's capture ladder: each tier retries once on the
+    transient remote-compile failure class; a knife-edge _Singular in a
+    grouped tier skips its bit-identical fori twin (a deterministic
+    outcome — don't pay its compile+invert); the first tier that lands
+    becomes the row of record.  Returns (gf, acc) or (None, None)."""
+    skip_grouped = False
+    for cfg, mm, kw in tiers:
+        if skip_grouped and kw.get("group"):
+            extra[f"invert_{n}_{cfg}_error"] = "skipped: singular twin"
+            continue
+        try:
+            gf, acc = _retry_transient(
+                lambda: _measure(n, mm, r1=r1, r2=r2, generator="rand",
+                                 max_rel=None, refine=1, **kw))
+        except _Singular as ge:
+            extra[f"invert_{n}_{cfg}_error"] = str(ge)[:200]
+            skip_grouped = bool(kw.get("group"))
+            continue
+        except Exception as ge:                 # noqa: BLE001
+            extra[f"invert_{n}_{cfg}_error"] = str(ge)[:200]
+            continue
+        extra[f"invert_{n}_f32_{cfg}_rand_gflops"] = round(gf, 1)
+        extra[f"vs_baseline_{n}_scale"] = round(gf / baseline_gflops, 1)
+        return gf, acc
+    return None, None
+
+
 def main():
     baseline_gflops = 6.8  # BASELINE.md: reference fp64, m=48, 1 CPU core
 
@@ -199,27 +227,11 @@ def main():
         ("m128_grouped2", 128, dict(group=2)),
         ("m128_grouped2_fori", 128, dict(group=2, fori=True)),
     ]
-    skip8 = False
-    for cfg, mm, kw in tiers8:
-        if skip8:
-            extra[f"invert_8192_{cfg}_error"] = "skipped: singular twin"
-            continue
-        try:
-            gf, acc = _retry_transient(
-                lambda: _measure(8192, mm, r1=3, r2=9, generator="rand",
-                                 max_rel=None, refine=1, **kw))
-        except _Singular as ge:
-            extra[f"invert_8192_{cfg}_error"] = str(ge)[:200]
-            skip8 = True
-            continue
-        except Exception as ge:                 # noqa: BLE001
-            extra[f"invert_8192_{cfg}_error"] = str(ge)[:200]
-            continue
-        extra[f"invert_8192_f32_{cfg}_rand_gflops"] = round(gf, 1)
-        extra["vs_baseline_8192_grouped"] = round(gf / baseline_gflops, 1)
-        extra["rel_residual_8192_grouped"] = acc["rel_residual"]
-        extra["kappa_8192_grouped"] = acc["kappa"]
-        break
+    gf8, acc8 = _capture_ladder(extra, 8192, tiers8, r1=3, r2=9,
+                                baseline_gflops=baseline_gflops)
+    if acc8 is not None:
+        extra["rel_residual_8192_grouped"] = acc8["rel_residual"]
+        extra["kappa_8192_grouped"] = acc8["kappa"]
 
     # 16384 scale point, best-effort (the two contract configs above must
     # never be lost to a failure here): |i−j| genuinely exceeds fp32 at
@@ -233,35 +245,16 @@ def main():
     # retries once on the transient remote-compile failure class; tier 2
     # is the grouped-fori twin whose seconds-flat compile shrinks the
     # flake window ~40x; tier 3 is the plain engine at m=256.
-    tiers = [
+    tiers16 = [
         ("m128_grouped2", 128, dict(group=2)),
         ("m128_grouped2_fori", 128, dict(group=2, fori=True)),
         ("m256_plain", 256, dict()),
     ]
-    skip_grouped = False
-    for cfg, mm, kw in tiers:
-        if skip_grouped and kw.get("group"):
-            # The fori twin is bit-identical to the unrolled grouped
-            # engine — a knife-edge _Singular there is deterministic, so
-            # don't pay its compile+invert for a known outcome.
-            extra[f"invert_16384_{cfg}_error"] = "skipped: singular twin"
-            continue
-        try:
-            gf_16384, acc_16384 = _retry_transient(
-                lambda: _measure(16384, mm, r1=2, r2=5, generator="rand",
-                                 max_rel=None, refine=1, **kw))
-        except _Singular as ge:
-            extra[f"invert_16384_{cfg}_error"] = str(ge)[:200]
-            skip_grouped = bool(kw.get("group"))
-            continue
-        except Exception as ge:                 # noqa: BLE001
-            extra[f"invert_16384_{cfg}_error"] = str(ge)[:200]
-            continue
-        extra[f"invert_16384_f32_{cfg}_rand_gflops"] = round(gf_16384, 1)
-        extra["vs_baseline_16384"] = round(gf_16384 / baseline_gflops, 1)
-        for k, v in acc_16384.items():
+    gf16, acc16 = _capture_ladder(extra, 16384, tiers16, r1=2, r2=5,
+                                  baseline_gflops=baseline_gflops)
+    if acc16 is not None:
+        for k, v in acc16.items():
             extra[f"{k}_16384"] = v
-        break
 
     print(json.dumps({
         "metric": "invert_4096x4096_f32_gflops",

@@ -55,6 +55,14 @@ def _chunk_candidates(num_blocks: int, m: int,
     budget."""
     if budget is None:
         budget = _W_BUDGET      # resolved at call time (tests monkeypatch it)
+    if m < 128:
+        # Small-m kernels admit huge cg under the stack-only budget, but
+        # the per-step temporaries (~3-4 stack-sized values live at the
+        # rank-1 update) scale with cg too: measured on v5e, m=64 at
+        # cg=128 (4 MB stack) exceeds the 16 MB scoped-vmem limit by
+        # 4 MB.  Clamp the stack to 1 MB below m=128 (cg=32 at m=64),
+        # which keeps the temporaries inside the limit.
+        budget = min(budget, 1024 * 1024)
     per_cand = m * width_factor * m * 4
     cap = max(1, budget // per_cand)
     cg = min(num_blocks, cap)
