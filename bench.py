@@ -168,7 +168,7 @@ def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
     return 2.0 * n**3 / per_call / 1e9, acc
 
 
-def _capture_ladder(extra, n, tiers, r1, r2, baseline_gflops):
+def _capture_ladder(extra, n, tiers, r1, r2, baseline_gflops, vs_key):
     """Run a scale row's capture ladder: each tier retries once on the
     transient remote-compile failure class; a knife-edge _Singular in a
     grouped tier skips its bit-identical fori twin (a deterministic
@@ -191,7 +191,7 @@ def _capture_ladder(extra, n, tiers, r1, r2, baseline_gflops):
             extra[f"invert_{n}_{cfg}_error"] = str(ge)[:200]
             continue
         extra[f"invert_{n}_f32_{cfg}_rand_gflops"] = round(gf, 1)
-        extra[f"vs_baseline_{n}_scale"] = round(gf / baseline_gflops, 1)
+        extra[vs_key] = round(gf / baseline_gflops, 1)
         return gf, acc
     return None, None
 
@@ -227,8 +227,9 @@ def main():
         ("m128_grouped2", 128, dict(group=2)),
         ("m128_grouped2_fori", 128, dict(group=2, fori=True)),
     ]
-    gf8, acc8 = _capture_ladder(extra, 8192, tiers8, r1=3, r2=9,
-                                baseline_gflops=baseline_gflops)
+    _, acc8 = _capture_ladder(extra, 8192, tiers8, r1=3, r2=9,
+                                baseline_gflops=baseline_gflops,
+                                vs_key="vs_baseline_8192_grouped")
     if acc8 is not None:
         extra["rel_residual_8192_grouped"] = acc8["rel_residual"]
         extra["kappa_8192_grouped"] = acc8["kappa"]
@@ -250,8 +251,9 @@ def main():
         ("m128_grouped2_fori", 128, dict(group=2, fori=True)),
         ("m256_plain", 256, dict()),
     ]
-    gf16, acc16 = _capture_ladder(extra, 16384, tiers16, r1=2, r2=5,
-                                  baseline_gflops=baseline_gflops)
+    _, acc16 = _capture_ladder(extra, 16384, tiers16, r1=2, r2=5,
+                                  baseline_gflops=baseline_gflops,
+                                  vs_key="vs_baseline_16384")
     if acc16 is not None:
         for k, v in acc16.items():
             extra[f"{k}_16384"] = v

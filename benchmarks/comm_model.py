@@ -81,7 +81,8 @@ def _allreduce(S: float, a: int, chip: Chip) -> float:
 
 
 def predict(n: int, m: int, pr: int, pc: int, chip: Chip,
-            measured_single: float | None = None, group: int = 1):
+            measured_single: float | None = None, group: int = 1,
+            swapfree: bool = False):
     """Returns dict of phase seconds + efficiency for an (pr, pc) mesh
     (pc=1 -> the 1D row-cyclic engine).
 
@@ -100,6 +101,12 @@ def predict(n: int, m: int, pr: int, pc: int, chip: Chip,
         first order, ~half the per-step collective LATENCY rounds — the
         term that dominates the v5p projections.
     """
+    if swapfree and (pc > 1 or group > 1):
+        # Mirrors the product contract (driver.resolve_engine /
+        # make_distributed_backend): no 2D or grouped swap-free engine
+        # exists — a projection for one would silently charge the wrong
+        # collectives.
+        raise ValueError("swapfree models the 1D ungrouped engine only")
     Nr = -(-n // m)
     N = Nr * m
     P = pr * pc
@@ -133,7 +140,12 @@ def predict(n: int, m: int, pr: int, pc: int, chip: Chip,
         # collectives.
         comm += 3 * LATENCY                      # scalar pivot reduction
         comm += _allreduce(4 * m * m, P, chip)   # H
-        if k == 1:
+        if swapfree:
+            # The implicit-permutation engine: ONE pivot-row psum; the
+            # row_t broadcast does not exist (no swap).  The deferred
+            # price is the one-shot permutation below.
+            comm += _allreduce(4 * m * (N / pc), pr, chip)
+        elif k == 1:
             comm += 2 * _allreduce(4 * m * (N / pc), pr, chip)  # both rows
         else:
             # ONE stacked psum: both rows + their U rows + the t-block.
@@ -144,6 +156,18 @@ def predict(n: int, m: int, pr: int, pc: int, chip: Chip,
             if k == 1:
                 comm += _allreduce(4 * m * m, pc, chip)  # swap fix-up
             comm += 2 * _allreduce(4 * (N / pr) * m, pc, chip)  # unscramble
+    if swapfree:
+        # The deferred row permutation is modeled at ZERO comm because
+        # the product restricts the swap-free engine to gather=True
+        # (driver.check_gather_flags), where the permutation folds into
+        # the full gather that happens anyway (a reorder of the same
+        # bytes — no model charges the gather itself).  The honest
+        # sharded-output accounting — an all-gather-shaped reshuffle at
+        # ~N²·4·(P−1)/P per worker — would CANCEL the row_t saving,
+        # which is exactly why that mode is rejected (XLA exposes no
+        # ragged point-to-point reshuffle).  The full-window probe
+        # loses the shrinking window: +~2x probe launches, charged.
+        probe *= 2.0
     total = elim + probe + comm + glue
     out = {"elim": elim, "probe": probe, "comm": comm, "glue": glue,
            "total": total}
@@ -156,11 +180,13 @@ def predict(n: int, m: int, pr: int, pc: int, chip: Chip,
     return out
 
 
-def _fmt(n, m, pr, pc, chip, group=1):
-    r = predict(n, m, pr, pc, chip, group=group)
+def _fmt(n, m, pr, pc, chip, group=1, swapfree=False):
+    r = predict(n, m, pr, pc, chip, group=group, swapfree=swapfree)
     mesh = f"{pr}x{pc}" if pc > 1 else f"1D p={pr}"
     if group > 1:
         mesh += f" k={group}"
+    if swapfree:
+        mesh += " SF"
     gf = 2.0 * n**3 / r["total"] / 1e9
     return (f"| {chip.name} {mesh} | {n} | {m} | {r['elim']*1e3:8.1f} | "
             f"{r['probe']*1e3:8.1f} | {r['comm']*1e3:8.1f} | "
@@ -181,27 +207,30 @@ def main():
           "| GFLOP/s | par.eff |")
     print("|---|---|---|---|---|---|---|---|---|")
     rows = [
-        # v4-8 (4 chips) and v5e-8 class, 8192 (plain vs grouped).
-        (8192, 256, 8, 1, V5E, 1),
-        (8192, 256, 8, 1, V5E, 4),
-        (8192, 256, 2, 4, V5E, 1),
-        (8192, 256, 2, 4, V5E, 4),
-        (8192, 512, 4, 1, V4, 1),
-        (8192, 512, 2, 2, V4, 1),
+        # v4-8 (4 chips) and v5e-8 class, 8192 (plain vs grouped vs SF).
+        (8192, 256, 8, 1, V5E, 1, False),
+        (8192, 256, 8, 1, V5E, 4, False),
+        (8192, 256, 8, 1, V5E, 1, True),
+        (8192, 256, 2, 4, V5E, 1, False),
+        (8192, 256, 2, 4, V5E, 4, False),
+        (8192, 512, 4, 1, V4, 1, False),
+        (8192, 512, 2, 2, V4, 1, False),
         # v5p-32, 32768 (the 2D north star; 1D shown for contrast).
-        (32768, 512, 32, 1, V5P, 1),
-        (32768, 512, 32, 1, V5P, 4),
-        (32768, 512, 4, 8, V5P, 1),
-        (32768, 512, 4, 8, V5P, 4),
-        (32768, 256, 4, 8, V5P, 4),
+        (32768, 512, 32, 1, V5P, 1, False),
+        (32768, 512, 32, 1, V5P, 4, False),
+        (32768, 512, 32, 1, V5P, 1, True),
+        (32768, 512, 4, 8, V5P, 1, False),
+        (32768, 512, 4, 8, V5P, 4, False),
+        (32768, 256, 4, 8, V5P, 4, False),
         # v5p-64, 65536.
-        (65536, 512, 64, 1, V5P, 1),
-        (65536, 512, 8, 8, V5P, 1),
-        (65536, 512, 8, 8, V5P, 4),
-        (65536, 256, 8, 8, V5P, 4),
+        (65536, 512, 64, 1, V5P, 1, False),
+        (65536, 512, 64, 1, V5P, 1, True),
+        (65536, 512, 8, 8, V5P, 1, False),
+        (65536, 512, 8, 8, V5P, 4, False),
+        (65536, 256, 8, 8, V5P, 4, False),
     ]
-    for n, m, pr, pc, chip, g in rows:
-        print(_fmt(n, m, pr, pc, chip, g))
+    for n, m, pr, pc, chip, g, sf in rows:
+        print(_fmt(n, m, pr, pc, chip, g, sf))
 
 
 if __name__ == "__main__":

@@ -189,6 +189,63 @@ class TestShardedGrouped:
         assert res < 1e-7
 
 
+class TestSwapFree:
+    """The swap-free (implicit-permutation) 1D engine: half the per-step
+    collective row bytes, one point-to-point row permutation at the end
+    — bit-identical to the swap engines, ties included (the pivot tie
+    rule keys on the swap COORDINATE, reproducing main.cpp:1051-1064)."""
+
+    @pytest.mark.parametrize("n,m,p", [(64, 8, 4), (128, 16, 8),
+                                       (100, 8, 8), (96, 8, 4)])
+    def test_bitmatches_swap_engine(self, rng, n, m, p):
+        mesh = make_mesh(p)
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float64)
+        x_sf, s_sf = sharded_jordan_invert_inplace(a, mesh, m,
+                                                   swapfree=True)
+        x_sw, s_sw = sharded_jordan_invert_inplace(a, mesh, m)
+        assert bool(s_sf) == bool(s_sw) is False
+        assert bool(jnp.all(x_sf == x_sw)), "swap-free engine diverged"
+
+    def test_tied_pivots_bitmatch(self, mesh4):
+        # |i-j|: exact ties + repeated swaps — the swap-coordinate tie
+        # rule must reproduce the swap engines' choices exactly.
+        a = generate("absdiff", (96, 96), jnp.float64)
+        x_sf, s_sf = sharded_jordan_invert_inplace(a, mesh4, 8,
+                                                   swapfree=True)
+        x_sw, s_sw = sharded_jordan_invert_inplace(a, mesh4, 8)
+        assert bool(s_sf) == bool(s_sw) is False
+        assert bool(jnp.all(x_sf == x_sw))
+
+    def test_singular_collective_agreement(self, mesh8):
+        _, sing = sharded_jordan_invert_inplace(
+            jnp.ones((64, 64), jnp.float64), mesh8, 8, swapfree=True)
+        assert bool(sing)
+
+    def test_solve_engine_swapfree(self):
+        from tpu_jordan.driver import solve
+
+        r = solve(96, 8, workers=4, dtype=jnp.float64, engine="swapfree")
+        assert r.residual < 1e-9 * 96 * 95
+        assert r.kappa is not None
+
+    def test_swapfree_usage_errors(self):
+        from tpu_jordan.driver import UsageError, solve
+        from tpu_jordan.models import JordanSolver
+
+        with pytest.raises(UsageError):
+            solve(64, 8, engine="swapfree")          # single device
+        with pytest.raises(UsageError):
+            solve(64, 8, workers=(2, 2), engine="swapfree")  # 2D
+        with pytest.raises(UsageError):
+            solve(64, 8, workers=4, engine="swapfree", group=2)
+        with pytest.raises(UsageError):
+            # gather=False: the sharded-output reshuffle is comm-neutral
+            # and transiently unsharded — rejected (PHASES.md round 5).
+            solve(64, 8, workers=4, engine="swapfree", gather=False)
+        with pytest.raises(UsageError):
+            JordanSolver(64, 8, engine="swapfree")   # single device
+
+
 class TestDriverEngineSelection:
     def test_inplace_is_default_1d_engine(self):
         from tpu_jordan.driver import _Dist1D

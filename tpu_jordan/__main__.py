@@ -49,14 +49,18 @@ def main(argv=None) -> int:
     ap.add_argument("--refine", type=int, default=0,
                     help="Newton-Schulz refinement steps")
     ap.add_argument("--engine", default="auto",
-                    choices=["auto", "inplace", "grouped", "augmented"],
+                    choices=["auto", "inplace", "grouped", "augmented",
+                             "swapfree"],
                     help="elimination engine: 'auto' = the conservative "
                          "in-place 2N^3 default; 'grouped' = delayed "
                          "group updates, the measured winner for "
                          "well-conditioned matrices at n >= 8192 with "
                          "m=128 (driver.resolve_engine documents the "
                          "measured dispatch policy); 'augmented' = the "
-                         "4N^3 reference-parity path")
+                         "4N^3 reference-parity path; 'swapfree' = the "
+                         "implicit-permutation distributed engine (half "
+                         "the per-step collective row bytes — the "
+                         "pod-scale comm design; 1D --workers only)")
     ap.add_argument("--group", type=int, default=0,
                     help="panels per delayed-group update (implies "
                          "--engine grouped when > 1; grouped default 2)")

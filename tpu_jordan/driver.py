@@ -70,7 +70,7 @@ class SolveResult:
     _norm_a: float | None = None             # ‖A‖∞, backing rel_residual
 
 
-ENGINES = ("auto", "inplace", "grouped", "augmented")
+ENGINES = ("auto", "inplace", "grouped", "augmented", "swapfree")
 
 
 def resolve_engine(engine: str, group: int):
@@ -107,6 +107,8 @@ def resolve_engine(engine: str, group: int):
     if group > 1 and engine == "augmented":
         raise UsageError("the augmented reference-parity engine has no "
                          "grouped variant")
+    if group > 1 and engine == "swapfree":
+        raise UsageError("the swap-free engine has no grouped variant")
     if engine == "grouped":
         return "grouped", (group if group > 1 else 2)
     if engine == "auto" and group > 1:
@@ -173,7 +175,7 @@ def solve(
     if isinstance(workers, tuple) or workers > 1:
         from .ops.refine import resolve_precision
 
-        check_gather_flags(gather, refine, precision)
+        check_gather_flags(gather, refine, precision, engine)
         sweep_prec, refine = resolve_precision(prec, refine)
         be = make_distributed_backend(workers, n, block_size, engine, group)
         return _solve_distributed_core(
@@ -181,6 +183,9 @@ def solve(
             gather, load, sweep_prec,
         )
 
+    if engine == "swapfree":
+        raise UsageError("engine='swapfree' is a distributed engine "
+                         "(its win is collective bytes); use workers=p")
     if not gather:
         raise UsageError(
             "gather=False is only supported on distributed paths "
@@ -333,13 +338,28 @@ def make_distributed_backend(workers, n: int, block_size: int,
           else _Dist1D(workers, n, m))
     be.inplace = engine != "augmented"
     be.group = group
+    be.swapfree = engine == "swapfree"
+    if be.swapfree and isinstance(workers, tuple):
+        raise UsageError("engine='swapfree' runs on the 1D layout "
+                         "(workers=p); the 2D twin is future work")
     return be
 
 
-def check_gather_flags(gather: bool, refine: int, precision: str = "highest"):
+def check_gather_flags(gather: bool, refine: int, precision: str = "highest",
+                       engine: str = "auto"):
     """Flag-compatibility contract for distributed solves, shared by
     ``solve`` and ``JordanSolver``: refinement (and the 'mixed' policy
-    that implies it) runs on the gathered inverse."""
+    that implies it) runs on the gathered inverse; the swap-free
+    engine's deferred row permutation makes its sharded-output mode
+    comm-neutral and transiently unsharded, so it requires
+    gather=True (where the permutation folds into the full gather and
+    the row_t saving is pure — see _step_swapfree)."""
+    if engine == "swapfree" and not gather:
+        raise UsageError(
+            "engine='swapfree' requires gather=True: its deferred row "
+            "permutation is only free when the inverse is gathered "
+            "anyway (the sharded-output twin needs a ragged "
+            "point-to-point reshuffle XLA does not expose)")
     if precision == "mixed" and not gather:
         raise UsageError(
             "precision='mixed' requires gather=True: it implies >=2 "
@@ -426,6 +446,7 @@ class _Dist1D:
         self.lay = CyclicLayout.create(n, m, workers)
         self.inplace = True
         self.group = 0
+        self.swapfree = False
 
     def generate_W(self, generator, dtype):
         from .parallel import sharded_generate
@@ -450,7 +471,8 @@ class _Dist1D:
 
             return compile_sharded_jordan_inplace(W, self.mesh, self.lay,
                                                   precision=precision,
-                                                  group=self.group)
+                                                  group=self.group,
+                                                  swapfree=self.swapfree)
         from .parallel.sharded_jordan import compile_sharded_jordan
 
         return compile_sharded_jordan(W, self.mesh, self.lay,

@@ -75,7 +75,8 @@ class JordanSolver:
             # refine, exactly like solve does.
             from ..driver import check_gather_flags, make_distributed_backend
 
-            check_gather_flags(self.gather, self.refine, self.precision)
+            check_gather_flags(self.gather, self.refine, self.precision,
+                               self.engine)
             self._be = make_distributed_backend(
                 self.workers, self.n, self.block_size, self.engine,
                 self.group)
@@ -83,6 +84,11 @@ class JordanSolver:
             from ..driver import UsageError
 
             raise UsageError("gather=False requires a distributed mesh")
+        elif self.engine == "swapfree":
+            from ..driver import UsageError
+
+            raise UsageError("engine='swapfree' is a distributed engine "
+                             "(its win is collective bytes); use workers=p")
         # Resolve the precision policy once: "mixed" implies HIGH sweeps
         # and bumps refine to the policy minimum.
         self._sweep_prec, self.refine = resolve_precision(

@@ -219,6 +219,180 @@ def _step_fori(t, Wloc, singular, swaps, *, lay: CyclicLayout, eps,
     return Wloc, singular, swaps.at[t].set(g_piv.astype(jnp.int32))
 
 
+def _step_swapfree(t, Wloc, alive, singular, pos, ipos, swaps, *,
+                   lay: CyclicLayout, eps, precision, use_pallas: bool):
+    """One super-step of the SWAP-FREE engine on one worker's
+    (bpw, m, N) shard: rows never move — the pivot permutation is
+    tracked implicitly — so the ``row t`` broadcast of the swap-by-copy
+    engines (main.cpp:1122-1129's exchange) DOES NOT EXIST.  Per step
+    the collective bill is ONE (m, N) pivot-row psum + the pivot
+    reduction: HALF the row-broadcast bytes of ``_step_fori``, which is
+    the term benchmarks/comm_model.py says dominates every projected
+    north-star mesh (e.g. v5p 1D p=32 @ 32768: 94 ms of 138 is comm,
+    all of it row psums).  The deferred price is ONE cross-worker row
+    permutation after the loop — point-to-point resharding bytes
+    (N²·4/p per worker), ~p× cheaper per link than the Nr allreduced
+    row_t broadcasts it replaces.
+
+    Pivot PARITY is exact, ties included: the live candidate set equals
+    the swap engines' shrinking window (same values — eliminations are
+    position-independent), and ties resolve by the pivot's SWAP
+    COORDINATE (``pos``, the position the row would occupy in the
+    swap-by-copy engine), reproducing the reference's
+    lowest-current-row rule (main.cpp:1051-1064) — so results bit-match
+    the swap engines after the final permutation, pinned by tests.
+
+    Carries beyond the swap engines: ``alive`` (bpw,) per-worker live
+    mask; ``pos``/``ipos`` (Nr,) replicated permutation bookkeeping
+    (pos[x] = swap coordinate of physical row x, ipos = inverse);
+    ``swaps`` records the swap-coordinate pivot sequence, feeding the
+    same composed column unscramble as every in-place engine.
+    """
+    p, m, bpw, N = lay.p, lay.m, lay.blocks_per_worker, lay.N
+    k = lax.axis_index(AXIS)
+    dtype = Wloc.dtype
+    z = jnp.int32(0)
+    t = jnp.asarray(t, jnp.int32)
+    gidx = jnp.arange(bpw) * p + k              # global block row per slot
+
+    # --- PIVOT PROBE: the full slot window, validity from the alive
+    # mask (dead physical rows are scattered, so no static shrink or
+    # quarter ladder applies — the structural trade of this engine).
+    cands = lax.dynamic_slice(Wloc, (z, z, t * m), (bpw, m, m))
+    invs, sing = probe_blocks(cands, eps, use_pallas)
+    valid = alive & ~sing
+    norms = block_inf_norms(invs)
+    key = jnp.where(valid, norms, jnp.asarray(jnp.inf, norms.dtype))
+    # Local then global argmin, ties by SWAP COORDINATE (see docstring).
+    posl = jnp.take(pos, gidx)                  # (bpw,) swap coords
+    lmin = jnp.min(key)
+    slot_best = jnp.argmin(jnp.where(key == lmin, posl, lay.Nr))
+    my_key = lmin
+    my_pos = posl[slot_best]
+
+    kmin = lax.pmin(my_key, AXIS)
+    finite = jnp.isfinite(kmin)
+    win_pos = lax.pmin(jnp.where(my_key == kmin, my_pos, lay.Nr), AXIS)
+    singular = singular | ~finite
+    i_won = (my_key == kmin) & (my_pos == win_pos) & finite
+    g_piv = lax.psum(jnp.where(i_won, gidx[slot_best], 0), AXIS)
+    # All-singular pin: the physical row at swap position t (the swap
+    # engines' benign self-swap target), H := 0 — deterministic.
+    g_piv = jnp.where(finite, g_piv, ipos[t])
+    H = lax.psum(
+        jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0).astype(dtype),
+        AXIS,
+    )
+
+    # --- THE one row broadcast (m, N): the pivot's physical row.
+    safe_best = jnp.where(i_won, slot_best, 0)
+    row_piv = lax.psum(
+        jnp.where(i_won, lax.dynamic_index_in_dim(Wloc, safe_best, 0, False),
+                  0.0),
+        AXIS,
+    )                                           # (m, N)
+
+    # --- NORMALIZE; the t-chunk becomes H.
+    prow = jnp.matmul(H, row_piv, precision=precision)      # (m, N)
+    prow = lax.dynamic_update_slice(prow, H, (z, t * m))
+
+    # --- ELIMINATE every row except the pivot's PHYSICAL row (which
+    # receives prow — rows stay put).
+    E = lax.dynamic_slice(Wloc, (z, z, t * m), (bpw, m, m))
+    E = jnp.where((gidx == g_piv)[:, None, None], jnp.asarray(0, dtype), E)
+    Wloc = lax.dynamic_update_slice(
+        Wloc, jnp.zeros((bpw, m, m), dtype), (z, z, t * m))
+    update = jnp.matmul(E.reshape(bpw * m, m), prow, precision=precision)
+    Wloc = Wloc - update.reshape(bpw, m, N)
+    own_piv = k == (g_piv % p)
+    slot_piv = jnp.where(own_piv, g_piv // p, 0)
+    cur = lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False)
+    Wloc = lax.dynamic_update_index_in_dim(
+        Wloc, jnp.where(own_piv, prow, cur), slot_piv, 0)
+
+    # --- BOOKKEEPING: retire the pivot's physical row; replay what the
+    # swap engine would have done to positions t <-> pos[g_piv] on the
+    # replicated permutation carries (O(1) scalar work; int32 throughout
+    # — x64 would promote the psum'd g_piv).
+    alive = alive & (gidx != g_piv)
+    g32 = g_piv.astype(jnp.int32)
+    piv_pos = pos[g32]
+    x = ipos[t]                                 # content at swap pos t
+    pos = pos.at[x].set(piv_pos).at[g32].set(t)
+    ipos = ipos.at[t].set(g32).at[piv_pos].set(x)
+    swaps = swaps.at[t].set(piv_pos)
+    return Wloc, alive, singular, pos, ipos, swaps
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas"))
+def _sharded_jordan_inplace_swapfree(W, mesh, lay: CyclicLayout, eps,
+                                     precision, use_pallas):
+    """The swap-free 1D engine (fori_loop; any Nr): half the per-step
+    collective row bytes of the swap engines, one point-to-point row
+    permutation at the end.  Bit-matches the swap engines (after the
+    permutation) — same pivot rule including ties.  Output contract is
+    identical: (inverse blocks in cyclic NATURAL row order, singular
+    per worker)."""
+    def worker(Wloc):
+        def body(t, carry):
+            Wl, alive, sing, pos, ipos, swaps = carry
+            return _step_swapfree(t, Wl, alive, sing, pos, ipos, swaps,
+                                  lay=lay, eps=eps, precision=precision,
+                                  use_pallas=use_pallas)
+
+        bpw = lay.blocks_per_worker
+        vary = lambda v: lax.pcast(v, AXIS, to='varying')  # noqa: E731
+        alive0 = vary(jnp.ones((bpw,), bool))
+        sing0 = vary(jnp.asarray(False))
+        pos0 = vary(jnp.arange(lay.Nr, dtype=jnp.int32))
+        ipos0 = vary(jnp.arange(lay.Nr, dtype=jnp.int32))
+        swaps0 = vary(jnp.zeros((lay.Nr,), jnp.int32))
+        Wloc, alive, singular, pos, ipos, swaps = lax.fori_loop(
+            0, lay.Nr, body, (Wloc, alive0, sing0, pos0, ipos0, swaps0))
+
+        from ..ops.jordan_inplace import apply_col_perm, compose_swap_perm
+
+        Wloc = apply_col_perm(Wloc, compose_swap_perm(swaps, lay.Nr),
+                              lay.m)
+        return Wloc, singular[None], ipos[None]
+
+    blocks, singular, ipos_all = shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=PartitionSpec(AXIS, None, None),
+        out_specs=(PartitionSpec(AXIS, None, None), PartitionSpec(AXIS),
+                   PartitionSpec(AXIS, None)),
+    )(W)
+
+    # --- THE deferred row permutation: storage slot s holds physical
+    # global row order[s]; natural row g lives at physical row ipos[g].
+    # The data-dependent jnp.take over the sharded axis makes XLA
+    # all-gather the operand and gather locally; the sharding
+    # constraint keeps the OUTPUT on the same (AXIS, None, None)
+    # layout as every other engine (without it the result silently
+    # replicates — the gather=False memory contract would be broken).
+    # Accounting (benchmarks/comm_model.py, honest): this costs
+    # ~N²·4·(p-1)/p wire bytes per worker — about what the Nr saved
+    # row_t broadcasts cost — plus a TRANSIENT full-N² per-worker
+    # buffer, so for sharded output the engine is comm-neutral; its
+    # real win is gather=True, where the permutation folds into the
+    # full gather that happens anyway and the row_t saving is pure
+    # (driver.check_gather_flags restricts it accordingly).
+    from jax.sharding import NamedSharding
+
+    from .layout import cyclic_gather_perm, cyclic_scatter_perm
+
+    ipos = ipos_all[0]                          # replicated; any row
+    order = cyclic_gather_perm(lay)             # slot -> global block
+    scatter = cyclic_scatter_perm(lay)          # global block -> slot
+    idx = jnp.take(scatter, jnp.take(ipos, order))
+    out = jnp.take(blocks, idx, axis=0)
+    out = jax.lax.with_sharding_constraint(
+        out, NamedSharding(mesh, PartitionSpec(AXIS, None, None)))
+    return out, singular
+
+
 def _gstep(t, j: int, Wloc, Uloc, P, singular, *, lay: CyclicLayout, eps,
            precision, use_pallas: bool):
     """One inner step of a delayed-group-update group on one worker's
@@ -562,6 +736,7 @@ def compile_sharded_jordan_inplace(
     use_pallas: bool | None = None,
     unroll: bool | None = None,
     group: int = 0,
+    swapfree: bool = False,
 ):
     """AOT-compile the in-place sharded elimination for a (Nr, m, N)
     identity-padded cyclic block tensor.  ``run(blocks) ->
@@ -573,7 +748,11 @@ def compile_sharded_jordan_inplace(
     identical results either way.  ``group=k > 1`` takes the delayed-
     group-update engines instead (one fat trailing matmul and one
     stacked row psum per step — the measured single-chip winner at
-    large n, ported; parity with the plain engines is to rounding)."""
+    large n, ported; parity with the plain engines is to rounding).
+    ``swapfree=True`` takes the implicit-permutation engine instead:
+    half the per-step collective row bytes, one point-to-point row
+    permutation at the end — the pod-scale comm design
+    (benchmarks/comm_model.py); bit-identical results."""
     from .sharded_jordan import resolve_use_pallas
 
     if eps is None:
@@ -582,6 +761,10 @@ def compile_sharded_jordan_inplace(
         use_pallas = resolve_use_pallas(blocks.dtype, lay.m)
     if unroll is None:
         unroll = lay.Nr <= MAX_UNROLL_NR
+    if swapfree:
+        return _sharded_jordan_inplace_swapfree.lower(
+            blocks, mesh, lay, eps, precision, use_pallas
+        ).compile()
     if group and group > 1:
         engine = (_sharded_jordan_inplace_grouped if unroll
                   else _sharded_jordan_inplace_grouped_fori)
@@ -638,6 +821,7 @@ def sharded_jordan_invert_inplace(
     use_pallas: bool | None = None,
     unroll: bool | None = None,
     group: int = 0,
+    swapfree: bool = False,
 ):
     """Invert (n, n) ``a`` over the 1D mesh with the in-place engine.
 
@@ -654,6 +838,6 @@ def sharded_jordan_invert_inplace(
     lay = CyclicLayout.create(n, min(block_size, n), mesh.devices.size)
     blocks = _to_identity_padded_blocks(a, lay, mesh)
     run = compile_sharded_jordan_inplace(blocks, mesh, lay, eps, precision,
-                                         use_pallas, unroll, group)
+                                         use_pallas, unroll, group, swapfree)
     out, singular = run(blocks)
     return gather_inverse_inplace(out, lay, n), singular.any()
