@@ -101,12 +101,10 @@ def predict(n: int, m: int, pr: int, pc: int, chip: Chip,
         first order, ~half the per-step collective LATENCY rounds — the
         term that dominates the v5p projections.
     """
-    if swapfree and (pc > 1 or group > 1):
-        # Mirrors the product contract (driver.resolve_engine /
-        # make_distributed_backend): no 2D or grouped swap-free engine
-        # exists — a projection for one would silently charge the wrong
-        # collectives.
-        raise ValueError("swapfree models the 1D ungrouped engine only")
+    if swapfree and group > 1:
+        # Mirrors the product contract (driver.resolve_engine): no
+        # grouped swap-free engine exists.
+        raise ValueError("swapfree has no grouped variant")
     Nr = -(-n // m)
     N = Nr * m
     P = pr * pc
@@ -153,9 +151,12 @@ def predict(n: int, m: int, pr: int, pc: int, chip: Chip,
                 4 * 2 * m * ((N / pc) + k * m + m), pr, chip)
         if pc > 1:
             comm += _allreduce(4 * (N / pr) * m, pc, chip)  # chunk/E panel
-            if k == 1:
+            if k == 1 and not swapfree:
                 comm += _allreduce(4 * m * m, pc, chip)  # swap fix-up
-            comm += 2 * _allreduce(4 * (N / pr) * m, pc, chip)  # unscramble
+            if not swapfree:
+                # Per-step psum unscramble — the swap-free 2D engine
+                # deletes it (rows+columns repaired in the gather fold).
+                comm += 2 * _allreduce(4 * (N / pr) * m, pc, chip)
     if swapfree:
         # The deferred row permutation is modeled at ZERO comm because
         # the product restricts the swap-free engine to gather=True
@@ -222,10 +223,12 @@ def main():
         (32768, 512, 4, 8, V5P, 1, False),
         (32768, 512, 4, 8, V5P, 4, False),
         (32768, 256, 4, 8, V5P, 4, False),
+        (32768, 512, 4, 8, V5P, 1, True),
         # v5p-64, 65536.
         (65536, 512, 64, 1, V5P, 1, False),
         (65536, 512, 64, 1, V5P, 1, True),
         (65536, 512, 8, 8, V5P, 1, False),
+        (65536, 512, 8, 8, V5P, 1, True),
         (65536, 512, 8, 8, V5P, 4, False),
         (65536, 256, 8, 8, V5P, 4, False),
     ]

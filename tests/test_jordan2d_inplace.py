@@ -265,3 +265,47 @@ class TestColumnParallelProbe:
                     # the layout adds stays < t only if i < t // stride.
                     assert i < t // stride, (w, stride, t, i)
                     assert i * stride + (stride - 1) < t, (w, stride, t, i)
+
+
+class TestSwapFree2D:
+    """The swap-free 2D engine (round 5): no row_t psum, no swap
+    fix-up, no per-step psum unscramble — bit-identical to the swap
+    engines, ties included."""
+
+    @pytest.mark.parametrize("shape,n,m", [((2, 4), 96, 8),
+                                           ((4, 2), 64, 8),
+                                           ((2, 2), 100, 8),
+                                           ((2, 4), 256, 8)])  # ladder size
+    def test_bitmatches_swap_engine(self, rng, shape, n, m):
+        mesh = make_mesh_2d(*shape)
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float64)
+        x_sf, s_sf = sharded_jordan_invert_inplace_2d(a, mesh, m,
+                                                      swapfree=True)
+        x_sw, s_sw = sharded_jordan_invert_inplace_2d(a, mesh, m)
+        assert bool(s_sf) == bool(s_sw) is False
+        assert bool(jnp.all(x_sf == x_sw)), "2D swap-free diverged"
+
+    def test_tied_pivots_bitmatch(self):
+        mesh = make_mesh_2d(2, 4)
+        a = generate("absdiff", (96, 96), jnp.float64)
+        x_sf, s_sf = sharded_jordan_invert_inplace_2d(a, mesh, 8,
+                                                      swapfree=True)
+        x_sw, s_sw = sharded_jordan_invert_inplace_2d(a, mesh, 8)
+        assert bool(s_sf) == bool(s_sw) is False
+        assert bool(jnp.all(x_sf == x_sw))
+
+    def test_singular_collective_agreement(self):
+        mesh = make_mesh_2d(2, 4)
+        _, sing = sharded_jordan_invert_inplace_2d(
+            jnp.ones((64, 64), jnp.float64), mesh, 8, swapfree=True)
+        assert bool(sing)
+
+    def test_solve_engine_swapfree_2d(self):
+        from tpu_jordan.driver import UsageError, solve
+
+        r = solve(96, 8, workers=(2, 4), dtype=jnp.float64,
+                  engine="swapfree")
+        assert r.residual < 1e-9 * 96 * 95
+        assert r.kappa is not None
+        with pytest.raises(UsageError):
+            solve(96, 8, workers=(2, 4), engine="swapfree", gather=False)

@@ -235,8 +235,6 @@ class TestSwapFree:
         with pytest.raises(UsageError):
             solve(64, 8, engine="swapfree")          # single device
         with pytest.raises(UsageError):
-            solve(64, 8, workers=(2, 2), engine="swapfree")  # 2D
-        with pytest.raises(UsageError):
             solve(64, 8, workers=4, engine="swapfree", group=2)
         with pytest.raises(UsageError):
             # gather=False: the sharded-output reshuffle is comm-neutral

@@ -58,9 +58,10 @@ def main(argv=None) -> int:
                          "m=128 (driver.resolve_engine documents the "
                          "measured dispatch policy); 'augmented' = the "
                          "4N^3 reference-parity path; 'swapfree' = the "
-                         "implicit-permutation distributed engine (half "
-                         "the per-step collective row bytes — the "
-                         "pod-scale comm design; 1D --workers only)")
+                         "implicit-permutation distributed engine (no "
+                         "row-swap broadcast, no per-step 2D unscramble "
+                         "— the pod-scale comm design; distributed, "
+                         "gathered output only)")
     ap.add_argument("--group", type=int, default=0,
                     help="panels per delayed-group update (implies "
                          "--engine grouped when > 1; grouped default 2)")

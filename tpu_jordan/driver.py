@@ -154,9 +154,10 @@ def solve(
     benchmarks/PHASES.md for the measured accuracy ladder).
 
     ``engine``/``group`` select the elimination engine (resolve_engine:
-    "auto" | "inplace" | "grouped" | "augmented"; the measured dispatch
-    policy lives in its docstring).  Engines differ in speed and
-    summation order only — same pivot rule, same results to rounding.
+    "auto" | "inplace" | "grouped" | "augmented" | "swapfree"; the
+    measured dispatch policy lives in its docstring).  Engines differ
+    in speed and summation order only — same pivot rule, same results
+    to rounding.
 
     Raises SingularMatrixError like the reference's -2 path
     (main.cpp:435-437); file errors propagate from read_matrix_file.
@@ -339,9 +340,6 @@ def make_distributed_backend(workers, n: int, block_size: int,
     be.inplace = engine != "augmented"
     be.group = group
     be.swapfree = engine == "swapfree"
-    if be.swapfree and isinstance(workers, tuple):
-        raise UsageError("engine='swapfree' runs on the 1D layout "
-                         "(workers=p); the 2D twin is future work")
     return be
 
 
@@ -551,6 +549,7 @@ class _Dist2D:
         self.lay = CyclicLayout2D.create(n, m, pr, pc)
         self.inplace = True
         self.group = 0
+        self.swapfree = False
 
     def generate_W(self, generator, dtype):
         from .parallel.jordan2d import sharded_generate_2d
@@ -575,7 +574,8 @@ class _Dist2D:
 
             return compile_sharded_jordan_inplace_2d(W, self.mesh, self.lay,
                                                      precision=precision,
-                                                     group=self.group)
+                                                     group=self.group,
+                                                     swapfree=self.swapfree)
         from .parallel.jordan2d import compile_sharded_jordan_2d
 
         return compile_sharded_jordan_2d(W, self.mesh, self.lay,

@@ -45,9 +45,10 @@ class JordanSolver:
       gather: distributed only — False returns the inverse as sharded
         cyclic blocks instead of one gathered n×n array.
       engine/group: elimination engine selection (driver.resolve_engine:
-        "auto" | "inplace" | "grouped" | "augmented"; its docstring
-        carries the measured dispatch policy — grouped m=128 k=2 wins
-        for well-conditioned matrices at n >= 8192).
+        "auto" | "inplace" | "grouped" | "augmented" | "swapfree"; its
+        docstring carries the measured dispatch policy — grouped m=128
+        k=2 wins for well-conditioned matrices at n >= 8192; swapfree
+        is the distributed gather=True comm design).
     """
 
     n: int

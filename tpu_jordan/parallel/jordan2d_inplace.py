@@ -85,16 +85,20 @@ def resolve_probe_layout(probe_layout: str, mesh: Mesh | None = None) -> bool:
 
 def _probe_candidates(chunk_all, tt, *, lay: CyclicLayout2D, eps,
                       use_pallas: bool, probe_cols: bool,
-                      static_s0: int | None):
+                      static_s0: int | None, full_window: bool = False):
     """The 2D pivot probe under either layout.
 
     Returns ``(invs, sing, idx)`` where ``idx`` are the local slots of
     ``chunk_all`` THIS worker probed (clipped; callers mask
     ``idx < bpr``).  ``static_s0`` is the unrolled engines' static live
     window start (t // pr), or None for the traced engines (full window
-    + the half cut).  With ``probe_cols=False`` non-owner mesh columns
-    skip the batched inverse entirely (identity blocks flagged
-    singular, masked out by the caller's validity test)."""
+    + the quarter ladder).  ``full_window=True`` disables the ladder —
+    required by the SWAP-FREE engine, whose dead rows are scattered (the
+    ladder's "slots below t//stride are dead" invariant only holds when
+    rows are physically swapped into place).  With ``probe_cols=False``
+    non-owner mesh columns skip the batched inverse entirely (identity
+    blocks flagged singular, masked out by the caller's validity
+    test)."""
     pr, pc, m, bpr = lay.pr, lay.pc, lay.m, lay.bpr
     kc = lax.axis_index(AXIS_C)
     if probe_cols:
@@ -109,8 +113,11 @@ def _probe_candidates(chunk_all, tt, *, lay: CyclicLayout2D, eps,
             wnd = -(-bpr // pc)
             idx = kc + jnp.arange(wnd) * pc
             cands = jnp.take(chunk_all, jnp.clip(idx, 0, bpr - 1), axis=0)
-            invs, sing = probe_blocks_quarter_masked(
-                cands, tt, pc * pr, eps, use_pallas)
+            if full_window:
+                invs, sing = probe_blocks(cands, eps, use_pallas)
+            else:
+                invs, sing = probe_blocks_quarter_masked(
+                    cands, tt, pc * pr, eps, use_pallas)
         return invs, sing, idx
 
     own_c = kc == (tt % pc)
@@ -120,6 +127,10 @@ def _probe_candidates(chunk_all, tt, *, lay: CyclicLayout2D, eps,
     if static_s0 is not None:
         idx = static_s0 + jnp.arange(bpr - static_s0) + 0 * kc
         cands = chunk_all[static_s0:]
+        probe = partial(probe_blocks, eps=eps, use_pallas=use_pallas)
+    elif full_window:
+        idx = jnp.arange(bpr) + 0 * kc
+        cands = chunk_all
         probe = partial(probe_blocks, eps=eps, use_pallas=use_pallas)
     else:
         from ..ops.block_inverse import probe_blocks_quarter_masked
@@ -249,6 +260,173 @@ def _step2d(t: int, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
     # row-granular select.
     Wloc = Wloc.at[slot_t].set(jnp.where(own_t, prow, Wloc[slot_t]))
     return Wloc, singular, g_piv
+
+
+def _step2d_swapfree(t, Wloc, alive, singular, pos, ipos, swaps, *,
+                     lay: CyclicLayout2D, eps, precision,
+                     use_pallas: bool, probe_cols: bool):
+    """One super-step of the SWAP-FREE engine on one worker's
+    (bpr, m, Wc) 2D shard — the 2D twin of
+    sharded_inplace.py::_step_swapfree.
+
+    Deleted relative to ``_step2d_fori``: the row_t broadcast along
+    "pr", the (m, m) swap fix-up psum along "pc", AND the entire
+    per-step psum unscramble after the loop (2 x (bpr, m, m) along "pc"
+    per step) — rows and columns are repaired once, folded into the
+    full gather (the engine is gather=True-only, like its 1D twin).
+    Per step the collective bill is: chunk psum along "pc" (still
+    needed — candidates and multipliers), the pivot reduction, and ONE
+    (m, Wc) pivot-row psum along "pr".  Pivot parity is exact, ties
+    included (swap-coordinate tie rule via the replicated pos carry).
+    """
+    pr, pc, m, bpr = lay.pr, lay.pc, lay.m, lay.bpr
+    kr = lax.axis_index(AXIS_R)
+    kc = lax.axis_index(AXIS_C)
+    dtype = Wloc.dtype
+    z = jnp.int32(0)
+    tt = jnp.asarray(t, jnp.int32)
+    u_t = tt // pc
+    own_c = kc == (tt % pc)
+
+    # --- CHUNK BROADCAST along "pc": candidates + multipliers.
+    chunk = lax.dynamic_slice(Wloc, (z, z, u_t * m), (bpr, m, m))
+    chunk_all = lax.psum(
+        jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
+
+    # --- PROBE over all slots (alive-masked; the scattered dead rows
+    # admit no static shrink), under either probe layout.
+    invs, sing, idx = _probe_candidates(
+        chunk_all, tt, lay=lay, eps=eps, use_pallas=use_pallas,
+        probe_cols=probe_cols, static_s0=None, full_window=True)
+    safe_idx = jnp.clip(idx, 0, bpr - 1)
+    gidx = idx * pr + kr
+    alive_i = jnp.take(alive, safe_idx)
+    valid = (idx < bpr) & alive_i & ~sing
+    norms = block_inf_norms(invs)
+    key = jnp.where(valid, norms, jnp.asarray(jnp.inf, norms.dtype))
+    posg = jnp.take(pos, jnp.clip(gidx, 0, lay.Nr - 1))
+    lmin = jnp.min(key)
+    slot_best = jnp.argmin(jnp.where(key == lmin, posg, lay.Nr))
+    my_key = lmin
+    my_pos = posg[slot_best]
+
+    # --- PIVOT REDUCTION over the whole mesh, ties by swap coordinate.
+    kmin = lax.pmin(my_key, BOTH)
+    finite = jnp.isfinite(kmin)
+    win_pos = lax.pmin(jnp.where(my_key == kmin, my_pos, lay.Nr), BOTH)
+    singular = singular | ~finite
+    i_won = (my_key == kmin) & (my_pos == win_pos) & finite
+    g_piv = lax.psum(jnp.where(i_won, gidx[slot_best], 0), BOTH)
+    g_piv = jnp.where(finite, g_piv, ipos[tt])
+    H = lax.psum(
+        jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0), BOTH
+    ).astype(dtype)
+
+    # --- THE one row broadcast along "pr": the pivot's physical row.
+    own_piv_r = kr == (g_piv % pr)
+    slot_piv = jnp.where(own_piv_r, g_piv // pr, 0)
+    row_piv = lax.psum(
+        jnp.where(own_piv_r,
+                  lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False), 0.0),
+        AXIS_R,
+    )                                           # (m, Wc)
+
+    # --- NORMALIZE; the owner column's t-chunk becomes H.
+    prow = jnp.matmul(H, row_piv, precision=precision)      # (m, Wc)
+    prow_H = lax.dynamic_update_slice(prow, H, (z, u_t * m))
+    prow = jnp.where(own_c, prow_H, prow)
+
+    # --- ELIMINATE every row except the pivot's PHYSICAL row.
+    gr = jnp.arange(bpr) * pr + kr
+    E = jnp.where((gr == g_piv)[:, None, None], jnp.asarray(0, dtype),
+                  chunk_all)
+    # ``chunk`` (sliced at the top) is still current — Wloc has not been
+    # written yet in this step, unlike the swap engines.
+    Wloc = lax.dynamic_update_slice(
+        Wloc, jnp.where(own_c, jnp.zeros_like(chunk), chunk),
+        (z, z, u_t * m))
+    update = jnp.matmul(E.reshape(bpr * m, m), prow, precision=precision)
+    Wloc = Wloc - update.reshape(Wloc.shape)
+    cur = lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False)
+    Wloc = lax.dynamic_update_index_in_dim(
+        Wloc, jnp.where(own_piv_r, prow, cur), slot_piv, 0)
+
+    # --- BOOKKEEPING (identical to the 1D twin; int32 throughout).
+    alive = alive & (gr != g_piv)
+    g32 = g_piv.astype(jnp.int32)
+    piv_pos = pos[g32]
+    x = ipos[tt]
+    pos = pos.at[x].set(piv_pos).at[g32].set(tt)
+    ipos = ipos.at[tt].set(g32).at[piv_pos].set(x)
+    swaps = swaps.at[tt].set(piv_pos)
+    return Wloc, alive, singular, pos, ipos, swaps
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas",
+                          "probe_cols"))
+def _sharded_jordan2d_inplace_swapfree(W, mesh, lay: CyclicLayout2D, eps,
+                                       precision, use_pallas,
+                                       probe_cols=True):
+    """The swap-free 2D engine (fori_loop; any Nr): per step it drops
+    the row_t psum, the swap fix-up, and the entire per-step psum
+    unscramble relative to the swap engines; rows AND columns are
+    repaired once at the end, folded into the full gather
+    (gather=True-only, like the 1D twin — see _step_swapfree's honest
+    reshuffle accounting).  Bit-matches the swap engines, ties
+    included."""
+    def worker(Wloc):
+        def body(t, carry):
+            Wl, alive, sing, pos, ipos, swaps = carry
+            return _step2d_swapfree(t, Wl, alive, sing, pos, ipos, swaps,
+                                    lay=lay, eps=eps, precision=precision,
+                                    use_pallas=use_pallas,
+                                    probe_cols=probe_cols)
+
+        vary = lambda v: lax.pcast(v, BOTH, to='varying')  # noqa: E731
+        alive0 = vary(jnp.ones((lay.bpr,), bool))
+        sing0 = vary(jnp.asarray(False))
+        pos0 = vary(jnp.arange(lay.Nr, dtype=jnp.int32))
+        ipos0 = vary(jnp.arange(lay.Nr, dtype=jnp.int32))
+        swaps0 = vary(jnp.zeros((lay.Nr,), jnp.int32))
+        Wloc, alive, singular, pos, ipos, swaps = lax.fori_loop(
+            0, lay.Nr, body, (Wloc, alive0, sing0, pos0, ipos0, swaps0))
+        return (Wloc, singular[None, None], ipos[None, None],
+                swaps[None, None])
+
+    blocks, singular, ipos_all, swaps_all = shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=_SPEC_W,
+        out_specs=(_SPEC_W, PartitionSpec(AXIS_R, AXIS_C),
+                   PartitionSpec(AXIS_R, AXIS_C, None),
+                   PartitionSpec(AXIS_R, AXIS_C, None)),
+    )(W)
+
+    # --- Deferred row + column permutations (the fold-into-gather
+    # repair; constrained back to the engine sharding so the output
+    # contract matches every other 2D engine — see the 1D twin's
+    # accounting note).
+    from jax.sharding import NamedSharding
+
+    from ..ops.jordan_inplace import compose_swap_perm
+
+    ipos = ipos_all[0, 0]
+    cols = compose_swap_perm(swaps_all[0, 0], lay.Nr)
+    # Rows: storage slot s holds physical global row row_perm[s];
+    # natural row g sits at physical ipos[g].
+    rp = jnp.asarray(lay.row_perm(), jnp.int32)
+    inv_rp = jnp.argsort(rp)
+    out = jnp.take(blocks, jnp.take(inv_rp, jnp.take(ipos, rp)), axis=0)
+    # Columns: storage chunk position s holds global column block
+    # col_perm[s]; natural column j is input column cols[j].
+    cp = jnp.asarray(lay.col_perm(lay.Nr), jnp.int32)
+    idx_cols = jnp.take(jnp.argsort(cp), jnp.take(cols, cp))
+    out = jnp.take(out.reshape(lay.Nr, lay.m, lay.Nr, lay.m), idx_cols,
+                   axis=2).reshape(lay.Nr, lay.m, lay.N)
+    out = jax.lax.with_sharding_constraint(
+        out, NamedSharding(mesh, _SPEC_W))
+    return out, singular
 
 
 def _unscramble_step(t: int, piv, Wloc, *, lay: CyclicLayout2D):
@@ -790,6 +968,7 @@ def compile_sharded_jordan_inplace_2d(
     unroll: bool | None = None,
     group: int = 0,
     probe_layout: str = "auto",
+    swapfree: bool = False,
 ):
     """AOT-compile the 2D in-place elimination for a (Nr, m, N) 2D-cyclic
     identity-padded block tensor.  ``run(W) -> (inverse_blocks,
@@ -809,6 +988,10 @@ def compile_sharded_jordan_inplace_2d(
     if unroll is None:
         unroll = lay.Nr <= MAX_UNROLL_NR
     probe_cols = resolve_probe_layout(probe_layout, mesh)
+    if swapfree:
+        return _sharded_jordan2d_inplace_swapfree.lower(
+            W, mesh, lay, eps, precision, use_pallas, probe_cols
+        ).compile()
     if group and group > 1:
         engine = (_sharded_jordan2d_inplace_grouped if unroll
                   else _sharded_jordan2d_inplace_grouped_fori)
@@ -833,6 +1016,7 @@ def sharded_jordan_invert_inplace_2d(
     unroll: bool | None = None,
     group: int = 0,
     probe_layout: str = "auto",
+    swapfree: bool = False,
 ):
     """Invert (n, n) ``a`` over a 2D (pr, pc) mesh with the in-place
     engine: drop-in for ``sharded_jordan_invert_2d`` at ~half the flops,
@@ -848,6 +1032,6 @@ def sharded_jordan_invert_inplace_2d(
     W = scatter_matrix_2d(a, lay, mesh)
     run = compile_sharded_jordan_inplace_2d(W, mesh, lay, eps, precision,
                                             use_pallas, unroll, group,
-                                            probe_layout)
+                                            probe_layout, swapfree)
     out, singular = run(W)
     return gather_inverse_inplace_2d(out, lay, n), singular.any()
