@@ -31,7 +31,7 @@ assert jax.default_backend() == "cpu", jax.default_backend()
 assert jax.device_count() == 8, jax.device_count()
 
 
-def compile_1d(n, m, unroll):
+def compile_1d(n, m, unroll, **kw):
     from tpu_jordan.parallel import make_mesh
     from tpu_jordan.parallel.layout import CyclicLayout
     from tpu_jordan.parallel.ring_gemm import _to_identity_padded_blocks
@@ -45,11 +45,11 @@ def compile_1d(n, m, unroll):
     a = generate("absdiff", (n, n), jnp.float32)
     W = _to_identity_padded_blocks(a, lay, mesh)
     t0 = time.perf_counter()
-    compile_sharded_jordan_inplace(W, mesh, lay, unroll=unroll)
+    compile_sharded_jordan_inplace(W, mesh, lay, unroll=unroll, **kw)
     return lay.Nr, time.perf_counter() - t0
 
 
-def compile_2d(n, m, unroll):
+def compile_2d(n, m, unroll, **kw):
     from tpu_jordan.parallel import make_mesh_2d
     from tpu_jordan.parallel.layout import CyclicLayout2D
     from tpu_jordan.parallel.jordan2d import scatter_matrix_2d
@@ -63,36 +63,43 @@ def compile_2d(n, m, unroll):
     a = generate("absdiff", (n, n), jnp.float32)
     W = scatter_matrix_2d(a, lay, mesh)
     t0 = time.perf_counter()
-    compile_sharded_jordan_inplace_2d(W, mesh, lay, unroll=unroll)
+    compile_sharded_jordan_inplace_2d(W, mesh, lay, unroll=unroll, **kw)
     return lay.Nr, time.perf_counter() - t0
 
 
 def main():
     # Fixed m=16 so Nr sweeps via n without huge arrays; compile cost
-    # depends on graph size (Nr), not on n's magnitude.
+    # depends on graph size (Nr), not on n's magnitude.  Round 5 adds
+    # the grouped (k=2) and swap-free variants: the grouped-fori and
+    # swap-free engines must stay flat in Nr (the bench capture ladder
+    # and the pod-scale engines depend on it).
     m = 16
     print("| engine | Nr | unrolled s | fori s |")
     print("|---|---|---|---|")
     for Nr in (16, 32, 64, 128):
         n = Nr * m
-        row = [f"1D p=8", str(Nr)]
-        for unroll in (True, False):
-            if unroll and Nr > 64:
-                row.append("—")
-                continue
-            _, secs = compile_1d(n, m, unroll)
-            row.append(f"{secs:.1f}")
-        print("| " + " | ".join(row) + " |")
+        for label, kw in (("1D p=8", {}), ("1D p=8 k=2", {"group": 2}),
+                          ("1D p=8 SF", {"swapfree": True})):
+            row = [label, str(Nr)]
+            for unroll in (True, False):
+                if (unroll and Nr > 64) or (unroll and kw.get("swapfree")):
+                    row.append("—")     # no unrolled swap-free flavor
+                    continue
+                _, secs = compile_1d(n, m, unroll, **kw)
+                row.append(f"{secs:.1f}")
+            print("| " + " | ".join(row) + " |")
     for Nr in (16, 32, 64, 128):
         n = Nr * m
-        row = [f"2D 2x4", str(Nr)]
-        for unroll in (True, False):
-            if unroll and Nr > 64:
-                row.append("—")
-                continue
-            _, secs = compile_2d(n, m, unroll)
-            row.append(f"{secs:.1f}")
-        print("| " + " | ".join(row) + " |")
+        for label, kw in (("2D 2x4", {}), ("2D 2x4 k=2", {"group": 2}),
+                          ("2D 2x4 SF", {"swapfree": True})):
+            row = [label, str(Nr)]
+            for unroll in (True, False):
+                if (unroll and Nr > 64) or (unroll and kw.get("swapfree")):
+                    row.append("—")
+                    continue
+                _, secs = compile_2d(n, m, unroll, **kw)
+                row.append(f"{secs:.1f}")
+            print("| " + " | ".join(row) + " |")
 
 
 if __name__ == "__main__":
