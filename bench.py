@@ -54,6 +54,28 @@ class _Singular(AssertionError):
 _RETRYABLE = ("INTERNAL", "remote_compile", "read body", "DEADLINE")
 
 
+def _is_transient(e: Exception) -> bool:
+    """Transient = a runtime/transport exception TYPE carrying one of the
+    documented-transient message markers.  Both conditions: substring
+    matching alone let any exception whose message merely QUOTES a
+    compiler error — e.g. an accuracy AssertionError embedding
+    "INTERNAL" — trigger a full n=16384 re-run (ADVICE r5)."""
+    if not any(s in str(e) for s in _RETRYABLE):
+        return False
+    types = [OSError, ConnectionError, TimeoutError]    # tunnel/transport
+    try:
+        from jax.errors import JaxRuntimeError
+        types.append(JaxRuntimeError)
+    except ImportError:
+        pass
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+        types.append(XlaRuntimeError)
+    except ImportError:
+        pass
+    return isinstance(e, tuple(types))
+
+
 def _retry_transient(fn):
     """One retry on the documented-transient remote-compile failure class
     (benchmarks/PHASES.md: same program passes minutes later; the round-4
@@ -65,7 +87,7 @@ def _retry_transient(fn):
     except _Singular:
         raise
     except Exception as e:                      # noqa: BLE001
-        if any(s in str(e) for s in _RETRYABLE):
+        if _is_transient(e):
             return fn()
         raise
 
@@ -116,10 +138,15 @@ def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
     inv, sing = engine(a, block_size=m)
     if bool(sing):
         raise _Singular(f"benchmark matrix flagged singular (n={n} m={m})")
-    per_call = slope_time(
+    # Median of 3 in-session slope samples on one compiled executable
+    # (VERDICT r5 weak #1: a single sample silently regressed the 4096
+    # headline 15% on session noise); min/max + spread ride the row so a
+    # noisy session can't masquerade as a code regression.
+    slopes = slope_time(
         lambda v: engine(v, block_size=m)[0],
-        (a,), r1=r1, r2=r2,
+        (a,), r1=r1, r2=r2, samples=3,
     )
+    per_call = float(np.median(slopes))
 
     norm_a = float(inf_norm(a))
     rel_res = float(residual_inf_norm(a, inv)) / norm_a
@@ -141,11 +168,23 @@ def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
         f"gate={gate:.3e} (predicted eps*n*kappa={predicted:.3e}, "
         f"kappa={kappa:.3e}, n={n})"
     )
+    gf = lambda t: 2.0 * n**3 / t / 1e9           # noqa: E731
+    spread = (max(slopes) - min(slopes)) / per_call
     acc = {
         "rel_residual": f"{rel_res:.1e}",
         "kappa": f"{kappa:.3e}",
         "predicted_bound": f"{predicted:.1e}",
+        # Median-of-3 capture record: [min, max] GFLOP/s around the
+        # median-of-record, plus the spread; >10% flags a session too
+        # noisy to read as a regression (or improvement).
+        "gflops_minmax": [round(gf(max(slopes)), 1),
+                          round(gf(min(slopes)), 1)],
+        "spread_pct": round(100.0 * spread, 1),
     }
+    if spread > 0.10:
+        acc["spread_flag"] = (
+            f"session spread {100 * spread:.1f}% > 10% — treat the "
+            f"median as noisy")
     if refine:
         refined = newton_schulz(a, inv, refine)
         rel_ref = float(residual_inf_norm(a, refined)) / norm_a
@@ -196,6 +235,55 @@ def _capture_ladder(extra, n, tiers, r1, r2, baseline_gflops, vs_key):
     return None, None
 
 
+def _record_spread(extra, prefix, acc):
+    """Median-of-3 bookkeeping per headline row: [min, max] GFLOP/s,
+    spread %, and the >10% noisy-session flag (VERDICT r5 weak #1)."""
+    extra[f"{prefix}_gflops_minmax"] = acc["gflops_minmax"]
+    extra[f"{prefix}_spread_pct"] = acc["spread_pct"]
+    if "spread_flag" in acc:
+        extra[f"{prefix}_spread_flag"] = acc["spread_flag"]
+
+
+def _sharded_swapfree_row(extra):
+    """Sharded-output (gather=False) capture: the swap-free engine with
+    its bucketed-ppermute permutations keeps the inverse block-sharded
+    end to end (VERDICT r5 missing #1).  This bench host exposes ONE
+    chip, so the leg runs on a forced 8-virtual-device CPU mesh in a
+    subprocess (the __graft_entry__ dryrun recipe) — the row evidences
+    the memory-contract path (relative residual + per-shard bytes =
+    exactly 1/8 of the matrix); its elapsed is CPU-mesh wall time and
+    is never compared to the chip baseline."""
+    import subprocess
+    import sys
+
+    from __graft_entry__ import _REPO, _cpu_env
+
+    child = (
+        "import jax, json\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from tpu_jordan.driver import solve\n"
+        "n, m = 2048, 128\n"
+        "r = solve(n, m, workers=(2, 4), engine='swapfree', gather=False)\n"
+        "b = r.inverse_blocks\n"
+        "shard = max(s.data.nbytes for s in b.addressable_shards)\n"
+        "assert r.inverse is None and shard * 8 == b.nbytes\n"
+        "print(json.dumps({'n': n, 'm': m, 'mesh': '2x4',\n"
+        "                  'engine': 'swapfree', 'gather': False,\n"
+        "                  'elapsed_s': round(r.elapsed, 3),\n"
+        "                  'rel_residual': f'{r.rel_residual:.1e}',\n"
+        "                  'per_shard_mib': round(shard / 2**20, 2)}))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", child], env=_cpu_env(8), cwd=_REPO,
+            capture_output=True, text=True, timeout=900, check=True)
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        row["note"] = "cpu-mesh memory-contract leg, not chip throughput"
+        extra["sharded_swapfree_gather_false"] = row
+    except Exception as e:                      # noqa: BLE001
+        extra["sharded_swapfree_gather_false_error"] = str(e)[:200]
+
+
 def main():
     baseline_gflops = 6.8  # BASELINE.md: reference fp64, m=48, 1 CPU core
 
@@ -218,6 +306,8 @@ def main():
         "kappa_4096": acc_4096["kappa"],
         "kappa_8192": acc_8192["kappa"],
     }
+    _record_spread(extra, "invert_4096", acc_4096)
+    _record_spread(extra, "invert_8192", acc_8192)
     # 8192 scale row, best-effort (VERDICT r4 weak #3: the 8192-class
     # captured number must reflect the best engine, not the |i−j|
     # contract row): rand fixture, delayed-group-update engine at
@@ -233,6 +323,7 @@ def main():
     if acc8 is not None:
         extra["rel_residual_8192_grouped"] = acc8["rel_residual"]
         extra["kappa_8192_grouped"] = acc8["kappa"]
+        _record_spread(extra, "invert_8192_grouped", acc8)
 
     # 16384 scale point, best-effort (the two contract configs above must
     # never be lost to a failure here): |i−j| genuinely exceeds fp32 at
@@ -257,6 +348,11 @@ def main():
     if acc16 is not None:
         for k, v in acc16.items():
             extra[f"{k}_16384"] = v
+
+    # Sharded-output tier: swapfree × gather=False (bucketed ppermute),
+    # best-effort — a failure records an error key, never loses the
+    # chip rows above.
+    _sharded_swapfree_row(extra)
 
     print(json.dumps({
         "metric": "invert_4096x4096_f32_gflops",

@@ -23,6 +23,14 @@ analogs main.cpp:1074 (custom pivot all-reduce), 1097 (pivot-row bcast),
     * swap fix-up:   (m, m)        along pc
     plus the 2D unscramble (after the loop): 2 x (N/pr, m) along pc per
     step.
+  Swap-free (sharded_inplace.py::_step_swapfree, jordan2d_inplace.py::
+  _step2d_swapfree):
+    * the row_t psum, the 2D swap fix-up, and the per-step 2D
+      unscramble are DELETED; in their place ONE bucketed-ppermute
+      permutation per sharded axis after the loop
+      (parallel/permute.py), charged by ``_bucketed_permute`` —
+      axis−1 single-hop rounds of one padded shard-size bucket, valid
+      under both gather modes (residency stays at one shard).
 
 The one-hot psums are semantically broadcasts but lower as all-reduces;
 ring all-reduce of S bytes over an axis of a chips with W bytes/s
@@ -78,6 +86,19 @@ C_PROBE_V5E = 4.07e-12  # s per candidate-element pass (35 ms @ 8192/256)
 
 def _allreduce(S: float, a: int, chip: Chip) -> float:
     return 0.0 if a == 1 else S * (a - 1) / a / chip.ici + LATENCY
+
+
+def _bucketed_permute(S: float, a: int, chip: Chip) -> float:
+    """The swap-free engines' deferred permutation along one mesh axis
+    (parallel/permute.py): a−1 single-hop ``ppermute`` rounds of one
+    padded shard-size bucket S (static shapes force worst-case padding,
+    so every round ships a full shard).  The forward and backward
+    rotation buffers ride OPPOSITE ring directions concurrently, so
+    wall time is the floor(a/2) forward rounds — the reason the
+    implementation rotates one hop per round instead of direct
+    shift-by-d ppermutes, whose min(d, a−d) link hops would sum to
+    ~a²/4 shard-times."""
+    return 0.0 if a == 1 else (a // 2) * (S / chip.ici + LATENCY)
 
 
 def predict(n: int, m: int, pr: int, pc: int, chip: Chip,
@@ -158,16 +179,20 @@ def predict(n: int, m: int, pr: int, pc: int, chip: Chip,
                 # deletes it (rows+columns repaired in the gather fold).
                 comm += 2 * _allreduce(4 * (N / pr) * m, pc, chip)
     if swapfree:
-        # The deferred row permutation is modeled at ZERO comm because
-        # the product restricts the swap-free engine to gather=True
-        # (driver.check_gather_flags), where the permutation folds into
-        # the full gather that happens anyway (a reorder of the same
-        # bytes — no model charges the gather itself).  The honest
-        # sharded-output accounting — an all-gather-shaped reshuffle at
-        # ~N²·4·(P−1)/P per worker — would CANCEL the row_t saving,
-        # which is exactly why that mode is rejected (XLA exposes no
-        # ragged point-to-point reshuffle).  The full-window probe
-        # loses the shrinking window: +~2x probe launches, charged.
+        # The deferred permutations, charged as MEASURED terms of the
+        # bucketed-ppermute implementation (parallel/permute.py): rows
+        # move only along the row axis, column chunks (2D) only along
+        # the column axis, each in axis−1 single-hop rounds of one
+        # padded shard-size bucket — residency stays at one shard, so
+        # this term applies to gather=False too (the old accounting
+        # charged zero under a gather=True-only contract and called
+        # sharded output "comm-neutral" via a hypothetical all-gather
+        # reshuffle; both are gone).  The full-window probe loses the
+        # shrinking window: +~2x probe launches, charged.
+        S_shard = 4.0 * (N / pr) * (N / pc)
+        comm += _bucketed_permute(S_shard, pr, chip)      # rows
+        if pc > 1:
+            comm += _bucketed_permute(S_shard, pc, chip)  # column chunks
         probe *= 2.0
     total = elim + probe + comm + glue
     out = {"elim": elim, "probe": probe, "comm": comm, "glue": glue,

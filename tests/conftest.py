@@ -34,6 +34,15 @@ import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy engine-parity/scale cases excluded from the tier-1 "
+        "fast run (ROADMAP.md's verify command deselects them under its "
+        "timeout; full coverage stays in the unmarked nightly run — "
+        "VERDICT r5 weak #6)")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

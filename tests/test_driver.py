@@ -200,7 +200,8 @@ class TestEngineSelection:
                 resolve_engine(*bad)
 
     @pytest.mark.parametrize("engine,workers", [
-        ("grouped", 1), ("grouped", 4), ("grouped", (2, 2)),
+        ("grouped", 1), ("grouped", 4),
+        pytest.param("grouped", (2, 2), marks=pytest.mark.slow),
         ("augmented", 1), ("inplace", 4),
     ])
     def test_engines_solve_and_verify(self, engine, workers):

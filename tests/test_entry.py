@@ -36,6 +36,7 @@ def test_dryrun_inline_on_8_fake_devices():
     g._dryrun_impl(8)
 
 
+@pytest.mark.slow
 def test_dryrun_subprocess_path():
     # The driver calls dryrun_multichip from an arbitrary backend state;
     # the subprocess fallback must work even when the parent env pins a

@@ -80,8 +80,9 @@ class TestInplaceForiEngine:
     at every Nr (same pivot choices, same arithmetic), and working beyond
     MAX_UNROLL_NR where the unrolled trace is unaffordable."""
 
-    @pytest.mark.parametrize("n,m", [(32, 8), (64, 16), (50, 8), (48, 48),
-                                     (96, 8)])
+    @pytest.mark.parametrize("n,m", [
+        (32, 8), (64, 16), (50, 8), (48, 48),
+        pytest.param(96, 8, marks=pytest.mark.slow)])
     def test_bitmatch_unrolled(self, rng, n, m):
         a = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
         x_u, s_u = block_jordan_invert_inplace(a, block_size=m)
@@ -125,10 +126,12 @@ class TestInplaceForiEngine:
                                                     group=1)
         assert bool(jnp.all(x1 == x2))
 
-    @pytest.mark.parametrize("n,m,k", [(64, 16, 2), (128, 16, 4),
-                                       (128, 32, 4), (96, 16, 3),
-                                       (160, 16, 4), (50, 8, 4),
-                                       (128, 16, 8)])
+    @pytest.mark.parametrize("n,m,k", [
+        (64, 16, 2),
+        pytest.param(128, 16, 4, marks=pytest.mark.slow),
+        (128, 32, 4), (96, 16, 3),
+        pytest.param(160, 16, 4, marks=pytest.mark.slow),
+        (50, 8, 4), (128, 16, 8)])
     def test_grouped_matches_plain_to_rounding(self, rng, n, m, k):
         # Delayed group updates change the summation order (one U·P
         # matmul per group), so parity is to rounding, not bitwise —
@@ -158,11 +161,14 @@ class TestInplaceForiEngine:
             jnp.ones((32, 32), jnp.float64), block_size=8, group=4)
         assert bool(sing)
 
-    @pytest.mark.parametrize("n,m,k", [(64, 16, 2), (128, 16, 4),
-                                       (96, 16, 4),   # tail group (Nr=6, k=4)
-                                       (160, 16, 4),  # tail group (Nr=10)
-                                       (50, 8, 4),    # ragged n + tail
-                                       (128, 16, 8)])
+    @pytest.mark.parametrize("n,m,k", [
+        (64, 16, 2),
+        pytest.param(128, 16, 4, marks=pytest.mark.slow),
+        (96, 16, 4),   # tail group (Nr=6, k=4)
+        pytest.param(160, 16, 4,
+                     marks=pytest.mark.slow),  # tail group (Nr=10)
+        (50, 8, 4),    # ragged n + tail
+        pytest.param(128, 16, 8, marks=pytest.mark.slow)])
     def test_grouped_fori_bitmatches_grouped(self, rng, n, m, k):
         # The fori grouped engine runs the same per-step arithmetic as
         # the unrolled grouped engine (the probe's masked full window

@@ -54,6 +54,7 @@ def test_solve_mixed_distributed():
     assert res.residual / (96 * 96 / 2) < 1e-5
 
 
+@pytest.mark.slow
 def test_solve_mixed_2d():
     res = solve(n=96, block_size=8, workers=(2, 2), precision="mixed")
     assert res.residual / (96 * 96 / 2) < 1e-5

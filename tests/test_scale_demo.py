@@ -4,13 +4,25 @@ The 2D block-cyclic + gather=False mode exists so per-worker memory is
 O(n²/(pr·pc)) — the fix for the reference's replicated-column memory wall
 (main.cpp:366-370).  This test runs it at n=2048 on the 8-device CPU mesh
 and asserts the actual per-device shard bytes, not just the residual.
-"""
 
+The swap-free tests below pin the round-6 reconciliation: the pod-scale
+comm engine (swapfree) in the pod-scale memory mode (gather=False) —
+legal since the deferred permutations run as bucketed ``ppermute``
+rounds inside the engine (parallel/permute.py), so no per-worker buffer
+at the permutation step exceeds one shard (N²/P elements; the old
+``jnp.take`` reshuffle transiently all-gathered the full N²).  Shard
+bytes are asserted on the solver OUTPUT, and the blocks must bit-match
+the gathered path (ties included — the |i−j| fixture exercises exact
+pivot ties)."""
+
+import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from tpu_jordan.driver import solve
 
 
+@pytest.mark.slow
 def test_2048_2d_no_gather_shard_bytes():
     n, m, pr, pc = 2048, 128, 2, 4
     res = solve(n, m, workers=(pr, pc), gather=False)
@@ -34,3 +46,65 @@ def test_2048_2d_no_gather_shard_bytes():
     # The point of the mode: each worker holds 1/(pr*pc) of the matrix.
     assert per_worker * pr * pc == full
     assert per_worker * 4 == full * 4 // (pr * pc)
+
+
+def _assert_sharded_blocks(blocks, lay, nshards, shard_shape):
+    """Every shard holds exactly 1/P of the (Nr, m, N) block tensor —
+    the gather=False memory contract, asserted in bytes."""
+    shards = blocks.addressable_shards
+    assert len(shards) == nshards
+    itemsize = blocks.dtype.itemsize
+    per_worker = int(np.prod(shard_shape))
+    for s in shards:
+        assert s.data.shape == shard_shape
+        assert s.data.nbytes == per_worker * itemsize
+    assert per_worker * nshards == lay.N * lay.N
+
+
+def test_swapfree_no_gather_1d_shard_bytes_and_bitmatch():
+    # |i−j| fixture: exact pivot ties — the swap-coordinate tie rule
+    # must reproduce the swap engines' choices through the bucketed
+    # permutation too.
+    n, m, p = 512, 32, 8
+    r_sf = solve(n, m, workers=p, gather=False, dtype=jnp.float64,
+                 engine="swapfree")
+    assert r_sf.residual / (n * n / 2) < 1e-10
+    lay = r_sf.layout
+    _assert_sharded_blocks(r_sf.inverse_blocks, lay, p,
+                           (lay.Nr // p, m, lay.N))
+    # Bit-match the gathered swap-free path AND the swap engine's
+    # sharded path (nonsingular fixture; invalid-singular outputs are
+    # exempt from the bit-match contract).
+    r_gathered = solve(n, m, workers=p, gather=True, dtype=jnp.float64,
+                       engine="swapfree")
+    from tpu_jordan.parallel.sharded_inplace import gather_inverse_inplace
+
+    assembled = gather_inverse_inplace(
+        jnp.asarray(r_sf.inverse_blocks), lay, n)
+    assert bool(jnp.all(assembled == r_gathered.inverse))
+    r_swap = solve(n, m, workers=p, gather=False, dtype=jnp.float64)
+    assert bool(jnp.all(jnp.asarray(r_sf.inverse_blocks)
+                        == jnp.asarray(r_swap.inverse_blocks)))
+
+
+def test_swapfree_no_gather_2d_shard_bytes_and_bitmatch():
+    n, m, pr, pc = 512, 32, 2, 4
+    r_sf = solve(n, m, workers=(pr, pc), gather=False, dtype=jnp.float64,
+                 engine="swapfree")
+    assert r_sf.residual / (n * n / 2) < 1e-10
+    lay = r_sf.layout
+    _assert_sharded_blocks(r_sf.inverse_blocks, lay, pr * pc,
+                           (lay.Nr // pr, m, lay.N // pc))
+    r_gathered = solve(n, m, workers=(pr, pc), gather=True,
+                       dtype=jnp.float64, engine="swapfree")
+    from tpu_jordan.parallel.jordan2d_inplace import (
+        gather_inverse_inplace_2d,
+    )
+
+    assembled = gather_inverse_inplace_2d(
+        jnp.asarray(r_sf.inverse_blocks), lay, n)
+    assert bool(jnp.all(assembled == r_gathered.inverse))
+    r_swap = solve(n, m, workers=(pr, pc), gather=False,
+                   dtype=jnp.float64)
+    assert bool(jnp.all(jnp.asarray(r_sf.inverse_blocks)
+                        == jnp.asarray(r_swap.inverse_blocks)))

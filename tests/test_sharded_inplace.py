@@ -24,7 +24,9 @@ def mesh4():
 
 
 class TestShardedInplace:
-    @pytest.mark.parametrize("n,m", [(64, 8), (128, 16), (100, 8)])
+    @pytest.mark.parametrize("n,m", [
+        (64, 8), (128, 16),
+        pytest.param(100, 8, marks=pytest.mark.slow)])
     def test_matches_linalg_inv(self, rng, mesh8, n, m):
         a = jnp.asarray(rng.standard_normal((n, n)), jnp.float64)
         inv, sing = sharded_jordan_invert_inplace(a, mesh8, m)
@@ -34,7 +36,8 @@ class TestShardedInplace:
             atol=1e-7,
         )
 
-    @pytest.mark.parametrize("p", [4, 8])
+    @pytest.mark.parametrize("p", [
+        pytest.param(4, marks=pytest.mark.slow), 8])
     def test_matches_single_device_inplace(self, rng, p):
         # Same pivot rule end to end: the distributed in-place result must
         # agree with the single-chip in-place engine to rounding.
@@ -88,7 +91,9 @@ class TestShardedInplace:
         assert inv.dtype == jnp.bfloat16
         assert not bool(sing)
 
-    @pytest.mark.parametrize("n,m", [(128, 16), (256, 32), (100, 8)])
+    @pytest.mark.parametrize("n,m", [
+        (128, 16), (256, 32),
+        pytest.param(100, 8, marks=pytest.mark.slow)])
     def test_fori_bitmatches_unrolled(self, rng, mesh8, n, m):
         # The fori_loop engine (traced offsets, full-window masked probe)
         # must make the same pivot choices and produce bit-identical
@@ -120,8 +125,10 @@ class TestShardedGrouped:
     rounding (the grouped summation-order trade), and the grouped
     unrolled/fori pair is bit-identical."""
 
-    @pytest.mark.parametrize("n,m,k", [(64, 8, 2), (128, 16, 4),
-                                       (100, 8, 4), (96, 8, 3)])
+    @pytest.mark.parametrize("n,m,k", [
+        (64, 8, 2), (128, 16, 4),
+        pytest.param(100, 8, 4, marks=pytest.mark.slow),
+        pytest.param(96, 8, 3, marks=pytest.mark.slow)])
     def test_grouped_matches_plain_to_rounding(self, rng, mesh8, n, m, k):
         a = jnp.asarray(rng.standard_normal((n, n)), jnp.float64)
         x_p, s_p = sharded_jordan_invert_inplace(a, mesh8, m)
@@ -143,8 +150,10 @@ class TestShardedGrouped:
         np.testing.assert_allclose(np.asarray(x_d), np.asarray(x_s),
                                    rtol=1e-9, atol=1e-9)
 
-    @pytest.mark.parametrize("n,m,k", [(128, 16, 2), (160, 8, 4),
-                                       (100, 8, 4)])
+    @pytest.mark.parametrize("n,m,k", [
+        (128, 16, 2),
+        pytest.param(160, 8, 4, marks=pytest.mark.slow),
+        pytest.param(100, 8, 4, marks=pytest.mark.slow)])
     def test_grouped_fori_bitmatches_unrolled(self, rng, mesh8, n, m, k):
         a = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
         x_u, s_u = sharded_jordan_invert_inplace(a, mesh8, m, group=k,
@@ -154,6 +163,7 @@ class TestShardedGrouped:
         assert bool(s_u) == bool(s_f)
         assert bool(jnp.all(x_u == x_f)), "grouped fori diverged bitwise"
 
+    @pytest.mark.slow
     def test_grouped_tied_pivots(self, mesh4):
         # |i-j|: repeated candidate blocks + zero diagonal — tie-breaks
         # and cross-group swaps must match the single-chip grouped engine.
@@ -221,12 +231,39 @@ class TestSwapFree:
             jnp.ones((64, 64), jnp.float64), mesh8, 8, swapfree=True)
         assert bool(sing)
 
+    def test_all_singular_flags_agree_but_arrays_diverge(self, mesh4):
+        # The engines' bit-match contract is scoped to NONSINGULAR
+        # inputs: on an all-singular input both engines flag singular
+        # (the only contractual output then), but their benign pin
+        # targets differ — the swap engine self-swaps position t, the
+        # swap-free engine pins the physical row at swap position t —
+        # so the (invalid) arrays diverge bitwise.  Pin both facts so
+        # the docstring scoping stays honest (ADVICE r5).
+        ones = jnp.ones((64, 64), jnp.float64)
+        x_sf, s_sf = sharded_jordan_invert_inplace(ones, mesh4, 8,
+                                                   swapfree=True)
+        x_sw, s_sw = sharded_jordan_invert_inplace(ones, mesh4, 8)
+        assert bool(s_sf) and bool(s_sw)
+        assert not bool(jnp.all(x_sf == x_sw))
+
     def test_solve_engine_swapfree(self):
         from tpu_jordan.driver import solve
 
         r = solve(96, 8, workers=4, dtype=jnp.float64, engine="swapfree")
         assert r.residual < 1e-9 * 96 * 95
         assert r.kappa is not None
+
+    def test_solve_engine_swapfree_no_gather(self):
+        # swapfree × gather=False is legal since the bucketed-ppermute
+        # permutation (parallel/permute.py): the pod-scale comm engine
+        # in the pod-scale memory mode.
+        from tpu_jordan.driver import solve
+
+        r = solve(96, 8, workers=4, dtype=jnp.float64, engine="swapfree",
+                  gather=False)
+        assert r.inverse is None
+        assert r.inverse_blocks.shape == (12, 8, 96)
+        assert r.residual < 1e-9 * 96 * 95
 
     def test_swapfree_usage_errors(self):
         from tpu_jordan.driver import UsageError, solve
@@ -236,10 +273,6 @@ class TestSwapFree:
             solve(64, 8, engine="swapfree")          # single device
         with pytest.raises(UsageError):
             solve(64, 8, workers=4, engine="swapfree", group=2)
-        with pytest.raises(UsageError):
-            # gather=False: the sharded-output reshuffle is comm-neutral
-            # and transiently unsharded — rejected (PHASES.md round 5).
-            solve(64, 8, workers=4, engine="swapfree", gather=False)
         with pytest.raises(UsageError):
             JordanSolver(64, 8, engine="swapfree")   # single device
 

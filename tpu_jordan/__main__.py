@@ -59,9 +59,10 @@ def main(argv=None) -> int:
                          "measured dispatch policy); 'augmented' = the "
                          "4N^3 reference-parity path; 'swapfree' = the "
                          "implicit-permutation distributed engine (no "
-                         "row-swap broadcast, no per-step 2D unscramble "
-                         "— the pod-scale comm design; distributed, "
-                         "gathered output only)")
+                         "row-swap broadcast, no per-step 2D unscramble, "
+                         "bucketed-ppermute deferred repairs — the "
+                         "pod-scale comm design; distributed, either "
+                         "gather mode incl. --no-gather)")
     ap.add_argument("--group", type=int, default=0,
                     help="panels per delayed-group update (implies "
                          "--engine grouped when > 1; grouped default 2)")

@@ -90,6 +90,13 @@ def resolve_engine(engine: str, group: int):
     fixture at n >= 8192 with m <= 256), the plain engine at the
     default block size remains the right choice — which is why "auto"
     does not select grouped on its own.
+
+    "swapfree" is the distributed pod-scale comm design (lowest
+    projected comm bill at the v5p north-star meshes) and is legal
+    under either gather mode: its deferred permutations run as bucketed
+    ppermute rounds with residency capped at one shard
+    (parallel/permute.py), so it composes with gather=False — the only
+    memory mode that reaches 32768²+.
     """
     if engine not in ENGINES:
         raise UsageError(f"unknown engine {engine!r}; choose from "
@@ -347,17 +354,18 @@ def check_gather_flags(gather: bool, refine: int, precision: str = "highest",
                        engine: str = "auto"):
     """Flag-compatibility contract for distributed solves, shared by
     ``solve`` and ``JordanSolver``: refinement (and the 'mixed' policy
-    that implies it) runs on the gathered inverse; the swap-free
-    engine's deferred row permutation makes its sharded-output mode
-    comm-neutral and transiently unsharded, so it requires
-    gather=True (where the permutation folds into the full gather and
-    the row_t saving is pure — see _step_swapfree)."""
-    if engine == "swapfree" and not gather:
-        raise UsageError(
-            "engine='swapfree' requires gather=True: its deferred row "
-            "permutation is only free when the inverse is gathered "
-            "anyway (the sharded-output twin needs a ragged "
-            "point-to-point reshuffle XLA does not expose)")
+    that implies it) runs on the gathered inverse.  The swap-free
+    engine is legal under EITHER gather mode: its deferred row
+    permutation runs as bucketed ``ppermute`` rounds inside the engine
+    (parallel/permute.py — per-worker residency capped at one shard),
+    so ``swapfree=True, gather=False`` is the pod-scale configuration:
+    the lowest projected comm bill in the only memory mode that reaches
+    32768²+ (benchmarks/comm_model.py).
+
+    ``engine`` currently gates nothing (the swap-free restriction it
+    existed for is gone) but stays in the signature: it is the shared
+    flag contract both entry points already thread, and the natural
+    seam for any future engine-specific gather rule."""
     if precision == "mixed" and not gather:
         raise UsageError(
             "precision='mixed' requires gather=True: it implies >=2 "

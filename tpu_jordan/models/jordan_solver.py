@@ -48,7 +48,9 @@ class JordanSolver:
         "auto" | "inplace" | "grouped" | "augmented" | "swapfree"; its
         docstring carries the measured dispatch policy — grouped m=128
         k=2 wins for well-conditioned matrices at n >= 8192; swapfree
-        is the distributed gather=True comm design).
+        is the distributed pod-scale comm design, legal with either
+        gather mode — its deferred row permutation runs as bucketed
+        ppermute rounds with per-worker residency capped at one shard).
     """
 
     n: int

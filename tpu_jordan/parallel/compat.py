@@ -1,0 +1,44 @@
+"""jax API compatibility: ``shard_map`` and ``pcast`` across versions.
+
+The engines are written against the current jax surface — top-level
+``jax.shard_map`` with the varying-type system and ``lax.pcast`` to
+stamp carries with a mesh-axis varying tag.  Stock jax 0.4.x ships
+shard_map at ``jax.experimental.shard_map`` and has no varying types;
+its older ``check_rep`` replication checker predates several of the
+patterns the engines rely on (one-hot psum broadcasts feeding scatter
+updates, replicated fori_loop carries against varying outputs), so on
+that lineage we run with ``check_rep=False`` — the same programs, the
+same collectives, just without the newer static type layer.  ``pcast``
+degrades to identity there: with no varying types, there is nothing to
+cast.  Every sharded module imports these two names from here instead
+of from jax, so the version split lives in exactly one file.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map          # jax >= 0.7 surface
+except ImportError:                                  # jax 0.4.x lineage
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_HAS_CHECK_REP = "check_rep" in inspect.signature(_shard_map).parameters
+
+
+def shard_map(f, *, mesh, in_specs, out_specs):
+    if _HAS_CHECK_REP:
+        return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                          out_specs=out_specs, check_rep=False)
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs)
+
+
+from jax import lax as _lax  # noqa: E402
+
+if hasattr(_lax, "pcast"):
+    pcast = _lax.pcast
+else:
+    def pcast(x, axis_name, *, to):
+        """No varying-type system in this jax: nothing to cast."""
+        del axis_name, to
+        return x

@@ -17,7 +17,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from .compat import shard_map
 from jax.sharding import PartitionSpec
 
 from ..ops.generators import GENERATORS

@@ -40,7 +40,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from .compat import pcast, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..config import eps_for
@@ -172,7 +172,7 @@ def _sharded_jordan2d(W, mesh, lay: CyclicLayout2D, eps, precision,
             return _local_step2d(t, Wl, sing, lay=lay, eps=eps,
                                  precision=precision, use_pallas=use_pallas)
 
-        sing0 = lax.pcast(jnp.zeros((1, 1), jnp.bool_), BOTH, to='varying')
+        sing0 = pcast(jnp.zeros((1, 1), jnp.bool_), BOTH, to='varying')
         Wl, sing = lax.fori_loop(0, lay.Nr, body, (Wloc, sing0))
         return Wl, sing
 
@@ -320,7 +320,7 @@ def _summa_residual_worker(a_loc, b_loc, *, lay: CyclicLayout2D, precision):
                          precision=precision)
         return d + upd.reshape(bpr, m, wc)
 
-    d0 = lax.pcast(jnp.zeros((bpr, m, wc), a_loc.dtype), BOTH, to='varying')
+    d0 = pcast(jnp.zeros((bpr, m, wc), a_loc.dtype), BOTH, to='varying')
     d = lax.fori_loop(0, lay.Nr, body, d0)
     # minus_i on the 2D-cyclic local indices.
     gi = ((jnp.arange(bpr) * pr + kr)[:, None] * m

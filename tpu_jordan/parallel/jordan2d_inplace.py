@@ -32,7 +32,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from .compat import pcast, shard_map
 from jax.sharding import Mesh, PartitionSpec
 
 from ..config import eps_for
@@ -272,8 +272,9 @@ def _step2d_swapfree(t, Wloc, alive, singular, pos, ipos, swaps, *,
     Deleted relative to ``_step2d_fori``: the row_t broadcast along
     "pr", the (m, m) swap fix-up psum along "pc", AND the entire
     per-step psum unscramble after the loop (2 x (bpr, m, m) along "pc"
-    per step) — rows and columns are repaired once, folded into the
-    full gather (the engine is gather=True-only, like its 1D twin).
+    per step) — rows and columns are repaired once at the end by
+    bucketed ppermute permutations along their own mesh axes
+    (permute.py; residency capped at one shard, so gather=False holds).
     Per step the collective bill is: chunk psum along "pc" (still
     needed — candidates and multipliers), the pivot reduction, and ONE
     (m, Wc) pivot-row psum along "pr".  Pivot parity is exact, ties
@@ -371,10 +372,14 @@ def _sharded_jordan2d_inplace_swapfree(W, mesh, lay: CyclicLayout2D, eps,
     """The swap-free 2D engine (fori_loop; any Nr): per step it drops
     the row_t psum, the swap fix-up, and the entire per-step psum
     unscramble relative to the swap engines; rows AND columns are
-    repaired once at the end, folded into the full gather
-    (gather=True-only, like the 1D twin — see _step_swapfree's honest
-    reshuffle accounting).  Bit-matches the swap engines, ties
-    included."""
+    repaired ONCE at the end by bucketed ``ppermute`` permutations
+    (permute.py) — the row permutation moves data only along the "pr"
+    axis, the column permutation only along "pc", each in axis−1
+    single-hop rounds with residency capped at one shard (N²/(pr·pc)
+    elements), so the engine holds the ``gather=False`` memory contract
+    like its 1D twin.  Bit-matches the swap engines on NONSINGULAR
+    inputs, ties included (all-singular inputs pin different benign
+    targets — both flag singular, the arrays diverge bitwise)."""
     def worker(Wloc):
         def body(t, carry):
             Wl, alive, sing, pos, ipos, swaps = carry
@@ -383,7 +388,7 @@ def _sharded_jordan2d_inplace_swapfree(W, mesh, lay: CyclicLayout2D, eps,
                                     use_pallas=use_pallas,
                                     probe_cols=probe_cols)
 
-        vary = lambda v: lax.pcast(v, BOTH, to='varying')  # noqa: E731
+        vary = lambda v: pcast(v, BOTH, to='varying')  # noqa: E731
         alive0 = vary(jnp.ones((lay.bpr,), bool))
         sing0 = vary(jnp.asarray(False))
         pos0 = vary(jnp.arange(lay.Nr, dtype=jnp.int32))
@@ -391,42 +396,33 @@ def _sharded_jordan2d_inplace_swapfree(W, mesh, lay: CyclicLayout2D, eps,
         swaps0 = vary(jnp.zeros((lay.Nr,), jnp.int32))
         Wloc, alive, singular, pos, ipos, swaps = lax.fori_loop(
             0, lay.Nr, body, (Wloc, alive0, sing0, pos0, ipos0, swaps0))
-        return (Wloc, singular[None, None], ipos[None, None],
-                swaps[None, None])
 
-    blocks, singular, ipos_all, swaps_all = shard_map(
+        from ..ops.jordan_inplace import compose_swap_perm
+
+        from .permute import ppermute_bucketed
+
+        # --- COLUMN permutation along "pc" alone: natural column block
+        # j is input column cols[j]; invert so each stored chunk knows
+        # its destination (input chunk c belongs at natural icols[c]).
+        cols = compose_swap_perm(swaps, lay.Nr)
+        icols = jnp.zeros_like(cols).at[cols].set(
+            jnp.arange(lay.Nr, dtype=jnp.int32) + 0 * cols)
+        chunks = Wloc.reshape(lay.bpr, lay.m, lay.bc1, lay.m)
+        chunks = jnp.moveaxis(chunks, 2, 0)     # (bc1, bpr, m, m)
+        chunks = ppermute_bucketed(chunks, icols, AXIS_C, lay.pc)
+        Wloc = jnp.moveaxis(chunks, 0, 2).reshape(
+            lay.bpr, lay.m, lay.bc1 * lay.m)
+        # --- ROW permutation along "pr" alone: physical row x (slot
+        # x // pr on mesh row x % pr) belongs at natural row pos[x].
+        Wloc = ppermute_bucketed(Wloc, pos, AXIS_R, lay.pr)
+        return Wloc, singular[None, None]
+
+    return shard_map(
         worker,
         mesh=mesh,
         in_specs=_SPEC_W,
-        out_specs=(_SPEC_W, PartitionSpec(AXIS_R, AXIS_C),
-                   PartitionSpec(AXIS_R, AXIS_C, None),
-                   PartitionSpec(AXIS_R, AXIS_C, None)),
+        out_specs=(_SPEC_W, PartitionSpec(AXIS_R, AXIS_C)),
     )(W)
-
-    # --- Deferred row + column permutations (the fold-into-gather
-    # repair; constrained back to the engine sharding so the output
-    # contract matches every other 2D engine — see the 1D twin's
-    # accounting note).
-    from jax.sharding import NamedSharding
-
-    from ..ops.jordan_inplace import compose_swap_perm
-
-    ipos = ipos_all[0, 0]
-    cols = compose_swap_perm(swaps_all[0, 0], lay.Nr)
-    # Rows: storage slot s holds physical global row row_perm[s];
-    # natural row g sits at physical ipos[g].
-    rp = jnp.asarray(lay.row_perm(), jnp.int32)
-    inv_rp = jnp.argsort(rp)
-    out = jnp.take(blocks, jnp.take(inv_rp, jnp.take(ipos, rp)), axis=0)
-    # Columns: storage chunk position s holds global column block
-    # col_perm[s]; natural column j is input column cols[j].
-    cp = jnp.asarray(lay.col_perm(lay.Nr), jnp.int32)
-    idx_cols = jnp.take(jnp.argsort(cp), jnp.take(cols, cp))
-    out = jnp.take(out.reshape(lay.Nr, lay.m, lay.Nr, lay.m), idx_cols,
-                   axis=2).reshape(lay.Nr, lay.m, lay.N)
-    out = jax.lax.with_sharding_constraint(
-        out, NamedSharding(mesh, _SPEC_W))
-    return out, singular
 
 
 def _unscramble_step(t: int, piv, Wloc, *, lay: CyclicLayout2D):
@@ -762,13 +758,13 @@ def _sharded_jordan2d_inplace_grouped(W, mesh, lay: CyclicLayout2D, eps,
 
     def worker(Wloc):
         bpr, m, Wc = lay.bpr, lay.m, lay.N // lay.pc
-        singular = lax.pcast(jnp.asarray(False), BOTH, to='varying')
+        singular = pcast(jnp.asarray(False), BOTH, to='varying')
         swaps = []
         for t0 in range(0, lay.Nr, kgrp):
             kg = min(kgrp, lay.Nr - t0)
-            Uloc = lax.pcast(jnp.zeros((bpr, m, kg * m), Wloc.dtype),
+            Uloc = pcast(jnp.zeros((bpr, m, kg * m), Wloc.dtype),
                              BOTH, to='varying')
-            Ploc = lax.pcast(jnp.zeros((kg * m, Wc), Wloc.dtype),
+            Ploc = pcast(jnp.zeros((kg * m, Wc), Wloc.dtype),
                              BOTH, to='varying')
             for j in range(kg):
                 Wloc, Uloc, Ploc, singular, g_piv = _gstep2d(
@@ -811,25 +807,25 @@ def _sharded_jordan2d_inplace_grouped_fori(W, mesh, lay: CyclicLayout2D,
         def body(g, carry):
             Wl, sing, swaps = carry
             t0 = (g * kgrp).astype(jnp.int32)
-            Ul = lax.pcast(jnp.zeros((bpr, m, kgrp * m), dtype),
+            Ul = pcast(jnp.zeros((bpr, m, kgrp * m), dtype),
                            BOTH, to='varying')
-            Pl = lax.pcast(jnp.zeros((kgrp * m, Wc), dtype),
+            Pl = pcast(jnp.zeros((kgrp * m, Wc), dtype),
                            BOTH, to='varying')
             for j in range(kgrp):
                 Wl, Ul, Pl, sing, g_piv = step(t0 + j, j, Wl, Ul, Pl, sing)
                 swaps = swaps.at[t0 + j].set(g_piv.astype(jnp.int32))
             return _group_end_2d(Wl, Ul, Pl, precision), sing, swaps
 
-        sing0 = lax.pcast(jnp.asarray(False), BOTH, to='varying')
-        swaps0 = lax.pcast(jnp.zeros((lay.Nr,), jnp.int32), BOTH,
+        sing0 = pcast(jnp.asarray(False), BOTH, to='varying')
+        swaps0 = pcast(jnp.zeros((lay.Nr,), jnp.int32), BOTH,
                            to='varying')
         Wloc, singular, swaps = lax.fori_loop(
             0, G, body, (Wloc, sing0, swaps0))
 
         if tail:
-            Ul = lax.pcast(jnp.zeros((bpr, m, tail * m), dtype),
+            Ul = pcast(jnp.zeros((bpr, m, tail * m), dtype),
                            BOTH, to='varying')
-            Pl = lax.pcast(jnp.zeros((tail * m, Wc), dtype),
+            Pl = pcast(jnp.zeros((tail * m, Wc), dtype),
                            BOTH, to='varying')
             for j in range(tail):
                 Wloc, Ul, Pl, singular, g_piv = step(
@@ -867,8 +863,8 @@ def _sharded_jordan2d_inplace_fori(W, mesh, lay: CyclicLayout2D, eps,
                                 precision=precision, use_pallas=use_pallas,
                                 probe_cols=probe_cols)
 
-        sing0 = lax.pcast(jnp.asarray(False), BOTH, to='varying')
-        swaps0 = lax.pcast(jnp.zeros((lay.Nr,), jnp.int32), BOTH,
+        sing0 = pcast(jnp.asarray(False), BOTH, to='varying')
+        swaps0 = pcast(jnp.zeros((lay.Nr,), jnp.int32), BOTH,
                            to='varying')
         Wloc, singular, swaps = lax.fori_loop(
             0, lay.Nr, body, (Wloc, sing0, swaps0))
@@ -896,7 +892,7 @@ def _sharded_jordan2d_inplace_fori(W, mesh, lay: CyclicLayout2D, eps,
 def _sharded_jordan2d_inplace(W, mesh, lay: CyclicLayout2D, eps, precision,
                               use_pallas, probe_cols=True):
     def worker(Wloc):
-        singular = lax.pcast(jnp.asarray(False), BOTH, to='varying')
+        singular = pcast(jnp.asarray(False), BOTH, to='varying')
         swaps = []
         for t in range(lay.Nr):
             Wloc, singular, g_piv = _step2d(

@@ -1,0 +1,85 @@
+"""Bucketed ``ppermute`` permutation over a cyclic mesh axis.
+
+The swap-free engines defer the pivot row permutation to ONE exchange
+after the elimination loop.  Implementing that exchange as a
+data-dependent ``jnp.take`` over the sharded axis makes XLA all-gather
+the whole operand — a transient full-N² buffer per worker (4 GB at
+n=32768 fp32), which is exactly the memory contract ``gather=False``
+exists to guarantee away.  The reference never materializes anything
+global either: its pivot-row exchange is pure point-to-point
+(main.cpp:1100-1131).
+
+This module is the point-to-point equivalent under XLA's static-shape
+rules, the pattern of arxiv 2112.09017 (gathers replaced by ring
+``ppermute`` exchanges) with JAXMg-style per-destination bucketing:
+
+  * the permutation is REPLICATED on every worker after the loop (the
+    ``pos`` carry), so routing needs no communication at all — each
+    round's "bucket" (which incoming rows belong here, and at which
+    slot) is computed locally from ``pos``;
+  * the exchange runs as **p − 1 single-hop ``ppermute`` rounds** on the
+    bidirectional ring: one buffer rotates forward one hop per round,
+    one backward, and at round d each worker extracts the rows of the
+    bucket addressed to it from the worker d hops away (forward rounds
+    serve distances 1..p//2, backward rounds p//2+1..p−1 — disjoint and
+    complete, so every row is delivered exactly once).  Single-hop
+    rounds are deliberate: a direct shift-by-d ``ppermute`` costs
+    min(d, p−d) link hops on the torus, so p−1 direct rounds sum to
+    ~p²/4 hop·buffers, while the rotation pipeline keeps every link busy
+    every round and finishes in ceil(p/2) round-trips;
+  * buckets are PADDED to the static worst case — ``ceil(Nr/p)`` rows,
+    i.e. the full shard, since an adversarial pivot history can route
+    every row of one worker to one destination — with validity implied
+    by the replicated ``pos`` (no mask bytes on the wire).  Wire bytes
+    are therefore bounded by (p−1)·N²/p per worker worst-case, N²/p of
+    which is payload; RESIDENCY is the contract this buys: no buffer
+    ever exceeds one shard (N²/p elements), vs the take/all-gather's
+    transient N².
+
+Used by both swap-free engines: the 1D row permutation (one call), and
+the 2D row + column permutations (one call per mesh axis — data moves
+only along the axis that shards it, never across the whole mesh).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def ppermute_bucketed(items, dest, axis_name, p: int):
+    """Deliver cyclically-stored ``items`` to their ``dest`` positions in
+    p − 1 single-hop ``ppermute`` rounds (see module docstring).
+
+    ``items``: (B, ...) — this worker's slots along the cyclic axis
+    ``axis_name`` of size ``p``; slot ``s`` on worker ``k`` holds the
+    item with physical cyclic index ``s·p + k`` (worker-major cyclic
+    storage, layout.py).  ``dest``: (B·p,) replicated int32 permutation —
+    the item at physical index ``x`` belongs at natural index
+    ``dest[x]``, which is stored at slot ``dest[x] // p`` of worker
+    ``dest[x] % p``.  Returns the (B, ...) permuted shard.  No buffer
+    larger than one shard is created, and data moves only along
+    ``axis_name``.
+    """
+    k = lax.axis_index(axis_name)
+    B = items.shape[0]
+    slots = jnp.arange(B, dtype=jnp.int32)
+
+    def extract(out, buf, src):
+        # Which rows of the buffer launched by worker ``src`` land here,
+        # and at which local slot — all from the replicated ``dest``.
+        d = jnp.take(dest, slots * p + src)     # natural index per slot
+        idx = jnp.where(d % p == k, d // p, B)  # B = dropped
+        return out.at[idx].set(buf, mode="drop")
+
+    out = extract(jnp.zeros_like(items), items, k)      # distance 0
+    fwd = bwd = items
+    fperm = [(i, (i + 1) % p) for i in range(p)]
+    bperm = [(i, (i - 1) % p) for i in range(p)]
+    for d in range(1, p // 2 + 1):
+        fwd = lax.ppermute(fwd, axis_name, fperm)       # from k - d
+        out = extract(out, fwd, (k - d) % p)
+        if d <= (p - 1) // 2:
+            bwd = lax.ppermute(bwd, axis_name, bperm)   # from k + d
+            out = extract(out, bwd, (k + d) % p)
+    return out

@@ -20,7 +20,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from .compat import pcast, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .layout import CyclicLayout, cyclic_gather_perm, cyclic_scatter_perm
@@ -54,7 +54,7 @@ def _ring_worker(a_loc, b_loc, *, lay: CyclicLayout, precision):
 
     # pcast-to-varying: the accumulator is device-varying from step one (it mixes the
     # local shard), so its initial value must carry the same vma type.
-    d0 = lax.pcast(jnp.zeros((rows, N), a_loc.dtype), AXIS, to='varying')
+    d0 = pcast(jnp.zeros((rows, N), a_loc.dtype), AXIS, to='varying')
     d, _ = lax.fori_loop(0, lay.p, body, (d0, b_loc))
     return d.reshape(bpw, m, N)
 

@@ -41,7 +41,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from .compat import pcast, shard_map
 from jax.sharding import Mesh, PartitionSpec
 
 from ..config import eps_for
@@ -229,10 +229,11 @@ def _step_swapfree(t, Wloc, alive, singular, pos, ipos, swaps, *,
     reduction: HALF the row-broadcast bytes of ``_step_fori``, which is
     the term benchmarks/comm_model.py says dominates every projected
     north-star mesh (e.g. v5p 1D p=32 @ 32768: 94 ms of 138 is comm,
-    all of it row psums).  The deferred price is ONE cross-worker row
-    permutation after the loop — point-to-point resharding bytes
-    (N²·4/p per worker), ~p× cheaper per link than the Nr allreduced
-    row_t broadcasts it replaces.
+    all of it row psums).  The deferred price is ONE bucketed-ppermute
+    row permutation after the loop (permute.py): p−1 single-hop rounds,
+    N²/p payload bytes per worker ((p−1)·N²/p worst-case padded), and
+    per-worker residency capped at one shard — so the engine holds the
+    ``gather=False`` memory contract at any scale.
 
     Pivot PARITY is exact, ties included: the live candidate set equals
     the swap engines' shrinking window (same values — eliminations are
@@ -240,7 +241,9 @@ def _step_swapfree(t, Wloc, alive, singular, pos, ipos, swaps, *,
     COORDINATE (``pos``, the position the row would occupy in the
     swap-by-copy engine), reproducing the reference's
     lowest-current-row rule (main.cpp:1051-1064) — so results bit-match
-    the swap engines after the final permutation, pinned by tests.
+    the swap engines after the final permutation on NONSINGULAR inputs,
+    pinned by tests (all-singular inputs pin different benign targets
+    per engine — both flag singular, the arrays diverge bitwise).
 
     Carries beyond the swap engines: ``alive`` (bpw,) per-worker live
     mask; ``pos``/``ipos`` (Nr,) replicated permutation bookkeeping
@@ -329,11 +332,21 @@ def _step_swapfree(t, Wloc, alive, singular, pos, ipos, swaps, *,
 def _sharded_jordan_inplace_swapfree(W, mesh, lay: CyclicLayout, eps,
                                      precision, use_pallas):
     """The swap-free 1D engine (fori_loop; any Nr): half the per-step
-    collective row bytes of the swap engines, one point-to-point row
-    permutation at the end.  Bit-matches the swap engines (after the
-    permutation) — same pivot rule including ties.  Output contract is
-    identical: (inverse blocks in cyclic NATURAL row order, singular
-    per worker)."""
+    collective row bytes of the swap engines, one bucketed ``ppermute``
+    row permutation at the end (permute.py).  Bit-matches the swap
+    engines on NONSINGULAR inputs (after the permutation) — same pivot
+    rule including ties; on all-singular inputs both flag ``singular``
+    but the returned arrays diverge bitwise (different benign pin
+    targets — pinned by tests).  Output contract is identical:
+    (inverse blocks in cyclic NATURAL row order, singular per worker).
+
+    The deferred row permutation runs INSIDE shard_map: the permutation
+    is fully replicated (``pos``), so each worker buckets its rows by
+    destination and p−1 single-hop ppermute rounds deliver them —
+    per-worker residency never exceeds one (bpw, m, N) shard (N²/p
+    elements), vs the transient full-N² buffer a sharded ``jnp.take``
+    would all-gather.  This is what makes ``gather=False`` (the
+    pod-scale memory mode) legal for this engine."""
     def worker(Wloc):
         def body(t, carry):
             Wl, alive, sing, pos, ipos, swaps = carry
@@ -342,7 +355,7 @@ def _sharded_jordan_inplace_swapfree(W, mesh, lay: CyclicLayout, eps,
                                   use_pallas=use_pallas)
 
         bpw = lay.blocks_per_worker
-        vary = lambda v: lax.pcast(v, AXIS, to='varying')  # noqa: E731
+        vary = lambda v: pcast(v, AXIS, to='varying')  # noqa: E731
         alive0 = vary(jnp.ones((bpw,), bool))
         sing0 = vary(jnp.asarray(False))
         pos0 = vary(jnp.arange(lay.Nr, dtype=jnp.int32))
@@ -355,42 +368,19 @@ def _sharded_jordan_inplace_swapfree(W, mesh, lay: CyclicLayout, eps,
 
         Wloc = apply_col_perm(Wloc, compose_swap_perm(swaps, lay.Nr),
                               lay.m)
-        return Wloc, singular[None], ipos[None]
+        # --- THE deferred row permutation, point-to-point: physical row
+        # x (slot x // p on worker x % p) belongs at natural row pos[x].
+        from .permute import ppermute_bucketed
 
-    blocks, singular, ipos_all = shard_map(
+        Wloc = ppermute_bucketed(Wloc, pos, AXIS, lay.p)
+        return Wloc, singular[None]
+
+    return shard_map(
         worker,
         mesh=mesh,
         in_specs=PartitionSpec(AXIS, None, None),
-        out_specs=(PartitionSpec(AXIS, None, None), PartitionSpec(AXIS),
-                   PartitionSpec(AXIS, None)),
+        out_specs=(PartitionSpec(AXIS, None, None), PartitionSpec(AXIS)),
     )(W)
-
-    # --- THE deferred row permutation: storage slot s holds physical
-    # global row order[s]; natural row g lives at physical row ipos[g].
-    # The data-dependent jnp.take over the sharded axis makes XLA
-    # all-gather the operand and gather locally; the sharding
-    # constraint keeps the OUTPUT on the same (AXIS, None, None)
-    # layout as every other engine (without it the result silently
-    # replicates — the gather=False memory contract would be broken).
-    # Accounting (benchmarks/comm_model.py, honest): this costs
-    # ~N²·4·(p-1)/p wire bytes per worker — about what the Nr saved
-    # row_t broadcasts cost — plus a TRANSIENT full-N² per-worker
-    # buffer, so for sharded output the engine is comm-neutral; its
-    # real win is gather=True, where the permutation folds into the
-    # full gather that happens anyway and the row_t saving is pure
-    # (driver.check_gather_flags restricts it accordingly).
-    from jax.sharding import NamedSharding
-
-    from .layout import cyclic_gather_perm, cyclic_scatter_perm
-
-    ipos = ipos_all[0]                          # replicated; any row
-    order = cyclic_gather_perm(lay)             # slot -> global block
-    scatter = cyclic_scatter_perm(lay)          # global block -> slot
-    idx = jnp.take(scatter, jnp.take(ipos, order))
-    out = jnp.take(blocks, idx, axis=0)
-    out = jax.lax.with_sharding_constraint(
-        out, NamedSharding(mesh, PartitionSpec(AXIS, None, None)))
-    return out, singular
 
 
 def _gstep(t, j: int, Wloc, Uloc, P, singular, *, lay: CyclicLayout, eps,
@@ -565,13 +555,13 @@ def _sharded_jordan_inplace_grouped(W, mesh, lay: CyclicLayout, eps,
 
     def worker(Wloc):
         bpw, m, N = lay.blocks_per_worker, lay.m, lay.N
-        singular = lax.pcast(jnp.asarray(False), AXIS, to='varying')
+        singular = pcast(jnp.asarray(False), AXIS, to='varying')
         swaps = []
         for t0 in range(0, lay.Nr, kgrp):
             kg = min(kgrp, lay.Nr - t0)
-            Uloc = lax.pcast(jnp.zeros((bpw, m, kg * m), Wloc.dtype),
+            Uloc = pcast(jnp.zeros((bpw, m, kg * m), Wloc.dtype),
                              AXIS, to='varying')
-            P = lax.pcast(jnp.zeros((kg * m, N), Wloc.dtype),
+            P = pcast(jnp.zeros((kg * m, N), Wloc.dtype),
                           AXIS, to='varying')
             for j in range(kg):
                 Wloc, Uloc, P, singular, g_piv = _gstep(
@@ -616,25 +606,25 @@ def _sharded_jordan_inplace_grouped_fori(W, mesh, lay: CyclicLayout, eps,
         def body(g, carry):
             Wl, sing, swaps = carry
             t0 = (g * kgrp).astype(jnp.int32)
-            Ul = lax.pcast(jnp.zeros((bpw, m, kgrp * m), dtype),
+            Ul = pcast(jnp.zeros((bpw, m, kgrp * m), dtype),
                            AXIS, to='varying')
-            P = lax.pcast(jnp.zeros((kgrp * m, N), dtype),
+            P = pcast(jnp.zeros((kgrp * m, N), dtype),
                           AXIS, to='varying')
             for j in range(kgrp):
                 Wl, Ul, P, sing, g_piv = step(t0 + j, j, Wl, Ul, P, sing)
                 swaps = swaps.at[t0 + j].set(g_piv.astype(jnp.int32))
             return _group_end(Wl, Ul, P, precision), sing, swaps
 
-        sing0 = lax.pcast(jnp.asarray(False), AXIS, to='varying')
-        swaps0 = lax.pcast(jnp.zeros((lay.Nr,), jnp.int32), AXIS,
+        sing0 = pcast(jnp.asarray(False), AXIS, to='varying')
+        swaps0 = pcast(jnp.zeros((lay.Nr,), jnp.int32), AXIS,
                            to='varying')
         Wloc, singular, swaps = lax.fori_loop(
             0, G, body, (Wloc, sing0, swaps0))
 
         if tail:
-            Ul = lax.pcast(jnp.zeros((bpw, m, tail * m), dtype),
+            Ul = pcast(jnp.zeros((bpw, m, tail * m), dtype),
                            AXIS, to='varying')
-            P = lax.pcast(jnp.zeros((tail * m, N), dtype),
+            P = pcast(jnp.zeros((tail * m, N), dtype),
                           AXIS, to='varying')
             for j in range(tail):
                 Wloc, Ul, P, singular, g_piv = step(
@@ -671,8 +661,8 @@ def _sharded_jordan_inplace_fori(W, mesh, lay: CyclicLayout, eps, precision,
             return _step_fori(t, Wl, sing, swaps, lay=lay, eps=eps,
                               precision=precision, use_pallas=use_pallas)
 
-        sing0 = lax.pcast(jnp.asarray(False), AXIS, to='varying')
-        swaps0 = lax.pcast(jnp.zeros((lay.Nr,), jnp.int32), AXIS,
+        sing0 = pcast(jnp.asarray(False), AXIS, to='varying')
+        swaps0 = pcast(jnp.zeros((lay.Nr,), jnp.int32), AXIS,
                            to='varying')
         Wloc, singular, swaps = lax.fori_loop(
             0, lay.Nr, body, (Wloc, sing0, swaps0))
@@ -700,7 +690,7 @@ def _sharded_jordan_inplace_fori(W, mesh, lay: CyclicLayout, eps, precision,
 def _sharded_jordan_inplace(W, mesh, lay: CyclicLayout, eps, precision,
                             use_pallas):
     def worker(Wloc):
-        singular = lax.pcast(jnp.asarray(False), AXIS, to='varying')
+        singular = pcast(jnp.asarray(False), AXIS, to='varying')
         swaps = []
         for t in range(lay.Nr):
             Wloc, singular, g_piv = _step(
@@ -750,9 +740,10 @@ def compile_sharded_jordan_inplace(
     stacked row psum per step — the measured single-chip winner at
     large n, ported; parity with the plain engines is to rounding).
     ``swapfree=True`` takes the implicit-permutation engine instead:
-    half the per-step collective row bytes, one point-to-point row
-    permutation at the end — the pod-scale comm design
-    (benchmarks/comm_model.py); bit-identical results."""
+    half the per-step collective row bytes, one bucketed-ppermute row
+    permutation at the end (residency capped at one shard — legal under
+    gather=False) — the pod-scale comm design (benchmarks/comm_model.py);
+    bit-identical results on nonsingular inputs."""
     from .sharded_jordan import resolve_use_pallas
 
     if eps is None:

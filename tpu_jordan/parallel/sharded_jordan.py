@@ -35,7 +35,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax import shard_map
+from .compat import pcast, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..config import eps_for
@@ -164,7 +164,7 @@ def _sharded_jordan(W, mesh, lay: CyclicLayout, eps, precision, use_pallas):
         # varying-axis typing marks as device-varying — the carry must start
         # out varying too, and the flag is returned per-worker (any() on the
         # host gives the collective verdict, identical on every worker).
-        sing0 = lax.pcast(jnp.zeros((1,), jnp.bool_), AXIS, to='varying')
+        sing0 = pcast(jnp.zeros((1,), jnp.bool_), AXIS, to='varying')
         Wl, sing = lax.fori_loop(0, lay.Nr, body, (Wloc, sing0))
         return Wl, sing
 

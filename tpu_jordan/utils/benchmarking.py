@@ -17,11 +17,19 @@ import time
 import numpy as np
 
 
-def slope_time(fn, args, r1: int = 4, r2: int = 12, trials: int = 3):
+def slope_time(fn, args, r1: int = 4, r2: int = 12, trials: int = 3,
+               samples: int = 1):
     """Per-iteration seconds of ``fn(*args)``, constant offsets cancelled.
 
     ``fn`` must return an array; its sum is folded back into ``args[0]``
     (times 1e-30) to chain iterations without changing the computation.
+
+    ``samples > 1`` repeats the whole (r1, r2) slope measurement that
+    many times on the SAME compiled executable and returns the list of
+    slopes — the median-of-N bench captures (VERDICT r5 weak #1: one
+    slope per session can silently lose 15% to the session lottery).
+    Reusing the executable matters: a fresh ``slope_time`` call re-jits
+    ``many``, and the unrolled engines' compile dwarfs the measurement.
     """
     import jax
     import jax.numpy as jnp
@@ -46,5 +54,10 @@ def slope_time(fn, args, r1: int = 4, r2: int = 12, trials: int = 3):
             ts.append(time.perf_counter() - t0)
         return float(np.min(ts))
 
-    t1, t2 = measure(r1), measure(r2)
-    return (t2 - t1) / (r2 - r1)
+    def sample():
+        t1, t2 = measure(r1), measure(r2)
+        return (t2 - t1) / (r2 - r1)
+
+    if samples == 1:
+        return sample()
+    return [sample() for _ in range(samples)]
