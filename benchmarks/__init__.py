@@ -1,0 +1,6 @@
+"""Benchmarks as an importable package: ``benchmarks.comm_model`` is the
+single source of the chip/topology constants (``topology_params``), and
+the autotuner's cost hook (``tpu_jordan/tuning/registry.py``) imports it
+from here when the repo root is on ``sys.path`` (the tuner falls back to
+a file-path import otherwise, so an installed ``tpu_jordan`` keeps
+working without this directory)."""

@@ -83,6 +83,68 @@ V5P = Chip("v5p", 72e12, 2.77e12, 9.0e10, 3.4)
 LATENCY = 2e-6          # per collective, seconds (ICI hop + launch)
 C_PROBE_V5E = 4.07e-12  # s per candidate-element pass (35 ms @ 8192/256)
 
+# The projected north-star configurations — ONE place (ISSUE 2
+# satellite: these rows were previously duplicated between this module's
+# ``main`` and the PHASES.md projection tables; the tuner's cost hook is
+# a third consumer).  Each row: (n, m, pr, pc, chip_name, group,
+# swapfree).  ``main`` renders them; ``topology_params`` exposes them.
+NORTH_STAR_ROWS = (
+    # v4-8 (4 chips) and v5e-8 class, 8192 (plain vs grouped vs SF).
+    (8192, 256, 8, 1, "v5e", 1, False),
+    (8192, 256, 8, 1, "v5e", 4, False),
+    (8192, 256, 8, 1, "v5e", 1, True),
+    (8192, 256, 2, 4, "v5e", 1, False),
+    (8192, 256, 2, 4, "v5e", 4, False),
+    (8192, 512, 4, 1, "v4", 1, False),
+    (8192, 512, 2, 2, "v4", 1, False),
+    # v5p-32, 32768 (the 2D north star; 1D shown for contrast).
+    (32768, 512, 32, 1, "v5p", 1, False),
+    (32768, 512, 32, 1, "v5p", 4, False),
+    (32768, 512, 32, 1, "v5p", 1, True),
+    (32768, 512, 4, 8, "v5p", 1, False),
+    (32768, 512, 4, 8, "v5p", 4, False),
+    (32768, 256, 4, 8, "v5p", 4, False),
+    (32768, 512, 4, 8, "v5p", 1, True),
+    # v5p-64, 65536.
+    (65536, 512, 64, 1, "v5p", 1, False),
+    (65536, 512, 64, 1, "v5p", 1, True),
+    (65536, 512, 8, 8, "v5p", 1, False),
+    (65536, 512, 8, 8, "v5p", 1, True),
+    (65536, 512, 8, 8, "v5p", 4, False),
+    (65536, 256, 8, 8, "v5p", 4, False),
+)
+
+
+def topology_params() -> dict:
+    """The public, single source of the chip/topology constants.
+
+    Consumed by (a) this module's own ``main`` (the PHASES.md projection
+    tables are regenerated from its output) and (b) the autotuner's cost
+    hook (``tpu_jordan/tuning/registry.py``), so the v5p/v5e/v4 envelope,
+    HBM, and ICI numbers can never drift between the projections and the
+    product's engine ranking.
+
+    Returns::
+
+        {"chips":        {name: Chip},      # measured/scaled constants
+         "backend_chip": {backend: name},   # cost-ranking stand-in per
+                                            # jax backend ("cpu"/"axon"
+                                            # rank with the calibrated
+                                            # v5e model: the tuner needs
+                                            # RELATIVE engine costs, not
+                                            # wall-clock truth)
+         "latency":      seconds per collective,
+         "c_probe_v5e":  probe calibration constant,
+         "north_star":   NORTH_STAR_ROWS}
+    """
+    return {
+        "chips": {c.name: c for c in (V5E, V4, V5P)},
+        "backend_chip": {"tpu": "v5e", "cpu": "v5e", "axon": "v5e"},
+        "latency": LATENCY,
+        "c_probe_v5e": C_PROBE_V5E,
+        "north_star": NORTH_STAR_ROWS,
+    }
+
 
 def _allreduce(S: float, a: int, chip: Chip) -> float:
     return 0.0 if a == 1 else S * (a - 1) / a / chip.ici + LATENCY
@@ -232,33 +294,9 @@ def main():
     print("| mesh | n | m | elim ms | probe ms | comm ms | total ms "
           "| GFLOP/s | par.eff |")
     print("|---|---|---|---|---|---|---|---|---|")
-    rows = [
-        # v4-8 (4 chips) and v5e-8 class, 8192 (plain vs grouped vs SF).
-        (8192, 256, 8, 1, V5E, 1, False),
-        (8192, 256, 8, 1, V5E, 4, False),
-        (8192, 256, 8, 1, V5E, 1, True),
-        (8192, 256, 2, 4, V5E, 1, False),
-        (8192, 256, 2, 4, V5E, 4, False),
-        (8192, 512, 4, 1, V4, 1, False),
-        (8192, 512, 2, 2, V4, 1, False),
-        # v5p-32, 32768 (the 2D north star; 1D shown for contrast).
-        (32768, 512, 32, 1, V5P, 1, False),
-        (32768, 512, 32, 1, V5P, 4, False),
-        (32768, 512, 32, 1, V5P, 1, True),
-        (32768, 512, 4, 8, V5P, 1, False),
-        (32768, 512, 4, 8, V5P, 4, False),
-        (32768, 256, 4, 8, V5P, 4, False),
-        (32768, 512, 4, 8, V5P, 1, True),
-        # v5p-64, 65536.
-        (65536, 512, 64, 1, V5P, 1, False),
-        (65536, 512, 64, 1, V5P, 1, True),
-        (65536, 512, 8, 8, V5P, 1, False),
-        (65536, 512, 8, 8, V5P, 1, True),
-        (65536, 512, 8, 8, V5P, 4, False),
-        (65536, 256, 8, 8, V5P, 4, False),
-    ]
-    for n, m, pr, pc, chip, g, sf in rows:
-        print(_fmt(n, m, pr, pc, chip, g, sf))
+    chips = topology_params()["chips"]
+    for n, m, pr, pc, chip_name, g, sf in NORTH_STAR_ROWS:
+        print(_fmt(n, m, pr, pc, chips[chip_name], g, sf))
 
 
 if __name__ == "__main__":

@@ -13,7 +13,10 @@ from tpu_jordan.parallel.jordan2d_inplace import (
 
 
 class TestSharded2DInplace:
-    @pytest.mark.parametrize("shape", [(2, 4), (4, 2), (2, 2)])
+    @pytest.mark.parametrize("shape", [
+        (2, 4),
+        pytest.param((4, 2), marks=pytest.mark.slow),
+        pytest.param((2, 2), marks=pytest.mark.slow)])
     def test_matches_single_device_inplace(self, rng, shape):
         mesh = make_mesh_2d(*shape)
         a = jnp.asarray(rng.standard_normal((64, 64)), jnp.float64)
@@ -63,7 +66,8 @@ class TestSharded2DInplace:
         assert not bool(sing)
 
     @pytest.mark.parametrize("pr,pc,n,m", [
-        (2, 4, 128, 16), (4, 2, 128, 16),
+        (2, 4, 128, 16),
+        pytest.param(4, 2, 128, 16, marks=pytest.mark.slow),
         pytest.param(2, 2, 96, 8, marks=pytest.mark.slow)])
     def test_fori_bitmatches_unrolled(self, rng, pr, pc, n, m):
         # Traced-t engine vs unrolled trace: identical pivots, identical

@@ -15,6 +15,7 @@ bytes are asserted on the solver OUTPUT, and the blocks must bit-match
 the gathered path (ties included — the |i−j| fixture exercises exact
 pivot ties)."""
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
@@ -82,7 +83,10 @@ def test_swapfree_no_gather_1d_shard_bytes_and_bitmatch():
     assembled = gather_inverse_inplace(
         jnp.asarray(r_sf.inverse_blocks), lay, n)
     assert bool(jnp.all(assembled == r_gathered.inverse))
-    r_swap = solve(n, m, workers=p, gather=False, dtype=jnp.float64)
+    # The swap engine, pinned explicitly ("auto" routes through the
+    # autotuner since ISSUE 2 and may legitimately pick another engine).
+    r_swap = solve(n, m, workers=p, gather=False, dtype=jnp.float64,
+                   engine="inplace")
     assert bool(jnp.all(jnp.asarray(r_sf.inverse_blocks)
                         == jnp.asarray(r_swap.inverse_blocks)))
 
@@ -105,6 +109,104 @@ def test_swapfree_no_gather_2d_shard_bytes_and_bitmatch():
         jnp.asarray(r_sf.inverse_blocks), lay, n)
     assert bool(jnp.all(assembled == r_gathered.inverse))
     r_swap = solve(n, m, workers=(pr, pc), gather=False,
-                   dtype=jnp.float64)
+                   dtype=jnp.float64, engine="inplace")
     assert bool(jnp.all(jnp.asarray(r_sf.inverse_blocks)
                         == jnp.asarray(r_swap.inverse_blocks)))
+
+
+class TestAutoEngineLegs:
+    """ISSUE 2 MULTICHIP harness legs: ``--engine auto`` on the
+    8-virtual-device CPU mesh.  The autotuner must select a LEGAL
+    registry engine at every dryrun leg (1D p=8 and 2D 2x4, gather=True
+    and gather=False), and the result must bit-match the same engine
+    requested directly (the acceptance contract; the zero-measurement
+    warm-cache half is pinned by the counter tests in test_tuning.py)."""
+
+    @pytest.mark.parametrize("workers,gather", [
+        (8, True), (8, False), ((2, 4), True), ((2, 4), False),
+    ])
+    def test_auto_selects_legal_engine_and_bitmatches(self, workers,
+                                                      gather):
+        from tpu_jordan.tuning.registry import REGISTRY, TunePoint
+
+        n, m = 64, 8
+        r = solve(n, m, workers=workers, gather=gather, dtype=jnp.float64,
+                  engine="auto")
+        cfgs = {c.engine: c for c in REGISTRY.values()}
+        assert r.engine in cfgs, f"auto selected unregistered {r.engine!r}"
+        pt = TunePoint.create(n, m, jnp.float64, workers, gather)
+        assert cfgs[r.engine].legal(pt), \
+            f"auto selected {r.engine!r}, illegal at {pt}"
+        assert r.plan is not None and r.plan.source == "cost_model"
+        direct = solve(n, m, workers=workers, gather=gather,
+                       dtype=jnp.float64, engine=r.engine, group=r.group)
+        if gather:
+            assert bool(jnp.all(r.inverse == direct.inverse))
+        else:
+            assert bool(jnp.all(jnp.asarray(r.inverse_blocks)
+                                == jnp.asarray(direct.inverse_blocks)))
+
+    def test_auto_gather_false_swapfree_selection(self, tmp_path):
+        """The gather=False swap-free auto-selection leg: (a) the cost
+        model routes the v5p pod-scale north-star meshes to the
+        swap-free engine under gather=False (the ISSUE 2 promise — the
+        projections in benchmarks/PHASES.md say SF wins there), and
+        (b) an executed CPU-mesh solve honoring a swap-free plan from a
+        warm cache runs swapfree and bit-matches the direct request."""
+        from tpu_jordan.tuning import (Plan, PlanCache, TunePoint,
+                                       plan_key, select_by_cost)
+
+        for mesh in ((4, 8), (8, 8)):
+            n = 32768 if mesh == (4, 8) else 65536
+            pt = TunePoint.create(n, 512, jnp.float32, mesh, gather=False,
+                                  backend="tpu", chip="v5p")
+            assert select_by_cost(pt).engine == "swapfree", \
+                f"v5p {mesh} @ {n} gather=False must rank swap-free first"
+        # Executed leg: seed a plan cache with the swap-free plan for
+        # this CPU-mesh point; auto must honor it (zero measurements)
+        # and bit-match engine='swapfree' requested directly.
+        n, m, mesh = 64, 8, (2, 4)
+        pt = TunePoint.create(n, m, jnp.float64, mesh, gather=False)
+        path = str(tmp_path / "plans.json")
+        cache = PlanCache(path)
+        cache.put(plan_key(pt), Plan(config="swapfree", engine="swapfree",
+                                     group=0, source="measured",
+                                     seconds=1e-3))
+        cache.save()
+        r = solve(n, m, workers=mesh, gather=False, dtype=jnp.float64,
+                  engine="auto", plan_cache=path)
+        assert r.engine == "swapfree"
+        direct = solve(n, m, workers=mesh, gather=False,
+                       dtype=jnp.float64, engine="swapfree")
+        assert bool(jnp.all(jnp.asarray(r.inverse_blocks)
+                            == jnp.asarray(direct.inverse_blocks)))
+
+
+def test_32768_fp32_aot_lowering_shape():
+    """Compile-only pin of the above-16384 path (ISSUE 2 / VERDICT r5):
+    AOT-lower the auto-selected single-chip engine at n=32768 fp32 — no
+    execution, no 4 GiB buffers (abstract avals only) — and check the
+    output shapes.  m=256 puts Nr=128 over MAX_UNROLL_NR, so this also
+    pins that the auto path takes the fori twin whose trace cost is flat
+    in Nr (the reason 32768 is traceable at all)."""
+    from jax import lax
+
+    from tpu_jordan.driver import single_device_invert
+    from tpu_jordan.parallel.sharded_inplace import MAX_UNROLL_NR
+    from tpu_jordan.tuning.registry import TunePoint, select_by_cost
+
+    n, m = 32768, 256
+    assert -(-n // m) > MAX_UNROLL_NR
+    cfg = select_by_cost(TunePoint.create(n, m, jnp.float32, 1, True))
+    # The measured single-chip dispatch policy, reproduced by the cost
+    # ranking: the delayed-group-update engine owns n >= 8192.
+    assert cfg.engine == "grouped"
+    a = jax.ShapeDtypeStruct((n, n), jnp.float32)
+    lowered = jax.jit(
+        single_device_invert(n, m, cfg.engine, cfg.group),
+        static_argnames=("block_size", "refine", "precision"),
+    ).lower(a, block_size=m, refine=0, precision=lax.Precision.HIGHEST)
+    out_inv, out_sing = lowered.out_info
+    assert tuple(out_inv.shape) == (n, n)
+    assert out_inv.dtype == jnp.float32
+    assert tuple(out_sing.shape) == ()

@@ -14,6 +14,8 @@ import sys
 
 import jax.numpy as jnp
 
+from .tuning.registry import ENGINES
+
 
 def _workers_arg(s: str):
     """'8' -> 8 workers on a 1D mesh; '2x4' -> a (2, 4) 2D mesh."""
@@ -49,10 +51,11 @@ def main(argv=None) -> int:
     ap.add_argument("--refine", type=int, default=0,
                     help="Newton-Schulz refinement steps")
     ap.add_argument("--engine", default="auto",
-                    choices=["auto", "inplace", "grouped", "augmented",
-                             "swapfree"],
-                    help="elimination engine: 'auto' = the conservative "
-                         "in-place 2N^3 default; 'grouped' = delayed "
+                    choices=list(ENGINES),
+                    help="elimination engine: 'auto' = autotuned "
+                         "selection (plan cache -> registry cost "
+                         "ranking -> --tune measured tuning; "
+                         "docs/TUNING.md); 'grouped' = delayed "
                          "group updates, the measured winner for "
                          "well-conditioned matrices at n >= 8192 with "
                          "m=128 (driver.resolve_engine documents the "
@@ -66,6 +69,20 @@ def main(argv=None) -> int:
     ap.add_argument("--group", type=int, default=0,
                     help="panels per delayed-group update (implies "
                          "--engine grouped when > 1; grouped default 2)")
+    ap.add_argument("--tune", action="store_true",
+                    help="--engine auto only: measure the registry's "
+                         "cost-pruned engine candidates at this "
+                         "(n, dtype, mesh, gather) point with the robust "
+                         "core (median-of-k, IQR outlier rejection) and "
+                         "run the fastest; combine with --plan-cache to "
+                         "persist the plan (docs/TUNING.md)")
+    ap.add_argument("--plan-cache", default=None, metavar="PATH",
+                    help="--engine auto only: versioned JSON plan cache "
+                         "consulted before any cost ranking or "
+                         "measurement (a warm hit performs zero "
+                         "measurements) and updated after selection; "
+                         "corrupt/version-stale files fall back to "
+                         "cost-model ranking")
     ap.add_argument("--workers", type=_workers_arg, default=1,
                     help="devices in the mesh: an integer for the 1D "
                          "row-cyclic layout (the reference's mpirun -np), "
@@ -161,6 +178,9 @@ def main(argv=None) -> int:
                 # batch-relevant n.
                 raise UsageError("--batch uses the batched engine; "
                                  "--engine/--group do not apply")
+            if args.tune or args.plan_cache:
+                raise UsageError("--batch uses the batched engine; "
+                                 "--tune/--plan-cache do not apply")
             result = solve_batch(
                 n=args.n,
                 block_size=args.m,
@@ -185,6 +205,8 @@ def main(argv=None) -> int:
                 precision=args.precision,
                 engine=args.engine,
                 group=args.group,
+                tune=args.tune,
+                plan_cache=args.plan_cache,
             )
     except FileNotFoundError:
         print(f"cannot open {args.file}")
@@ -208,6 +230,11 @@ def main(argv=None) -> int:
     if args.quiet:
         print(f"glob_time: {result.elapsed:.2f}")
         print(f"residual: {result.residual:e}")
+    elif result.plan is not None:
+        # Surface what the autotuner ran (and from which ladder rung) so
+        # --engine auto is never a black box.
+        print(f"engine: {result.engine} "
+              f"(auto, {result.plan.source} plan)")
     return 0
 
 

@@ -58,6 +58,12 @@ class SolveResult:
     kappa: float | None = None  # κ∞(A) = ‖A‖∞‖A⁻¹‖∞ (ops/norms.condition_inf):
     #   no reference analog — the accuracy context the residual needs
     #   (expected rel residual ≈ eps·n·κ∞/‖A‖∞, benchmarks/PHASES.md)
+    engine: str | None = None   # the RESOLVED engine that ran ("auto" never
+    #   appears here: the tuner's pick is recorded so callers can see —
+    #   and re-request — exactly what ran)
+    group: int = 0              # resolved delayed-group size (0 = ungrouped)
+    plan: object | None = None  # tuning.Plan when engine="auto" selected it
+    #   (source: "cache" via plan.source preserved / cost_model / measured)
 
     @property
     def rel_residual(self) -> float | None:
@@ -70,14 +76,19 @@ class SolveResult:
     _norm_a: float | None = None             # ‖A‖∞, backing rel_residual
 
 
-ENGINES = ("auto", "inplace", "grouped", "augmented", "swapfree")
+# The engine vocabulary is DERIVED from the declarative registry
+# (tuning/registry.py — name, legality, cost hook per configuration);
+# tests/test_tuning.py lints that the two can never drift.
+from .tuning.registry import ENGINES
 
 
 def resolve_engine(engine: str, group: int):
     """Shared engine/group flag contract (solve, JordanSolver, CLI).
 
-    Returns the resolved ``(engine, group)`` pair: "auto" keeps the
-    conservative default (the plain in-place 2N³ engine) unless
+    Returns the resolved ``(engine, group)`` pair: "auto" stays "auto"
+    (the caller then routes it through the autotuner ladder —
+    ``tuning.auto_select``: plan cache, cost-model ranking over the
+    declarative registry, optionally measured tuning) unless
     ``group > 1`` explicitly opts into the delayed-group-update engine;
     "grouped" defaults ``group`` to the measured-best k=2.
 
@@ -88,8 +99,11 @@ def resolve_engine(engine: str, group: int):
     engine at its best m); at n <= 4096, or on ill-conditioned inputs
     where small pivot blocks sit under the fp32 noise floor (the |i−j|
     fixture at n >= 8192 with m <= 256), the plain engine at the
-    default block size remains the right choice — which is why "auto"
-    does not select grouped on its own.
+    default block size remains the right choice.  This policy is
+    encoded as a cost-hook prior in the registry (grouped is never
+    cost-preferred on a single chip below 8192), so cost-only "auto"
+    reproduces it; a measured tuning run (``tune=True``) can still
+    overrule the model with evidence.
 
     "swapfree" is the distributed pod-scale comm design (lowest
     projected comm bill at the v5p north-star meshes) and is legal
@@ -137,6 +151,8 @@ def solve(
     precision: str = "highest",
     engine: str = "auto",
     group: int = 0,
+    tune: bool = False,
+    plan_cache: str | None = None,
 ) -> SolveResult:
     """Invert an n x n matrix from a file or a generator and verify it.
 
@@ -166,6 +182,16 @@ def solve(
     in speed and summation order only — same pivot rule, same results
     to rounding.
 
+    ``engine="auto"`` resolves through the autotuner ladder
+    (tuning/tuner.py): a ``plan_cache`` JSON hit costs zero
+    measurements; otherwise the declarative registry's legality + cost
+    ranking picks the projected-best engine, and ``tune=True``
+    additionally MEASURES the cost-pruned survivors (robust median-of-k,
+    IQR outlier rejection) and persists the winner to ``plan_cache``.
+    The resolved choice is reported on ``SolveResult.engine``/``group``/
+    ``plan``.  ``tune``/``plan_cache`` with an explicit engine is a
+    UsageError — a requested engine leaves nothing to tune.
+
     Raises SingularMatrixError like the reference's -2 path
     (main.cpp:435-437); file errors propagate from read_matrix_file.
     """
@@ -173,6 +199,27 @@ def solve(
         block_size = default_block_size(n)
     prec = _PRECISIONS[precision]
     engine, group = resolve_engine(engine, group)
+    distributed = isinstance(workers, tuple) or workers > 1
+    if (tune or plan_cache is not None) and engine != "auto":
+        raise UsageError("tune/plan_cache apply to engine='auto' only "
+                         "(an explicit engine leaves nothing to tune)")
+    if not distributed and not gather:
+        raise UsageError(
+            "gather=False is only supported on distributed paths "
+            "(workers > 1 or a (pr, pc) tuple)"
+        )
+    if distributed:
+        # Flag validity is engine-independent — check it BEFORE the
+        # autotuner so an invalid combination never pays for selection
+        # (let alone a measured tuning run).
+        check_gather_flags(gather, refine, precision, engine)
+    plan = None
+    if engine == "auto":
+        from .tuning.tuner import auto_select
+
+        engine, group, plan = auto_select(n, block_size, dtype, workers,
+                                          gather, tune=tune,
+                                          plan_cache=plan_cache)
 
     def load():
         if file is not None:
@@ -180,25 +227,21 @@ def solve(
             return jax.device_put(jnp.asarray(host, dtype), device)
         return jax.device_put(generate(generator, (n, n), dtype), device)
 
-    if isinstance(workers, tuple) or workers > 1:
+    if distributed:
         from .ops.refine import resolve_precision
 
-        check_gather_flags(gather, refine, precision, engine)
         sweep_prec, refine = resolve_precision(prec, refine)
         be = make_distributed_backend(workers, n, block_size, engine, group)
-        return _solve_distributed_core(
+        res = _solve_distributed_core(
             be, n, block_size, file, generator, dtype, refine, verbose,
             gather, load, sweep_prec,
         )
+        res.engine, res.group, res.plan = engine, group, plan
+        return res
 
     if engine == "swapfree":
         raise UsageError("engine='swapfree' is a distributed engine "
                          "(its win is collective bytes); use workers=p")
-    if not gather:
-        raise UsageError(
-            "gather=False is only supported on distributed paths "
-            "(workers > 1 or a (pr, pc) tuple)"
-        )
 
     a = load()
     if verbose:
@@ -253,6 +296,9 @@ def solve(
         gflops=2.0 * n**3 / elapsed / 1e9,
         kappa=kappa,
         _norm_a=norm_a,
+        engine=engine,
+        group=group,
+        plan=plan,
     )
 
 

@@ -51,6 +51,12 @@ class JordanSolver:
         is the distributed pod-scale comm design, legal with either
         gather mode — its deferred row permutation runs as bucketed
         ppermute rounds with per-worker residency capped at one shard).
+      tune/plan_cache: ``engine="auto"`` only — the autotuner ladder
+        (tuning/tuner.py): consult the ``plan_cache`` JSON (a warm hit
+        performs zero measurements), else rank by the registry's cost
+        model, and with ``tune=True`` measure the cost-pruned survivors
+        and persist the winner.  The resolved pick lands on
+        ``self.engine``/``self.group``/``self.plan``.
     """
 
     n: int
@@ -62,36 +68,52 @@ class JordanSolver:
     gather: bool = True
     engine: str = "auto"
     group: int = 0
+    tune: bool = False
+    plan_cache: str | None = None
+    plan: Any = field(default=None, repr=False)
     _run: Any = field(default=None, repr=False)
     _be: Any = field(default=None, repr=False)
 
     def __post_init__(self):
-        from ..driver import resolve_engine
+        from ..driver import UsageError, resolve_engine
         from ..ops.refine import PRECISIONS, resolve_precision
 
         if self.block_size is None:
             self.block_size = default_block_size(self.n)
         self.engine, self.group = resolve_engine(self.engine, self.group)
+        if (self.tune or self.plan_cache) and self.engine != "auto":
+            raise UsageError("tune/plan_cache apply to engine='auto' only "
+                             "(an explicit engine leaves nothing to tune)")
+        if not self._distributed and not self.gather:
+            raise UsageError("gather=False requires a distributed mesh")
         if self._distributed:
             # Shared with driver.solve (flag contract + layout policy
             # can't drift): validate flags BEFORE resolve_precision bumps
             # refine, exactly like solve does.
-            from ..driver import check_gather_flags, make_distributed_backend
+            from ..driver import check_gather_flags
 
             check_gather_flags(self.gather, self.refine, self.precision,
                                self.engine)
+        if self.engine == "auto":
+            # The same autotuner ladder as driver.solve: plan cache ->
+            # registry cost ranking -> (tune=True) measured survivors.
+            # The resolved pick is pinned on self.engine/group/plan, so
+            # the cached executable and the reported configuration can
+            # never disagree.
+            from ..tuning.tuner import auto_select
+
+            self.engine, self.group, self.plan = auto_select(
+                self.n, self.block_size, self.dtype, self.workers,
+                self.gather, tune=self.tune, plan_cache=self.plan_cache)
+        if not self._distributed and self.engine == "swapfree":
+            raise UsageError("engine='swapfree' is a distributed engine "
+                             "(its win is collective bytes); use workers=p")
+        if self._distributed:
+            from ..driver import make_distributed_backend
+
             self._be = make_distributed_backend(
                 self.workers, self.n, self.block_size, self.engine,
                 self.group)
-        elif not self.gather:
-            from ..driver import UsageError
-
-            raise UsageError("gather=False requires a distributed mesh")
-        elif self.engine == "swapfree":
-            from ..driver import UsageError
-
-            raise UsageError("engine='swapfree' is a distributed engine "
-                             "(its win is collective bytes); use workers=p")
         # Resolve the precision policy once: "mixed" implies HIGH sweeps
         # and bumps refine to the policy minimum.
         self._sweep_prec, self.refine = resolve_precision(

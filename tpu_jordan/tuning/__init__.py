@@ -1,0 +1,37 @@
+"""Autotuner + persistent plan cache (ISSUE 2 tentpole): measured engine
+selection with a variance-damped measurement core.
+
+Four parts (docs/TUNING.md is the operator guide):
+
+  * ``registry``   — the single declarative registry of every engine
+    configuration (legality predicates + comm_model cost hooks); the
+    driver's ``ENGINES`` vocabulary derives from it.
+  * ``measure``    — the robust measurement core (warmup, median-of-k
+    with IQR outlier rejection, variance flags, typed transient retry),
+    shared with bench.py.
+  * ``tuner``      — cache -> cost ranking -> measured tuning ladder;
+    records measured-vs-projected drift.
+  * ``plan_cache`` — the versioned JSON plan store keyed by
+    (backend, topology, n-bucket, dtype, memory mode) with
+    corruption/staleness fallback.
+
+Product surface: ``solve(engine="auto", tune=..., plan_cache=...)``,
+``JordanSolver(engine="auto", ...)``, CLI ``--engine auto --tune
+--plan-cache PATH``.
+"""
+
+from .measure import (Measurement, is_transient, measure_direct,
+                      measure_slope, retry_transient, robust_stats)
+from .plan_cache import CACHE_VERSION, Plan, PlanCache, n_bucket, plan_key
+from .registry import (CONFIGS, ENGINES, REGISTRY, EngineConfig,
+                       TunePoint, candidates, select_by_cost)
+from .tuner import Tuner, auto_select, measure_config
+
+__all__ = [
+    "Measurement", "is_transient", "measure_direct", "measure_slope",
+    "retry_transient", "robust_stats",
+    "CACHE_VERSION", "Plan", "PlanCache", "n_bucket", "plan_key",
+    "CONFIGS", "ENGINES", "REGISTRY", "EngineConfig", "TunePoint",
+    "candidates", "select_by_cost",
+    "Tuner", "auto_select", "measure_config",
+]

@@ -1,0 +1,163 @@
+"""Versioned, persistent JSON plan cache (ISSUE 2 tentpole part 4).
+
+A *plan* is one resolved engine choice for one plan key; the cache is a
+flat ``{key: plan}`` JSON document with a format version.  Keys are
+``backend|topology|n-bucket|dtype|memory-mode`` (``plan_key``): the five
+coordinates engine choice is measured to depend on.  ``n`` is bucketed
+to the next power of two — engine crossover points move slowly with n
+(the measured grouped/plain crossover sits between 4096 and 8192), so a
+plan tuned at 10000 legitimately serves 16384-bucket neighbors while the
+cache stays small enough to pre-tune a pod in minutes (docs/TUNING.md).
+
+Failure policy (all covered by tests/test_tuning.py): a missing file is
+an empty cache; a corrupt file (bad JSON, wrong structure, bad plan
+fields) or a version mismatch is ALSO an empty cache with
+``fallback_reason`` set — the tuner then falls back to cost-model
+ranking instead of crashing the solve, and the next ``save`` rewrites
+the file cleanly.  Saves are atomic (tmp + ``os.replace``) so a crashed
+writer can never leave a half-written cache for the next reader.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+from dataclasses import dataclass, field, asdict
+
+from .registry import TunePoint
+
+CACHE_VERSION = 1
+
+
+def n_bucket(n: int) -> int:
+    """Round ``n`` up to the next power of two (the cache-key bucket)."""
+    return 1 << max(0, int(n - 1).bit_length())
+
+
+def plan_key(point: TunePoint) -> str:
+    """``backend|topology|n-bucket|dtype|memory-mode`` — e.g.
+    ``tpu-v5p|4x8|n32768|float32|sharded``.
+
+    The backend segment carries the sniffed chip generation when known
+    (``tpu-v5p`` vs bare ``tpu``): a plans.json measured on a v5e pod
+    must not be honored verbatim on a v5p pod — the v5p link/HBM ratios
+    are exactly what flips the engine ranking at pod meshes."""
+    backend = (f"{point.backend}-{point.chip}" if point.chip
+               else point.backend)
+    mem = "gathered" if point.gather else "sharded"
+    return (f"{backend}|{point.topology}|n{n_bucket(point.n)}|"
+            f"{point.dtype}|{mem}")
+
+
+@dataclass(frozen=True)
+class Plan:
+    """One resolved engine choice.  ``config`` is the registry name;
+    ``engine``/``group`` are denormalized so a cached plan can drive the
+    driver even if the registry entry is later renamed (staleness is
+    still caught: the tuner re-validates legality against the live
+    registry before honoring a cached plan).  ``projected`` vs
+    ``seconds`` makes comm_model drift observable; ``trials`` carries
+    the per-candidate measured-vs-projected records of the tuning run
+    that produced the plan."""
+
+    config: str
+    engine: str
+    group: int = 0
+    source: str = "cost_model"       # "cost_model" | "measured"
+    seconds: float | None = None     # measured median (None: cost-only)
+    projected: float | None = None   # comm_model seconds for the pick
+    drift: float | None = None       # seconds / projected
+    variance_flag: str | None = None
+    trials: tuple = field(default=())
+
+    def to_json(self) -> dict:
+        d = asdict(self)
+        d["trials"] = list(self.trials)
+        return d
+
+    @classmethod
+    def from_json(cls, d: dict) -> "Plan":
+        return cls(
+            config=str(d["config"]),
+            engine=str(d["engine"]),
+            group=int(d.get("group", 0)),
+            source=str(d.get("source", "cost_model")),
+            seconds=d.get("seconds"),
+            projected=d.get("projected"),
+            drift=d.get("drift"),
+            variance_flag=d.get("variance_flag"),
+            trials=tuple(d.get("trials", ())),
+        )
+
+
+class PlanCache:
+    """The cache object the tuner holds: ``get``/``put`` in memory,
+    ``load``/``save`` against the versioned JSON file."""
+
+    def __init__(self, path: str | None = None,
+                 plans: dict[str, Plan] | None = None,
+                 fallback_reason: str | None = None):
+        self.path = path
+        self.plans = dict(plans or {})
+        #: why a load produced an empty cache (corruption/version skew);
+        #: None on a clean load.  Surfaced so operators can see that a
+        #: cache was ignored rather than silently empty.
+        self.fallback_reason = fallback_reason
+
+    @classmethod
+    def load(cls, path: str) -> "PlanCache":
+        """Load ``path``; NEVER raises for bad cache contents — the
+        documented fallback is an empty cache + ``fallback_reason`` (the
+        tuner then ranks by cost model)."""
+        if not os.path.exists(path):
+            return cls(path=path)
+        try:
+            with open(path, "r") as f:
+                doc = json.load(f)
+            version = doc.get("version")
+            if version != CACHE_VERSION:
+                return cls(path=path, fallback_reason=(
+                    f"plan cache version {version!r} != "
+                    f"{CACHE_VERSION} — ignoring stale cache"))
+            plans = {str(k): Plan.from_json(v)
+                     for k, v in doc["plans"].items()}
+            return cls(path=path, plans=plans)
+        except (OSError, ValueError, KeyError, TypeError,
+                AttributeError) as e:
+            # ValueError covers json.JSONDecodeError; Key/Type/Attribute
+            # cover structurally-wrong documents (plans not a dict, plan
+            # entries missing fields, scalars where objects belong).
+            return cls(path=path, fallback_reason=(
+                f"corrupt plan cache ({type(e).__name__}: {e}) — "
+                f"falling back to cost-model ranking"))
+
+    def get(self, key: str) -> Plan | None:
+        return self.plans.get(key)
+
+    def put(self, key: str, plan: Plan) -> None:
+        self.plans[key] = plan
+
+    def save(self, path: str | None = None) -> None:
+        """Atomic write (tmp file + ``os.replace`` in the destination
+        directory) of the versioned document."""
+        path = path or self.path
+        if path is None:
+            return
+        doc = {"version": CACHE_VERSION,
+               "plans": {k: p.to_json() for k, p in
+                         sorted(self.plans.items())}}
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=d, suffix=".plan.tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+                f.write("\n")
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise

@@ -1,0 +1,190 @@
+"""The autotuner (ISSUE 2 tentpole part 3): legality + cost pruning over
+the registry, robust measurement of the survivors, measured-vs-projected
+drift recording, and the plan-cache read/write path.
+
+Selection ladder (``Tuner.select``), cheapest evidence first:
+
+  1. **Plan cache hit** — a cached plan whose registry config is still
+     present and legal at the point wins outright: ZERO measurements
+     (the warm-pod steady state, pinned by a counter in
+     tests/test_tuning.py).  Stale plans (renamed config, legality
+     change) fall through instead of being honored.
+  2. **Cost-model ranking** — without ``measure=True`` the cheapest
+     projected candidate is the plan (``registry.select_by_cost``).
+     This is what plain ``solve(engine="auto")`` runs: deterministic,
+     measurement-free, and already enough to route gather=False pod
+     meshes to the swap-free engine and 16384²+ single-chip solves to
+     the grouped engine.
+  3. **Measured tuning** — with ``measure=True`` the top ``survivors``
+     candidates by projected cost are each measured with the robust core
+     (``measure.measure_direct``: warmup, median-of-k, IQR rejection,
+     transient retry) and the fastest median wins.  Every trial records
+     measured/projected so comm_model drift is observable in the plan
+     itself (VERDICT r5: projections were never validated against
+     measurements).
+
+Whatever ladder rung produced the plan, it is written back to the cache
+(if one is attached) so the NEXT solve at the same key is rung 1.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from . import registry as _registry
+from .measure import Measurement, measure_direct
+from .plan_cache import Plan, PlanCache, plan_key
+from .registry import EngineConfig, TunePoint
+
+
+def measure_config(point: TunePoint, cfg: EngineConfig,
+                   samples: int = 5) -> Measurement:
+    """Measure one engine configuration at a point: full engine
+    executions through the driver's own compile paths (the same
+    executables a solve would run), warmed once so compile never lands
+    in a timed sample.
+
+    Measurement buffers are NOT donated (unlike ``driver.solve``'s timed
+    call) so one input serves every repetition; the 'rand' fixture keeps
+    the matrix well-conditioned at any n so no knife-edge singularity
+    aborts a tuning session.  Real-measurement tests are ``slow``-marked
+    (tier-1 runs the tuner on injected fake timings only)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..driver import make_distributed_backend, single_device_invert
+    from ..ops import generate
+
+    dtype = jnp.dtype(point.dtype)
+    n, m = point.n, point.block_size
+    if point.distributed:
+        be = make_distributed_backend(point.workers, n, m, cfg.engine,
+                                      cfg.group)
+        W = be.generate_W("rand", dtype)
+        run = be.compile(W)
+
+        def call():
+            jax.block_until_ready(run(W)[0])
+    else:
+        a = generate("rand", (n, n), dtype)
+        compiled = jax.jit(
+            single_device_invert(n, m, cfg.engine, cfg.group),
+            static_argnames=("block_size", "refine", "precision"),
+        ).lower(
+            a, block_size=m, refine=0, precision=lax.Precision.HIGHEST
+        ).compile()
+
+        def call():
+            jax.block_until_ready(compiled(a)[0])
+
+    return measure_direct(call, samples=samples)
+
+
+@dataclass
+class Tuner:
+    """One tuning session.  ``measurements`` counts real (or injected)
+    engine measurements — the warm-cache acceptance contract is
+    "second solve at the same key: counter unchanged"."""
+
+    cache: PlanCache | None = None
+    measure: bool = False
+    measure_fn: object = None          # (point, cfg) -> Measurement
+    survivors: int = 3                 # candidates measured per point
+    samples: int = 5                   # robust-core k per candidate
+    measurements: int = 0
+    last_source: str | None = field(default=None, repr=False)
+
+    def select(self, point: TunePoint) -> Plan:
+        key = plan_key(point)
+        if self.cache is not None:
+            cached = self.cache.get(key)
+            # A measuring tuner is only satisfied by measured evidence:
+            # a cost_model-sourced cache entry must not short-circuit an
+            # explicit tune=True request (it would pin the unmeasured
+            # guess forever); it IS good enough when measurement wasn't
+            # asked for.
+            if (cached is not None and self._still_valid(cached, point)
+                    and (not self.measure or cached.source == "measured")):
+                self.last_source = "cache"
+                return cached
+        plan = (self._tune(point) if self.measure
+                else self._rank(point))
+        self.last_source = plan.source
+        if self.cache is not None:
+            self.cache.put(key, plan)
+            self.cache.save()
+        return plan
+
+    @staticmethod
+    def _still_valid(plan: Plan, point: TunePoint) -> bool:
+        """Staleness gate for cached plans: the config must still exist
+        in the live registry, resolve to the same (engine, group), and
+        be legal at the point — otherwise the cache entry is from
+        another era and falls through to fresh selection."""
+        cfg = _registry.REGISTRY.get(plan.config)
+        return (cfg is not None
+                and cfg.engine == plan.engine
+                and cfg.group == plan.group
+                and cfg.legal(point))
+
+    def _rank(self, point: TunePoint) -> Plan:
+        cfg = _registry.select_by_cost(point)
+        proj = cfg.cost(point)
+        return Plan(config=cfg.name, engine=cfg.engine, group=cfg.group,
+                    source="cost_model",
+                    projected=None if math.isinf(proj) else proj)
+
+    def _tune(self, point: TunePoint) -> Plan:
+        cands = _registry.candidates(point)
+        if not cands:
+            raise ValueError(f"no legal engine at {point}")
+        # Prune: only the top `survivors` by projected cost are worth
+        # paying a measurement for; infinite-cost candidates (measured
+        # dispatch priors) never make the cut.
+        survivors = [c for c in cands if not math.isinf(c.cost(point))]
+        survivors = survivors[:max(1, self.survivors)] or cands[:1]
+        fn = self.measure_fn or measure_config
+        trials = []
+        best = None                       # (seconds, trial, cfg)
+        for cfg in survivors:
+            proj = cfg.cost(point)
+            meas = fn(point, cfg, samples=self.samples)
+            self.measurements += 1
+            drift = (None if math.isinf(proj) or proj <= 0.0
+                     else meas.seconds / proj)
+            trial = {
+                "config": cfg.name,
+                "projected": None if math.isinf(proj) else proj,
+                "measured": meas.seconds,
+                "drift": drift,
+                "spread_pct": meas.spread_pct,
+                "rejected_samples": len(meas.rejected),
+            }
+            if meas.variance_flag:
+                trial["variance_flag"] = meas.variance_flag
+            trials.append(trial)
+            if best is None or meas.seconds < best[0]:
+                best = (meas.seconds, trial, cfg, meas)
+        seconds, trial, cfg, meas = best
+        return Plan(config=cfg.name, engine=cfg.engine, group=cfg.group,
+                    source="measured", seconds=seconds,
+                    projected=trial["projected"], drift=trial["drift"],
+                    variance_flag=meas.variance_flag,
+                    trials=tuple(trials))
+
+
+def auto_select(n: int, block_size: int | None, dtype, workers,
+                gather: bool, tune: bool = False,
+                plan_cache: str | None = None) -> tuple[str, int, Plan]:
+    """The driver's ``engine="auto"`` hook: build the tuning point from
+    the solve arguments, run the selection ladder, return the resolved
+    ``(engine, group, plan)``.  ``plan_cache`` is a JSON path (consulted
+    always, updated whenever selection ran); ``tune=True`` turns on real
+    measurement of the cost-pruned survivors."""
+    point = TunePoint.create(n, block_size, dtype, workers, gather)
+    cache = PlanCache.load(plan_cache) if plan_cache else None
+    tuner = Tuner(cache=cache, measure=tune)
+    plan = tuner.select(point)
+    return plan.engine, plan.group, plan
