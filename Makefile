@@ -10,8 +10,10 @@ CXXFLAGS ?= -O3 -fPIC -Wall
 N        ?= 4096
 M        ?= 128
 WORKERS  ?= 1
+REQUESTS  ?= 64
+BATCH_CAP ?= 8
 
-.PHONY: all native tpu test bench clean
+.PHONY: all native tpu test smoke serve-demo bench clean
 
 all: native
 
@@ -29,6 +31,18 @@ tpu:
 
 test:
 	python -m pytest tests/ -q
+
+# Fast signal tier (< 2 min): one engine-parity case per family + layout
+# + entry + a serve round-trip.  Full coverage stays in `make test`.
+smoke:
+	python -m pytest tests/ -q -m smoke
+
+# The dynamic-batching inversion service demo (docs/SERVING.md): mixed
+# request sizes micro-batched through the bucketed AOT executable
+# cache; prints one JSON line of per-bucket stats.
+serve-demo:
+	python -m tpu_jordan $(N) $(M) --serve-demo \
+	  --serve-requests $(REQUESTS) --batch-cap $(BATCH_CAP)
 
 bench: native
 	python bench.py

@@ -8,6 +8,10 @@ sizes).  We report GFLOP/s (2n^3 / wall) on one TPU chip and the speedup
 vs that 6.8 GFLOP/s.  Two configs are captured (VERDICT r2 #3):
 
   * 4096^2, m=128 — the tuned single-chip headline (the primary metric);
+  * batched tiers (ISSUE 3): 512x512^2 m=128 (the dedicated batch-first
+    engine) and the largest-fitting Bx2048^2 tier, with per-element
+    singular counts and element-0 residual gates — the BASELINE.md
+    batch north star's driver-captured rows (VERDICT r5 item 5);
   * 8192^2, m=256 — the BASELINE.md v4-8 north-star config (m=256 is
     the round-4 tuned block size: the composed-permutation unscramble
     removed the per-step copy tax that previously favored m=384, and
@@ -227,6 +231,104 @@ def _record_spread(extra, prefix, acc):
         extra[f"{prefix}_variance_flag"] = acc["variance_flag"]
 
 
+def _batched_row(extra, B, n, m, r1, r2, baseline_gflops, label):
+    """One batched capture row (VERDICT r5 item 5: the batch north star
+    had ZERO driver-captured numbers): B generated n² matrices through
+    ``ops.batched.batched_jordan_invert`` (the dedicated batch-first
+    engine in its validated small-n regime, the fori route beyond),
+    slope-timed on the robust core, with per-element singular counts
+    and an element-0 residual gate (3× the predicted eps·n·κ∞ bound,
+    capped at 0.5 — the same dynamic gate as the scale rows).
+
+    Returns the per-call seconds, or None (error keys recorded)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_jordan.driver import batch_metrics
+    from tpu_jordan.ops import batched_jordan_invert, generate
+    from tpu_jordan.tuning.measure import measure_slope
+
+    # The solve_batch fixture convention: per-element index offsets give
+    # distinct matrices under the 'rand' generator.
+    offs = jnp.arange(B, dtype=jnp.int32) * n
+    a = jax.jit(jax.vmap(
+        lambda o: generate("rand", (n, n), jnp.float32, row_offset=o,
+                           col_offset=o)
+    ))(offs)
+    inv, sing = batched_jordan_invert(a, block_size=m)
+    jax.block_until_ready(inv)
+    nsing = int(jnp.sum(sing))
+    extra[f"batched_{label}_singular"] = f"{nsing}/{B}"
+    if nsing:
+        raise _Singular(f"batched fixture flagged singular ({nsing}/{B} "
+                        f"elements, B={B} n={n} m={m})")
+    met = batch_metrics(a[:1], inv[:1])
+    rel0 = float(met["rel_residual"][0])
+    kappa0 = float(met["kappa"][0])
+    norm0 = float(met["norm_a"][0])
+    predicted = float(np.finfo(np.float32).eps) * n * kappa0 / norm0
+    gate = min(3.0 * predicted, 0.5)
+    assert rel0 < gate, (
+        f"batched inverse inaccurate: rel_residual[0]={rel0} exceeds "
+        f"gate={gate:.3e} (kappa={kappa0:.3e}, B={B}, n={n})")
+    del inv
+    meas = measure_slope(
+        lambda v: batched_jordan_invert(v, block_size=m)[0], (a,),
+        r1=r1, r2=r2, samples=3)
+    gf = 2.0 * n**3 * B / meas.seconds / 1e9
+    extra[f"batched_{label}_f32_gflops"] = round(gf, 1)
+    extra[f"batched_{label}_vs_baseline"] = round(gf / baseline_gflops, 1)
+    extra[f"batched_{label}_rel_residual0"] = f"{rel0:.1e}"
+    extra[f"batched_{label}_kappa0"] = f"{kappa0:.3e}"
+    _record_spread(extra, f"batched_{label}",
+                   {"gflops_minmax": [
+                       round(2.0 * n**3 * B / max(meas.accepted) / 1e9, 1),
+                       round(2.0 * n**3 * B / min(meas.accepted) / 1e9, 1)],
+                    "spread_pct": meas.spread_pct,
+                    **({"iqr_rejected_samples": len(meas.rejected)}
+                       if meas.rejected else {}),
+                    **({"variance_flag": meas.variance_flag}
+                       if meas.variance_flag else {})})
+    return meas.seconds
+
+
+def _batched_rows(extra, baseline_gflops):
+    """The batch north-star captures (best-effort — a failure records an
+    error key, never loses the single-matrix rows):
+
+      * 512×512², m=128 — the dedicated small-n batch-first engine
+        (Nr=4, B >= 32: its validated regime, measured 1,602 GF/s in
+        the round-5 session);
+      * the largest-fitting B×2048² tier (BASELINE.md batch north star
+        is 512×2048² on a v5p-64; one v5e chip fits a B ladder probed
+        largest-first, fori route).
+    """
+    try:
+        _retry_transient(lambda: _batched_row(
+            extra, 512, 512, 128, r1=2, r2=6,
+            baseline_gflops=baseline_gflops, label="512x512"))
+    except Exception as ge:                     # noqa: BLE001
+        extra["batched_512x512_error"] = str(ge)[:200]
+    for B in (64, 32, 16, 8):
+        try:
+            _retry_transient(lambda: _batched_row(
+                extra, B, 2048, 128, r1=1, r2=3,
+                baseline_gflops=baseline_gflops, label=f"{B}x2048"))
+            extra["batched_2048_tier"] = B
+            return
+        except AssertionError as ge:
+            # Deterministic fixture verdict (_Singular or the element-0
+            # accuracy gate — element 0 is offset-0 regardless of B):
+            # shrinking B cannot change it, stop the ladder.
+            extra[f"batched_{B}x2048_error"] = str(ge)[:200]
+            return
+        except Exception as ge:                 # noqa: BLE001
+            # OOM/compile failure at this tier: record and try smaller.
+            extra[f"batched_{B}x2048_error"] = str(ge)[:200]
+
+
 def _sharded_swapfree_row(extra):
     """Sharded-output (gather=False) capture: the swap-free engine with
     its bucketed-ppermute permutations keeps the inverse block-sharded
@@ -331,6 +433,13 @@ def main():
     if acc16 is not None:
         for k, v in acc16.items():
             extra[f"{k}_16384"] = v
+
+    # Batched tiers (ISSUE 3 satellite / VERDICT r5 item 5): the
+    # 512×512² dedicated-engine row and the largest-fitting B×2048²
+    # tier, with per-element singular counts and element-0 residual
+    # gates — the batch north star finally carried by the driver
+    # capture.  Best-effort like the sharded row below.
+    _batched_rows(extra, baseline_gflops)
 
     # Sharded-output tier: swapfree × gather=False (bucketed ppermute),
     # best-effort — a failure records an error key, never loses the

@@ -41,6 +41,26 @@ def pytest_configure(config):
         "fast run (ROADMAP.md's verify command deselects them under its "
         "timeout; full coverage stays in the unmarked nightly run — "
         "VERDICT r5 weak #6)")
+    config.addinivalue_line(
+        "markers",
+        "smoke: the < 2 min fast-signal tier (`pytest -m smoke` / `make "
+        "smoke`, documented next to the tier-1 line in ROADMAP.md): one "
+        "engine-parity case per family + layout + entry + one serve "
+        "round-trip.  Every smoke test must also be tier-1-eligible "
+        "(not slow) — linted at collection (VERDICT r5 weak #6)")
+
+
+def pytest_collection_modifyitems(config, items):
+    # Lint (ISSUE 3 satellite): smoke is a SUBSET of tier-1 — a test
+    # carrying both `smoke` and `slow` would vanish from the tier-1 run
+    # while claiming fast-signal membership.  Fail collection loudly.
+    bad = [item.nodeid for item in items
+           if item.get_closest_marker("smoke")
+           and item.get_closest_marker("slow")]
+    if bad:
+        raise pytest.UsageError(
+            "smoke tests must be tier-1-eligible (not slow): "
+            + ", ".join(bad))
 
 
 @pytest.fixture

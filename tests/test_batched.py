@@ -27,6 +27,7 @@ class TestBatchedInvert:
             np.asarray(inv), np.linalg.inv(a), rtol=1e-8, atol=1e-8
         )
 
+    @pytest.mark.smoke      # the batched-family parity + flag case
     def test_per_element_singularity(self, rng):
         good = rng.standard_normal((8, 8))
         bad = np.ones((8, 8))
@@ -136,6 +137,69 @@ class TestBatchedInvert:
         np.testing.assert_allclose(
             np.asarray(inv), np.linalg.inv(a), rtol=1e-8, atol=1e-8
         )
+
+    def test_mixed_singular_batch_does_not_poison_healthy_gates(self, rng):
+        # ISSUE 3 satellite: the service depends on a mixed
+        # singular/nonsingular batch reporting per-element flags while
+        # the HEALTHY elements' accuracy metrics stay gate-clean — a
+        # batch-wide abort (solve_batch's SingularMatrixError) would
+        # poison every rider of the batch.
+        from tpu_jordan.driver import batch_metrics
+
+        good = [rng.standard_normal((48, 48)) for _ in range(3)]
+        a = jnp.asarray(np.stack(
+            [good[0], np.ones((48, 48)), good[1], np.zeros((48, 48)),
+             good[2]]))
+        inv, sing = batched_jordan_invert(a, block_size=16)
+        assert list(np.asarray(sing)) == [False, True, False, True, False]
+        met = batch_metrics(a, inv)
+        rel = np.asarray(met["rel_residual"])
+        kap = np.asarray(met["kappa"])
+        healthy = ~np.asarray(sing)
+        # Healthy elements pass the standard residual gate; their κ∞ is
+        # finite and positive — nothing about the singular neighbors
+        # leaked into their rows.
+        assert (rel[healthy] < 1e-5).all(), rel
+        assert (kap[healthy] > 0).all() and np.isfinite(kap[healthy]).all()
+        for i, g in zip((0, 2, 4), good):
+            np.testing.assert_allclose(np.asarray(inv[i]), np.linalg.inv(g),
+                                       rtol=1e-8, atol=1e-8)
+
+    def test_batch_of_one_bitmatches_unbatched_engine(self, rng):
+        # ISSUE 3 satellite (batch_cap=1 contract): a single-element
+        # batch through the batched machinery is EXACTLY the unbatched
+        # engine — bit for bit, flags included.
+        from tpu_jordan.ops import block_jordan_invert_inplace
+
+        a = rng.standard_normal((64, 64))
+        inv_b, sing_b = batched_jordan_invert(jnp.asarray(a)[None],
+                                              block_size=16)
+        inv_s, sing_s = block_jordan_invert_inplace(jnp.asarray(a),
+                                                    block_size=16)
+        assert bool(sing_b[0]) == bool(sing_s) is False
+        assert bool(jnp.all(inv_b[0] == inv_s)), \
+            "B=1 batched result diverged from the unbatched engine"
+
+    def test_batch_metrics_masks_identity_padding(self, rng):
+        # The row mask is load-bearing: identity-pad rows abs-sum to
+        # exactly 1 and would otherwise cap a small true norm (the
+        # serve executors' bucketed stacks hit this on every request).
+        from tpu_jordan.driver import batch_metrics
+        from tpu_jordan.ops import pad_with_identity
+
+        a = 0.01 * rng.standard_normal((24, 24))
+        pad = jnp.stack([pad_with_identity(jnp.asarray(a), 32)])
+        inv, sing = batched_jordan_invert(pad, block_size=8)
+        assert not bool(sing[0])
+        masked = batch_metrics(pad, inv, n_real=jnp.asarray([24]))
+        unmasked = batch_metrics(pad, inv)
+        want_norm = float(np.max(np.sum(np.abs(a), axis=-1)))
+        assert float(masked["norm_a"][0]) == pytest.approx(want_norm)
+        assert float(unmasked["norm_a"][0]) == pytest.approx(1.0)
+        # A fully-masked filler slot (n_real=0) reports zeros, not NaN.
+        filler = batch_metrics(pad, inv, n_real=jnp.asarray([0]))
+        assert float(filler["rel_residual"][0]) == 0.0
+        assert float(filler["kappa"][0]) == 0.0
 
     def test_augmented_fallback_large_Nr(self, rng):
         # Nr > MAX_UNROLL_NR: the fori_loop engine takes over (no

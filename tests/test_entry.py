@@ -18,6 +18,7 @@ def test_make_mesh_raises_on_too_few_devices():
         make_mesh(1024)
 
 
+@pytest.mark.smoke          # the entry-point case
 def test_entry_compiles():
     import jax
 

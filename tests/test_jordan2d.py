@@ -114,6 +114,9 @@ class TestDriver2D:
         assert res.residual / (64 * 64 / 2) < 1e-12
         assert res.inverse is not None
 
+    @pytest.mark.slow   # tier-1 headroom (ISSUE 3): driver-level 2D
+    #   gather=False stays tier-1 via test_scale_demo's 2D swap-free
+    #   shard-bytes+bitmatch leg and the κ∞ (2,2) gather=False leg
     def test_solve_2d_gather_false(self, monkeypatch):
         import tpu_jordan.driver as drv
         from tpu_jordan.driver import solve
@@ -128,6 +131,9 @@ class TestDriver2D:
         assert len(res.inverse_blocks.sharding.device_set) == 8
         assert res.residual / (96 * 96 / 2) < 1e-5
 
+    @pytest.mark.slow   # tier-1 headroom (ISSUE 3): 2D streamed-file
+    #   scatter stays tier-1 in test_stream_scatter.py; the 1D file
+    #   driver leg stays
     def test_solve_2d_file(self, rng, tmp_path):
         from tpu_jordan.driver import solve
         from tpu_jordan.io import write_matrix_file
@@ -139,6 +145,9 @@ class TestDriver2D:
                     dtype=jnp.float64)
         assert res.residual < 1e-9
 
+    @pytest.mark.slow   # tier-1 headroom (ISSUE 3): PRxPC parsing +
+    #   the 2D driver path stay tier-1 via test_solve_2d_generator and
+    #   the 1D CLI legs; nightly here
     def test_cli_2d_workers(self):
         from tpu_jordan.__main__ import main
 

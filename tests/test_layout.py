@@ -62,6 +62,7 @@ def test_padded_num_blocks(n, m, p):
     assert Nr - p < layout.num_block_rows(n, m) + p  # minimal
 
 
+@pytest.mark.smoke          # the layout index-math case
 def test_cyclic_layout_perms():
     lo = layout.CyclicLayout.create(n=10, m=3, p=2)
     assert lo.Nr == 4 and lo.N == 12

@@ -126,7 +126,10 @@ class TestProductionSizeParity:
     """Parity of every kernel with the XLA reference at production block
     sizes (m=64/128); the small-m tests above use m=32."""
 
-    @pytest.mark.parametrize("m", [64, 128])
+    @pytest.mark.parametrize("m", [
+        # tier-1 headroom (ISSUE 3): m=64 is below the production
+        # fused-panel sizes (128/256/384) — nightly only.
+        pytest.param(64, marks=pytest.mark.slow), 128])
     @pytest.mark.parametrize("kernel", KERNELS)
     def test_matches_xla(self, rng, m, kernel):
         blocks = rng.standard_normal((4, m, m))

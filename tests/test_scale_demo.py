@@ -62,6 +62,7 @@ def _assert_sharded_blocks(blocks, lay, nshards, shard_shape):
     assert per_worker * nshards == lay.N * lay.N
 
 
+@pytest.mark.slow   # tier-1 headroom (ISSUE 3): the 2D twin below stays
 def test_swapfree_no_gather_1d_shard_bytes_and_bitmatch():
     # |i−j| fixture: exact pivot ties — the swap-coordinate tie rule
     # must reproduce the swap engines' choices through the bucketed

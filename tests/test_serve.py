@@ -1,0 +1,358 @@
+"""The serving subsystem (ISSUE 3): bucket rounding, the AOT executable
+cache (one compile per key, plan-cache engine resolution), the dynamic
+micro-batcher (futures, deadlines, partial batches), JordanService's
+product contract (admission control, warmup, draining shutdown,
+stats), the CLI --serve-demo exit codes, and the acceptance pin — the
+sustained-throughput demo with every counter nailed down."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_jordan.serve import (JordanService, MIN_BUCKET_N, ServiceClosedError,
+                              ServiceOverloadedError, bucket_for, serve_demo)
+
+
+def _mats(rng, sizes, copies=1, dtype=np.float32):
+    """Well-conditioned request fixtures, one per (size, copy)."""
+    return [rng.standard_normal((s, s)).astype(dtype)
+            for s in sizes for _ in range(copies)]
+
+
+def _direct_padded(a, bucket, block_size=None):
+    """The comparison oracle the acceptance contract names: a direct
+    solve of the same padded shape — the identity-padded matrix run
+    through the driver's own single-device engine (what solve() runs
+    for this shape)."""
+    from tpu_jordan.config import default_block_size
+    from tpu_jordan.driver import single_device_invert
+    from tpu_jordan.ops import pad_with_identity
+
+    m = block_size or default_block_size(bucket)
+    pad = pad_with_identity(jnp.asarray(a, jnp.float32), bucket)
+    inv, sing = single_device_invert(bucket, m)(pad, block_size=m)
+    return np.asarray(inv), bool(sing)
+
+
+class TestBuckets:
+    def test_pow2_rounding_with_floor(self):
+        assert bucket_for(1) == MIN_BUCKET_N
+        assert bucket_for(MIN_BUCKET_N) == MIN_BUCKET_N
+        assert bucket_for(MIN_BUCKET_N + 1) == 2 * MIN_BUCKET_N
+        assert bucket_for(200) == 256
+        assert bucket_for(256) == 256
+        with pytest.raises(ValueError):
+            bucket_for(0)
+
+    def test_block_size_is_part_of_executor_key(self):
+        """A direct cache user requesting a different m must get a
+        fresh executable, never a stale-m cache hit."""
+        from tpu_jordan.serve import ExecutorCache
+
+        cache = ExecutorCache(dtype=jnp.float32)
+        e8 = cache.get(64, 2, block_size=8)
+        e32 = cache.get(64, 2, block_size=32)
+        assert e8 is not e32
+        assert e8.key.block_size == 8 and e32.key.block_size == 32
+        assert cache.get(64, 2, block_size=8) is e8
+
+
+class TestExecutorCache:
+    def test_one_compile_per_key_then_hits(self):
+        from tpu_jordan.serve import ExecutorCache, ServeStats
+
+        stats = ServeStats()
+        cache = ExecutorCache(dtype=jnp.float32, stats=stats)
+        e1 = cache.get(64, 4)
+        e2 = cache.get(64, 4)
+        assert e1 is e2
+        snap = stats.snapshot()["buckets"]["64"]
+        assert snap["compiles"] == 1 and snap["cache_hits"] == 1
+        # A different batch_cap is a different executable (static shape).
+        e3 = cache.get(64, 2)
+        assert e3 is not e1
+        assert stats.snapshot()["buckets"]["64"]["compiles"] == 2
+
+    def test_engine_resolved_through_plan_cache(self, tmp_path):
+        """Warm path: the resolved plan comes from the JSON plan cache
+        (batched key) and the tuner performs zero measurements."""
+        from tpu_jordan.serve import ExecutorCache
+        from tpu_jordan.tuning import PlanCache
+
+        path = str(tmp_path / "plans.json")
+        c1 = ExecutorCache(plan_cache=path, dtype=jnp.float32)
+        ex = c1.get(64, 4)
+        assert ex.key.engine == "inplace"          # cost ladder, small n
+        assert ex.plan is not None and ex.plan.source == "cost_model"
+        assert c1.measurements == 0
+        # The batched key landed on disk...
+        disk = PlanCache.load(path)
+        assert any(k.endswith("|b4") for k in disk.plans)
+        # ... and a fresh cache over the same file serves it warm.
+        c2 = ExecutorCache(plan_cache=path, dtype=jnp.float32)
+        ex2 = c2.get(64, 4)
+        assert ex2.key == ex.key
+        assert c2.tuner.last_source == "cache"
+        assert c2.measurements == 0
+
+    def test_explicit_engine_skips_tuner(self):
+        from tpu_jordan.serve import ExecutorCache
+
+        cache = ExecutorCache(engine="augmented", dtype=jnp.float64)
+        ex = cache.get(64, 2)
+        assert ex.key.engine == "augmented" and ex.plan is None
+
+    def test_distributed_engine_rejected(self):
+        from tpu_jordan.driver import UsageError
+        from tpu_jordan.serve import ExecutorCache
+
+        with pytest.raises(UsageError, match="swapfree|unknown"):
+            ExecutorCache(engine="swapfree").get(64, 2)
+
+
+class TestServiceRoundTrip:
+    @pytest.mark.smoke      # the serve round-trip case (smoke tier)
+    def test_round_trip_bitmatches_direct_padded_solve(self, rng):
+        a = rng.standard_normal((48, 48)).astype(np.float32)
+        with JordanService(batch_cap=2, max_wait_ms=1.0) as svc:
+            res = svc.invert(a, timeout=120)
+        assert res.n == 48 and res.bucket_n == 64
+        assert not res.singular
+        direct, sing = _direct_padded(a, res.bucket_n)
+        assert not sing
+        assert (np.asarray(res.inverse) == direct[:48, :48]).all()
+        assert res.rel_residual < 1e-4
+        assert res.kappa > 0
+
+    def test_result_metrics_match_unpadded_conventions(self, rng):
+        """κ∞/rel_residual of a bucketed solve must be the UNPADDED
+        matrix's numbers (row-masked batch_metrics): identity-pad rows
+        abs-sum to exactly 1 and must not leak into small-norm κ."""
+        from tpu_jordan.ops import condition_inf, residual_inf_norm
+
+        a = (0.01 * rng.standard_normal((40, 40))).astype(np.float32)
+        with JordanService(batch_cap=1, max_wait_ms=0.5) as svc:
+            res = svc.invert(a, timeout=120)
+        aj = jnp.asarray(a)
+        xj = jnp.asarray(res.inverse)
+        want_rel = float(residual_inf_norm(aj, xj)) / float(
+            jnp.max(jnp.sum(jnp.abs(aj), axis=-1)))
+        want_kappa = float(condition_inf(aj, xj))
+        assert res.rel_residual == pytest.approx(want_rel, rel=1e-6)
+        assert res.kappa == pytest.approx(want_kappa, rel=1e-6)
+
+    def test_batch_cap_1_bitmatches_unbatched_engine(self, rng):
+        """ISSUE 3 satellite: batch_cap=1 must bit-match the unbatched
+        engine — a single-slot batch is exactly a direct solve."""
+        a = rng.standard_normal((64, 64)).astype(np.float32)
+        with JordanService(batch_cap=1, max_wait_ms=0.5) as svc:
+            res = svc.invert(a, timeout=120)
+        direct, _ = _direct_padded(a, 64)
+        assert (np.asarray(res.inverse) == direct).all()
+
+    def test_singular_request_flagged_not_poisoning(self, rng):
+        """Per-element flags (solve_batch's machinery): one singular
+        request resolves ITS result singular; batch-mates in the same
+        launch stay healthy with passing residuals."""
+        good = [rng.standard_normal((48, 48)).astype(np.float32)
+                for _ in range(3)]
+        bad = np.ones((48, 48), np.float32)          # rank 1, singular
+        with JordanService(batch_cap=4, max_wait_ms=50.0,
+                           autostart=False) as svc:
+            futs = ([svc.submit(g) for g in good[:2]]
+                    + [svc.submit(bad)] + [svc.submit(good[2])])
+            svc.start()
+            res = [f.result(120) for f in futs]
+        assert [r.singular for r in res] == [False, False, True, False]
+        assert all(r.rel_residual < 1e-4 for r in res if not r.singular)
+        assert res[0].batch_occupancy == 4
+        # The synchronous surface raises for the singular caller only.
+        from tpu_jordan.driver import SingularMatrixError
+
+        with JordanService(batch_cap=1, max_wait_ms=0.5) as svc:
+            with pytest.raises(SingularMatrixError):
+                svc.invert(bad, timeout=120)
+
+    def test_submit_validates_shape(self):
+        with JordanService(batch_cap=1) as svc:
+            with pytest.raises(ValueError, match="square"):
+                svc.submit(np.zeros((4, 5), np.float32))
+            with pytest.raises(ValueError, match="square"):
+                svc.submit(np.zeros((4,), np.float32))
+
+
+class TestBackpressureAndShutdown:
+    def test_full_queue_raises_overloaded_never_drops(self, rng):
+        mats = _mats(rng, [32], copies=5)
+        svc = JordanService(batch_cap=2, max_wait_ms=1.0, max_queue=4,
+                            autostart=False)
+        futs = [svc.submit(m) for m in mats[:4]]
+        with pytest.raises(ServiceOverloadedError):
+            svc.submit(mats[4])
+        assert svc.stats()["totals"]["rejected"] == 1
+        # Backpressure is not a drop: every ACCEPTED request completes
+        # once the dispatcher runs.
+        svc.start()
+        res = [f.result(120) for f in futs]
+        assert all(not r.singular for r in res)
+        svc.close()
+
+    def test_close_drains_queued_work(self, rng):
+        svc = JordanService(batch_cap=4, max_wait_ms=10_000.0,
+                            autostart=False)
+        futs = [svc.submit(m) for m in _mats(rng, [24], copies=3)]
+        # Never-started dispatcher + huge deadline: close() must still
+        # complete everything (drain), not hang or cancel.
+        svc.close(drain=True)
+        assert all(f.done() for f in futs)
+        assert all(not f.result().singular for f in futs)
+        with pytest.raises(ServiceClosedError):
+            svc.submit(np.eye(8, dtype=np.float32))
+
+    def test_caller_cancel_drops_only_that_request(self, rng):
+        # A caller-cancelled future must not crash the dispatcher or
+        # affect batch-mates (the stdlib claim-at-dispatch protocol).
+        svc = JordanService(batch_cap=4, max_wait_ms=5.0, autostart=False)
+        futs = [svc.submit(m) for m in _mats(rng, [24], copies=3)]
+        assert futs[1].cancel()
+        svc.start()
+        res = [futs[0].result(120), futs[2].result(120)]
+        assert all(not r.singular for r in res)
+        assert futs[1].cancelled()
+        svc.close()
+        assert svc.stats()["totals"]["batches"] >= 1
+
+    def test_close_without_drain_fails_futures_explicitly(self, rng):
+        svc = JordanService(batch_cap=4, max_wait_ms=10_000.0,
+                            autostart=False)
+        futs = [svc.submit(m) for m in _mats(rng, [24], copies=2)]
+        svc.close(drain=False)
+        for f in futs:
+            with pytest.raises(ServiceClosedError):
+                f.result(10)
+
+
+class TestSustainedThroughput:
+    """The ISSUE 3 acceptance criterion, pinned end to end on the CPU
+    backend: >= 64 mixed-size concurrent requests across >= 3 shape
+    buckets; exactly one compile per (bucket, batch_cap); compile and
+    plan-cache measurement counters at ZERO after warmup; mean batch
+    occupancy > 1; every result bit-matching a direct solve of the same
+    padded shape; backpressure typed, not dropping."""
+
+    def test_acceptance_demo(self, rng, tmp_path):
+        sizes = [24, 48, 96, 130, 200]      # buckets 64, 64, 128, 256, 256
+        reqs = _mats(rng, sizes, copies=13)  # 65 requests
+        assert len(reqs) >= 64
+        buckets = {bucket_for(a.shape[0]) for a in reqs}
+        assert len(buckets) >= 3
+
+        plan_path = str(tmp_path / "plans.json")
+        svc = JordanService(batch_cap=8, max_wait_ms=5.0,
+                            plan_cache=plan_path, max_queue=128,
+                            autostart=False)
+        svc.warmup(shapes=sorted({a.shape[0] for a in reqs}))
+        warm = svc.stats()
+        assert warm["totals"]["compiles"] == len(buckets), \
+            "exactly one compile per (bucket, batch_cap)"
+        assert warm["measurements"] == 0
+
+        # Stage everything before the dispatcher runs, so batching is
+        # deterministic and occupancy has no race to win.
+        futs = [(a, svc.submit(a)) for a in reqs]
+        svc.start()
+        results = [(a, f.result(300)) for a, f in futs]
+        svc.close()
+        stats = svc.stats()
+
+        # Counter pins: ZERO compiles and ZERO plan-cache measurements
+        # after warmup — the whole request stream ran on warm
+        # executables and cached plans.
+        assert stats["totals"]["compiles"] == len(buckets)
+        assert stats["measurements"] == 0
+        assert stats["totals"]["requests"] == len(reqs)
+        assert stats["totals"]["rejected"] == 0
+        assert stats["totals"]["singular"] == 0
+
+        # Mean batch occupancy > 1 in every bucket (and well above 1
+        # overall — the micro-batcher actually batched).
+        occs = [b["mean_occupancy"] for b in stats["buckets"].values()]
+        assert all(o > 1 for o in occs), stats["buckets"]
+        total_batches = stats["totals"]["batches"]
+        assert len(reqs) / total_batches > 1
+
+        # Latency percentiles exist for every served bucket.
+        for b in stats["buckets"].values():
+            assert b["execute_ms"]["p50"] is not None
+            assert b["queue_ms"]["p99"] is not None
+
+        # Every result bit-matches a direct solve of the same padded
+        # shape (the driver's own engine on the identity-padded input).
+        direct_cache = {}
+        for a, r in results:
+            assert not r.singular
+            key = r.bucket_n
+            if key not in direct_cache:
+                direct_cache[key] = {}
+            direct, sing = _direct_padded(a, r.bucket_n)
+            assert not sing
+            assert (np.asarray(r.inverse)
+                    == direct[:r.n, :r.n]).all(), \
+                f"serve result diverged from direct solve (n={r.n})"
+
+        # Backpressure: a bounded queue overflows with the typed error.
+        svc2 = JordanService(batch_cap=2, max_queue=2, autostart=False)
+        svc2.submit(reqs[0]); svc2.submit(reqs[1])
+        with pytest.raises(ServiceOverloadedError):
+            svc2.submit(reqs[2])
+        svc2.close()
+
+
+class TestServeDemoCLI:
+    def test_serve_demo_exit_codes(self, tmp_path):
+        """The --serve-demo mode folds into the 0/1/2 taxonomy
+        (ISSUE 3 satellite): 0 = demo ran and reported, 1 = usage."""
+        from tpu_jordan.__main__ import main
+
+        # Usage errors, all pre-device: exit 1.
+        assert main(["96", "32", "--serve-demo", "--workers", "8",
+                     "--quiet"]) == 1
+        assert main(["96", "32", "--serve-demo", "--batch", "4",
+                     "--quiet"]) == 1
+        assert main(["96", "32", "--serve-demo", "--tune",
+                     "--quiet"]) == 1
+        assert main(["96", "32", "--serve-demo", "--engine", "swapfree",
+                     "--quiet"]) == 1
+        assert main(["96", "32", "--serve-demo", "--serve-requests", "0",
+                     "--quiet"]) == 1
+        assert main(["96", "32", "/no/such/file", "--serve-demo",
+                     "--quiet"]) == 1
+
+    def test_serve_demo_runs_and_reports(self, capsys, tmp_path):
+        import json
+
+        from tpu_jordan.__main__ import main
+
+        path = str(tmp_path / "plans.json")
+        rc = main(["96", "32", "--serve-demo", "--serve-requests", "9",
+                   "--batch-cap", "3", "--plan-cache", path, "--quiet"])
+        assert rc == 0
+        line = capsys.readouterr().out.strip().splitlines()[-1]
+        report = json.loads(line)
+        assert report["metric"] == "serve_demo"
+        assert report["requests"] == 9
+        assert report["singular"] == 0
+        assert report["compiles_on_request_path"] == 0
+        assert report["plan_cache_measurements"] == 0
+
+
+def test_serve_demo_function_report_shape(tmp_path):
+    """serve_demo() itself (the CLI engine): full report incl. nested
+    stats, >= 2 buckets at n=96 (64 + 128), occupancy recorded."""
+    report = serve_demo(n=96, block_size=32, requests=8, batch_cap=4,
+                        max_wait_ms=20.0)
+    assert report["buckets"] >= 2
+    assert report["stats"]["totals"]["requests"] == 8
+    assert set(report["mean_occupancy"]) == set(report["stats"]["buckets"])
+    assert report["worst_rel_residual"] is not None

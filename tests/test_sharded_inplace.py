@@ -50,6 +50,7 @@ class TestShardedInplace:
             np.asarray(inv_d), np.asarray(inv_s), rtol=1e-9, atol=1e-9
         )
 
+    @pytest.mark.smoke      # the 1D-layout engine-parity case (ties incl.)
     def test_tied_pivots_match_single_device(self, mesh4):
         # |i-j| has exactly-repeated candidate blocks: ties must resolve to
         # the lowest global block row, matching the single-device argmin.
@@ -61,6 +62,9 @@ class TestShardedInplace:
             np.asarray(inv_d), np.asarray(inv_s), rtol=1e-9, atol=1e-12
         )
 
+    @pytest.mark.slow   # tier-1 headroom (ISSUE 3): inplace-vs-augmented
+    #   parity stays tier-1 at single-device (smoke); the distributed
+    #   cross-engine leg runs nightly
     def test_matches_augmented_distributed(self, rng, mesh8):
         from tpu_jordan.parallel import sharded_jordan_invert
 
@@ -205,8 +209,12 @@ class TestSwapFree:
     — bit-identical to the swap engines, ties included (the pivot tie
     rule keys on the swap COORDINATE, reproducing main.cpp:1051-1064)."""
 
-    @pytest.mark.parametrize("n,m,p", [(64, 8, 4), (128, 16, 8),
-                                       (100, 8, 8), (96, 8, 4)])
+    @pytest.mark.parametrize("n,m,p", [
+        (64, 8, 4), (128, 16, 8),
+        # tier-1 headroom (ISSUE 3): the ragged swap-free case runs
+        # nightly; tier-1 keeps two 1D configs + the 2D swap-free pin.
+        pytest.param(100, 8, 8, marks=pytest.mark.slow),
+        (96, 8, 4)])
     def test_bitmatches_swap_engine(self, rng, n, m, p):
         mesh = make_mesh(p)
         a = jnp.asarray(rng.standard_normal((n, n)), jnp.float64)

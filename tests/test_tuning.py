@@ -111,6 +111,18 @@ class TestPlanKey:
                                          backend="cpu")
                         ) == "cpu|single|n64|float64|gathered"
 
+    def test_batch_segment(self):
+        """ISSUE 3: batched points (the serving executors') key with a
+        trailing ``bN`` segment; batch=1 keys are byte-identical to the
+        PR 2 format, so pre-existing caches stay valid."""
+        base = TunePoint.create(512, 128, jnp.float32, 1, True,
+                                backend="cpu")
+        batched = TunePoint.create(512, 128, jnp.float32, 1, True,
+                                   backend="cpu", batch=32)
+        assert plan_key(base) == "cpu|single|n512|float32|gathered"
+        assert plan_key(batched) == "cpu|single|n512|float32|gathered|b32"
+        assert base.batch == 1 and batched.batch == 32
+
 
 class TestPlanCache:
     def _plan(self):

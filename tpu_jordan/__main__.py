@@ -110,6 +110,28 @@ def main(argv=None) -> int:
                          "capability; generator input only, single "
                          "device; B distinct matrices via per-element "
                          "index offsets)")
+    ap.add_argument("--serve-demo", action="store_true",
+                    help="run the dynamic-batching inversion service "
+                         "demo (tpu_jordan.serve.JordanService): mixed "
+                         "request sizes cycling through n/2^k across "
+                         ">= 3 shape buckets, micro-batched through the "
+                         "bucketed AOT executable cache, then print ONE "
+                         "JSON line of per-bucket stats (occupancy, "
+                         "latency percentiles, compile + plan-cache "
+                         "measurement counters; docs/SERVING.md); n is "
+                         "the largest request size, m the block-size "
+                         "hint; single device, generator input only")
+    ap.add_argument("--serve-requests", type=int, default=64,
+                    metavar="R", help="--serve-demo: concurrent requests "
+                                      "to submit (default 64)")
+    ap.add_argument("--batch-cap", type=int, default=8, metavar="B",
+                    help="--serve-demo: max requests fused per "
+                         "executable launch (default 8)")
+    ap.add_argument("--max-wait-ms", type=float, default=2.0,
+                    metavar="MS", help="--serve-demo: micro-batcher "
+                                       "deadline — how long the oldest "
+                                       "request waits for batch-mates "
+                                       "(default 2.0)")
     ap.add_argument("--quiet", action="store_true")
     try:
         args = ap.parse_args(argv)
@@ -120,6 +142,10 @@ def main(argv=None) -> int:
             raise ValueError("workers must be positive")
         if args.sleep < 0:
             raise ValueError("--sleep must be non-negative")
+        if args.serve_requests < 1 or args.batch_cap < 1:
+            raise ValueError("--serve-requests/--batch-cap must be >= 1")
+        if args.max_wait_ms < 0:
+            raise ValueError("--max-wait-ms must be non-negative")
     except SystemExit as e:
         if e.code == 0:      # --help / --version are not usage errors
             return 0
@@ -164,8 +190,50 @@ def main(argv=None) -> int:
     from .driver import SingularMatrixError, UsageError, solve, solve_batch
     from .io import MatrixReadError
     from .parallel.mesh import MeshSizeError
+    from .serve.batcher import ServiceClosedError, ServiceOverloadedError
 
     try:
+        if args.serve_demo:
+            # The serving demo: single-device, generator input,
+            # gathered output — same shape of restrictions as --batch
+            # (exit 1 on bad combos, main.cpp:77-85 taxonomy).
+            if args.file is not None or args.workers != 1 or not args.gather:
+                raise UsageError(
+                    "--serve-demo requires generator input on a single "
+                    "device (gathered output)")
+            if args.batch > 1:
+                raise UsageError("--serve-demo and --batch are distinct "
+                                 "modes; pick one")
+            if args.tune:
+                raise UsageError("--serve-demo resolves engines through "
+                                 "the cost-only ladder (optionally a "
+                                 "--plan-cache); --tune does not apply")
+            if args.group != 0 or args.engine == "swapfree":
+                raise UsageError("--serve-demo engines are single-device "
+                                 "(auto/inplace/grouped/augmented); "
+                                 "--group does not apply")
+            import json as _json
+
+            from .serve import serve_demo
+
+            report = serve_demo(
+                n=args.n, block_size=args.m,
+                requests=args.serve_requests, batch_cap=args.batch_cap,
+                max_wait_ms=args.max_wait_ms, engine=args.engine,
+                plan_cache=args.plan_cache,
+                dtype=jnp.dtype(args.dtype), generator=args.generator)
+            if args.quiet:
+                report.pop("stats", None)
+            print(_json.dumps(report))
+            if report["singular"]:
+                # Same taxonomy as the one-shot path: a singular solve
+                # is a runtime failure, exit 2 (main.cpp:435-437).  The
+                # prose goes to stderr — stdout stays the documented
+                # single JSON line.
+                print(f"singular matrix ({report['singular']} requests "
+                      f"flagged)", file=sys.stderr)
+                return 2
+            return 0
         if args.batch > 1:
             if args.file is not None or args.workers != 1 or not args.gather:
                 raise UsageError(
@@ -220,6 +288,11 @@ def main(argv=None) -> int:
     except MeshSizeError as e:
         # --workers exceeding the device count: the analog of mpirun -np
         # failing to launch — a runtime error, not a crash.
+        print(e, file=sys.stderr)
+        return 2
+    except (ServiceOverloadedError, ServiceClosedError) as e:
+        # Serving runtime failures (backpressure/shutdown races in the
+        # demo) are runtime errors like a failed launch, not usage.
         print(e, file=sys.stderr)
         return 2
     except UsageError as e:

@@ -302,6 +302,53 @@ def solve(
     )
 
 
+def batch_metrics(a, x, n_real=None, precision=_lax.Precision.HIGHEST):
+    """Per-element accuracy assembly for the batched path — ONE shared
+    implementation (ISSUE 3: factored out of ``solve_batch`` so the
+    serving executors and the bench batched rows reuse it instead of
+    forking their own residual conventions).
+
+    ``a``/``x`` are (B, N, N) stacks; returns a dict of (B,) arrays:
+    ``residual`` ‖A·X−I‖∞, ``norm_a`` ‖A‖∞, ``norm_x`` ‖X‖∞,
+    ``kappa`` = ‖A‖∞‖X‖∞, and ``rel_residual`` = residual/‖A‖∞ — the
+    same conventions as ``SolveResult`` (ops/residual.py, ops/norms.py).
+
+    ``n_real`` (optional (B,) int vector) masks the norms to each
+    element's REAL rows when the stack is identity-padded to a shape
+    bucket (serve/executors.py): pad rows abs-sum to exactly 1 and would
+    cap a small true norm; real rows are exact because pad columns
+    contribute 0 to them (ops/padding.py — the pad block of a real row
+    is exactly zero, and stays zero through elimination).  The residual
+    needs no mask: a pad row of A·X−I is identically zero.
+    """
+    N = a.shape[-1]
+    r = jnp.matmul(a, x, precision=precision) - jnp.eye(N, dtype=x.dtype)
+    r_sums = jnp.sum(jnp.abs(r), axis=-1)
+    a_sums = jnp.sum(jnp.abs(a), axis=-1)
+    x_sums = jnp.sum(jnp.abs(x), axis=-1)
+    if n_real is not None:
+        mask = (jnp.arange(N)[None, :]
+                < jnp.asarray(n_real, jnp.int32)[:, None])
+        zero = jnp.asarray(0, r_sums.dtype)
+        r_sums = jnp.where(mask, r_sums, zero)
+        a_sums = jnp.where(mask, a_sums, zero)
+        x_sums = jnp.where(mask, x_sums, zero)
+    residual = jnp.max(r_sums, axis=-1)
+    norm_a = jnp.max(a_sums, axis=-1)
+    norm_x = jnp.max(x_sums, axis=-1)
+    return {
+        "residual": residual,
+        "norm_a": norm_a,
+        "norm_x": norm_x,
+        "kappa": norm_a * norm_x,
+        # Guarded division: an all-masked filler element (n_real=0) has
+        # norm_a == 0 and must report 0, not NaN.
+        "rel_residual": jnp.where(norm_a > 0, residual
+                                  / jnp.where(norm_a > 0, norm_a, 1),
+                                  residual),
+    }
+
+
 def solve_batch(
     n: int,
     block_size: int | None = None,
@@ -322,7 +369,7 @@ def solve_batch(
     2n³·batch convention; ``residual`` is element 0's, and a
     SingularMatrixError reports how many elements were flagged.
     """
-    from .ops import batched_jordan_invert, residual_inf_norm as _res
+    from .ops import batched_jordan_invert
 
     if block_size is None:
         block_size = default_block_size(n)
@@ -352,7 +399,8 @@ def solve_batch(
         raise SingularMatrixError(
             f"singular matrix ({nsing}/{batch} elements flagged)")
     a0 = generate(generator, (n, n), dtype)
-    residual = float(_res(a0, inv[0]))
+    met = batch_metrics(a0[None], inv[:1])
+    residual = float(met["residual"][0])
     if verbose:
         print(f"glob_time: {elapsed:.2f} ({batch} matrices)")
         print(f"residual[0]: {residual:e}")
@@ -363,6 +411,8 @@ def solve_batch(
         n=n,
         block_size=block_size,
         gflops=2.0 * n**3 * batch / elapsed / 1e9,
+        kappa=float(met["kappa"][0]),
+        _norm_a=float(met["norm_a"][0]),
     )
 
 

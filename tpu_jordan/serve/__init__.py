@@ -1,0 +1,40 @@
+"""tpu_jordan.serve — a dynamic-batching inversion service with a
+shape-bucketed AOT executable cache (ISSUE 3 tentpole).
+
+Four parts (docs/SERVING.md is the operator guide):
+
+  * ``executors`` — requests round up to power-of-two n-buckets
+    (identity padding makes the rounding exact); one AOT executable per
+    (bucket_n, batch_cap, dtype, engine), compiled at most once; engine
+    choice resolved through PR 2's plan cache (batched ``bN`` keys) so
+    a warm server performs zero measurements and zero recompiles.
+  * ``batcher`` — the thread-safe dynamic micro-batcher: same-bucket
+    requests group up to ``batch_cap`` or a ``max_wait_ms`` deadline,
+    run through the batched engine machinery, and fan per-element
+    results (inverse, κ∞, rel_residual, singular flag) back to
+    per-request futures.
+  * ``service`` — :class:`JordanService`: ``submit()``/futures plus a
+    synchronous ``invert()``, bounded-queue admission control
+    (:class:`ServiceOverloadedError` backpressure — never a silent
+    drop), ``warmup(shapes=)``, clean draining shutdown, and
+    ``serve_demo`` (the ``--serve-demo`` CLI mode's engine).
+  * ``stats`` — per-bucket counters (requests, batches, mean occupancy,
+    compiles, cache hits, singular count) and p50/p95/p99 queue +
+    execute latency percentiles, surfaced via ``service.stats()``.
+"""
+
+from .batcher import (InvertResult, MicroBatcher, ServiceClosedError,
+                      ServiceOverloadedError)
+from .executors import (MIN_BUCKET_N, BucketExecutor, ExecutorCache,
+                        ExecutorKey, bucket_for)
+from .service import JordanService, serve_demo
+from .stats import ServeStats
+
+__all__ = [
+    "InvertResult", "MicroBatcher", "ServiceClosedError",
+    "ServiceOverloadedError",
+    "MIN_BUCKET_N", "BucketExecutor", "ExecutorCache", "ExecutorKey",
+    "bucket_for",
+    "JordanService", "serve_demo",
+    "ServeStats",
+]

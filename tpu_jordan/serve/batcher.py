@@ -1,0 +1,251 @@
+"""The dynamic micro-batcher (ISSUE 3 tentpole part 2).
+
+A thread-safe request queue grouped by shape bucket plus ONE dispatcher
+thread.  A bucket dispatches when it can fill a whole batch
+(``batch_cap`` requests), when its oldest request has waited
+``max_wait_ms`` (the latency bound — a lone request never starves
+waiting for company), or when the service is draining for shutdown.
+Partial batches are padded with identity filler elements (inert and
+never singular — the executable's shape is static), so occupancy is the
+explicit throughput-vs-latency dial (docs/SERVING.md).
+
+Each dispatched batch runs through the bucket's AOT executable
+(``executors.py``) and the per-element results — inverse (unpadded back
+to the request's n), κ∞, rel_residual, singular flag, queue/execute
+timings — fan back to per-request ``concurrent.futures.Future``s.  A
+singular element resolves ITS future's result with ``singular=True``;
+healthy elements of the same batch are untouched (``solve_batch``'s
+per-element flag machinery — no batch-wide poisoning).
+
+Admission control is the caller's thread: a full bounded queue raises
+:class:`ServiceOverloadedError` at ``submit`` time — typed backpressure,
+never a silent drop.  An execution failure resolves every future of its
+batch with the exception, same contract.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass
+
+import numpy as np
+
+
+class ServiceOverloadedError(RuntimeError):
+    """The bounded request queue is full — backpressure, not a drop.
+    Callers retry with their own policy; the service never discards an
+    accepted request (ISSUE 3 acceptance contract)."""
+
+
+class ServiceClosedError(RuntimeError):
+    """submit() after close() — the service no longer accepts work."""
+
+
+@dataclass
+class InvertResult:
+    """What a request's future resolves to: the unpadded inverse plus
+    the per-element accuracy/diagnostics the compiled batch program
+    assembled (``driver.batch_metrics``)."""
+
+    inverse: object           # (n, n) device array, padding sliced off
+    n: int
+    bucket_n: int
+    singular: bool
+    kappa: float
+    rel_residual: float
+    queue_seconds: float      # submit -> dispatch
+    execute_seconds: float    # the batch execution this request rode
+    batch_occupancy: int      # real requests in that batch
+
+
+@dataclass
+class _Request:
+    padded: np.ndarray        # (bucket_n, bucket_n) identity-padded input
+    n: int
+    bucket_n: int
+    t_enqueue: float
+    future: Future
+
+
+class MicroBatcher:
+    """The queue + dispatcher.  ``autostart=False`` leaves the
+    dispatcher thread unstarted (tests fill the bounded queue
+    deterministically, then ``start()`` drains it); ``close()`` on a
+    never-started batcher drains inline on the calling thread."""
+
+    def __init__(self, executors, stats, batch_cap: int = 8,
+                 max_wait_ms: float = 2.0, max_queue: int = 256,
+                 block_size: int | None = None, autostart: bool = True):
+        if batch_cap < 1:
+            raise ValueError("batch_cap must be >= 1")
+        if max_queue < 1:
+            raise ValueError("max_queue must be >= 1")
+        self.executors = executors
+        self.stats = stats
+        self.batch_cap = int(batch_cap)
+        self.max_wait = float(max_wait_ms) / 1e3
+        self.max_queue = int(max_queue)
+        self.block_size = block_size
+        self._cv = threading.Condition()
+        self._queues: dict[int, deque] = {}
+        self._queued = 0
+        self._closing = False
+        self._thread: threading.Thread | None = None
+        if autostart:
+            self.start()
+
+    # ---- caller side -------------------------------------------------
+
+    def submit(self, padded: np.ndarray, n: int, bucket_n: int) -> Future:
+        req = _Request(padded, n, bucket_n, time.perf_counter(), Future())
+        with self._cv:
+            if self._closing:
+                raise ServiceClosedError("service is closed")
+            if self._queued >= self.max_queue:
+                self.stats.rejected(bucket_n)
+                raise ServiceOverloadedError(
+                    f"request queue full ({self.max_queue} pending) — "
+                    f"retry later (typed backpressure, nothing dropped)")
+            self._queues.setdefault(bucket_n, deque()).append(req)
+            self._queued += 1
+            self.stats.request(bucket_n)
+            self._cv.notify()
+        return req.future
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="tpu-jordan-serve", daemon=True)
+            self._thread.start()
+
+    def close(self, drain: bool = True) -> None:
+        """Stop accepting work.  ``drain=True`` (the default) completes
+        every queued request before returning; ``drain=False`` fails
+        queued futures with :class:`ServiceClosedError` (explicitly —
+        never silently)."""
+        with self._cv:
+            self._closing = True
+            if not drain:
+                for q in self._queues.values():
+                    while q:
+                        req = q.popleft()
+                        # Claim-then-fail: a future the caller already
+                        # cancelled is left alone (claim fails).
+                        if req.future.set_running_or_notify_cancel():
+                            req.future.set_exception(
+                                ServiceClosedError(
+                                    "service closed before this "
+                                    "request ran"))
+                self._queued = 0
+            self._cv.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        elif self._queued:
+            # Never started: drain inline on the caller's thread (the
+            # loop exits once closing and empty).
+            self._loop()
+
+    @property
+    def queued(self) -> int:
+        with self._cv:
+            return self._queued
+
+    # ---- dispatcher side ---------------------------------------------
+
+    def _pick(self, now: float) -> int | None:
+        """The bucket to dispatch: any full batch, else the bucket whose
+        head request has aged past the deadline (oldest head first);
+        when draining, any nonempty bucket."""
+        best = None
+        for b, q in self._queues.items():
+            if not q:
+                continue
+            age = now - q[0].t_enqueue
+            if (len(q) >= self.batch_cap or self._closing
+                    or age >= self.max_wait):
+                if best is None or age > best[1]:
+                    best = (b, age)
+        return None if best is None else best[0]
+
+    def _next_deadline(self, now: float) -> float | None:
+        waits = [self.max_wait - (now - q[0].t_enqueue)
+                 for q in self._queues.values() if q]
+        return max(0.0, min(waits)) if waits else None
+
+    def _loop(self) -> None:
+        while True:
+            with self._cv:
+                while True:
+                    now = time.perf_counter()
+                    bucket = self._pick(now)
+                    if bucket is not None:
+                        q = self._queues[bucket]
+                        take = min(len(q), self.batch_cap)
+                        batch = [q.popleft() for _ in range(take)]
+                        self._queued -= take
+                        # Claim each future (the stdlib executor
+                        # protocol): a caller-cancelled one drops out
+                        # here, and no future can transition under the
+                        # execution — set_result below can never race
+                        # a cancel into InvalidStateError.
+                        batch = [r for r in batch
+                                 if r.future.set_running_or_notify_cancel()]
+                        if not batch:
+                            continue
+                        break
+                    if self._closing and self._queued == 0:
+                        return
+                    self._cv.wait(self._next_deadline(now))
+            self._execute(bucket, batch, now)
+
+    def _execute(self, bucket: int, batch: list, t_dispatch: float) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        try:
+            ex = self.executors.get(bucket, self.batch_cap,
+                                    self.block_size)
+            dtype = jnp.dtype(ex.key.dtype)
+            cap = self.batch_cap
+            stacked = np.broadcast_to(
+                np.eye(bucket, dtype=dtype), (cap, bucket, bucket)).copy()
+            n_real = np.zeros((cap,), np.int32)
+            for i, req in enumerate(batch):
+                stacked[i] = req.padded
+                n_real[i] = req.n
+            t0 = time.perf_counter()
+            inv, sing, kappa, rel = ex.run(jnp.asarray(stacked),
+                                           jnp.asarray(n_real))
+            jax.block_until_ready(inv)
+            exec_s = time.perf_counter() - t0
+            sing = np.asarray(sing)
+            kappa = np.asarray(kappa)
+            rel = np.asarray(rel)
+        except BaseException as e:                  # noqa: BLE001
+            # Fan the failure to every rider — a batch error must be N
+            # explicit per-request failures, never a hang or a drop.
+            for req in batch:
+                if not req.future.done():
+                    req.future.set_exception(e)
+            return
+
+        queue_waits = [t_dispatch - req.t_enqueue for req in batch]
+        self.stats.batch(bucket, occupancy=len(batch),
+                         exec_seconds=exec_s, queue_seconds=queue_waits,
+                         singular=int(sing[:len(batch)].sum()))
+        for i, req in enumerate(batch):
+            req.future.set_result(InvertResult(
+                inverse=inv[i, :req.n, :req.n],
+                n=req.n,
+                bucket_n=bucket,
+                singular=bool(sing[i]),
+                kappa=float(kappa[i]),
+                rel_residual=float(rel[i]),
+                queue_seconds=queue_waits[i],
+                execute_seconds=exec_s,
+                batch_occupancy=len(batch),
+            ))

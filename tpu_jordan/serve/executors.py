@@ -1,0 +1,210 @@
+"""Shape-bucketed AOT executable cache (ISSUE 3 tentpole part 1).
+
+Serving many small solves at throughput means never paying trace/compile
+on the request path: requests are rounded UP to power-of-two n-buckets
+(``bucket_for``) — identity padding makes the rounding *exact*, not
+approximate (``ops/padding.py``: the padded inverse is [[A⁻¹, 0], [0, I]]
+and the pad blocks stay exactly zero through elimination) — and each
+(bucket_n, batch_cap, dtype, engine) gets ONE executable, AOT-lowered
+from ``ShapeDtypeStruct``s (no batch materialized to compile) and reused
+for every batch ever dispatched to that bucket.
+
+Engine choice is resolved through PR 2's autotuner ladder at a *batched*
+tuning point (``TunePoint.create(..., batch=batch_cap)`` — plan-cache
+keys grow a ``bN`` segment, ``tuning/plan_cache.plan_key``), so a warm
+server performs ZERO plan-cache measurements and ZERO recompiles; both
+are counter-pinned by ``tests/test_serve.py`` (``Tuner.measurements``
+and the per-bucket ``compiles`` stat).
+
+The compiled program does the whole per-batch job in one launch: invert
+the padded stack through the batched engine machinery (``ops/batched``'s
+dispatch — the dedicated small-n batch-first engine in its validated
+regime), then assemble per-element accuracy (``driver.batch_metrics``,
+row-masked to each element's real n) so the batcher can fan κ∞ and
+rel_residual back to every request without a second device round trip.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..config import default_block_size
+from ..tuning.plan_cache import PlanCache, n_bucket
+from ..tuning.registry import TunePoint
+from ..tuning.tuner import Tuner
+
+#: The smallest bucket served.  Sub-64 matrices still invert correctly
+#: (identity-padded to 64); a finer ladder would multiply executables
+#: for no measurable win — a 64² solve is launch-bound, not flop-bound.
+MIN_BUCKET_N = 64
+
+
+def bucket_for(n: int, floor: int = MIN_BUCKET_N) -> int:
+    """Round a request size up to its serving bucket: next power of two
+    (the same rounding as the plan cache's ``n_bucket``), floored at
+    ``MIN_BUCKET_N``.  Exact by identity padding — a bucketed solve
+    returns bit-identically the top-left n×n of the padded inverse."""
+    if n <= 0:
+        raise ValueError(f"matrix dimension must be positive, got {n}")
+    return max(floor, n_bucket(n))
+
+
+@dataclass(frozen=True)
+class ExecutorKey:
+    """The executable cache key — the coordinates a compiled serving
+    program depends on (ISSUE 3 tentpole): shape bucket, batch capacity,
+    dtype, the RESOLVED engine (never "auto"), and the pivot block size
+    (part of the key so a direct cache user requesting a different m
+    can never be handed a stale-m executable from a cache hit)."""
+
+    bucket_n: int
+    batch_cap: int
+    dtype: str
+    engine: str
+    block_size: int
+
+
+class BucketExecutor:
+    """One AOT-compiled batched-inversion executable for one bucket.
+
+    ``run(stacked, n_real)`` takes the identity-padded
+    (batch_cap, N, N) stack plus the per-element real sizes (0 for
+    identity filler slots of a partial batch) and returns numpy-ready
+    device arrays: (inverses, singular_flags, kappa, rel_residual).
+    """
+
+    def __init__(self, key: ExecutorKey, plan):
+        self.key = key
+        self.block_size = key.block_size
+        self.plan = plan          # tuning.Plan (None for explicit engines)
+        self._compiled = self._build()
+
+    def _build(self):
+        from ..driver import batch_metrics
+        from ..ops import batched_jordan_invert
+        from ..ops.jordan import block_jordan_invert
+        from ..ops.jordan_inplace import (
+            block_jordan_invert_inplace_grouped_fori,
+        )
+
+        key = self.key
+        m = key.block_size
+        if key.engine in ("inplace", "auto"):
+            # The batched dispatch (ops/batched.py): the dedicated
+            # batch-first small-n engine in its validated regime
+            # (Nr <= 4, B >= 32), the vmapped/fori routes otherwise.
+            def invert(a):
+                return batched_jordan_invert(a, block_size=m)
+        elif key.engine == "grouped":
+            grouped = block_jordan_invert_inplace_grouped_fori
+
+            def invert(a):
+                return jax.vmap(lambda x: grouped(
+                    x, block_size=m, group=2))(a)
+        elif key.engine == "augmented":
+            def invert(a):
+                return jax.vmap(lambda x: block_jordan_invert(
+                    x, block_size=m))(a)
+        else:
+            from ..driver import UsageError
+
+            raise UsageError(
+                f"engine {key.engine!r} is not servable on a single "
+                f"device (the service batches on one chip; distributed "
+                f"engines need workers > 1)")
+
+        def fn(a, n_real):
+            inv, sing = invert(a)
+            met = batch_metrics(a, inv, n_real)
+            return inv, sing, met["kappa"], met["rel_residual"]
+
+        dtype = jnp.dtype(key.dtype)
+        shape = (key.batch_cap, key.bucket_n, key.bucket_n)
+        return jax.jit(fn).lower(
+            jax.ShapeDtypeStruct(shape, dtype),
+            jax.ShapeDtypeStruct((key.batch_cap,), jnp.int32),
+        ).compile()
+
+    def run(self, stacked, n_real):
+        return self._compiled(stacked, n_real)
+
+
+class ExecutorCache:
+    """The service's executable store: ``get()`` compiles at most once
+    per key (lock-held; ``compiles``/``cache_hits`` counted per bucket
+    in ``ServeStats``) and resolves the engine through the PR 2 tuner
+    ladder — plan cache first, registry cost ranking otherwise — at a
+    batched tuning point.  ``measurements`` (the tuner's counter) stays
+    0 for the service's cost-only ladder; the acceptance test pins it.
+    """
+
+    def __init__(self, engine: str = "auto", plan_cache: str | None = None,
+                 dtype=jnp.float32, stats=None):
+        from ..driver import resolve_engine
+
+        # Shared flag contract with solve/JordanSolver: "auto" stays
+        # auto (resolved per bucket through the tuner), an explicit
+        # engine is validated once here.
+        self.engine, self.group = resolve_engine(engine, 0)
+        self.dtype = jnp.dtype(dtype).name
+        self.stats = stats
+        self._lock = threading.Lock()
+        self._executors: dict[ExecutorKey, BucketExecutor] = {}
+        #: memoized (engine, plan) per (bucket_n, batch_cap, block_size):
+        #: resolution cannot change for the life of the cache, so the
+        #: hot dispatch path never re-walks the tuner ladder.
+        self._resolved: dict[tuple, tuple] = {}
+        cache = PlanCache.load(plan_cache) if plan_cache else None
+        self.tuner = Tuner(cache=cache)
+
+    @property
+    def measurements(self) -> int:
+        """Plan-cache measurement counter (the warm-server pin)."""
+        return self.tuner.measurements
+
+    def _resolve(self, bucket_n: int, batch_cap: int, block_size: int):
+        """(engine, plan) for one bucket: the tuner ladder for "auto"
+        (batched plan-cache key — zero measurements on the cost-only
+        ladder, counter-pinned), the explicit engine otherwise."""
+        if self.engine != "auto":
+            return self.engine, None
+        point = TunePoint.create(bucket_n, block_size, self.dtype,
+                                 workers=1, gather=True, batch=batch_cap)
+        plan = self.tuner.select(point)
+        return plan.engine, plan
+
+    def get(self, bucket_n: int, batch_cap: int,
+            block_size: int | None = None) -> BucketExecutor:
+        """The executor for a bucket — compiled on first use, a cache
+        hit forever after (ISSUE 3: a warm server performs zero
+        recompiles; the per-bucket ``compiles`` counter is the pin)."""
+        m = min(block_size if block_size is not None
+                else default_block_size(bucket_n), bucket_n)
+        with self._lock:
+            rkey = (bucket_n, batch_cap, m)
+            if rkey not in self._resolved:
+                self._resolved[rkey] = self._resolve(bucket_n, batch_cap, m)
+            engine, plan = self._resolved[rkey]
+            key = ExecutorKey(bucket_n, batch_cap, self.dtype, engine, m)
+            ex = self._executors.get(key)
+            if ex is not None:
+                if self.stats is not None:
+                    self.stats.cache_hit(bucket_n)
+                return ex
+            ex = BucketExecutor(key, plan)
+            self._executors[key] = ex
+            if self.stats is not None:
+                self.stats.compile(bucket_n)
+            return ex
+
+    def keys(self):
+        with self._lock:
+            return list(self._executors)
+
+    def entries(self):
+        with self._lock:
+            return list(self._executors.items())

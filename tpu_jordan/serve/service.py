@@ -1,0 +1,232 @@
+"""``JordanService`` — the serving product surface (ISSUE 3 tentpole
+part 3).
+
+The library so far is one-shot: every ``solve()`` pays selection and
+(for a new shape) blocking compilation, and the dedicated small-n
+batched engine is only reachable by hand-assembling a uniform batch.
+The service turns that into a request stream: callers ``submit()``
+arbitrary (n, n) matrices and get futures; requests are rounded up to
+power-of-two shape buckets (exact — identity padding), micro-batched
+per bucket up to ``batch_cap`` or a ``max_wait_ms`` deadline, and run
+through per-bucket AOT executables that are compiled at most once
+(``serve/executors.py``).  Engine choice per bucket rides PR 2's plan
+cache, so a warm server performs zero measurements and zero recompiles.
+
+Contract highlights (docs/SERVING.md is the operator guide):
+
+  * **Admission control** — the queue is bounded (``max_queue``); a full
+    queue raises :class:`ServiceOverloadedError` at submit time.  Typed
+    backpressure, never a silent drop.
+  * **Warmup** — ``warmup(shapes=...)`` pre-compiles the buckets those
+    shapes land in, so the first real request never pays a compile.
+  * **Per-element verification** — every result carries κ∞ and
+    rel_residual from the same compiled launch (``driver.batch_metrics``)
+    plus its element's singular flag; one singular request never poisons
+    its batch-mates.
+  * **Clean shutdown** — ``close()`` (or the context manager) drains
+    in-flight and queued work before returning.
+  * **Observability** — ``stats()`` reports per-bucket counters and
+    latency percentiles (``serve/stats.py``).
+"""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+
+import jax.numpy as jnp
+import numpy as np
+
+from .batcher import (InvertResult, MicroBatcher, ServiceClosedError,
+                      ServiceOverloadedError)
+from .executors import ExecutorCache, bucket_for
+from .stats import ServeStats
+
+
+class JordanService:
+    """A dynamic-batching inversion service on one device.
+
+    Args:
+      engine: "auto" (default — resolved per bucket through the PR 2
+        tuner ladder: plan cache, then registry cost ranking) or an
+        explicit single-device engine ("inplace" | "grouped" |
+        "augmented").
+      plan_cache: optional path to the PR 2 JSON plan cache; batched
+        keys carry a ``bN`` segment (``tuning/plan_cache.plan_key``).
+      dtype: storage dtype of requests/results.
+      batch_cap: max requests fused into one executable launch (the
+        executable's static batch dimension).
+      max_wait_ms: how long the oldest queued request may wait for
+        batch-mates before a partial batch dispatches (the
+        occupancy-vs-latency dial, docs/SERVING.md).
+      max_queue: bounded-queue admission limit across all buckets.
+      block_size: pivot block size override for every bucket (default:
+        ``config.default_block_size`` per bucket).
+      autostart: start the dispatcher thread immediately (tests pass
+        False to stage the queue deterministically, then ``start()``).
+    """
+
+    def __init__(self, engine: str = "auto", plan_cache: str | None = None,
+                 dtype=jnp.float32, batch_cap: int = 8,
+                 max_wait_ms: float = 2.0, max_queue: int = 256,
+                 block_size: int | None = None, autostart: bool = True):
+        self.dtype = jnp.dtype(dtype)
+        self.batch_cap = int(batch_cap)
+        self._stats = ServeStats()
+        self.executors = ExecutorCache(engine=engine, plan_cache=plan_cache,
+                                       dtype=self.dtype, stats=self._stats)
+        self._batcher = MicroBatcher(
+            self.executors, self._stats, batch_cap=batch_cap,
+            max_wait_ms=max_wait_ms, max_queue=max_queue,
+            block_size=block_size, autostart=autostart)
+        self._closed = False
+
+    # ---- request path ------------------------------------------------
+
+    def submit(self, a) -> Future:
+        """Queue one (n, n) matrix; returns a future resolving to
+        :class:`InvertResult`.  Raises :class:`ServiceOverloadedError`
+        when the bounded queue is full (backpressure — retry later) and
+        :class:`ServiceClosedError` after ``close()``."""
+        a = np.asarray(a, self.dtype)
+        if a.ndim != 2 or a.shape[0] != a.shape[1]:
+            raise ValueError(f"expected a square (n, n) matrix, "
+                             f"got shape {a.shape}")
+        n = a.shape[0]
+        bucket = bucket_for(n)
+        padded = np.asarray(np.eye(bucket, dtype=self.dtype))
+        padded[:n, :n] = a
+        return self._batcher.submit(padded, n, bucket)
+
+    @staticmethod
+    def result(future: Future, timeout: float | None = None) -> InvertResult:
+        """Block on a submitted future (sugar over ``future.result``)."""
+        return future.result(timeout)
+
+    def invert(self, a, timeout: float | None = None) -> InvertResult:
+        """Synchronous submit + wait.  Raises
+        :class:`~..driver.SingularMatrixError` when THIS request's
+        element was flagged (batch-mates are unaffected either way —
+        the async ``submit`` path reports the flag on the result
+        instead, for callers that want to inspect rather than raise)."""
+        res = self.submit(a).result(timeout)
+        if res.singular:
+            from ..driver import SingularMatrixError
+
+            raise SingularMatrixError("singular matrix")
+        return res
+
+    # ---- lifecycle ---------------------------------------------------
+
+    def warmup(self, shapes) -> dict:
+        """Pre-compile the executables for every bucket the given
+        request sizes land in; returns {bucket_n: resolved engine}.
+        After a warmup covering the live shape mix, the serve path
+        performs zero compiles and zero plan-cache measurements (both
+        counter-pinned by the acceptance test)."""
+        out = {}
+        for n in shapes:
+            b = bucket_for(int(n))
+            ex = self.executors.get(b, self.batch_cap,
+                                    self._batcher.block_size)
+            out[b] = ex.key.engine
+        return out
+
+    def start(self) -> None:
+        """Start the dispatcher (no-op when ``autostart=True``)."""
+        self._batcher.start()
+
+    def close(self, drain: bool = True) -> None:
+        """Stop accepting requests; ``drain=True`` completes all queued
+        and in-flight work before returning."""
+        if not self._closed:
+            self._batcher.close(drain=drain)
+            self._closed = True
+
+    def __enter__(self) -> "JordanService":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- observability ----------------------------------------------
+
+    def stats(self) -> dict:
+        """Per-bucket counters + latency percentiles (serve/stats.py),
+        the resolved engine per compiled bucket, and the plan-cache
+        measurement counter (0 on the cost-only ladder — the
+        warm-server pin)."""
+        snap = self._stats.snapshot()
+        snap["engines"] = {
+            f"{k.bucket_n}": {"engine": k.engine,
+                              "batch_cap": k.batch_cap,
+                              "plan_source": (ex.plan.source
+                                              if ex.plan else None)}
+            for k, ex in self.executors.entries()
+        }
+        snap["measurements"] = self.executors.measurements
+        snap["batch_cap"] = self.batch_cap
+        snap["queued"] = self._batcher.queued
+        return snap
+
+
+def serve_demo(n: int, block_size: int | None = None, requests: int = 64,
+               batch_cap: int = 8, max_wait_ms: float = 2.0,
+               engine: str = "auto", plan_cache: str | None = None,
+               dtype=jnp.float32, generator: str = "rand") -> dict:
+    """The ``--serve-demo`` CLI mode's engine: a self-contained
+    sustained-throughput demonstration on whatever backend is live.
+
+    Submits ``requests`` mixed-size concurrent requests — sizes cycle
+    through {n, n/2, n/4} (floored at the service's minimum bucket), so
+    ≥ 3 shape buckets are exercised whenever n ≥ 4·MIN_BUCKET_N —
+    through a warmed :class:`JordanService`, waits for every future, and
+    returns the one-line JSON report: request/batch counts, per-bucket
+    stats with mean occupancy and latency percentiles, the compile and
+    plan-cache measurement counters (a warm server pins both at zero on
+    the request path), worst rel_residual, and wall time.
+    """
+    import time
+
+    from ..ops import generate
+
+    sizes = sorted({max(1, n), max(1, n // 2), max(1, n // 4)},
+                   reverse=True)
+    elapsed0 = time.perf_counter()
+    with JordanService(engine=engine, plan_cache=plan_cache, dtype=dtype,
+                       batch_cap=batch_cap, max_wait_ms=max_wait_ms,
+                       max_queue=max(requests, 1),
+                       block_size=block_size) as svc:
+        svc.warmup(shapes=sizes)
+        compiles_after_warmup = svc.stats()["totals"]["compiles"]
+        futures = []
+        for i in range(requests):
+            sz = sizes[i % len(sizes)]
+            # Distinct well-conditioned matrices per request via index
+            # offsets (the solve_batch convention).
+            a = generate(generator, (sz, sz), dtype,
+                         row_offset=i * sz, col_offset=i * sz)
+            futures.append(svc.submit(a))
+        results = [f.result(timeout=600) for f in futures]
+        stats = svc.stats()
+    elapsed = time.perf_counter() - elapsed0
+    singular = sum(r.singular for r in results)
+    worst_rel = max((r.rel_residual for r in results
+                     if not r.singular), default=None)
+    return {
+        "metric": "serve_demo",
+        "requests": requests,
+        "request_sizes": sizes,
+        "buckets": len(stats["buckets"]),
+        "batch_cap": batch_cap,
+        "singular": singular,
+        "worst_rel_residual": (None if worst_rel is None
+                               else f"{worst_rel:.1e}"),
+        "compiles": stats["totals"]["compiles"],
+        "compiles_on_request_path": (stats["totals"]["compiles"]
+                                     - compiles_after_warmup),
+        "plan_cache_measurements": stats["measurements"],
+        "mean_occupancy": {
+            b: s["mean_occupancy"] for b, s in stats["buckets"].items()},
+        "elapsed_s": round(elapsed, 3),
+        "stats": stats,
+    }

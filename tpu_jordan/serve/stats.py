@@ -1,0 +1,124 @@
+"""Per-bucket serving counters and latency percentiles (ISSUE 3
+tentpole part 4).
+
+One :class:`ServeStats` instance rides a :class:`~.service.JordanService`
+for its whole life; every mutation happens under one lock because the
+writers are two threads (the caller thread on submit/reject, the
+dispatcher thread on batch completion and compile).  ``snapshot()``
+returns a plain-JSON dict — the payload of ``service.stats()`` and of
+the ``--serve-demo`` one-line report.
+
+The per-bucket keys the acceptance contract pins (ISSUE 3): ``requests``,
+``batches``, ``mean_occupancy`` (> 1 is the whole point of the
+micro-batcher), ``compiles`` (exactly one per (bucket, batch_cap) —
+zero after warmup), ``cache_hits``, ``singular``, and p50/p95/p99 for
+both queue wait and execute time.
+"""
+
+from __future__ import annotations
+
+import threading
+
+# Latency samples kept per (bucket, phase); beyond this the OLDEST are
+# dropped (a serving process must not grow without bound).  4096 recent
+# samples keep p99 meaningful at any realistic demo scale.
+MAX_LATENCY_SAMPLES = 4096
+
+_PCTS = (50.0, 95.0, 99.0)
+
+
+def _percentiles(samples) -> dict:
+    """p50/p95/p99 (milliseconds, 3 decimals) by the nearest-rank method
+    on a sorted copy — no numpy interpolation surprises for tiny k."""
+    if not samples:
+        return {"p50": None, "p95": None, "p99": None}
+    s = sorted(samples)
+    out = {}
+    for p in _PCTS:
+        rank = max(0, min(len(s) - 1, int(round(p / 100.0 * len(s))) - 1))
+        out[f"p{p:.0f}"] = round(s[rank] * 1e3, 3)
+    return out
+
+
+class _BucketStats:
+    """Counters for one shape bucket (all mutation under the owner's
+    lock — this class itself is not thread-safe on purpose)."""
+
+    def __init__(self):
+        self.requests = 0
+        self.rejected = 0
+        self.batches = 0
+        self.elements = 0          # occupied slots over all batches
+        self.compiles = 0
+        self.cache_hits = 0
+        self.singular = 0
+        self.queue_s: list[float] = []
+        self.exec_s: list[float] = []
+
+    def to_json(self) -> dict:
+        occ = (self.elements / self.batches) if self.batches else 0.0
+        return {
+            "requests": self.requests,
+            "rejected": self.rejected,
+            "batches": self.batches,
+            "mean_occupancy": round(occ, 3),
+            "compiles": self.compiles,
+            "cache_hits": self.cache_hits,
+            "singular": self.singular,
+            "queue_ms": _percentiles(self.queue_s),
+            "execute_ms": _percentiles(self.exec_s),
+        }
+
+
+class ServeStats:
+    """Thread-safe serving scoreboard, keyed by bucket n."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._buckets: dict[int, _BucketStats] = {}
+
+    def _b(self, bucket: int) -> _BucketStats:
+        return self._buckets.setdefault(bucket, _BucketStats())
+
+    def request(self, bucket: int) -> None:
+        with self._lock:
+            self._b(bucket).requests += 1
+
+    def rejected(self, bucket: int) -> None:
+        with self._lock:
+            self._b(bucket).rejected += 1
+
+    def compile(self, bucket: int) -> None:
+        with self._lock:
+            self._b(bucket).compiles += 1
+
+    def cache_hit(self, bucket: int) -> None:
+        with self._lock:
+            self._b(bucket).cache_hits += 1
+
+    def batch(self, bucket: int, occupancy: int, exec_seconds: float,
+              queue_seconds, singular: int = 0) -> None:
+        """One dispatched batch: ``occupancy`` occupied slots,
+        ``queue_seconds`` an iterable of per-request queue waits."""
+        with self._lock:
+            b = self._b(bucket)
+            b.batches += 1
+            b.elements += occupancy
+            b.singular += singular
+            b.exec_s.append(float(exec_seconds))
+            b.queue_s.extend(float(q) for q in queue_seconds)
+            del b.exec_s[:-MAX_LATENCY_SAMPLES]
+            del b.queue_s[:-MAX_LATENCY_SAMPLES]
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            buckets = {str(k): v.to_json()
+                       for k, v in sorted(self._buckets.items())}
+        totals = {
+            "requests": sum(b["requests"] for b in buckets.values()),
+            "rejected": sum(b["rejected"] for b in buckets.values()),
+            "batches": sum(b["batches"] for b in buckets.values()),
+            "compiles": sum(b["compiles"] for b in buckets.values()),
+            "singular": sum(b["singular"] for b in buckets.values()),
+        }
+        return {"buckets": buckets, "totals": totals}

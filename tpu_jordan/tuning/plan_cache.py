@@ -36,18 +36,28 @@ def n_bucket(n: int) -> int:
 
 
 def plan_key(point: TunePoint) -> str:
-    """``backend|topology|n-bucket|dtype|memory-mode`` — e.g.
-    ``tpu-v5p|4x8|n32768|float32|sharded``.
+    """``backend|topology|n-bucket|dtype|memory-mode[|bB]`` — e.g.
+    ``tpu-v5p|4x8|n32768|float32|sharded`` or, for a batched point,
+    ``tpu-v5e|single|n512|float32|gathered|b64``.
 
     The backend segment carries the sniffed chip generation when known
     (``tpu-v5p`` vs bare ``tpu``): a plans.json measured on a v5e pod
     must not be honored verbatim on a v5p pod — the v5p link/HBM ratios
-    are exactly what flips the engine ranking at pod meshes."""
+    are exactly what flips the engine ranking at pod meshes.
+
+    The batch segment (ISSUE 3) appears only when ``point.batch > 1`` —
+    the serving executors key plans per (bucket, batch_cap) because
+    per-launch overheads amortize differently across a batch — so every
+    pre-existing unbatched key is byte-identical and old caches stay
+    valid without a version bump."""
     backend = (f"{point.backend}-{point.chip}" if point.chip
                else point.backend)
     mem = "gathered" if point.gather else "sharded"
-    return (f"{backend}|{point.topology}|n{n_bucket(point.n)}|"
-            f"{point.dtype}|{mem}")
+    key = (f"{backend}|{point.topology}|n{n_bucket(point.n)}|"
+           f"{point.dtype}|{mem}")
+    if getattr(point, "batch", 1) > 1:
+        key += f"|b{point.batch}"
+    return key
 
 
 @dataclass(frozen=True)

@@ -67,12 +67,19 @@ class TunePoint:
     #: ``create`` from the real device kind on TPU backends — the v5p
     #: link/HBM ratios are what route pod meshes to the swap-free engine.
     chip: str | None = None
+    #: batch size of the point (ISSUE 3: the serving executors tune and
+    #: cache plans per (bucket, batch_cap) — a plan measured for one
+    #: matrix must not be honored verbatim for a 64-element batch, where
+    #: per-launch overheads amortize differently).  1 = the unbatched
+    #: solve; plan keys only grow a ``bN`` segment when batch > 1, so
+    #: every pre-existing cache key is unchanged.
+    batch: int = 1
 
     @classmethod
     def create(cls, n: int, block_size: int | None = None, dtype="float32",
                workers: Any = 1, gather: bool = True,
                backend: str | None = None,
-               chip: str | None = None) -> "TunePoint":
+               chip: str | None = None, batch: int = 1) -> "TunePoint":
         import jax
         import jax.numpy as jnp
 
@@ -90,7 +97,8 @@ class TunePoint:
             chip = _sniff_chip()
         return cls(n=int(n), block_size=int(min(block_size, n)),
                    dtype=jnp.dtype(dtype).name, workers=workers,
-                   gather=bool(gather), backend=backend, chip=chip)
+                   gather=bool(gather), backend=backend, chip=chip,
+                   batch=int(batch))
 
     @property
     def distributed(self) -> bool:
