@@ -13,7 +13,7 @@ WORKERS  ?= 1
 REQUESTS  ?= 64
 BATCH_CAP ?= 8
 
-.PHONY: all native tpu test smoke serve-demo bench clean
+.PHONY: all native tpu test smoke serve-demo metrics-demo bench clean
 
 all: native
 
@@ -43,6 +43,20 @@ smoke:
 serve-demo:
 	python -m tpu_jordan $(N) $(M) --serve-demo \
 	  --serve-requests $(REQUESTS) --batch-cap $(BATCH_CAP)
+
+# Telemetry demo + validation (docs/OBSERVABILITY.md): a small solve
+# and a serve burst, each exporting the process-wide tpu_jordan_*
+# metrics (Prometheus text) and the solve's span tree (Chrome trace
+# JSON, viewable in Perfetto); the checker validates both formats and
+# the metric namespace.
+metrics-demo:
+	python -m tpu_jordan 256 64 --quiet \
+	  --metrics-out /tmp/tpu_jordan_solve.prom \
+	  --trace-json /tmp/tpu_jordan_solve_trace.json
+	python -m tpu_jordan 256 64 --serve-demo --serve-requests 24 --quiet \
+	  --metrics-out /tmp/tpu_jordan_serve.prom
+	python tools/check_telemetry.py /tmp/tpu_jordan_solve.prom \
+	  /tmp/tpu_jordan_serve.prom /tmp/tpu_jordan_solve_trace.json
 
 bench: native
 	python bench.py

@@ -110,11 +110,19 @@ def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
         engine = partial(grouped, group=group)
     else:
         engine = block_jordan_invert_inplace
+    from tpu_jordan.obs.spans import timed_blocking
+
     a = generate(generator, (n, n), jnp.float32)
     # Invert ONCE before the timing campaign: the knife-edge fallback
     # (_Singular) must fire from this cheap call, not after r2 timed
-    # repetitions of a result that would be discarded.
-    inv, sing = engine(a, block_size=m)
+    # repetitions of a result that would be discarded.  The call is
+    # bracketed as a compile-inclusive first-call span (ISSUE 4
+    # satellite): BENCH_*.json rows record it NEXT TO the steady-state
+    # slope so a compile-time change can never masquerade as (or mask)
+    # an execution regression across capture rounds.
+    (inv, sing), first_sp = timed_blocking(
+        lambda: engine(a, block_size=m),
+        name="first_call_compile_inclusive")
     if bool(sing):
         raise _Singular(f"benchmark matrix flagged singular (n={n} m={m})")
     # The robust measurement core (tuning/measure.py, shared with the
@@ -164,6 +172,11 @@ def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
         "gflops_minmax": [round(gf(max(meas.accepted)), 1),
                           round(gf(min(meas.accepted)), 1)],
         "spread_pct": meas.spread_pct,
+        # Compile vs execute separated (ISSUE 4): the first call pays
+        # trace+compile+one inversion; the steady state is the slope
+        # per-call on the cached executable.
+        "first_call_compile_inclusive_s": round(first_sp.duration, 3),
+        "steady_state_s": round(per_call, 6),
     }
     if meas.rejected:
         acc["iqr_rejected_samples"] = len(meas.rejected)
@@ -225,6 +238,12 @@ def _record_spread(extra, prefix, acc):
     the explicit >10% variance_flag (VERDICT r5 weak #1)."""
     extra[f"{prefix}_gflops_minmax"] = acc["gflops_minmax"]
     extra[f"{prefix}_spread_pct"] = acc["spread_pct"]
+    # Optional because _batched_row records its compile/steady split
+    # directly into extra and passes a spread-only dict here.
+    if "first_call_compile_inclusive_s" in acc:
+        extra[f"{prefix}_first_call_compile_inclusive_s"] = (
+            acc["first_call_compile_inclusive_s"])
+        extra[f"{prefix}_steady_state_s"] = acc["steady_state_s"]
     if "iqr_rejected_samples" in acc:
         extra[f"{prefix}_iqr_rejected_samples"] = acc["iqr_rejected_samples"]
     if "variance_flag" in acc:
@@ -250,6 +269,8 @@ def _batched_row(extra, B, n, m, r1, r2, baseline_gflops, label):
     from tpu_jordan.ops import batched_jordan_invert, generate
     from tpu_jordan.tuning.measure import measure_slope
 
+    from tpu_jordan.obs.spans import timed_blocking
+
     # The solve_batch fixture convention: per-element index offsets give
     # distinct matrices under the 'rand' generator.
     offs = jnp.arange(B, dtype=jnp.int32) * n
@@ -257,8 +278,13 @@ def _batched_row(extra, B, n, m, r1, r2, baseline_gflops, label):
         lambda o: generate("rand", (n, n), jnp.float32, row_offset=o,
                            col_offset=o)
     ))(offs)
-    inv, sing = batched_jordan_invert(a, block_size=m)
-    jax.block_until_ready(inv)
+    # Compile-inclusive first call recorded next to the steady-state
+    # slope (ISSUE 4 satellite — same policy as _measure).
+    (inv, sing), first_sp = timed_blocking(
+        lambda: batched_jordan_invert(a, block_size=m),
+        name="first_call_compile_inclusive")
+    extra[f"batched_{label}_first_call_compile_inclusive_s"] = round(
+        first_sp.duration, 3)
     nsing = int(jnp.sum(sing))
     extra[f"batched_{label}_singular"] = f"{nsing}/{B}"
     if nsing:
@@ -278,6 +304,7 @@ def _batched_row(extra, B, n, m, r1, r2, baseline_gflops, label):
         lambda v: batched_jordan_invert(v, block_size=m)[0], (a,),
         r1=r1, r2=r2, samples=3)
     gf = 2.0 * n**3 * B / meas.seconds / 1e9
+    extra[f"batched_{label}_steady_state_s"] = round(meas.seconds, 6)
     extra[f"batched_{label}_f32_gflops"] = round(gf, 1)
     extra[f"batched_{label}_vs_baseline"] = round(gf / baseline_gflops, 1)
     extra[f"batched_{label}_rel_residual0"] = f"{rel0:.1e}"

@@ -63,6 +63,22 @@ def pytest_collection_modifyitems(config, items):
             + ", ".join(bad))
 
 
+@pytest.fixture(autouse=True, scope="session")
+def _metric_namespace_lint():
+    """ISSUE 4 satellite: after the whole suite ran (and therefore
+    registered every metric any code path creates), every name in the
+    process-wide registry must match ``^tpu_jordan_[a-z0-9_]+$`` so the
+    Prometheus namespace stays consistent.  The registry also enforces
+    this at registration time; the lint catches any bypass (e.g. a
+    direct ``Metric`` construction) and documents the contract."""
+    yield
+    from tpu_jordan.obs.metrics import NAME_RE, REGISTRY
+
+    bad = sorted(n for n in REGISTRY.names() if not NAME_RE.match(n))
+    assert not bad, (f"metrics registered outside the tpu_jordan_ "
+                     f"namespace: {bad}")
+
+
 @pytest.fixture
 def rng():
     return np.random.default_rng(0)

@@ -1,0 +1,359 @@
+"""Unified telemetry layer (ISSUE 4): deterministic fake-clock span
+nesting, the process-wide metrics registry, the three exporters
+(one-line JSON / Prometheus text / Chrome trace-event JSON), the driver
+and serve wiring, and the acceptance pins — a 2D-mesh solve's span tree
+carries pivot/permute/eliminate/residual children plus distinct
+compile/execute spans, and a warm ``JordanService`` Prometheus scrape
+reports ``tpu_jordan_compiles_total`` unchanged across 50 requests.
+
+Everything here is CPU-cheap (tier-1 runs near its 870 s budget); the
+one serve round-trip case is the smoke representative.
+"""
+
+import importlib.util
+import json
+import re
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpu_jordan.driver import solve
+from tpu_jordan.obs import export
+from tpu_jordan.obs.metrics import (NAME_RE, REGISTRY, MetricsRegistry,
+                                    Reservoir, percentiles)
+from tpu_jordan.obs.spans import (NULL, PHASES, Telemetry,
+                                  attribute_phases, timed_blocking)
+
+# The Makefile `metrics-demo` checker, loaded from tools/ (not a
+# package) so the exporter tests and the CI target share ONE validator.
+_CHECKER_PATH = Path(__file__).resolve().parents[1] / "tools" \
+    / "check_telemetry.py"
+_spec = importlib.util.spec_from_file_location("check_telemetry",
+                                               _CHECKER_PATH)
+check_telemetry = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_telemetry)
+
+
+class FakeClock:
+    """Deterministic injectable clock: every read advances 1.0 s (the
+    tuner's fake-timings discipline applied to spans)."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        self.t += 1.0
+        return self.t
+
+
+class TestSpans:
+    def test_fake_clock_nesting_deterministic(self):
+        tel = Telemetry(clock=FakeClock())
+        with tel.span("solve") as root:
+            with tel.span("compile"):
+                pass
+            with tel.span("execute"):
+                with tel.span("inner"):
+                    pass
+        # Clock reads: solve@1, compile@2-3, execute@4, inner@5-6,
+        # execute ends@7, solve ends@8 — fully deterministic.
+        assert [c.name for c in root.children] == ["compile", "execute"]
+        assert root.t_start == 1.0 and root.t_end == 8.0
+        assert root.children[0].duration == 1.0
+        assert root.children[1].duration == 3.0
+        assert root.find("inner").duration == 1.0
+        assert tel.roots == [root]
+
+    def test_threads_get_separate_roots(self):
+        tel = Telemetry()
+
+        def worker():
+            with tel.span("dispatcher"):
+                pass
+
+        with tel.span("main"):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join()
+        assert sorted(r.name for r in tel.roots) == ["dispatcher", "main"]
+        # The worker's span must NOT have nested under "main".
+        assert tel.find("main").children == []
+
+    def test_root_retention_is_bounded(self):
+        # A long-lived telemetry'd server roots one span per batch —
+        # retention must be a window, not unbounded growth.
+        tel = Telemetry(clock=FakeClock(), max_roots=3)
+        for i in range(7):
+            with tel.span(f"r{i}"):
+                pass
+        assert [r.name for r in tel.roots] == ["r4", "r5", "r6"]
+
+    def test_null_telemetry_measures_but_retains_nothing(self):
+        with NULL.span("x") as sp:
+            pass
+        assert sp.t_end is not None and sp.duration >= 0.0
+        assert NULL.roots == []
+
+    def test_timed_blocking_span_is_the_elapsed(self):
+        tel = Telemetry(clock=FakeClock())
+        out, sp = timed_blocking(lambda: 7, telemetry=tel, name="execute")
+        assert out == 7
+        assert sp.duration == 1.0
+        assert tel.roots[0] is sp
+
+    def test_attribute_phases_partitions_execute(self):
+        tel = Telemetry(clock=FakeClock())
+        with tel.span("execute") as sp:
+            pass
+        kids = attribute_phases(sp, n=1024, block_size=128)
+        assert [k.name for k in kids] == list(PHASES)
+        assert all(k.attrs["modeled"] for k in kids)
+        assert kids[0].t_start == sp.t_start
+        assert kids[-1].t_end == sp.t_end
+        assert abs(sum(k.duration for k in kids) - sp.duration) < 1e-9
+        # The 2n³ MXU sweep must dominate the model at any real size.
+        assert max(kids, key=lambda k: k.duration).name == "eliminate"
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram(self):
+        reg = MetricsRegistry()
+        c = reg.counter("tpu_jordan_test_total", "h")
+        c.inc()
+        c.inc(2, bucket="64")
+        assert c.value() == 1 and c.value(bucket="64") == 2
+        assert c.total() == 3
+        with pytest.raises(ValueError):
+            c.inc(-1)
+        g = reg.gauge("tpu_jordan_test_gauge", "h")
+        g.set(5)
+        g.set(7)
+        assert g.value() == 7
+        h = reg.histogram("tpu_jordan_test_seconds", "h")
+        for v in range(1, 101):
+            h.observe(float(v))
+        assert h.percentiles() == {"p50": 50.0, "p95": 95.0, "p99": 99.0}
+        assert h.percentiles(bucket="none") == {"p50": None, "p95": None,
+                                                "p99": None}
+        # Histogram.value() is the lifetime sum (never float(Reservoir)).
+        assert h.value() == sum(range(1, 101))
+        assert h.value(bucket="none") == 0.0
+
+    def test_registration_idempotent_and_kind_conflict(self):
+        reg = MetricsRegistry()
+        a = reg.counter("tpu_jordan_x_total")
+        assert reg.counter("tpu_jordan_x_total") is a
+        with pytest.raises(ValueError):
+            reg.gauge("tpu_jordan_x_total")
+
+    def test_namespace_lint_at_registration(self):
+        reg = MetricsRegistry()
+        for bad in ("solves_total", "tpu_jordan_Bad", "tpu_jordan-x",
+                    "jordan_tpu_x"):
+            with pytest.raises(ValueError):
+                reg.counter(bad)
+        # The live process registry must already be clean (the conftest
+        # session lint re-checks after the whole suite).
+        assert all(NAME_RE.match(n) for n in REGISTRY.names())
+
+    def test_reservoir_bounded_window_lifetime_totals(self):
+        r = Reservoir(maxlen=4)
+        r.extend(range(10))
+        assert r.samples == [6.0, 7.0, 8.0, 9.0]
+        assert r.count == 10 and r.total == 45.0
+        assert percentiles([]) == {"p50": None, "p95": None, "p99": None}
+
+
+class TestExporters:
+    def _registry(self):
+        reg = MetricsRegistry()
+        c = reg.counter("tpu_jordan_demo_total", "demo counter")
+        c.inc(3, bucket="64")
+        c.inc(1)
+        h = reg.histogram("tpu_jordan_demo_seconds", "demo timing")
+        for v in (0.1, 0.2, 0.3):
+            h.observe(v)
+        return reg
+
+    def test_prometheus_text_parses(self):
+        text = export.to_prometheus(self._registry())
+        lines = text.splitlines()
+        assert "# TYPE tpu_jordan_demo_total counter" in lines
+        assert "# TYPE tpu_jordan_demo_seconds summary" in lines
+        assert 'tpu_jordan_demo_total{bucket="64"} 3' in lines
+        assert "tpu_jordan_demo_seconds_count 3" in lines
+        # Every sample line parses as name[{labels}] value.
+        sample = re.compile(r"^[a-z0-9_]+(\{[^}]*\})? -?[0-9.eE+-]+$")
+        for ln in lines:
+            if ln and not ln.startswith("#"):
+                assert sample.match(ln), ln
+        # The Makefile checker accepts the same text (shared validator).
+        assert check_telemetry.check_prometheus(text, "<test>") > 0
+
+    def test_chrome_trace_loads_with_matched_events(self):
+        tel = Telemetry(clock=FakeClock())
+        with tel.span("solve", n=64):
+            with tel.span("execute") as ex:
+                pass
+        attribute_phases(ex, 512, 128)
+        text = json.dumps(export.to_chrome_trace(tel))
+        doc = json.loads(text)
+        evs = doc["traceEvents"]
+        assert {e["name"] for e in evs} >= {"solve", "execute", "pivot",
+                                            "permute", "eliminate"}
+        # Complete events only — each is its own matched begin/end.
+        assert all(e["ph"] == "X" and isinstance(e["dur"], (int, float))
+                   for e in evs)
+        assert check_telemetry.check_chrome_trace(text, "<test>") == len(evs)
+
+    def test_json_line(self):
+        line = export.to_json_line(registry=self._registry(), run="r1")
+        doc = json.loads(line)
+        assert doc["metric"] == "telemetry" and doc["run"] == "r1"
+        assert "tpu_jordan_demo_total" in doc["metrics"]
+        assert "\n" not in line
+
+
+class TestSolveTelemetry:
+    def test_2d_mesh_solve_span_tree(self):
+        """The ISSUE 4 acceptance pin: one telemetry'd solve on a
+        2D-mesh engine yields pivot/permute/eliminate/residual child
+        spans and DISTINCT compile/execute spans; its Chrome-trace
+        export loads as valid trace-event JSON."""
+        tel = Telemetry()
+        r = solve(64, 16, workers=(2, 4), telemetry=tel)
+        assert r.trace is not None and r.trace.name == "solve"
+        names = {s.name for s in r.trace.walk()}
+        assert {"compile", "execute", "pivot", "permute", "eliminate",
+                "residual"} <= names
+        ex = r.trace.find("execute")
+        assert r.trace.find("compile") is not ex
+        # The dedup satellite's contract: elapsed IS the execute span's
+        # duration (one shared bracket — they cannot disagree).
+        assert r.elapsed == ex.duration
+        assert {c.name for c in ex.children} >= set(PHASES)
+        assert all(c.attrs.get("modeled") for c in ex.children
+                   if c.name in PHASES)
+        text = json.dumps(export.to_chrome_trace(tel))
+        assert check_telemetry.check_chrome_trace(text, "<test>") >= 6
+
+    def test_no_telemetry_means_no_trace(self):
+        r = solve(32, 16)
+        assert r.trace is None
+
+    def test_auto_select_records_select_span(self):
+        from tpu_jordan.tuning.tuner import auto_select
+
+        tel = Telemetry(clock=FakeClock())
+        engine, group, plan = auto_select(256, 64, "float32", 1, True,
+                                          telemetry=tel)
+        sp = tel.find("select")
+        assert sp is not None and sp.attrs["engine"] == engine
+        assert sp.attrs["source"] in ("cache", "cost_model", "measured")
+
+    def test_tuner_plan_cache_hit_miss_metrics(self, tmp_path):
+        from tpu_jordan.tuning.plan_cache import PlanCache
+        from tpu_jordan.tuning.registry import TunePoint
+        from tpu_jordan.tuning.tuner import Tuner
+
+        hits = REGISTRY.counter("tpu_jordan_plan_cache_hits_total")
+        misses = REGISTRY.counter("tpu_jordan_plan_cache_misses_total")
+        cache = PlanCache(path=str(tmp_path / "plans.json"))
+        t = Tuner(cache=cache)
+        pt = TunePoint.create(256, 64, "float32", 1, True)
+        h0, m0 = hits.total(), misses.total()
+        t.select(pt)                 # cold -> miss, plan written back
+        t.select(pt)                 # warm -> hit
+        assert misses.total() == m0 + 1
+        assert hits.total() == h0 + 1
+
+    def test_scoreboard_timed_shim_is_span_backed(self):
+        from tpu_jordan.utils.profiling import Scoreboard, timed
+
+        tel = Telemetry(clock=FakeClock())
+        with timed("glob", flops=2e9, telemetry=tel) as sb:
+            pass
+        assert sb.elapsed == 1.0
+        assert sb.report() == "glob_time: 1.00  (2.0 GFLOP/s)"
+        sp = tel.roots[0]
+        assert sp.name == "glob" and sp.duration == sb.elapsed
+        # Satellite: GFLOP/s rides the span as an attribute.
+        assert sp.attrs["gflops"] == 2.0
+        assert isinstance(Scoreboard("x"), Scoreboard)
+
+
+def _scrape_compiles_total() -> float:
+    """Sum every ``tpu_jordan_compiles_total`` series from an actual
+    Prometheus-text scrape of the process registry (the acceptance pin
+    reads the exported format, not a Python attribute)."""
+    total = 0.0
+    for line in export.to_prometheus(REGISTRY).splitlines():
+        if line.startswith("tpu_jordan_compiles_total{") or \
+                line.startswith("tpu_jordan_compiles_total "):
+            total += float(line.rsplit(" ", 1)[1])
+    return total
+
+
+class TestServeTelemetry:
+    @pytest.mark.smoke
+    def test_warm_scrape_zero_compiles_across_50_requests(self):
+        """ISSUE 4 acceptance: a warm JordanService Prometheus scrape
+        reports ``tpu_jordan_compiles_total`` unchanged across 50
+        requests (the smoke-tier serve round trip)."""
+        from tpu_jordan.serve import JordanService
+
+        tel = Telemetry()
+        rng = np.random.default_rng(0)
+        with JordanService(batch_cap=4, max_queue=128,
+                           telemetry=tel) as svc:
+            svc.warmup(shapes=[32])
+            before = _scrape_compiles_total()
+            futs = [svc.submit(
+                2.0 * np.eye(32, dtype=np.float32)
+                + 0.1 * rng.standard_normal((32, 32)).astype(np.float32))
+                for _ in range(50)]
+            results = [f.result(timeout=120) for f in futs]
+            after = _scrape_compiles_total()
+        assert after == before, "warm serve path must never compile"
+        assert len(results) == 50
+        assert not any(r.singular for r in results)
+        # Zero-compile warm trace: the only compile span is warmup's.
+        assert sum(1 for s in tel.spans() if s.name == "compile") == 1
+        assert any(s.name == "execute" for s in tel.spans())
+
+    def test_stats_rebase_preserves_snapshot_and_mirrors_registry(self):
+        from tpu_jordan.serve.stats import ServeStats
+
+        reqs = REGISTRY.counter("tpu_jordan_serve_requests_total")
+        before = reqs.value(bucket="999")
+        s = ServeStats()
+        s.request(999)
+        s.batch(999, occupancy=3, exec_seconds=0.5,
+                queue_seconds=[0.1, 0.2, 0.3])
+        snap = s.snapshot()["buckets"]["999"]
+        # The ISSUE 3 snapshot contract, byte-for-byte keys.
+        assert snap["requests"] == 1 and snap["batches"] == 1
+        assert snap["mean_occupancy"] == 3.0
+        assert snap["execute_ms"]["p50"] == 500.0
+        assert snap["queue_ms"]["p95"] == 300.0
+        # ...and the same mutation landed in the process registry.
+        assert reqs.value(bucket="999") == before + 1
+
+
+class TestCLI:
+    def test_metrics_out_and_trace_json(self, tmp_path):
+        from tpu_jordan.__main__ import main
+
+        mpath = tmp_path / "metrics.prom"
+        tpath = tmp_path / "trace.json"
+        rc = main(["48", "16", "--quiet", "--metrics-out", str(mpath),
+                   "--trace-json", str(tpath)])
+        assert rc == 0
+        assert check_telemetry.check_prometheus(
+            mpath.read_text(), str(mpath)) > 0
+        assert check_telemetry.check_chrome_trace(
+            tpath.read_text(), str(tpath)) > 0
+        # The checker CLI itself agrees (the metrics-demo target path).
+        assert check_telemetry.main([str(mpath), str(tpath)]) == 0

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Validate telemetry export artifacts (the Makefile ``metrics-demo``
+target's checker; ISSUE 4 satellite).
+
+Usage: ``python tools/check_telemetry.py FILE [FILE ...]``
+
+Each file is sniffed by content: a document starting with ``{`` is
+checked as Chrome trace-event JSON, anything else as Prometheus text
+exposition format.  Checks (all must pass; exit 1 with a message
+otherwise):
+
+  * Prometheus: every sample line parses as ``name[{labels}] value``,
+    every metric family has a ``# TYPE`` line with a known type, every
+    family name lives in the ``tpu_jordan_`` namespace
+    (``obs.metrics.NAME_RE``), and at least one sample exists.
+  * Chrome trace: the document loads as JSON with a ``traceEvents``
+    list, every event has a known phase (complete ``X`` events carry a
+    numeric ``dur``; duration events come as matched ``B``/``E`` pairs
+    per (pid, tid, name)), and at least one event exists.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+
+NAME_RE = re.compile(r"^tpu_jordan_[a-z0-9_]+$")
+SAMPLE_RE = re.compile(
+    r"^([A-Za-z_:][A-Za-z0-9_:]*)(\{[^}]*\})?\s+(-?[0-9.eE+-]+|NaN|"
+    r"[+-]?Inf)$")
+_SUFFIXES = ("_sum", "_count")
+_TYPES = {"counter", "gauge", "summary", "histogram", "untyped"}
+
+
+def check_prometheus(text: str, path: str) -> int:
+    """Returns the sample count; raises AssertionError on any violation."""
+    typed: set[str] = set()
+    samples = 0
+    for i, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            assert len(parts) == 4 and parts[3] in _TYPES, \
+                f"{path}:{i}: malformed TYPE line: {line!r}"
+            typed.add(parts[2])
+            continue
+        if line.startswith("#"):
+            continue
+        m = SAMPLE_RE.match(line)
+        assert m, f"{path}:{i}: unparseable sample line: {line!r}"
+        name = m.group(1)
+        family = name
+        for suf in _SUFFIXES:
+            if family.endswith(suf) and family[:-len(suf)] in typed:
+                family = family[:-len(suf)]
+                break
+        assert NAME_RE.match(family), (
+            f"{path}:{i}: metric {family!r} outside the tpu_jordan_ "
+            f"namespace ({NAME_RE.pattern})")
+        assert family in typed, \
+            f"{path}:{i}: sample {name!r} has no preceding # TYPE line"
+        float(m.group(3).replace("Inf", "inf").replace("NaN", "nan"))
+        samples += 1
+    assert samples > 0, f"{path}: no samples — empty scrape"
+    return samples
+
+
+def check_chrome_trace(text: str, path: str) -> int:
+    """Returns the event count; raises AssertionError on any violation."""
+    doc = json.loads(text)
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events, \
+        f"{path}: traceEvents missing or empty"
+    open_be: dict = {}
+    for ev in events:
+        ph = ev.get("ph")
+        assert ph in {"X", "B", "E", "M", "i"}, \
+            f"{path}: unknown event phase {ph!r}: {ev}"
+        if ph == "X":
+            assert isinstance(ev.get("dur"), (int, float)), \
+                f"{path}: complete event without numeric dur: {ev}"
+            assert isinstance(ev.get("ts"), (int, float)), \
+                f"{path}: complete event without numeric ts: {ev}"
+        elif ph in ("B", "E"):
+            key = (ev.get("pid"), ev.get("tid"), ev.get("name"))
+            open_be[key] = open_be.get(key, 0) + (1 if ph == "B" else -1)
+            assert open_be[key] >= 0, \
+                f"{path}: E before B for {key}"
+    bad = {k: v for k, v in open_be.items() if v != 0}
+    assert not bad, f"{path}: unmatched B/E events: {bad}"
+    return len(events)
+
+
+def main(argv=None) -> int:
+    paths = (argv if argv is not None else sys.argv[1:])
+    if not paths:
+        print(__doc__, file=sys.stderr)
+        return 1
+    failures = 0
+    for path in paths:
+        try:
+            with open(path) as f:
+                text = f.read()
+            if text.lstrip().startswith("{"):
+                n = check_chrome_trace(text, path)
+                print(f"{path}: OK chrome-trace ({n} events)")
+            else:
+                n = check_prometheus(text, path)
+                print(f"{path}: OK prometheus ({n} samples)")
+        except Exception as e:                   # noqa: BLE001
+            print(f"{path}: FAIL — {e}", file=sys.stderr)
+            failures += 1
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

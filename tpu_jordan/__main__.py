@@ -25,6 +25,24 @@ def _workers_arg(s: str):
     return int(s)
 
 
+def _write_telemetry(metrics_out, trace_json, telemetry) -> None:
+    """``--metrics-out`` / ``--trace-json`` emission — runs on every
+    exit path (a failed solve's partial telemetry is still evidence).
+    Write failures warn on stderr but never mask the run's own exit
+    code/diagnostic (the documented 0/1/2 taxonomy, main.cpp:77-85)."""
+    try:
+        if metrics_out:
+            from .obs.export import write_metrics
+
+            write_metrics(metrics_out)
+        if trace_json and telemetry is not None:
+            from .obs.export import write_chrome_trace
+
+            write_chrome_trace(trace_json, telemetry)
+    except OSError as e:
+        print(f"warning: telemetry export failed: {e}", file=sys.stderr)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tpu_jordan",
@@ -132,6 +150,19 @@ def main(argv=None) -> int:
                                        "deadline — how long the oldest "
                                        "request waits for batch-mates "
                                        "(default 2.0)")
+    ap.add_argument("--metrics-out", default=None, metavar="PATH",
+                    help="write the process-wide tpu_jordan_* metrics "
+                         "registry (solves, compiles, plan-cache "
+                         "hits/misses, serve counters, latency "
+                         "percentiles) as Prometheus text format on "
+                         "exit (docs/OBSERVABILITY.md)")
+    ap.add_argument("--trace-json", default=None, metavar="PATH",
+                    help="record the run's span tree (solve: select/"
+                         "load/compile/execute/gather/residual + "
+                         "model-attributed hot-loop phases; serve: "
+                         "per-batch compile/execute) and write it as "
+                         "Chrome trace-event JSON — open in Perfetto "
+                         "(ui.perfetto.dev) or chrome://tracing")
     ap.add_argument("--quiet", action="store_true")
     try:
         args = ap.parse_args(argv)
@@ -192,6 +223,13 @@ def main(argv=None) -> int:
     from .parallel.mesh import MeshSizeError
     from .serve.batcher import ServiceClosedError, ServiceOverloadedError
 
+    telemetry = None
+    if args.metrics_out or args.trace_json:
+        # One span collector for the whole run (ISSUE 4); the metrics
+        # registry is process-wide and needs no handle.
+        from .obs.spans import Telemetry
+
+        telemetry = Telemetry()
     try:
         if args.serve_demo:
             # The serving demo: single-device, generator input,
@@ -221,7 +259,8 @@ def main(argv=None) -> int:
                 requests=args.serve_requests, batch_cap=args.batch_cap,
                 max_wait_ms=args.max_wait_ms, engine=args.engine,
                 plan_cache=args.plan_cache,
-                dtype=jnp.dtype(args.dtype), generator=args.generator)
+                dtype=jnp.dtype(args.dtype), generator=args.generator,
+                telemetry=telemetry)
             if args.quiet:
                 report.pop("stats", None)
             print(_json.dumps(report))
@@ -258,6 +297,7 @@ def main(argv=None) -> int:
                 refine=args.refine,
                 precision=args.precision,
                 verbose=not args.quiet,
+                telemetry=telemetry,
             )
         else:
             result = solve(
@@ -275,6 +315,7 @@ def main(argv=None) -> int:
                 group=args.group,
                 tune=args.tune,
                 plan_cache=args.plan_cache,
+                telemetry=telemetry,
             )
     except FileNotFoundError:
         print(f"cannot open {args.file}")
@@ -300,6 +341,8 @@ def main(argv=None) -> int:
         # single-device path) -> exit 1 (main.cpp:77-85).
         print(e, file=sys.stderr)
         return 1
+    finally:
+        _write_telemetry(args.metrics_out, args.trace_json, telemetry)
     if args.quiet:
         print(f"glob_time: {result.elapsed:.2f}")
         print(f"residual: {result.residual:e}")

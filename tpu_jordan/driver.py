@@ -18,7 +18,6 @@ Differences by design (documented, not accidental):
 
 from __future__ import annotations
 
-import time
 from dataclasses import dataclass
 
 import jax
@@ -26,6 +25,9 @@ import jax.numpy as jnp
 
 from .config import default_block_size
 from .io import read_matrix_file
+from .obs import metrics as _obs_metrics
+from .obs.spans import NULL as _NULL_TEL
+from .obs.spans import attribute_phases, timed_blocking
 from .ops import generate, inf_norm, residual_inf_norm
 
 
@@ -64,6 +66,11 @@ class SolveResult:
     group: int = 0              # resolved delayed-group size (0 = ungrouped)
     plan: object | None = None  # tuning.Plan when engine="auto" selected it
     #   (source: "cache" via plan.source preserved / cost_model / measured)
+    trace: object | None = None  # obs.spans.Span root ("solve") when the
+    #   caller passed telemetry= — select/load/compile/execute/gather/
+    #   residual children plus model-attributed hot-loop phases; the
+    #   execute span's duration IS `elapsed` (one shared bracket,
+    #   obs/spans.timed_blocking — they cannot disagree)
 
     @property
     def rel_residual(self) -> float | None:
@@ -80,6 +87,42 @@ class SolveResult:
 # (tuning/registry.py — name, legality, cost hook per configuration);
 # tests/test_tuning.py lints that the two can never drift.
 from .tuning.registry import ENGINES
+
+
+def _record_compile(compile_span, component: str) -> None:
+    """ONE compile-accounting path across the process (solve driver,
+    solve_batch, the distributed core, solver models): increments
+    ``tpu_jordan_compiles_total`` — the name the warm-path acceptance
+    pin scrapes — and observes the span's duration into
+    ``tpu_jordan_compile_seconds``, so the counter and the histogram's
+    ``_count`` can never disagree."""
+    _obs_metrics.counter(
+        "tpu_jordan_compiles_total",
+        "executable compiles (solve driver, solver models, serve "
+        "executor cache)").inc(component=component)
+    _obs_metrics.histogram(
+        "tpu_jordan_compile_seconds",
+        "wall seconds spent in AOT lowering+compilation",
+    ).observe(compile_span.duration, component=component)
+
+
+def _solve_metrics(n: int, elapsed: float, exec_span,
+                   singular: bool = False, batch: int = 1) -> None:
+    """Registry bookkeeping shared by every solve path; GFLOP/s rides
+    the execute span as an attribute (the Scoreboard convention)."""
+    _obs_metrics.counter("tpu_jordan_solves_total",
+                         "driver solves executed").inc()
+    _obs_metrics.histogram(
+        "tpu_jordan_solve_seconds",
+        "timed elimination wall seconds (the glob_time analog)",
+    ).observe(elapsed)
+    if elapsed > 0:
+        exec_span.attrs["gflops"] = round(
+            2.0 * n**3 * batch / elapsed / 1e9, 3)
+    if singular:
+        _obs_metrics.counter("tpu_jordan_singular_total",
+                             "solves/requests flagged singular"
+                             ).inc(component="solve")
 
 
 def resolve_engine(engine: str, group: int):
@@ -153,8 +196,18 @@ def solve(
     group: int = 0,
     tune: bool = False,
     plan_cache: str | None = None,
+    telemetry=None,
 ) -> SolveResult:
     """Invert an n x n matrix from a file or a generator and verify it.
+
+    ``telemetry`` (an ``obs.spans.Telemetry``) records the solve as a
+    span tree — ``solve`` root with select/load/compile/execute/gather/
+    residual children, model-attributed hot-loop phases (pivot /
+    permute / eliminate) under ``execute`` — returned on
+    ``SolveResult.trace`` and exportable as Chrome trace-event JSON
+    (``obs/export.py``, docs/OBSERVABILITY.md).  The driver's metrics
+    (solves, compiles, singular flags, timings) land in the
+    process-wide ``obs.metrics.REGISTRY`` either way.
 
     ``workers > 1`` runs the distributed path: 1D mesh over that many
     devices, sharded elimination, ring-GEMM residual — the analog of
@@ -195,6 +248,20 @@ def solve(
     Raises SingularMatrixError like the reference's -2 path
     (main.cpp:435-437); file errors propagate from read_matrix_file.
     """
+    tel = telemetry if telemetry is not None else _NULL_TEL
+    with tel.span("solve", n=n, workers=str(workers),
+                  generator=(None if file else generator)) as root:
+        res = _solve_impl(n, block_size, file, generator, dtype, refine,
+                          workers, device, verbose, gather, precision,
+                          engine, group, tune, plan_cache, tel)
+    if telemetry is not None:
+        res.trace = root
+    return res
+
+
+def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
+                device, verbose, gather, precision, engine, group, tune,
+                plan_cache, tel) -> SolveResult:
     if block_size is None:
         block_size = default_block_size(n)
     prec = _PRECISIONS[precision]
@@ -219,7 +286,8 @@ def solve(
 
         engine, group, plan = auto_select(n, block_size, dtype, workers,
                                           gather, tune=tune,
-                                          plan_cache=plan_cache)
+                                          plan_cache=plan_cache,
+                                          telemetry=tel)
 
     def load():
         if file is not None:
@@ -234,7 +302,7 @@ def solve(
         be = make_distributed_backend(workers, n, block_size, engine, group)
         res = _solve_distributed_core(
             be, n, block_size, file, generator, dtype, refine, verbose,
-            gather, load, sweep_prec,
+            gather, load, sweep_prec, tel=tel, engine=engine,
         )
         res.engine, res.group, res.plan = engine, group, plan
         return res
@@ -243,7 +311,8 @@ def solve(
         raise UsageError("engine='swapfree' is a distributed engine "
                          "(its win is collective bytes); use workers=p")
 
-    a = load()
+    with tel.span("load"):
+        a = load()
     if verbose:
         from .utils.printing import print_corner
 
@@ -256,17 +325,20 @@ def solve(
     # reload semantics), and donation lets XLA alias A's HBM into the
     # working matrix — the difference between fitting and OOM at
     # n >= 16384 (4 GB per n=32768 fp32 buffer on a 16 GB chip).
-    compiled = jax.jit(
-        single_device_invert(n, block_size, engine, group),
-        static_argnames=("block_size", "refine", "precision"),
-        donate_argnums=(0,),
-    ).lower(
-        a, block_size=block_size, refine=refine, precision=prec
-    ).compile()
-    t0 = time.perf_counter()
-    inv, singular = compiled(a)
-    jax.block_until_ready(inv)
-    elapsed = time.perf_counter() - t0
+    with tel.span("compile", engine=engine, n=n) as csp:
+        compiled = jax.jit(
+            single_device_invert(n, block_size, engine, group),
+            static_argnames=("block_size", "refine", "precision"),
+            donate_argnums=(0,),
+        ).lower(
+            a, block_size=block_size, refine=refine, precision=prec
+        ).compile()
+    _record_compile(csp, "solve")
+    (inv, singular), esp = timed_blocking(compiled, a, telemetry=tel,
+                                          name="execute", engine=engine)
+    elapsed = esp.duration
+    attribute_phases(esp, n, block_size)
+    _solve_metrics(n, elapsed, esp, singular=bool(singular))
 
     if bool(singular):
         raise SingularMatrixError("singular matrix")
@@ -279,10 +351,11 @@ def solve(
     # Re-load A (the reference re-reads/regenerates, main.cpp:463-488) and
     # verify independently (all distributed cases returned above via
     # _solve_distributed_core, so this is always the single-device residual).
-    a_fresh = load()
-    residual = float(residual_inf_norm(a_fresh, inv))
-    norm_a = float(inf_norm(a_fresh))
-    kappa = norm_a * float(inf_norm(inv))   # = condition_inf, one pass per matrix
+    with tel.span("residual"):
+        a_fresh = load()
+        residual = float(residual_inf_norm(a_fresh, inv))
+        norm_a = float(inf_norm(a_fresh))
+        kappa = norm_a * float(inf_norm(inv))  # condition_inf, one pass each
     if verbose:
         print(f"residual: {residual:e}")
         print(f"kappa_inf: {kappa:e}")
@@ -293,7 +366,7 @@ def solve(
         residual=residual,
         n=n,
         block_size=block_size,
-        gflops=2.0 * n**3 / elapsed / 1e9,
+        gflops=(2.0 * n**3 / elapsed / 1e9) if elapsed > 0 else 0.0,
         kappa=kappa,
         _norm_a=norm_a,
         engine=engine,
@@ -358,6 +431,7 @@ def solve_batch(
     refine: int = 0,
     precision: str = "highest",
     verbose: bool = False,
+    telemetry=None,
 ) -> SolveResult:
     """Invert ``batch`` generated n×n matrices in ONE vmapped computation
     (the north-star batch capability, ops/batched.py; single device).
@@ -380,27 +454,34 @@ def solve_batch(
     # working matrix is the difference between fitting and OOM — the
     # same policy as the single-solve driver; A[0] is regenerated fresh
     # for the residual (reference reload semantics).
-    offs = jnp.arange(batch, dtype=jnp.int32) * n
-    a = jax.jit(jax.vmap(
-        lambda o: generate(generator, (n, n), dtype, row_offset=o,
-                           col_offset=o)
-    ))(offs)  # jit fuses the index grids — eagerly they are 2x the batch
-    compiled = jax.jit(
-        lambda x: batched_jordan_invert(
-            x, block_size=block_size, refine=refine, precision=prec),
-        donate_argnums=(0,),
-    ).lower(a).compile()
-    t0 = time.perf_counter()
-    inv, singular = compiled(a)
-    jax.block_until_ready(inv)
-    elapsed = time.perf_counter() - t0
-    nsing = int(jnp.sum(singular))
-    if nsing:
-        raise SingularMatrixError(
-            f"singular matrix ({nsing}/{batch} elements flagged)")
-    a0 = generate(generator, (n, n), dtype)
-    met = batch_metrics(a0[None], inv[:1])
-    residual = float(met["residual"][0])
+    tel = telemetry if telemetry is not None else _NULL_TEL
+    with tel.span("solve_batch", n=n, batch=batch) as root:
+        with tel.span("load"):
+            offs = jnp.arange(batch, dtype=jnp.int32) * n
+            a = jax.jit(jax.vmap(
+                lambda o: generate(generator, (n, n), dtype, row_offset=o,
+                                   col_offset=o)
+            ))(offs)  # jit fuses the index grids — eager is 2x the batch
+        with tel.span("compile", n=n, batch=batch) as csp:
+            compiled = jax.jit(
+                lambda x: batched_jordan_invert(
+                    x, block_size=block_size, refine=refine,
+                    precision=prec),
+                donate_argnums=(0,),
+            ).lower(a).compile()
+        _record_compile(csp, "solve")
+        (inv, singular), esp = timed_blocking(compiled, a, telemetry=tel,
+                                              name="execute", batch=batch)
+        elapsed = esp.duration
+        nsing = int(jnp.sum(singular))
+        _solve_metrics(n, elapsed, esp, singular=bool(nsing), batch=batch)
+        if nsing:
+            raise SingularMatrixError(
+                f"singular matrix ({nsing}/{batch} elements flagged)")
+        with tel.span("residual"):
+            a0 = generate(generator, (n, n), dtype)
+            met = batch_metrics(a0[None], inv[:1])
+            residual = float(met["residual"][0])
     if verbose:
         print(f"glob_time: {elapsed:.2f} ({batch} matrices)")
         print(f"residual[0]: {residual:e}")
@@ -410,9 +491,11 @@ def solve_batch(
         residual=residual,
         n=n,
         block_size=block_size,
-        gflops=2.0 * n**3 * batch / elapsed / 1e9,
+        gflops=((2.0 * n**3 * batch / elapsed / 1e9)
+                if elapsed > 0 else 0.0),
         kappa=float(met["kappa"][0]),
         _norm_a=float(met["norm_a"][0]),
+        trace=root if telemetry is not None else None,
     )
 
 
@@ -745,7 +828,7 @@ class _Dist2D:
 def _solve_distributed_core(
     be, n: int, block_size: int, file, generator: str, dtype,
     refine: int, verbose: bool, gather: bool, load,
-    precision=_lax.Precision.HIGHEST,
+    precision=_lax.Precision.HIGHEST, tel=_NULL_TEL, engine=None,
 ):
     """The one distributed solve skeleton, shared by the 1D and 2D
     layouts via the backend adapter ``be``.
@@ -779,10 +862,11 @@ def _solve_distributed_core(
     # one) — the streamed strips round per-strip, same result.
     storage = in_dtype if in_dtype != jnp.dtype(dtype) else None
 
-    if file is None:
-        W = be.generate_W(generator, dtype)
-    else:
-        W = be.stream_W(file, dtype, storage)
+    with tel.span("load", streamed=file is not None):
+        if file is None:
+            W = be.generate_W(generator, dtype)
+        else:
+            W = be.stream_W(file, dtype, storage)
     if verbose:
         from .io import read_matrix_corner
         from .utils.printing import print_corner
@@ -793,16 +877,21 @@ def _solve_distributed_core(
                      else generate(generator, (min(n, 10), min(n, 10)),
                                    dtype))
 
-    run = be.compile(W, precision)
-    t0 = time.perf_counter()
-    out, singular = run(W)
-    jax.block_until_ready(out)
-    elapsed = time.perf_counter() - t0
-    if bool(singular.any()):
+    with tel.span("compile", engine=engine, n=n) as csp:
+        run = be.compile(W, precision)
+    _record_compile(csp, "solve")
+    (out, singular), esp = timed_blocking(run, W, telemetry=tel,
+                                          name="execute", engine=engine)
+    elapsed = esp.duration
+    attribute_phases(esp, n, be.lay.m, distributed=True)
+    singular_flag = bool(singular.any())
+    _solve_metrics(n, elapsed, esp, singular=singular_flag)
+    if singular_flag:
         raise SingularMatrixError("singular matrix")
 
-    inv = be.gather(out, n) if gather else None
-    inv_b = None if (gather and refine) else be.inv_blocks(out)
+    with tel.span("gather", gathered=gather):
+        inv = be.gather(out, n) if gather else None
+        inv_b = None if (gather and refine) else be.inv_blocks(out)
     # Round to the storage dtype BEFORE verification, so the reported
     # residual reflects what the caller actually receives.
     if in_dtype != dtype:
@@ -816,29 +905,30 @@ def _solve_distributed_core(
     # block-sharded state (be.inf_norm_blocks — column storage order is
     # irrelevant to a row sum), so nothing n×n ever materializes.
     kappa = norm_a = None
-    if refine:
-        a_full = load() if file is not None else generate(
-            generator, (n, n), dtype
-        )
-        a_full = jnp.asarray(a_full, dtype)
-        inv = newton_schulz(a_full, jnp.asarray(inv, dtype), refine)
-        # Round to the storage dtype BEFORE the residual (same policy as the
-        # non-refine branch): the reported number must include the final
-        # rounding error of what the caller actually receives.
-        inv = inv.astype(in_dtype)
-        inv_f = inv.astype(dtype)
-        residual = float(residual_inf_norm(a_full, inv_f))
-        norm_a = float(inf_norm(a_full))
-        kappa = norm_a * float(inf_norm(inv_f))  # = condition_inf, one pass each
-        del inv_f
-    else:
-        a_b = (be.stream_a_blocks(file, dtype, storage)
-               if file is not None
-               else be.generate_a_blocks(generator, dtype))
-        inv_bf = jnp.asarray(inv_b, dtype)
-        residual = float(be.residual(a_b, inv_bf))
-        norm_a = float(be.inf_norm_blocks(a_b))
-        kappa = norm_a * float(be.inf_norm_blocks(inv_bf))
+    with tel.span("residual", refined=bool(refine)):
+        if refine:
+            a_full = load() if file is not None else generate(
+                generator, (n, n), dtype
+            )
+            a_full = jnp.asarray(a_full, dtype)
+            inv = newton_schulz(a_full, jnp.asarray(inv, dtype), refine)
+            # Round to the storage dtype BEFORE the residual (same policy
+            # as the non-refine branch): the reported number must include
+            # the final rounding error of what the caller receives.
+            inv = inv.astype(in_dtype)
+            inv_f = inv.astype(dtype)
+            residual = float(residual_inf_norm(a_full, inv_f))
+            norm_a = float(inf_norm(a_full))
+            kappa = norm_a * float(inf_norm(inv_f))  # = condition_inf
+            del inv_f
+        else:
+            a_b = (be.stream_a_blocks(file, dtype, storage)
+                   if file is not None
+                   else be.generate_a_blocks(generator, dtype))
+            inv_bf = jnp.asarray(inv_b, dtype)
+            residual = float(be.residual(a_b, inv_bf))
+            norm_a = float(be.inf_norm_blocks(a_b))
+            kappa = norm_a * float(be.inf_norm_blocks(inv_bf))
 
     if verbose:
         from .utils.printing import print_corner
@@ -858,7 +948,7 @@ def _solve_distributed_core(
         residual=residual,
         n=n,
         block_size=be.lay.m,
-        gflops=2.0 * n**3 / elapsed / 1e9,
+        gflops=(2.0 * n**3 / elapsed / 1e9) if elapsed > 0 else 0.0,
         inverse_blocks=None if gather else inv_b,
         layout=None if gather else be.lay,
         kappa=kappa,

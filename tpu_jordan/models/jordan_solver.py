@@ -57,6 +57,12 @@ class JordanSolver:
         model, and with ``tune=True`` measure the cost-pruned survivors
         and persist the winner.  The resolved pick lands on
         ``self.engine``/``self.group``/``self.plan``.
+      telemetry: optional ``obs.spans.Telemetry`` — the select/compile
+        steps and every ``invert`` record distinct compile/execute
+        spans (repeat solves on the cached executable show zero-compile
+        traces).  NOTE: with telemetry attached, ``invert`` adds a
+        ``block_until_ready`` so the execute span is an honest wall
+        bracket; without it the lazy-return behavior is unchanged.
     """
 
     n: int
@@ -70,6 +76,7 @@ class JordanSolver:
     group: int = 0
     tune: bool = False
     plan_cache: str | None = None
+    telemetry: Any = None
     plan: Any = field(default=None, repr=False)
     _run: Any = field(default=None, repr=False)
     _be: Any = field(default=None, repr=False)
@@ -104,7 +111,8 @@ class JordanSolver:
 
             self.engine, self.group, self.plan = auto_select(
                 self.n, self.block_size, self.dtype, self.workers,
-                self.gather, tune=self.tune, plan_cache=self.plan_cache)
+                self.gather, tune=self.tune, plan_cache=self.plan_cache,
+                telemetry=self.telemetry)
         if not self._distributed and self.engine == "swapfree":
             raise UsageError("engine='swapfree' is a distributed engine "
                              "(its win is collective bytes); use workers=p")
@@ -129,18 +137,40 @@ class JordanSolver:
     def _distributed(self) -> bool:
         return isinstance(self.workers, tuple) or self.workers > 1
 
-    def _compile(self, sample):
-        if self._distributed:
-            self._run = self._be.compile(sample, self._sweep_prec)
-        else:
-            from ..driver import single_device_invert
+    @property
+    def _tel(self):
+        from ..obs.spans import NULL
 
-            self._run = single_device_invert(
-                self.n, self.block_size, self.engine, self.group,
-            ).lower(
-                sample, block_size=self.block_size, refine=self.refine,
-                precision=self._sweep_prec,
-            ).compile()
+        return self.telemetry if self.telemetry is not None else NULL
+
+    def _compile(self, sample):
+        from ..driver import _record_compile
+
+        with self._tel.span("compile", engine=self.engine, n=self.n) as csp:
+            if self._distributed:
+                self._run = self._be.compile(sample, self._sweep_prec)
+            else:
+                from ..driver import single_device_invert
+
+                self._run = single_device_invert(
+                    self.n, self.block_size, self.engine, self.group,
+                ).lower(
+                    sample, block_size=self.block_size, refine=self.refine,
+                    precision=self._sweep_prec,
+                ).compile()
+        _record_compile(csp, "solver")
+
+    def _execute(self, arg):
+        """One executable launch: with telemetry, an honest blocking
+        execute span (obs.spans.timed_blocking); without, the original
+        lazy return."""
+        if self.telemetry is None:
+            return self._run(arg)
+        from ..obs.spans import timed_blocking
+
+        out, _ = timed_blocking(self._run, arg, telemetry=self.telemetry,
+                                name="execute", engine=self.engine)
+        return out
 
     def invert(self, a: jnp.ndarray):
         """Invert one (n, n) matrix; returns (inverse, singular).
@@ -154,13 +184,13 @@ class JordanSolver:
         if not self._distributed:
             if self._run is None:
                 self._compile(a)
-            inv, singular = self._run(a)
+            inv, singular = self._execute(a)
             return inv.astype(self._in_dtype), singular
 
         W = self._be.scatter_W(a)
         if self._run is None:
             self._compile(W)
-        out, singular = self._run(W)
+        out, singular = self._execute(W)
         singular = singular.any()
         if not self.gather:
             return self._be.inv_blocks(out).astype(self._in_dtype), singular

@@ -1,0 +1,35 @@
+"""tpu_jordan.obs — the unified telemetry layer (ISSUE 4 tentpole).
+
+Three modules replace the three private timing/counter islands the repo
+had grown (``utils/profiling.Scoreboard``, the tuner's measurement
+counter, ``serve/stats``' per-instance dicts):
+
+  * ``spans`` — thread-safe span tree with an injectable monotonic
+    clock: ``solve`` roots with select/load/compile/execute/gather/
+    residual children, model-attributed hot-loop phases (pivot /
+    permute / eliminate) under ``execute``, and the one shared
+    wall-clock bracket ``timed_blocking`` the driver's timings ride.
+  * ``metrics`` — the process-wide registry of ``tpu_jordan_*``
+    counters/gauges/reservoir histograms (p50/p95/p99) that solve, the
+    autotuner, and the serving layer all register into.
+  * ``export`` — one-line JSON, Prometheus text, Chrome trace-event
+    JSON (Perfetto), plus the jax.profiler kernel tier.
+
+Operator guide: ``docs/OBSERVABILITY.md``.
+"""
+
+from . import export, metrics, spans
+from .export import (profiler_trace, to_chrome_trace, to_json_line,
+                     to_prometheus, write_chrome_trace, write_metrics)
+from .metrics import REGISTRY, MetricsRegistry, Reservoir
+from .spans import (NULL, NullTelemetry, Span, Telemetry,
+                    attribute_phases, timed_blocking)
+
+__all__ = [
+    "export", "metrics", "spans",
+    "profiler_trace", "to_chrome_trace", "to_json_line", "to_prometheus",
+    "write_chrome_trace", "write_metrics",
+    "REGISTRY", "MetricsRegistry", "Reservoir",
+    "NULL", "NullTelemetry", "Span", "Telemetry", "attribute_phases",
+    "timed_blocking",
+]

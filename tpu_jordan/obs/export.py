@@ -1,0 +1,144 @@
+"""Telemetry exporters (ISSUE 4 tentpole part 3) — four tiers:
+
+  1. **One-line JSON** (``to_json_line``) — the ``--serve-demo`` report
+     style: one ``json.dumps`` line a log scraper can cut out.
+  2. **Prometheus text format** (``to_prometheus`` /
+     ``write_metrics``) — ``# HELP``/``# TYPE`` + sample lines,
+     scrapeable; histograms export in summary form (quantile-labeled
+     lines plus ``_count``/``_sum``).  The CLI's ``--metrics-out``.
+  3. **Chrome trace-event JSON** (``to_chrome_trace`` /
+     ``write_chrome_trace``) — complete ("X") events from the span
+     tree, loadable in Perfetto (https://ui.perfetto.dev) or
+     ``chrome://tracing``.  The CLI's ``--trace-json``.
+  4. **jax.profiler capture** (``profiler_trace``) — the kernel-level
+     ground truth on real hardware (XProf/TensorBoard), folded in from
+     ``utils/profiling.trace`` (which now shims to this).
+
+Tiers 1-3 read the span tree / metrics registry the library populated;
+tier 4 records what XLA actually launched.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+
+from . import metrics as _metrics
+
+_PROM_TYPE = {"counter": "counter", "gauge": "gauge",
+              "histogram": "summary"}
+
+_QUANTILES = {"p50": "0.5", "p95": "0.95", "p99": "0.99"}
+
+
+def _escape(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _fmt_labels(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def to_prometheus(registry: "_metrics.MetricsRegistry | None" = None
+                  ) -> str:
+    """The registry as Prometheus text exposition format (one trailing
+    newline; empty registries export as an empty string)."""
+    reg = registry if registry is not None else _metrics.REGISTRY
+    lines: list[str] = []
+    for m in reg.collect():
+        if m.help:
+            lines.append(f"# HELP {m.name} {m.help}")
+        lines.append(f"# TYPE {m.name} {_PROM_TYPE[m.kind]}")
+        series = m.series() or {(): (0.0 if m.kind != "histogram"
+                                     else _metrics.Reservoir())}
+        for key, val in sorted(series.items()):
+            labels = dict(key)
+            if isinstance(val, _metrics.Reservoir):
+                pct = val.percentiles()
+                for pk, q in _QUANTILES.items():
+                    if pct[pk] is not None:
+                        qlab = dict(labels, quantile=q)
+                        lines.append(f"{m.name}{_fmt_labels(qlab)} "
+                                     f"{_fmt_value(pct[pk])}")
+                lines.append(f"{m.name}_sum{_fmt_labels(labels)} "
+                             f"{_fmt_value(val.total)}")
+                lines.append(f"{m.name}_count{_fmt_labels(labels)} "
+                             f"{val.count}")
+            else:
+                lines.append(f"{m.name}{_fmt_labels(labels)} "
+                             f"{_fmt_value(val)}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def to_chrome_trace(telemetry) -> dict:
+    """The span tree as a Chrome trace-event document: one complete
+    ("X") event per finished span, microsecond timestamps on the
+    telemetry's own clock base.  Model-attributed phase children carry
+    their ``modeled``/``fraction`` attrs in ``args`` so Perfetto shows
+    the attribution honestly."""
+    events = []
+    for root in telemetry.roots:
+        for sp in root.walk():
+            events.append({
+                "name": sp.name,
+                "cat": "tpu_jordan",
+                "ph": "X",
+                "ts": round(sp.t_start * 1e6, 3),
+                "dur": round(sp.duration * 1e6, 3),
+                "pid": 0,
+                "tid": sp.thread,
+                "args": {k: (v if isinstance(v, (str, int, float, bool,
+                                                 type(None)))
+                             else str(v))
+                         for k, v in sp.attrs.items()},
+            })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def to_json_line(registry=None, telemetry=None, **extra) -> str:
+    """ONE JSON line — the ``--serve-demo`` report convention: metrics
+    snapshot and/or span trees plus any caller extras."""
+    doc: dict = {"metric": "telemetry"}
+    if registry is not None:
+        doc["metrics"] = registry.snapshot()
+    if telemetry is not None:
+        doc["spans"] = [r.to_dict() for r in telemetry.roots]
+    doc.update(extra)
+    return json.dumps(doc)
+
+
+def write_metrics(path: str, registry=None) -> None:
+    """Write the Prometheus text format to ``path`` (``--metrics-out``)."""
+    with open(path, "w") as f:
+        f.write(to_prometheus(registry))
+
+
+def write_chrome_trace(path: str, telemetry) -> None:
+    """Write the Chrome trace-event JSON to ``path`` (``--trace-json``);
+    open the file in Perfetto to see the phase spans on a timeline."""
+    with open(path, "w") as f:
+        json.dump(to_chrome_trace(telemetry), f)
+
+
+@contextlib.contextmanager
+def profiler_trace(log_dir: str = "/tmp/tpu_jordan_trace"):
+    """Tier 4: capture a jax.profiler trace (view with XProf/
+    TensorBoard) — real kernel-level timing on TPU, the ground truth the
+    model-attributed phase spans approximate.  Folded in from
+    ``utils/profiling.trace``, which now delegates here."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield log_dir
+    finally:
+        jax.profiler.stop_trace()

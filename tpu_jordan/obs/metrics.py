@@ -1,0 +1,253 @@
+"""Process-wide metrics registry (ISSUE 4 tentpole part 2).
+
+Before this layer, three subsystems kept private, incompatible counter
+state: ``utils/profiling.Scoreboard`` (wall seconds), the tuner's
+``measurements`` attribute (test-pinned but not scrapeable), and
+``serve/stats.ServeStats`` (per-instance bucket dicts).  Nobody could
+answer "how many executables has this process compiled" without knowing
+which object to interrogate.  Here: ONE named registry every subsystem
+registers into — counters, gauges, and reservoir-backed histograms
+(p50/p95/p99 via the bounded most-recent-samples window prototyped in
+``serve/stats.py``, now shared) — queryable as a dict (``snapshot``),
+as Prometheus text, or inside the one-line JSON report
+(``obs/export.py``).
+
+Naming contract: every metric name must match ``NAME_RE``
+(``^tpu_jordan_[a-z0-9_]+$``) so the Prometheus namespace stays
+consistent; registration raises on violations and a conftest lint
+re-checks the live registry after the whole suite ran.  Counters end in
+``_total``, timings in ``_seconds`` (convention, not enforced).
+
+Label support is deliberately minimal: pass keyword labels at mutation
+time (``inc(1, bucket="512")``); each distinct label set is one series.
+``registry.counter(...)`` is idempotent per name (the same object comes
+back), so call sites fetch-at-use without import-order coupling; a kind
+conflict (counter vs gauge under one name) raises.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+
+NAME_RE = re.compile(r"^tpu_jordan_[a-z0-9_]+$")
+
+#: Bounded most-recent-sample window per histogram series (the
+#: serve/stats prototype: beyond this the OLDEST samples drop — a
+#: long-lived process must not grow without bound; 4096 recent samples
+#: keep p99 meaningful at any realistic scale).
+MAX_RESERVOIR_SAMPLES = 4096
+
+_PCTS = (50.0, 95.0, 99.0)
+
+
+def percentiles(samples) -> dict:
+    """p50/p95/p99 by the nearest-rank method on a sorted copy — no
+    numpy interpolation surprises for tiny k.  Values in the samples'
+    own units; missing data reports None (folded here from
+    ``serve/stats.py``, which now delegates)."""
+    if not samples:
+        return {"p50": None, "p95": None, "p99": None}
+    s = sorted(samples)
+    out = {}
+    for p in _PCTS:
+        rank = max(0, min(len(s) - 1, int(round(p / 100.0 * len(s))) - 1))
+        out[f"p{p:.0f}"] = s[rank]
+    return out
+
+
+class Reservoir:
+    """The bounded recent-sample window behind histogram percentiles.
+    NOT thread-safe on its own — the owning metric (or ServeStats) holds
+    the lock, exactly like ``serve/stats._BucketStats``."""
+
+    def __init__(self, maxlen: int = MAX_RESERVOIR_SAMPLES):
+        self.maxlen = int(maxlen)
+        self._samples: list[float] = []
+        self.count = 0          # lifetime observations (never windowed)
+        self.total = 0.0        # lifetime sum (the Prometheus _sum line)
+
+    def add(self, value: float) -> None:
+        self._samples.append(float(value))
+        del self._samples[:-self.maxlen]
+        self.count += 1
+        self.total += float(value)
+
+    def extend(self, values) -> None:
+        for v in values:
+            self.add(v)
+
+    @property
+    def samples(self) -> list[float]:
+        return list(self._samples)
+
+    def percentiles(self) -> dict:
+        return percentiles(self._samples)
+
+
+def _label_key(labels: dict) -> tuple:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Metric:
+    """Base: one named metric, many label series.  All mutation under
+    the metric's own lock (writers include the serve dispatcher
+    thread)."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = ""):
+        if not NAME_RE.match(name):
+            raise ValueError(
+                f"metric name {name!r} violates the namespace contract "
+                f"{NAME_RE.pattern} (docs/OBSERVABILITY.md)")
+        self.name = name
+        self.help = help
+        self._lock = threading.Lock()
+        self._series: dict[tuple, object] = {}
+
+    def series(self) -> dict:
+        """{label_key_tuple: value-or-reservoir} snapshot."""
+        with self._lock:
+            return dict(self._series)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return float(self._series.get(_label_key(labels), 0.0))
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, **labels) -> None:
+        if value < 0:
+            raise ValueError("counters only go up; use a gauge")
+        key = _label_key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + float(value)
+
+    def total(self) -> float:
+        """Sum over every label series (the headline scalar)."""
+        with self._lock:
+            return float(sum(self._series.values()))
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._series[_label_key(labels)] = float(value)
+
+
+class Histogram(Metric):
+    """Reservoir-backed summary: per-series bounded recent samples with
+    nearest-rank p50/p95/p99 plus lifetime count/sum — exported in
+    Prometheus summary form (quantile-labeled lines + _count/_sum)."""
+
+    kind = "histogram"
+
+    def observe(self, value: float, **labels) -> None:
+        key = _label_key(labels)
+        with self._lock:
+            res = self._series.get(key)
+            if res is None:
+                res = self._series[key] = Reservoir()
+            res.add(value)
+
+    def value(self, **labels) -> float:
+        """Lifetime sum of observations for the series (the Prometheus
+        ``_sum`` line) — the base implementation would float() the
+        Reservoir; use ``percentiles()`` for the distribution."""
+        with self._lock:
+            res = self._series.get(_label_key(labels))
+        return 0.0 if res is None else res.total
+
+    def percentiles(self, **labels) -> dict:
+        with self._lock:
+            res = self._series.get(_label_key(labels))
+        return res.percentiles() if res is not None else percentiles(())
+
+
+class MetricsRegistry:
+    """Named metric store.  ``counter``/``gauge``/``histogram`` are
+    idempotent per name — the process-wide instance (``REGISTRY``) is
+    what solve, the tuner, and the serving layer all register into, and
+    what the exporters scrape."""
+
+    _KINDS = {"counter": Counter, "gauge": Gauge, "histogram": Histogram}
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, Metric] = {}
+
+    def _get(self, cls, name: str, help: str):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, help)
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "") -> Histogram:
+        return self._get(Histogram, name, help)
+
+    def names(self) -> list[str]:
+        with self._lock:
+            return sorted(self._metrics)
+
+    def collect(self) -> list[Metric]:
+        with self._lock:
+            return [self._metrics[k] for k in sorted(self._metrics)]
+
+    def snapshot(self) -> dict:
+        """Plain-JSON view: {name: {type, help, series: [{labels, ...}]}}
+        — the payload behind the one-line JSON exporter."""
+        out = {}
+        for m in self.collect():
+            series = []
+            for key, val in m.series().items():
+                entry: dict = {"labels": dict(key)}
+                if isinstance(val, Reservoir):
+                    entry["count"] = val.count
+                    entry["sum"] = val.total
+                    entry.update(val.percentiles())
+                else:
+                    entry["value"] = val
+                series.append(entry)
+            out[m.name] = {"type": m.kind, "help": m.help,
+                           "series": series}
+        return out
+
+    def reset(self) -> None:
+        """Drop every registered metric (TESTS ONLY — a process's
+        counters are meant to be monotone for its whole life)."""
+        with self._lock:
+            self._metrics.clear()
+
+
+#: THE process-wide registry (ISSUE 4: one queryable surface instead of
+#: three private scoreboards).  Library code mutates through this;
+#: exporters and the conftest namespace lint read it.
+REGISTRY = MetricsRegistry()
+
+
+def counter(name: str, help: str = "") -> Counter:
+    return REGISTRY.counter(name, help)
+
+
+def gauge(name: str, help: str = "") -> Gauge:
+    return REGISTRY.gauge(name, help)
+
+
+def histogram(name: str, help: str = "") -> Histogram:
+    return REGISTRY.histogram(name, help)

@@ -1,0 +1,228 @@
+"""Phase-span tracing (ISSUE 4 tentpole part 1).
+
+The reference's only timing is one max-allreduced ``MPI_Wtime`` bracket
+printed as ``glob_time`` (main.cpp:427-458).  Here: a thread-safe span
+TREE with an injectable monotonic clock (deterministic in tests — the
+same fake-input discipline as the tuner's injected timings), so "where
+did this solve's milliseconds go" has a first-class answer.
+
+Span taxonomy (docs/OBSERVABILITY.md):
+
+  * ``solve`` (root) → ``select`` (autotuner ladder) / ``load`` /
+    ``compile`` / ``execute`` / ``gather`` / ``residual``.
+  * ``compile`` vs ``execute`` are DISTINCT spans everywhere (driver,
+    solver model, serve executors), so an AOT-cache hit is visible as a
+    zero-compile trace — the warm-server contract made inspectable.
+  * Inside ``execute``, the paper's hot-loop phases — ``pivot``
+    (candidate probe + reduction), ``permute`` (row broadcast / swap /
+    bucketed-ppermute repairs), ``eliminate`` (normalize + trailing
+    sweep) — run inside ONE fused XLA executable, which the host cannot
+    bracket.  ``attribute_phases`` subdivides the measured execute span
+    with MODEL-attributed children (marked ``modeled=True`` with their
+    fraction); the jax.profiler tier (``obs/export.profiler_trace``) is
+    the kernel-level ground truth when the model is not enough.
+  * ``residual`` (the independent verification) is a REAL span — the
+    verify step is host-separable.
+
+Thread model: each thread nests spans on its own stack; a span opened on
+a non-request thread (e.g. the serve dispatcher) becomes its own root.
+Only root-list mutation takes the lock — parent/child edges are
+single-thread by construction.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from dataclasses import dataclass, field
+
+#: The hot-loop phases of the paper's super-step, in execution order
+#: (main.cpp:1026-1196 → the engines' probe / broadcast / sweep).
+PHASES = ("pivot", "permute", "eliminate")
+
+
+@dataclass
+class Span:
+    """One timed interval in the tree.  Times are clock-native (the
+    telemetry's injectable clock — ``time.perf_counter`` by default)."""
+
+    name: str
+    t_start: float
+    t_end: float | None = None
+    attrs: dict = field(default_factory=dict)
+    children: list = field(default_factory=list)
+    thread: int = 0
+
+    @property
+    def duration(self) -> float:
+        """Seconds (0.0 while the span is still open)."""
+        return 0.0 if self.t_end is None else self.t_end - self.t_start
+
+    def child(self, name: str, t_start: float, t_end: float,
+              **attrs) -> "Span":
+        """Attach an explicitly-timed child (the phase-attribution
+        path builds synthetic sub-intervals this way)."""
+        sp = Span(name, t_start, t_end, dict(attrs), thread=self.thread)
+        self.children.append(sp)
+        return sp
+
+    def walk(self):
+        """Depth-first iteration over this span and its subtree."""
+        yield self
+        for c in self.children:
+            yield from c.walk()
+
+    def find(self, name: str) -> "Span | None":
+        """First span named ``name`` in this subtree (depth-first)."""
+        for sp in self.walk():
+            if sp.name == name:
+                return sp
+        return None
+
+    def to_dict(self) -> dict:
+        """Plain-JSON view (the one-line JSON exporter's span payload)."""
+        return {
+            "name": self.name,
+            "start": self.t_start,
+            "duration": self.duration,
+            "attrs": dict(self.attrs),
+            "children": [c.to_dict() for c in self.children],
+        }
+
+
+#: Root spans retained per collector; beyond this the OLDEST roots are
+#: dropped — a long-lived telemetry'd server (one "execute" root per
+#: dispatched batch) must not grow without bound, the same policy as
+#: ``obs.metrics.MAX_RESERVOIR_SAMPLES``.
+MAX_ROOT_SPANS = 4096
+
+
+class Telemetry:
+    """A span collector: ``span(name)`` opens a child of the current
+    thread's innermost open span (or a new root).  ``clock`` is any
+    zero-arg monotonic callable — tests inject a fake for deterministic
+    trees; production uses ``time.perf_counter``.  At most ``max_roots``
+    finished roots are retained (oldest dropped first)."""
+
+    #: Subclass hook: ``NullTelemetry`` flips this so unobserved code
+    #: paths still get honest durations without retaining anything.
+    retain = True
+
+    def __init__(self, clock=None, max_roots: int = MAX_ROOT_SPANS):
+        self.clock = clock if clock is not None else time.perf_counter
+        self.max_roots = int(max_roots)
+        self._lock = threading.Lock()
+        self._local = threading.local()
+        self._roots: list[Span] = []
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    @contextlib.contextmanager
+    def span(self, name: str, **attrs):
+        sp = Span(name, t_start=self.clock(), attrs=dict(attrs),
+                  thread=threading.get_ident())
+        stack = self._stack()
+        parent = stack[-1] if stack else None
+        stack.append(sp)
+        try:
+            yield sp
+        finally:
+            sp.t_end = self.clock()
+            stack.pop()
+            if self.retain:
+                if parent is not None:
+                    parent.children.append(sp)
+                else:
+                    with self._lock:
+                        self._roots.append(sp)
+                        del self._roots[:-self.max_roots]
+
+    @property
+    def roots(self) -> list[Span]:
+        with self._lock:
+            return list(self._roots)
+
+    def spans(self):
+        """Every finished span, depth-first across all roots."""
+        for r in self.roots:
+            yield from r.walk()
+
+    def find(self, name: str) -> Span | None:
+        """First finished span with this name, across all roots."""
+        for sp in self.spans():
+            if sp.name == name:
+                return sp
+        return None
+
+
+class NullTelemetry(Telemetry):
+    """Measures (real clock, real durations) but retains nothing — the
+    default sink when no telemetry is passed, so instrumented code paths
+    cost one clock pair and never grow memory."""
+
+    retain = False
+
+
+#: The shared discard-only sink (safe to share: it retains no state
+#: beyond each thread's transient stack).
+NULL = NullTelemetry()
+
+
+def timed_blocking(fn, *args, telemetry=None, name: str = "execute",
+                   **attrs):
+    """THE wall-clock bracket: run ``fn(*args)``, ``block_until_ready``
+    the result (the single-controller analog of the reference's MAX
+    allreduce over per-rank times, main.cpp:455), and return
+    ``(result, span)``.
+
+    ISSUE 4 satellite: ``driver.py`` carried three hand-rolled
+    ``perf_counter``/``block_until_ready`` brackets (solve, solve_batch,
+    the distributed core); they all collapse onto this helper, so the
+    reported ``elapsed`` and the ``execute`` span duration are the SAME
+    number by construction — they can never disagree.
+    """
+    import jax
+
+    tel = telemetry if telemetry is not None else NULL
+    with tel.span(name, **attrs) as sp:
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return out, sp
+
+
+def attribute_phases(span: Span, n: int, block_size: int,
+                     distributed: bool = False) -> list[Span]:
+    """Subdivide a measured ``execute`` span into the paper's hot-loop
+    phases as MODEL-attributed children (``modeled=True`` + the fraction
+    on every child — never mistakable for measured sub-brackets).
+
+    The host cannot bracket phases inside one fused XLA executable, so
+    the split uses the same first-order weights the registry's cost
+    hooks use: ``eliminate`` carries the 2n³ MXU sweep, ``pivot`` the
+    Nr·2m³ (= 2nm²) probe flops, ``permute`` an O(n²) data-movement
+    term weighted heavier on distributed meshes (ICI rounds vs local
+    copies).  Kernel-level ground truth is the jax.profiler tier
+    (``obs/export.profiler_trace``), not this model.
+    """
+    m = max(1, min(block_size, n))
+    weights = {
+        "pivot": 2.0 * n * m * m,
+        "permute": (64.0 if distributed else 8.0) * float(n) * n,
+        "eliminate": 2.0 * float(n) ** 3,
+    }
+    total = sum(weights.values())
+    out = []
+    t = span.t_start
+    for i, phase in enumerate(PHASES):
+        frac = weights[phase] / total
+        t1 = (span.t_end if i == len(PHASES) - 1
+              else t + frac * span.duration)
+        out.append(span.child(phase, t, t1, modeled=True,
+                              fraction=round(frac, 6)))
+        t = t1
+    return out

@@ -78,13 +78,20 @@ class MicroBatcher:
 
     def __init__(self, executors, stats, batch_cap: int = 8,
                  max_wait_ms: float = 2.0, max_queue: int = 256,
-                 block_size: int | None = None, autostart: bool = True):
+                 block_size: int | None = None, autostart: bool = True,
+                 telemetry=None):
+        from ..obs.spans import NULL
+
         if batch_cap < 1:
             raise ValueError("batch_cap must be >= 1")
         if max_queue < 1:
             raise ValueError("max_queue must be >= 1")
         self.executors = executors
         self.stats = stats
+        # Telemetry (ISSUE 4): each dispatched batch is an "execute"
+        # span (dispatcher-thread root; bucket/occupancy attrs), so the
+        # wall time fanned to futures IS the span duration.
+        self._tel = telemetry if telemetry is not None else NULL
         self.batch_cap = int(batch_cap)
         self.max_wait = float(max_wait_ms) / 1e3
         self.max_queue = int(max_queue)
@@ -203,7 +210,6 @@ class MicroBatcher:
             self._execute(bucket, batch, now)
 
     def _execute(self, bucket: int, batch: list, t_dispatch: float) -> None:
-        import jax
         import jax.numpy as jnp
 
         try:
@@ -217,11 +223,13 @@ class MicroBatcher:
             for i, req in enumerate(batch):
                 stacked[i] = req.padded
                 n_real[i] = req.n
-            t0 = time.perf_counter()
-            inv, sing, kappa, rel = ex.run(jnp.asarray(stacked),
-                                           jnp.asarray(n_real))
-            jax.block_until_ready(inv)
-            exec_s = time.perf_counter() - t0
+            from ..obs.spans import timed_blocking
+
+            (inv, sing, kappa, rel), esp = timed_blocking(
+                ex.run, jnp.asarray(stacked), jnp.asarray(n_real),
+                telemetry=self._tel, name="execute", bucket=bucket,
+                occupancy=len(batch))
+            exec_s = esp.duration
             sing = np.asarray(sing)
             kappa = np.asarray(kappa)
             rel = np.asarray(rel)

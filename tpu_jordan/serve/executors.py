@@ -143,8 +143,9 @@ class ExecutorCache:
     """
 
     def __init__(self, engine: str = "auto", plan_cache: str | None = None,
-                 dtype=jnp.float32, stats=None):
+                 dtype=jnp.float32, stats=None, telemetry=None):
         from ..driver import resolve_engine
+        from ..obs.spans import NULL
 
         # Shared flag contract with solve/JordanSolver: "auto" stays
         # auto (resolved per bucket through the tuner), an explicit
@@ -152,6 +153,10 @@ class ExecutorCache:
         self.engine, self.group = resolve_engine(engine, 0)
         self.dtype = jnp.dtype(dtype).name
         self.stats = stats
+        # Telemetry (ISSUE 4): compiles are recorded as distinct
+        # "compile" spans, so a warm server's trace has NONE — the
+        # AOT-cache contract made visible.
+        self._tel = telemetry if telemetry is not None else NULL
         self._lock = threading.Lock()
         self._executors: dict[ExecutorKey, BucketExecutor] = {}
         #: memoized (engine, plan) per (bucket_n, batch_cap, block_size):
@@ -195,7 +200,9 @@ class ExecutorCache:
                 if self.stats is not None:
                     self.stats.cache_hit(bucket_n)
                 return ex
-            ex = BucketExecutor(key, plan)
+            with self._tel.span("compile", bucket=bucket_n,
+                                engine=engine, batch_cap=batch_cap):
+                ex = BucketExecutor(key, plan)
             self._executors[key] = ex
             if self.stats is not None:
                 self.stats.compile(bucket_n)

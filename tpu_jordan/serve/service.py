@@ -63,21 +63,30 @@ class JordanService:
         ``config.default_block_size`` per bucket).
       autostart: start the dispatcher thread immediately (tests pass
         False to stage the queue deterministically, then ``start()``).
+      telemetry: optional ``obs.spans.Telemetry`` — executor compiles
+        and per-batch executions are recorded as distinct compile /
+        execute spans (a warm server's trace shows ZERO compile spans),
+        and every counter mirrors into the process-wide
+        ``obs.metrics.REGISTRY`` regardless (docs/OBSERVABILITY.md).
     """
 
     def __init__(self, engine: str = "auto", plan_cache: str | None = None,
                  dtype=jnp.float32, batch_cap: int = 8,
                  max_wait_ms: float = 2.0, max_queue: int = 256,
-                 block_size: int | None = None, autostart: bool = True):
+                 block_size: int | None = None, autostart: bool = True,
+                 telemetry=None):
         self.dtype = jnp.dtype(dtype)
         self.batch_cap = int(batch_cap)
+        self.telemetry = telemetry
         self._stats = ServeStats()
         self.executors = ExecutorCache(engine=engine, plan_cache=plan_cache,
-                                       dtype=self.dtype, stats=self._stats)
+                                       dtype=self.dtype, stats=self._stats,
+                                       telemetry=telemetry)
         self._batcher = MicroBatcher(
             self.executors, self._stats, batch_cap=batch_cap,
             max_wait_ms=max_wait_ms, max_queue=max_queue,
-            block_size=block_size, autostart=autostart)
+            block_size=block_size, autostart=autostart,
+            telemetry=telemetry)
         self._closed = False
 
     # ---- request path ------------------------------------------------
@@ -172,7 +181,8 @@ class JordanService:
 def serve_demo(n: int, block_size: int | None = None, requests: int = 64,
                batch_cap: int = 8, max_wait_ms: float = 2.0,
                engine: str = "auto", plan_cache: str | None = None,
-               dtype=jnp.float32, generator: str = "rand") -> dict:
+               dtype=jnp.float32, generator: str = "rand",
+               telemetry=None) -> dict:
     """The ``--serve-demo`` CLI mode's engine: a self-contained
     sustained-throughput demonstration on whatever backend is live.
 
@@ -195,7 +205,7 @@ def serve_demo(n: int, block_size: int | None = None, requests: int = 64,
     with JordanService(engine=engine, plan_cache=plan_cache, dtype=dtype,
                        batch_cap=batch_cap, max_wait_ms=max_wait_ms,
                        max_queue=max(requests, 1),
-                       block_size=block_size) as svc:
+                       block_size=block_size, telemetry=telemetry) as svc:
         svc.warmup(shapes=sizes)
         compiles_after_warmup = svc.stats()["totals"]["compiles"]
         futures = []

@@ -1,43 +1,74 @@
 """Per-bucket serving counters and latency percentiles (ISSUE 3
-tentpole part 4).
+tentpole part 4; re-based on the unified telemetry layer in ISSUE 4).
 
 One :class:`ServeStats` instance rides a :class:`~.service.JordanService`
 for its whole life; every mutation happens under one lock because the
 writers are two threads (the caller thread on submit/reject, the
 dispatcher thread on batch completion and compile).  ``snapshot()``
 returns a plain-JSON dict — the payload of ``service.stats()`` and of
-the ``--serve-demo`` one-line report.
+the ``--serve-demo`` one-line report.  The per-bucket keys the
+acceptance contract pins (ISSUE 3) are unchanged: ``requests``,
+``batches``, ``mean_occupancy``, ``compiles``, ``cache_hits``,
+``singular``, and p50/p95/p99 for both queue wait and execute time.
 
-The per-bucket keys the acceptance contract pins (ISSUE 3): ``requests``,
-``batches``, ``mean_occupancy`` (> 1 is the whole point of the
-micro-batcher), ``compiles`` (exactly one per (bucket, batch_cap) —
-zero after warmup), ``cache_hits``, ``singular``, and p50/p95/p99 for
-both queue wait and execute time.
+ISSUE 4 re-base: the reservoir + nearest-rank percentile machinery this
+module prototyped now lives in ``obs/metrics.py`` (``Reservoir``,
+``percentiles``) and every mutation is MIRRORED into the process-wide
+``tpu_jordan_*`` registry (bucket-labeled series), so a warm server is
+scrapeable in Prometheus text format — ``tpu_jordan_compiles_total``
+unchanged across requests IS the warm-path acceptance pin — while the
+per-instance snapshot API keeps its exact shape.
 """
 
 from __future__ import annotations
 
 import threading
 
-# Latency samples kept per (bucket, phase); beyond this the OLDEST are
-# dropped (a serving process must not grow without bound).  4096 recent
-# samples keep p99 meaningful at any realistic demo scale.
-MAX_LATENCY_SAMPLES = 4096
+from ..obs import metrics as _metrics
+from ..obs.metrics import Reservoir
 
-_PCTS = (50.0, 95.0, 99.0)
+#: Latency samples kept per (bucket, phase); beyond this the OLDEST are
+#: dropped (a serving process must not grow without bound).  Now the
+#: shared ``obs.metrics`` reservoir bound.
+MAX_LATENCY_SAMPLES = _metrics.MAX_RESERVOIR_SAMPLES
 
 
 def _percentiles(samples) -> dict:
-    """p50/p95/p99 (milliseconds, 3 decimals) by the nearest-rank method
-    on a sorted copy — no numpy interpolation surprises for tiny k."""
-    if not samples:
-        return {"p50": None, "p95": None, "p99": None}
-    s = sorted(samples)
-    out = {}
-    for p in _PCTS:
-        rank = max(0, min(len(s) - 1, int(round(p / 100.0 * len(s))) - 1))
-        out[f"p{p:.0f}"] = round(s[rank] * 1e3, 3)
-    return out
+    """p50/p95/p99 in milliseconds (3 decimals) — the serve snapshot's
+    historical unit; the nearest-rank core is ``obs.metrics.percentiles``."""
+    pct = _metrics.percentiles(samples)
+    return {k: (None if v is None else round(v * 1e3, 3))
+            for k, v in pct.items()}
+
+
+# Process-wide registry series (ISSUE 4): every ServeStats mirrors into
+# these bucket-labeled metrics.  tpu_jordan_compiles_total is THE shared
+# compile counter (driver + solver models + serve executor cache).
+_M_REQUESTS = _metrics.counter("tpu_jordan_serve_requests_total",
+                               "requests admitted to the serve queue")
+_M_REJECTED = _metrics.counter("tpu_jordan_serve_rejected_total",
+                               "requests rejected by bounded-queue "
+                               "admission (typed backpressure)")
+_M_BATCHES = _metrics.counter("tpu_jordan_serve_batches_total",
+                              "micro-batches dispatched")
+_M_COMPILES = _metrics.counter(
+    "tpu_jordan_compiles_total",
+    "executable compiles (solve driver, solver models, serve "
+    "executor cache)")
+_M_CACHE_HITS = _metrics.counter(
+    "tpu_jordan_serve_executor_cache_hits_total",
+    "serve dispatches satisfied by an already-compiled bucket "
+    "executable")
+_M_SINGULAR = _metrics.counter("tpu_jordan_singular_total",
+                               "solves/requests flagged singular")
+_M_OCCUPANCY = _metrics.histogram(
+    "tpu_jordan_serve_batch_occupancy",
+    "occupied slots per dispatched batch (cap = batch_cap)")
+_M_QUEUE_S = _metrics.histogram("tpu_jordan_serve_queue_seconds",
+                                "per-request queue wait (submit to "
+                                "dispatch)")
+_M_EXEC_S = _metrics.histogram("tpu_jordan_serve_execute_seconds",
+                               "per-batch executable wall seconds")
 
 
 class _BucketStats:
@@ -52,8 +83,8 @@ class _BucketStats:
         self.compiles = 0
         self.cache_hits = 0
         self.singular = 0
-        self.queue_s: list[float] = []
-        self.exec_s: list[float] = []
+        self.queue_s = Reservoir(MAX_LATENCY_SAMPLES)
+        self.exec_s = Reservoir(MAX_LATENCY_SAMPLES)
 
     def to_json(self) -> dict:
         occ = (self.elements / self.batches) if self.batches else 0.0
@@ -65,13 +96,15 @@ class _BucketStats:
             "compiles": self.compiles,
             "cache_hits": self.cache_hits,
             "singular": self.singular,
-            "queue_ms": _percentiles(self.queue_s),
-            "execute_ms": _percentiles(self.exec_s),
+            "queue_ms": _percentiles(self.queue_s.samples),
+            "execute_ms": _percentiles(self.exec_s.samples),
         }
 
 
 class ServeStats:
-    """Thread-safe serving scoreboard, keyed by bucket n."""
+    """Thread-safe serving scoreboard, keyed by bucket n.  Mutations
+    mirror into the process-wide ``obs.metrics.REGISTRY`` with a
+    ``bucket`` label; ``snapshot()`` stays per-instance."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -83,32 +116,42 @@ class ServeStats:
     def request(self, bucket: int) -> None:
         with self._lock:
             self._b(bucket).requests += 1
+        _M_REQUESTS.inc(bucket=bucket)
 
     def rejected(self, bucket: int) -> None:
         with self._lock:
             self._b(bucket).rejected += 1
+        _M_REJECTED.inc(bucket=bucket)
 
     def compile(self, bucket: int) -> None:
         with self._lock:
             self._b(bucket).compiles += 1
+        _M_COMPILES.inc(component="serve", bucket=bucket)
 
     def cache_hit(self, bucket: int) -> None:
         with self._lock:
             self._b(bucket).cache_hits += 1
+        _M_CACHE_HITS.inc(bucket=bucket)
 
     def batch(self, bucket: int, occupancy: int, exec_seconds: float,
               queue_seconds, singular: int = 0) -> None:
         """One dispatched batch: ``occupancy`` occupied slots,
         ``queue_seconds`` an iterable of per-request queue waits."""
+        queue_seconds = [float(q) for q in queue_seconds]
         with self._lock:
             b = self._b(bucket)
             b.batches += 1
             b.elements += occupancy
             b.singular += singular
-            b.exec_s.append(float(exec_seconds))
-            b.queue_s.extend(float(q) for q in queue_seconds)
-            del b.exec_s[:-MAX_LATENCY_SAMPLES]
-            del b.queue_s[:-MAX_LATENCY_SAMPLES]
+            b.exec_s.add(float(exec_seconds))
+            b.queue_s.extend(queue_seconds)
+        _M_BATCHES.inc(bucket=bucket)
+        _M_OCCUPANCY.observe(occupancy, bucket=bucket)
+        _M_EXEC_S.observe(float(exec_seconds), bucket=bucket)
+        for q in queue_seconds:
+            _M_QUEUE_S.observe(q, bucket=bucket)
+        if singular:
+            _M_SINGULAR.inc(singular, component="serve", bucket=bucket)
 
     def snapshot(self) -> dict:
         with self._lock:

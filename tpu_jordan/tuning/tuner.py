@@ -36,6 +36,21 @@ from . import registry as _registry
 from .measure import Measurement, measure_direct
 from .plan_cache import Plan, PlanCache, plan_key
 from .registry import EngineConfig, TunePoint
+from ..obs import metrics as _obs_metrics
+
+# Registry surface (ISSUE 4): the tuner's private counters become
+# scrapeable — plan-cache hits/misses and real (or injected) engine
+# measurements land in the process-wide registry next to the serve and
+# driver metrics; ``Tuner.measurements`` stays the per-session pin.
+_M_HITS = _obs_metrics.counter(
+    "tpu_jordan_plan_cache_hits_total",
+    "tuner selections satisfied by a cached plan (zero measurements)")
+_M_MISSES = _obs_metrics.counter(
+    "tpu_jordan_plan_cache_misses_total",
+    "tuner selections that fell through to cost ranking or measurement")
+_M_MEASUREMENTS = _obs_metrics.counter(
+    "tpu_jordan_tuner_measurements_total",
+    "engine measurements performed by tune=True selection")
 
 
 def measure_config(point: TunePoint, cfg: EngineConfig,
@@ -108,7 +123,9 @@ class Tuner:
             if (cached is not None and self._still_valid(cached, point)
                     and (not self.measure or cached.source == "measured")):
                 self.last_source = "cache"
+                _M_HITS.inc()
                 return cached
+        _M_MISSES.inc()
         plan = (self._tune(point) if self.measure
                 else self._rank(point))
         self.last_source = plan.source
@@ -152,6 +169,7 @@ class Tuner:
             proj = cfg.cost(point)
             meas = fn(point, cfg, samples=self.samples)
             self.measurements += 1
+            _M_MEASUREMENTS.inc()
             drift = (None if math.isinf(proj) or proj <= 0.0
                      else meas.seconds / proj)
             trial = {
@@ -177,14 +195,23 @@ class Tuner:
 
 def auto_select(n: int, block_size: int | None, dtype, workers,
                 gather: bool, tune: bool = False,
-                plan_cache: str | None = None) -> tuple[str, int, Plan]:
+                plan_cache: str | None = None,
+                telemetry=None) -> tuple[str, int, Plan]:
     """The driver's ``engine="auto"`` hook: build the tuning point from
     the solve arguments, run the selection ladder, return the resolved
     ``(engine, group, plan)``.  ``plan_cache`` is a JSON path (consulted
     always, updated whenever selection ran); ``tune=True`` turns on real
-    measurement of the cost-pruned survivors."""
-    point = TunePoint.create(n, block_size, dtype, workers, gather)
-    cache = PlanCache.load(plan_cache) if plan_cache else None
-    tuner = Tuner(cache=cache, measure=tune)
-    plan = tuner.select(point)
+    measurement of the cost-pruned survivors.  ``telemetry`` records
+    the ladder walk as a ``select`` span (attrs: resolved engine +
+    ladder rung — obs/spans.py)."""
+    from ..obs.spans import NULL
+
+    tel = telemetry if telemetry is not None else NULL
+    with tel.span("select", n=n, tune=tune) as sp:
+        point = TunePoint.create(n, block_size, dtype, workers, gather)
+        cache = PlanCache.load(plan_cache) if plan_cache else None
+        tuner = Tuner(cache=cache, measure=tune)
+        plan = tuner.select(point)
+        sp.attrs["engine"] = plan.engine
+        sp.attrs["source"] = tuner.last_source
     return plan.engine, plan.group, plan

@@ -1,19 +1,30 @@
-"""Timing scoreboard and profiler hooks.
+"""Timing scoreboard and profiler hooks — COMPAT SHIMS over the unified
+telemetry layer.
 
-The reference's only observability is the max-allreduced MPI_Wtime bracket
-around Jordan printed as glob_time (main.cpp:427-458) plus a flops
-convention of 2n^3.  Here: the same scoreboard (wall seconds + GFLOP/s)
-as a context manager, plus `jax.profiler` trace capture for real kernel-
-level inspection on TPU.
+.. deprecated:: ISSUE 4
+   The real implementation lives in ``tpu_jordan/obs/`` — span tracing
+   in ``obs/spans.py``, the process-wide metrics registry in
+   ``obs/metrics.py``, and the exporters (incl. the jax.profiler
+   kernel tier this module's ``trace`` used to own) in
+   ``obs/export.py``.  This module keeps the original surface —
+   ``Scoreboard`` (the glob_time report string, main.cpp:427-458),
+   ``timed``, ``trace``, ``invert_flops`` — as thin wrappers so
+   existing callers keep working; new code should use
+   ``tpu_jordan.obs`` directly (docs/OBSERVABILITY.md).
+
+``timed`` is now span-backed: the bracket IS a span on the given
+telemetry (default: the discard-only null sink), its GFLOP/s attached
+as a span attribute, and ``Scoreboard.elapsed`` set from the span's
+duration — wall-clock and span timing can never disagree.
 """
 
 from __future__ import annotations
 
 import contextlib
-import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
-import jax
+from ..obs.export import profiler_trace as trace  # noqa: F401  (tier 4)
+from ..obs.spans import NULL
 
 
 @dataclass
@@ -38,26 +49,27 @@ class Scoreboard:
 
 
 @contextlib.contextmanager
-def timed(label: str, flops: float | None = None, sync=None):
+def timed(label: str, flops: float | None = None, sync=None,
+          telemetry=None):
     """Time a block; ``sync`` (an array or pytree) is block_until_ready'd
     before the clock stops, the single-controller analog of the MAX
-    allreduce over per-rank times (main.cpp:455)."""
+    allreduce over per-rank times (main.cpp:455).
+
+    Deprecated shim: the bracket is an ``obs.spans`` span on
+    ``telemetry`` (discarded when none is given); GFLOP/s, when
+    computable, rides the span as an attribute.
+    """
+    tel = telemetry if telemetry is not None else NULL
     sb = Scoreboard(label, flops=flops)
-    t0 = time.perf_counter()
-    yield sb
-    if sync is not None:
-        jax.block_until_ready(sync)
-    sb.elapsed = time.perf_counter() - t0
+    with tel.span(label) as sp:
+        yield sb
+        if sync is not None:
+            import jax
 
-
-@contextlib.contextmanager
-def trace(log_dir: str = "/tmp/tpu_jordan_trace"):
-    """Capture a jax.profiler trace (view with TensorBoard/XProf)."""
-    jax.profiler.start_trace(log_dir)
-    try:
-        yield log_dir
-    finally:
-        jax.profiler.stop_trace()
+            jax.block_until_ready(sync)
+    sb.elapsed = sp.duration
+    if sb.gflops is not None:
+        sp.attrs["gflops"] = round(sb.gflops, 3)
 
 
 def invert_flops(n: int) -> float:
