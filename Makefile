@@ -13,7 +13,7 @@ WORKERS  ?= 1
 REQUESTS  ?= 64
 BATCH_CAP ?= 8
 
-.PHONY: all native tpu test smoke serve-demo metrics-demo bench clean
+.PHONY: all native tpu test smoke serve-demo chaos-demo metrics-demo bench clean
 
 all: native
 
@@ -43,6 +43,18 @@ smoke:
 serve-demo:
 	python -m tpu_jordan $(N) $(M) --serve-demo \
 	  --serve-requests $(REQUESTS) --batch-cap $(BATCH_CAP)
+
+# Chaos demo + validation (docs/RESILIENCE.md): the same deterministic
+# request stream served fault-free and under a seeded FaultPlan
+# (compile failures, transient execute errors, NaN result corruption,
+# plan-cache write failures); the checker proves every injected fault
+# was retried, degraded, or typed — none silent — and every response
+# bit-matched the fault-free replay or carried a typed error.
+chaos-demo:
+	python -m tpu_jordan 96 32 --chaos-demo \
+	  --serve-requests $(REQUESTS) --batch-cap 4 --quiet \
+	  > /tmp/tpu_jordan_chaos.json
+	python tools/check_chaos.py /tmp/tpu_jordan_chaos.json
 
 # Telemetry demo + validation (docs/OBSERVABILITY.md): a small solve
 # and a serve burst, each exporting the process-wide tpu_jordan_*

@@ -61,12 +61,14 @@ class _Singular(AssertionError):
 
 def _retry_transient(fn):
     """One retry on the documented-transient remote-compile failure class
-    — the TYPED classifier lives in tpu_jordan/tuning/measure.py (shared
-    with the autotuner) so bench.py can't fork its own weaker rule.
-    Anything non-transient — including the knife-edge _Singular (an
-    AssertionError, never a runtime/transport type) — is a real result
-    and propagates immediately."""
-    from tpu_jordan.tuning.measure import retry_transient
+    — the TYPED classifier and the one shared backoff implementation
+    live in tpu_jordan/resilience/policy.py (RetryPolicy; ISSUE 5
+    satellite — shared with the autotuner's measurement core) so
+    bench.py can't fork its own weaker rule.  Anything non-transient —
+    including the knife-edge _Singular (an AssertionError, never a
+    runtime/transport type) — is a real result and propagates
+    immediately; retries land in tpu_jordan_retries_total."""
+    from tpu_jordan.resilience.policy import retry_transient
 
     return retry_transient(fn)
 

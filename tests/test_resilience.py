@@ -1,0 +1,425 @@
+"""The resilience layer (ISSUE 5): deterministic fault injection
+(nth-call FaultPlans), the promoted transient classifier + RetryPolicy
+(deterministic-jitter backoff, injectable sleep), the circuit-breaker
+state machine (fake clock), the plan-cache write-failure degrade
+satellite, and the driver-side residual-gate degradation ladder — incl.
+the bf16 -> refine -> fp32-re-solve acceptance pin with every rung on
+``SolveResult.recovery`` and in the span tree."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_jordan.obs.metrics import REGISTRY
+from tpu_jordan.resilience import (CircuitBreaker, FaultPlan, FaultSpec,
+                                   InjectedFaultError,
+                                   InjectedTransientError, ResiliencePolicy,
+                                   ResultCorruptionError, RetryPolicy,
+                                   activate, faults)
+from tpu_jordan.resilience.policy import (DeadlineExceededError,
+                                          ResidualGateError, retryable)
+
+
+def _counter_total(name):
+    return REGISTRY.counter(name).total()
+
+
+class TestFaultPlan:
+    def test_nth_call_schedule_is_exact(self):
+        plan = FaultPlan([FaultSpec("execute", (2, 4), "transient")])
+        with activate(plan):
+            faults.fire("execute")                       # call 1: quiet
+            with pytest.raises(InjectedTransientError):
+                faults.fire("execute")                   # call 2: fires
+            faults.fire("execute")                       # call 3: quiet
+            with pytest.raises(InjectedTransientError):
+                faults.fire("execute")                   # call 4: fires
+            faults.fire("execute")                       # call 5: quiet
+        assert [c for _, c, _ in plan.injections] == [2, 4]
+
+    def test_modes(self):
+        plan = FaultPlan([
+            FaultSpec("compile", (1,), "permanent"),
+            FaultSpec("plan_cache_write", (1,), "oserror"),
+            FaultSpec("result_corrupt_nan", (2,), "corrupt"),
+        ])
+        with activate(plan):
+            with pytest.raises(InjectedFaultError):
+                faults.fire("compile")
+            with pytest.raises(OSError):
+                faults.fire("plan_cache_write")
+            assert faults.corrupt("result_corrupt_nan") is False  # call 1
+            assert faults.corrupt("result_corrupt_nan") is True   # call 2
+            assert faults.corrupt("result_corrupt_nan") is False  # call 3
+
+    def test_inactive_points_are_noops(self):
+        # No active plan: fire/corrupt cost one global load, do nothing.
+        faults.fire("execute")
+        assert faults.corrupt("result_corrupt_nan") is False
+        assert faults.active() is None
+
+    def test_seeded_plans_are_reproducible(self):
+        p1, p2 = FaultPlan.seeded(7), FaultPlan.seeded(7)
+        assert [(s.point, s.calls, s.mode) for s in p1.specs] \
+            == [(s.point, s.calls, s.mode) for s in p2.specs]
+        p3 = FaultPlan.seeded(8)
+        assert [(s.point, s.calls) for s in p1.specs] \
+            != [(s.point, s.calls) for s in p3.specs]
+
+    def test_unknown_point_and_mode_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault point"):
+            FaultSpec("warp_core", (1,))
+        with pytest.raises(ValueError, match="unknown fault mode"):
+            FaultSpec("execute", (1,), "probabilistic")
+        plan = FaultPlan([])
+        with pytest.raises(ValueError, match="unknown fault point"):
+            plan.fire("warp_core")
+
+    def test_chaos_scopes_do_not_nest(self):
+        with activate(FaultPlan([])):
+            with pytest.raises(RuntimeError, match="already active"):
+                with activate(FaultPlan([])):
+                    pass
+        # ... and the outer scope still deactivated cleanly.
+        assert faults.active() is None
+
+    def test_injections_counted_in_registry(self):
+        before = _counter_total("tpu_jordan_faults_injected_total")
+        plan = FaultPlan([FaultSpec("measure", (1,), "transient")])
+        with activate(plan):
+            with pytest.raises(InjectedTransientError):
+                faults.fire("measure")
+        assert _counter_total(
+            "tpu_jordan_faults_injected_total") == before + 1
+        rep = plan.report()
+        assert rep["injected_total"] == 1
+        assert rep["injected_by_point"] == {"measure": 1}
+        assert rep["log"] == [{"point": "measure", "call": 1,
+                               "mode": "transient"}]
+
+
+class TestRetryPolicy:
+    def test_classifier_promoted_and_injected_faults_typed(self):
+        # The one shared classifier (formerly tuning/measure.py): the
+        # compat import must serve the SAME function object.
+        from tpu_jordan.resilience.policy import is_transient
+        from tpu_jordan.tuning import measure
+
+        assert measure.is_transient is is_transient
+        assert is_transient(InjectedTransientError("INTERNAL: x"))
+        assert not is_transient(InjectedFaultError("INTERNAL: x"))
+        # Corruption is retryable by the default policy classifier but
+        # is NOT transport-transient.
+        assert retryable(ResultCorruptionError("NaN"))
+        assert not is_transient(ResultCorruptionError("INTERNAL NaN"))
+
+    def test_deterministic_backoff_sequence(self):
+        pol = RetryPolicy(max_retries=3, backoff_s=0.1, multiplier=2.0,
+                          max_backoff_s=1.0, jitter_pct=10.0)
+        # The jitter is a pure function of the attempt index: two
+        # policies, one sequence — byte-reproducible chaos timing.
+        seq = [pol.delay_s(k) for k in range(3)]
+        assert seq == [RetryPolicy(max_retries=3, backoff_s=0.1).delay_s(k)
+                       for k in range(3)]
+        assert 0.1 <= seq[0] <= 0.11 and 0.2 <= seq[1] <= 0.22
+        slept, calls = [], []
+
+        def fn():
+            calls.append(1)
+            if len(calls) < 3:
+                raise InjectedTransientError("INTERNAL: flaky")
+            return "ok"
+
+        pol = RetryPolicy(max_retries=3, backoff_s=0.1,
+                          sleep=slept.append)
+        before = _counter_total("tpu_jordan_retries_total")
+        assert pol.call(fn, component="test") == "ok"
+        assert slept == [pol.delay_s(0), pol.delay_s(1)]
+        assert _counter_total("tpu_jordan_retries_total") == before + 2
+
+    def test_budget_exhaustion_raises_last_error(self):
+        calls = []
+
+        def always(_=None):
+            calls.append(1)
+            raise InjectedTransientError("INTERNAL: down")
+
+        pol = RetryPolicy(max_retries=2, backoff_s=0.0)
+        with pytest.raises(InjectedTransientError):
+            pol.call(lambda: always())
+        assert len(calls) == 3                    # 1 try + 2 retries
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def fn():
+            calls.append(1)
+            raise AssertionError("INTERNAL quoted in an accuracy check")
+
+        with pytest.raises(AssertionError):
+            RetryPolicy(max_retries=5, backoff_s=0.0).call(fn)
+        assert len(calls) == 1
+
+    def test_measure_fault_point_rides_the_shared_retry(self):
+        # tuning/measure.measure_direct crosses the `measure` point and
+        # absorbs one transient via the shared policy.
+        from tpu_jordan.tuning.measure import measure_direct
+
+        before = _counter_total("tpu_jordan_retries_total")
+        plan = FaultPlan([FaultSpec("measure", (1,), "transient")])
+        with activate(plan):
+            m = measure_direct(lambda: None, samples=2, warmup=1)
+        assert len(m.samples) == 2
+        assert plan.injected_total == 1
+        assert _counter_total("tpu_jordan_retries_total") == before + 1
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestCircuitBreaker:
+    def test_state_machine_with_fake_clock(self):
+        clk = FakeClock()
+        br = CircuitBreaker(failures=3, cooldown_s=5.0, clock=clk,
+                            name="t1")
+        opens = _counter_total("tpu_jordan_breaker_open_total")
+        assert br.allow() and br.state == "closed"
+        br.record_failure(); br.record_failure()
+        assert br.state == "closed" and br.allow()
+        br.record_failure()                      # K=3 consecutive: open
+        assert br.state == "open" and not br.allow()
+        assert _counter_total(
+            "tpu_jordan_breaker_open_total") == opens + 1
+        clk.t = 4.9
+        assert not br.allow()                    # cooldown not elapsed
+        clk.t = 5.0
+        assert br.state == "half_open"
+        assert br.allow()                        # the probe is admitted
+        br.record_failure()                      # failed probe: reopen
+        assert br.state == "open" and not br.allow()
+        clk.t = 10.0
+        assert br.allow()
+        br.record_success()                      # probe success: closed
+        assert br.state == "closed" and br.allow()
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker(failures=2, cooldown_s=1.0, clock=FakeClock())
+        br.record_failure()
+        br.record_success()
+        br.record_failure()
+        assert br.state == "closed"              # never 2 consecutive
+
+    def test_typed_errors(self):
+        from tpu_jordan.resilience import CircuitOpenError
+
+        assert issubclass(DeadlineExceededError, TimeoutError)
+        assert issubclass(CircuitOpenError, RuntimeError)
+
+
+class TestPlanCacheWriteDegrade:
+    def test_injected_write_failure_degrades_to_memory(self, tmp_path):
+        """ISSUE 5 satellite: a save failure (disk full, simulated via
+        the plan_cache_write fault point) warns + keeps serving from
+        memory; it never raises out of the solve that triggered it."""
+        from tpu_jordan.tuning.plan_cache import Plan, PlanCache
+
+        path = str(tmp_path / "plans.json")
+        cache = PlanCache(path=path)
+        cache.put("k", Plan(config="inplace", engine="inplace"))
+        before = _counter_total(
+            "tpu_jordan_plan_cache_write_failures_total")
+        plan = FaultPlan([FaultSpec("plan_cache_write", (1,), "oserror")])
+        with activate(plan):
+            cache.save()                          # degrades, no raise
+        assert _counter_total(
+            "tpu_jordan_plan_cache_write_failures_total") == before + 1
+        assert cache.last_write_error is not None
+        assert not (tmp_path / "plans.json").exists()
+        assert cache.get("k") is not None         # in-memory plans live
+        cache.save()                              # disk pressure cleared
+        assert cache.last_write_error is None
+        assert PlanCache.load(path).get("k").engine == "inplace"
+
+    def test_real_readonly_destination_degrades(self, tmp_path):
+        """A genuinely unwritable destination (dirname is a FILE) takes
+        the same degrade path with no fault plan active."""
+        from tpu_jordan.tuning.plan_cache import Plan, PlanCache
+
+        blocker = tmp_path / "blocker"
+        blocker.write_text("not a directory")
+        cache = PlanCache(path=str(blocker / "plans.json"))
+        cache.put("k", Plan(config="inplace", engine="inplace"))
+        cache.save()                              # no raise
+        assert cache.last_write_error is not None
+        assert cache.get("k") is not None
+
+    def test_tuner_select_survives_write_failure(self, tmp_path):
+        """End to end: engine="auto" with a failing plan-cache write
+        still resolves (and re-resolves) — the documented degrade."""
+        from tpu_jordan.tuning.plan_cache import PlanCache
+        from tpu_jordan.tuning.registry import TunePoint
+        from tpu_jordan.tuning.tuner import Tuner
+
+        cache = PlanCache(path=str(tmp_path / "x" / "plans.json"))
+        t = Tuner(cache=cache)
+        pt = TunePoint.create(512, 128, "float32", 1, True)
+        plan = FaultPlan([FaultSpec("plan_cache_write", (1,), "oserror")])
+        with activate(plan):
+            p1 = t.select(pt)
+        assert p1.engine == "inplace"
+        assert cache.last_write_error is not None
+        assert t.select(pt).engine == p1.engine   # in-memory hit
+
+
+class TestDriverPolicy:
+    def test_transient_compile_and_execute_faults_retried_bitmatch(self):
+        """ONE solve absorbs a transient compile failure AND a
+        transient execute failure (two counted retries) and still
+        bit-matches the fault-free solve."""
+        from tpu_jordan import solve
+
+        clean = solve(48, 16, generator="rand", engine="inplace")
+        pol = ResiliencePolicy(retry=RetryPolicy(max_retries=2,
+                                                 backoff_s=0.0))
+        before = _counter_total("tpu_jordan_retries_total")
+        plan = FaultPlan([FaultSpec("compile", (1,), "transient"),
+                          FaultSpec("execute", (1,), "transient")])
+        with activate(plan):
+            r = solve(48, 16, generator="rand", engine="inplace",
+                      policy=pol)
+        assert plan.injected_total == 2
+        assert _counter_total("tpu_jordan_retries_total") == before + 2
+        assert r.recovery == ()
+        assert (np.asarray(r.inverse) == np.asarray(clean.inverse)).all()
+
+    def test_nan_corruption_recovers_through_resolve_rung(self):
+        """Injected NaN corruption fails the gate (NaN rel_residual),
+        refine can't fix NaN, the re-solve rung returns the bit-exact
+        clean inverse — zero silent corruption."""
+        from tpu_jordan import solve
+
+        clean = solve(48, 16, generator="rand", engine="inplace")
+        pol = ResiliencePolicy(retry=RetryPolicy(max_retries=1,
+                                                 backoff_s=0.0))
+        plan = FaultPlan([FaultSpec("result_corrupt_nan", (1,),
+                                    "corrupt")])
+        with activate(plan):
+            r = solve(48, 16, generator="rand", engine="inplace",
+                      policy=pol)
+        assert [x["rung"] for x in r.recovery] == ["refine", "resolve"]
+        assert not r.recovery[0]["passed"] and r.recovery[1]["passed"]
+        assert (np.asarray(r.inverse) == np.asarray(clean.inverse)).all()
+
+    def test_exhausted_ladder_raises_typed_not_silent(self):
+        from tpu_jordan import solve
+
+        pol = ResiliencePolicy(gate_tol=1e-12, refine_steps=0,
+                               escalate=False)
+        with pytest.raises(ResidualGateError) as ei:
+            solve(32, 8, generator="rand", engine="inplace", policy=pol)
+        assert ei.value.recovery == ()
+
+    def test_solver_model_policy_retries_execute(self):
+        from tpu_jordan.models import JordanSolver
+
+        pol = ResiliencePolicy(retry=RetryPolicy(max_retries=1,
+                                                 backoff_s=0.0))
+        sol = JordanSolver(n=32, block_size=8, engine="inplace",
+                           policy=pol)
+        a = np.asarray(jnp.eye(32) * 2.0)
+        before = _counter_total("tpu_jordan_retries_total")
+        plan = FaultPlan([FaultSpec("execute", (1,), "transient")])
+        with activate(plan):
+            inv, sing = sol.invert(a)
+        assert not bool(sing)
+        assert _counter_total("tpu_jordan_retries_total") == before + 1
+        np.testing.assert_allclose(np.asarray(inv), np.eye(32) / 2.0)
+
+
+def _ill_conditioned(n: int, kappa_decades: float = 4.5,
+                     seed: int = 7) -> np.ndarray:
+    """A deliberately ill-conditioned (κ∞ ~ 10^decades) but well-scaled
+    dense matrix: rotated graded diagonal."""
+    rng = np.random.default_rng(seed)
+    q1, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    q2, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    return (q1 * np.logspace(0, -kappa_decades, n)) @ q2
+
+
+class TestDegradationLadderAcceptance:
+    def test_bf16_fails_gate_recovers_refine_then_fp32(self, tmp_path):
+        """ISSUE 5 acceptance: an ill-conditioned matrix that fails the
+        residual gate at bf16 recovers through refine -> fp32 re-solve,
+        each rung visible in SolveResult.recovery AND the span tree."""
+        from tpu_jordan import solve
+        from tpu_jordan.io import write_matrix_file
+        from tpu_jordan.obs.spans import Telemetry
+
+        n = 16
+        path = str(tmp_path / "ill.mat")
+        write_matrix_file(path, _ill_conditioned(n))
+        tel = Telemetry()
+        pol = ResiliencePolicy(gate_dtype="float32")
+        r = solve(n, 8, file=path, dtype=jnp.bfloat16, policy=pol,
+                  telemetry=tel)
+        # Both rungs ran: refine diverged (bf16-grade initial residual
+        # > 1 kills Newton-Schulz), the fp32 re-solve passed its gate.
+        assert [x["rung"] for x in r.recovery] == ["refine", "resolve"]
+        assert not r.recovery[0]["passed"]
+        assert r.recovery[1]["passed"]
+        assert r.recovery[1]["dtype"] == "float32"
+        assert r.inverse.dtype == jnp.float32
+        assert r.rel_residual < r.recovery[0]["rel_residual_before"]
+        # Span tree: solve -> ... -> recover -> {refine, resolve}, with
+        # the re-solve's own compile/execute nested under `resolve`.
+        root = tel.roots[-1]
+        rec = root.find("recover")
+        assert rec is not None
+        assert [c.name for c in rec.children] == ["refine", "resolve"]
+        assert rec.attrs["recovered_by"] == "resolve"
+        resolve_span = rec.find("resolve")
+        assert resolve_span.find("execute") is not None
+
+    def test_float64_refine_rung_stays_float64(self, tmp_path):
+        """A float64 solve that enters the ladder must refine at
+        float64 and be judged against eps64 — never silently downgraded
+        to fp32 (which would 'pass' a ~1e9x looser gate)."""
+        from tpu_jordan import solve
+        from tpu_jordan.io import write_matrix_file
+
+        n = 16
+        path = str(tmp_path / "ill64.mat")
+        write_matrix_file(path, _ill_conditioned(n))
+        # Force the ladder: corrupt the f64 result, no escalation room.
+        pol = ResiliencePolicy(refine_steps=2)
+        plan = FaultPlan([FaultSpec("result_corrupt_nan", (1,),
+                                    "corrupt")])
+        with activate(plan):
+            r = solve(n, 8, file=path, dtype=jnp.float64, policy=pol)
+        # NaN corruption: refine on NaN stays NaN (fails at eps64),
+        # the re-solve rung recovers — and everything stays float64.
+        assert [x["rung"] for x in r.recovery] == ["refine", "resolve"]
+        assert r.recovery[1]["dtype"] == "float64"
+        assert r.inverse.dtype == jnp.float64
+        assert r.rel_residual < 1e-10        # genuinely fp64-grade
+
+    def test_gate_passes_untouched_on_healthy_solve(self):
+        """Fault-free warm path: a healthy fp32 solve under the default
+        policy pays one gate comparison — no rungs, no retries, same
+        bits as the policy-free solve."""
+        from tpu_jordan import solve
+        from tpu_jordan.resilience import DEFAULT_POLICY
+
+        before = _counter_total("tpu_jordan_retries_total")
+        clean = solve(48, 16, generator="rand", engine="inplace")
+        r = solve(48, 16, generator="rand", engine="inplace",
+                  policy=DEFAULT_POLICY)
+        assert r.recovery == ()
+        assert (np.asarray(r.inverse) == np.asarray(clean.inverse)).all()
+        assert _counter_total("tpu_jordan_retries_total") == before

@@ -1,0 +1,106 @@
+#!/usr/bin/env python
+"""Validate a ``--chaos-demo`` report (ISSUE 5 CI satellite).
+
+Usage: ``python tools/check_chaos.py report.json [...]`` (or ``-`` for
+stdin).  No jax import — this is the ``make chaos-demo`` gate and runs
+anywhere.
+
+What a valid chaos report must prove (docs/RESILIENCE.md):
+
+  * chaos actually happened — ``injected`` > 0, and every fault KIND
+    the demo promises (compile, execute, result_corrupt_nan,
+    plan_cache_write) actually fired;
+  * nothing silent — every injected fault is accounted for as retried,
+    degraded, or typed-error (``unaccounted == 0``), and
+    ``silent_corruption`` is false;
+  * the replay pin held — zero ``mismatches``: every response either
+    bit-matched the fault-free run of the same request or carried a
+    typed error;
+  * the response ledger adds up — matched + typed errors == requests.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+REQUIRED_POINTS = ("compile", "execute", "result_corrupt_nan",
+                   "plan_cache_write")
+
+
+def check(report: dict) -> list[str]:
+    """Return a list of violations (empty = valid)."""
+    errs = []
+    if report.get("metric") != "chaos_demo":
+        return [f"not a chaos_demo report (metric="
+                f"{report.get('metric')!r})"]
+    acct = report.get("accounting", {})
+    faults = report.get("faults", {})
+    by_point = faults.get("injected_by_point", {})
+
+    if acct.get("injected", 0) <= 0:
+        errs.append("no faults injected — the chaos run was vacuous")
+    for point in REQUIRED_POINTS:
+        if by_point.get(point, 0) <= 0:
+            errs.append(f"required fault point {point!r} never fired "
+                        f"(schedule horizon vs actual call count?)")
+    # Fault-event units: injected == retried + degraded + terminal
+    # batch failures for an honest run.  A POSITIVE remainder is a
+    # silently absorbed fault; a negative one means a real (uninjected)
+    # transient also fired — noisy, but nothing was swallowed.
+    if acct.get("unaccounted", 1) > 0:
+        errs.append(f"{acct.get('unaccounted')} injected fault(s) "
+                    f"unaccounted (not retried, degraded, or a counted "
+                    f"terminal failure) — silent fault absorption")
+    if report.get("silent_corruption", True):
+        errs.append("silent_corruption flagged by the demo itself")
+    mism = report.get("mismatches", [{"missing": True}])
+    if mism:
+        errs.append(f"{len(mism)} response(s) diverged from the "
+                    f"fault-free replay without a typed error: "
+                    f"{mism[:3]}")
+    requests = report.get("requests", 0)
+    matched = report.get("matched_bitwise", 0)
+    typed = sum(report.get("typed_errors", {}).values())
+    if matched + typed + len(mism) != requests:
+        errs.append(f"response ledger does not add up: {matched} matched "
+                    f"+ {typed} typed + {len(mism)} mismatched != "
+                    f"{requests} requests")
+    return errs
+
+
+def main(argv) -> int:
+    if not argv:
+        print("usage: check_chaos.py report.json [...]", file=sys.stderr)
+        return 2
+    rc = 0
+    for path in argv:
+        try:
+            if path == "-":
+                report = json.load(sys.stdin)
+            else:
+                with open(path) as f:
+                    report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"FAIL {path}: unreadable report ({e})", file=sys.stderr)
+            rc = 1
+            continue
+        errs = check(report)
+        if errs:
+            rc = 1
+            for e in errs:
+                print(f"FAIL {path}: {e}", file=sys.stderr)
+        else:
+            acct = report["accounting"]
+            print(f"OK {path}: {report['requests']} requests, "
+                  f"{acct['injected']} faults injected "
+                  f"({acct['retried']:.0f} retried, "
+                  f"{acct['degraded']:.0f} degraded, "
+                  f"{acct['terminal_failures']:.0f} terminal), "
+                  f"{report['matched_bitwise']} bit-matched the "
+                  f"fault-free replay, 0 silent")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -7,7 +7,8 @@ sharding, ring GEMM, residual verification, matrix generators/file I/O, and
 a CLI — designed for the MXU/ICI, not translated from MPI.
 """
 
-from . import config, io, models, obs, ops, parallel, serve, tuning, utils
+from . import (config, io, models, obs, ops, parallel, resilience, serve,
+               tuning, utils)
 from .driver import SingularMatrixError, SolveResult, solve
 
 __version__ = "0.1.0"

@@ -139,9 +139,27 @@ def main(argv=None) -> int:
                          "measurement counters; docs/SERVING.md); n is "
                          "the largest request size, m the block-size "
                          "hint; single device, generator input only")
+    ap.add_argument("--chaos-demo", action="store_true",
+                    help="serve the SAME deterministic mixed request "
+                         "stream twice — fault-free, then under a "
+                         "seeded FaultPlan injecting compile failures, "
+                         "transient execute errors, NaN result "
+                         "corruption, and plan-cache write failures "
+                         "(tpu_jordan.resilience; docs/RESILIENCE.md) — "
+                         "and print ONE JSON line proving every "
+                         "response bit-matched the fault-free replay "
+                         "or carried a typed error, with every "
+                         "injected fault accounted for as retried, "
+                         "degraded, or typed-error (exit 2 on any "
+                         "silent corruption; tools/check_chaos.py "
+                         "validates the report)")
+    ap.add_argument("--chaos-seed", type=int, default=0, metavar="S",
+                    help="--chaos-demo: FaultPlan + request-stream seed "
+                         "(default 0; same seed = identical chaos)")
     ap.add_argument("--serve-requests", type=int, default=64,
-                    metavar="R", help="--serve-demo: concurrent requests "
-                                      "to submit (default 64)")
+                    metavar="R", help="--serve-demo/--chaos-demo: "
+                                      "concurrent requests to submit "
+                                      "(default 64)")
     ap.add_argument("--batch-cap", type=int, default=8, metavar="B",
                     help="--serve-demo: max requests fused per "
                          "executable launch (default 8)")
@@ -231,6 +249,45 @@ def main(argv=None) -> int:
 
         telemetry = Telemetry()
     try:
+        if args.chaos_demo:
+            # Chaos demo: same restrictions as --serve-demo (single
+            # device, generator-free deterministic fixtures, gathered),
+            # same 0/1/2 taxonomy — exit 2 IS the silent-corruption
+            # alarm (a response that neither bit-matched the fault-free
+            # replay nor carried a typed error, or an unaccounted
+            # injected fault).
+            if args.serve_demo:
+                raise UsageError("--chaos-demo and --serve-demo are "
+                                 "distinct modes; pick one")
+            if args.file is not None or args.workers != 1 or not args.gather:
+                raise UsageError(
+                    "--chaos-demo runs on a single device (gathered "
+                    "output, deterministic built-in fixtures)")
+            if args.batch > 1 or args.tune:
+                raise UsageError("--chaos-demo takes no --batch/--tune")
+            if args.group != 0 or args.engine == "swapfree":
+                raise UsageError("--chaos-demo engines are single-device "
+                                 "(auto resolution); --group does not "
+                                 "apply")
+            import json as _json
+
+            from .serve import chaos_demo
+
+            report = chaos_demo(
+                n=args.n, block_size=args.m, requests=args.serve_requests,
+                batch_cap=args.batch_cap, max_wait_ms=args.max_wait_ms,
+                seed=args.chaos_seed, dtype=jnp.dtype(args.dtype),
+                plan_cache=args.plan_cache, telemetry=telemetry)
+            if args.quiet:
+                report["faults"].pop("log", None)
+            print(_json.dumps(report))
+            if report["silent_corruption"]:
+                print(f"silent corruption under chaos: "
+                      f"{len(report['mismatches'])} mismatches, "
+                      f"{report['accounting']['unaccounted']} "
+                      f"unaccounted faults", file=sys.stderr)
+                return 2
+            return 0
         if args.serve_demo:
             # The serving demo: single-device, generator input,
             # gathered output — same shape of restrictions as --batch

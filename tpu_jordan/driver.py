@@ -29,6 +29,7 @@ from .obs import metrics as _obs_metrics
 from .obs.spans import NULL as _NULL_TEL
 from .obs.spans import attribute_phases, timed_blocking
 from .ops import generate, inf_norm, residual_inf_norm
+from .resilience import faults as _faults
 
 
 from jax import lax as _lax
@@ -71,6 +72,12 @@ class SolveResult:
     #   residual children plus model-attributed hot-loop phases; the
     #   execute span's duration IS `elapsed` (one shared bracket,
     #   obs/spans.timed_blocking — they cannot disagree)
+    recovery: tuple = ()  # degradation-ladder rungs this solve climbed
+    #   (resilience/degrade.py, policy= solves only): one dict per rung
+    #   ("refine" / "resolve") with rel_residual before/after and the
+    #   pass verdict — empty on the fault-free gate-passing path.  When
+    #   non-empty, `inverse` (and residual/kappa) are the RECOVERED
+    #   numbers, possibly at a higher precision than requested.
 
     @property
     def rel_residual(self) -> float | None:
@@ -197,6 +204,7 @@ def solve(
     tune: bool = False,
     plan_cache: str | None = None,
     telemetry=None,
+    policy=None,
 ) -> SolveResult:
     """Invert an n x n matrix from a file or a generator and verify it.
 
@@ -245,6 +253,18 @@ def solve(
     ``plan``.  ``tune``/``plan_cache`` with an explicit engine is a
     UsageError — a requested engine leaves nothing to tune.
 
+    ``policy`` (a ``resilience.ResiliencePolicy``) attaches the
+    resilience layer (ISSUE 5, docs/RESILIENCE.md): transient
+    compile/execute failures are retried per ``policy.retry`` (counted
+    in ``tpu_jordan_retries_total``), and on single-device solves the
+    residual gate (``rel_residual <= gate_tol·eps·n·κ∞``) guards the
+    result — a failing gate escalates through the degradation ladder
+    (Newton-Schulz refine, then a higher-precision re-solve), each rung
+    recorded on ``SolveResult.recovery`` and as ``recover`` span
+    children; an exhausted ladder raises ``ResidualGateError`` instead
+    of returning a known-bad inverse.  Without a policy, behavior (and
+    the warm-path cost) is unchanged.
+
     Raises SingularMatrixError like the reference's -2 path
     (main.cpp:435-437); file errors propagate from read_matrix_file.
     """
@@ -253,7 +273,7 @@ def solve(
                   generator=(None if file else generator)) as root:
         res = _solve_impl(n, block_size, file, generator, dtype, refine,
                           workers, device, verbose, gather, precision,
-                          engine, group, tune, plan_cache, tel)
+                          engine, group, tune, plan_cache, tel, policy)
     if telemetry is not None:
         res.trace = root
     return res
@@ -261,7 +281,7 @@ def solve(
 
 def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
                 device, verbose, gather, precision, engine, group, tune,
-                plan_cache, tel) -> SolveResult:
+                plan_cache, tel, policy=None) -> SolveResult:
     if block_size is None:
         block_size = default_block_size(n)
     prec = _PRECISIONS[precision]
@@ -303,6 +323,7 @@ def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
         res = _solve_distributed_core(
             be, n, block_size, file, generator, dtype, refine, verbose,
             gather, load, sweep_prec, tel=tel, engine=engine,
+            policy=policy,
         )
         res.engine, res.group, res.plan = engine, group, plan
         return res
@@ -326,19 +347,42 @@ def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
     # working matrix — the difference between fitting and OOM at
     # n >= 16384 (4 GB per n=32768 fp32 buffer on a 16 GB chip).
     with tel.span("compile", engine=engine, n=n) as csp:
-        compiled = jax.jit(
-            single_device_invert(n, block_size, engine, group),
-            static_argnames=("block_size", "refine", "precision"),
-            donate_argnums=(0,),
-        ).lower(
-            a, block_size=block_size, refine=refine, precision=prec
-        ).compile()
+        def _compile():
+            _faults.fire("compile")
+            return jax.jit(
+                single_device_invert(n, block_size, engine, group),
+                static_argnames=("block_size", "refine", "precision"),
+                donate_argnums=(0,),
+            ).lower(
+                a, block_size=block_size, refine=refine, precision=prec
+            ).compile()
+        compiled = (policy.retry.call(_compile, component="solve.compile")
+                    if policy is not None else _compile())
     _record_compile(csp, "solve")
-    (inv, singular), esp = timed_blocking(compiled, a, telemetry=tel,
-                                          name="execute", engine=engine)
+
+    def _execute():
+        _faults.fire("execute")
+        return timed_blocking(compiled, a, telemetry=tel,
+                              name="execute", engine=engine)
+
+    def _reload_donated(_e, _attempt):
+        # The timed call DONATES a; a retry after a mid-execution
+        # failure must rebuild the input buffer first.
+        nonlocal a
+        a = load()
+
+    (inv, singular), esp = (
+        policy.retry.call(_execute, component="solve.execute",
+                          on_retry=_reload_donated)
+        if policy is not None else _execute())
     elapsed = esp.duration
     attribute_phases(esp, n, block_size)
     _solve_metrics(n, elapsed, esp, singular=bool(singular))
+    if _faults.corrupt("result_corrupt_nan"):
+        # Silent-corruption simulation: poison the computed inverse so
+        # the residual (verified against a FRESH A below) goes NaN and
+        # the policy's gate — not a lucky caller — must catch it.
+        inv = inv.at[0, 0].set(float("nan"))
 
     if bool(singular):
         raise SingularMatrixError("singular matrix")
@@ -356,6 +400,28 @@ def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
         residual = float(residual_inf_norm(a_fresh, inv))
         norm_a = float(inf_norm(a_fresh))
         kappa = norm_a * float(inf_norm(inv))  # condition_inf, one pass each
+
+    recovery = ()
+    if policy is not None:
+        # The residual gate + degradation ladder (ISSUE 5): refine on
+        # the inverse in hand, then an escalated re-solve — storage
+        # dtype promoted to fp32 where sub-fp32, matmul precision to
+        # HIGHEST — which also clears transient result corruption (the
+        # re-solve is a fresh execution of a fresh load).
+        from .resilience.degrade import maybe_recover
+
+        def _escalated_resolve():
+            esc_dtype = (jnp.float32
+                         if jnp.dtype(dtype).itemsize < 4 else dtype)
+            return _solve_impl(n, block_size, file, generator, esc_dtype,
+                               refine, workers, device, False, gather,
+                               "highest", engine, group, False, None, tel)
+
+        inv, residual, norm_a, kappa, recovery = maybe_recover(
+            policy, tel, a_fresh=a_fresh, inv=inv, residual=residual,
+            norm_a=norm_a, kappa=kappa, n=n, dtype=dtype,
+            resolve=_escalated_resolve)
+
     if verbose:
         print(f"residual: {residual:e}")
         print(f"kappa_inf: {kappa:e}")
@@ -372,6 +438,7 @@ def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
         engine=engine,
         group=group,
         plan=plan,
+        recovery=recovery,
     )
 
 
@@ -829,6 +896,7 @@ def _solve_distributed_core(
     be, n: int, block_size: int, file, generator: str, dtype,
     refine: int, verbose: bool, gather: bool, load,
     precision=_lax.Precision.HIGHEST, tel=_NULL_TEL, engine=None,
+    policy=None,
 ):
     """The one distributed solve skeleton, shared by the 1D and 2D
     layouts via the backend adapter ``be``.
@@ -878,8 +946,16 @@ def _solve_distributed_core(
                                    dtype))
 
     with tel.span("compile", engine=engine, n=n) as csp:
-        run = be.compile(W, precision)
+        def _compile():
+            _faults.fire("compile")
+            return be.compile(W, precision)
+        run = (policy.retry.call(_compile, component="solve.compile")
+               if policy is not None else _compile())
     _record_compile(csp, "solve")
+    # The execute fault point fires here too, but distributed execute is
+    # NOT retried (the sharded working state may be donated into the
+    # engine): a mid-flight failure propagates typed, never silently.
+    _faults.fire("execute")
     (out, singular), esp = timed_blocking(run, W, telemetry=tel,
                                           name="execute", engine=engine)
     elapsed = esp.duration

@@ -63,6 +63,13 @@ class JordanSolver:
         traces).  NOTE: with telemetry attached, ``invert`` adds a
         ``block_until_ready`` so the execute span is an honest wall
         bracket; without it the lazy-return behavior is unchanged.
+      policy: optional ``resilience.ResiliencePolicy`` — transient
+        compile/execute failures are retried per ``policy.retry``
+        (``tpu_jordan_retries_total``); the compile/execute fault
+        points (``resilience/faults.py``) fire either way, so chaos
+        plans reach the solver model too.  The residual-gate ladder is
+        a ``driver.solve``/serve concern (the solver returns raw
+        ``(inverse, singular)`` without a residual pass).
     """
 
     n: int
@@ -77,6 +84,7 @@ class JordanSolver:
     tune: bool = False
     plan_cache: str | None = None
     telemetry: Any = None
+    policy: Any = None
     plan: Any = field(default=None, repr=False)
     _run: Any = field(default=None, repr=False)
     _be: Any = field(default=None, repr=False)
@@ -145,32 +153,47 @@ class JordanSolver:
 
     def _compile(self, sample):
         from ..driver import _record_compile
+        from ..resilience import faults as _faults
 
         with self._tel.span("compile", engine=self.engine, n=self.n) as csp:
-            if self._distributed:
-                self._run = self._be.compile(sample, self._sweep_prec)
-            else:
+            def compile_once():
+                _faults.fire("compile")
+                if self._distributed:
+                    return self._be.compile(sample, self._sweep_prec)
                 from ..driver import single_device_invert
 
-                self._run = single_device_invert(
+                return single_device_invert(
                     self.n, self.block_size, self.engine, self.group,
                 ).lower(
                     sample, block_size=self.block_size, refine=self.refine,
                     precision=self._sweep_prec,
                 ).compile()
+
+            self._run = (self.policy.retry.call(compile_once,
+                                                component="solver.compile")
+                         if self.policy is not None else compile_once())
         _record_compile(csp, "solver")
 
     def _execute(self, arg):
         """One executable launch: with telemetry, an honest blocking
         execute span (obs.spans.timed_blocking); without, the original
-        lazy return."""
-        if self.telemetry is None:
-            return self._run(arg)
-        from ..obs.spans import timed_blocking
+        lazy return.  The solver's executables never donate their
+        input, so a policy retry re-runs on the same buffer."""
+        from ..resilience import faults as _faults
 
-        out, _ = timed_blocking(self._run, arg, telemetry=self.telemetry,
-                                name="execute", engine=self.engine)
-        return out
+        def run_once():
+            _faults.fire("execute")
+            if self.telemetry is None:
+                return self._run(arg)
+            from ..obs.spans import timed_blocking
+
+            out, _ = timed_blocking(self._run, arg,
+                                    telemetry=self.telemetry,
+                                    name="execute", engine=self.engine)
+            return out
+
+        return (self.policy.retry.call(run_once, component="solver.execute")
+                if self.policy is not None else run_once())
 
     def invert(self, a: jnp.ndarray):
         """Invert one (n, n) matrix; returns (inverse, singular).

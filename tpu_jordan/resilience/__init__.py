@@ -1,0 +1,38 @@
+"""tpu_jordan.resilience — deterministic fault injection, policy-driven
+retry/deadline/circuit-breaking, and the numerical degradation ladder
+(ISSUE 5 tentpole; docs/RESILIENCE.md is the operator guide).
+
+Three modules:
+
+  * ``faults`` — named injection points (compile / execute /
+    plan_cache_write / measure / result_corrupt_nan / dispatch)
+    activated by a seeded :class:`FaultPlan` of nth-call schedules —
+    never probabilities — so every chaos test replays exactly.
+  * ``policy`` — the shared transient classifier + :class:`RetryPolicy`
+    (deterministic-jitter backoff, injectable sleep), the typed
+    :class:`DeadlineExceededError` / :class:`CircuitOpenError` /
+    :class:`ResultCorruptionError` failures, the per-bucket
+    :class:`CircuitBreaker`, and the :class:`ResiliencePolicy` umbrella
+    the product surface takes.
+  * ``degrade`` — the residual-gate degradation ladder: refine
+    (Newton-Schulz) then a higher-precision re-solve, each rung recorded
+    on ``SolveResult.recovery`` and in the span tree; a wrong inverse is
+    never returned silently (:class:`ResidualGateError`).
+"""
+
+from . import faults
+from .faults import (FaultPlan, FaultSpec, InjectedFaultError,
+                     InjectedTransientError, activate)
+from .policy import (DEFAULT_POLICY, CircuitBreaker, CircuitOpenError,
+                     DeadlineExceededError, ResidualGateError,
+                     ResiliencePolicy, ResultCorruptionError, RetryPolicy,
+                     is_transient, retry_transient, retryable)
+
+__all__ = [
+    "faults", "FaultPlan", "FaultSpec", "InjectedFaultError",
+    "InjectedTransientError", "activate",
+    "DEFAULT_POLICY", "CircuitBreaker", "CircuitOpenError",
+    "DeadlineExceededError", "ResidualGateError", "ResiliencePolicy",
+    "ResultCorruptionError", "RetryPolicy", "is_transient",
+    "retry_transient", "retryable",
+]

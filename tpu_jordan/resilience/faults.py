@@ -1,0 +1,272 @@
+"""Deterministic fault injection (ISSUE 5 tentpole part 1).
+
+Chaos testing a serving system is worthless if the chaos is not
+reproducible: a probabilistic fault that fires on Tuesdays can neither
+pin a regression nor be replayed in a failing CI log.  Here every fault
+is an **nth-call schedule**: an injection point fires on exactly the
+k-th time it is reached (1-based, counted per point under a lock), so a
+seeded :class:`FaultPlan` produces byte-identical chaos on every run —
+the same discipline as the tuner's injected timings and the obs layer's
+fake clocks.
+
+Injection points (``POINTS``), threaded through the layers built in
+PRs 1-4:
+
+  ==================  ====================================================
+  point               fires inside
+  ==================  ====================================================
+  compile             driver solve/solve_batch compile spans,
+                      ``JordanSolver._compile``, serve
+                      ``BucketExecutor._build``
+  execute             driver timed executions, the serve dispatcher's
+                      per-batch executable run
+  plan_cache_write    ``tuning/plan_cache.PlanCache.save`` (simulates
+                      disk full / read-only dir)
+  measure             ``tuning/measure.measure_direct`` timed calls
+  result_corrupt_nan  the serve dispatcher's result fan-out and the
+                      driver's post-execute result (silent-corruption
+                      simulation: poisons the result so the integrity /
+                      residual gates must catch it)
+  dispatch            the serve dispatcher, before executor lookup
+  ==================  ====================================================
+
+A point with no active plan costs one module-global ``is None`` check —
+the fault-free warm path pays nothing measurable (acceptance-pinned).
+Every fired injection increments ``tpu_jordan_faults_injected_total``
+(labeled by point) and is recorded on the plan itself, so a chaos
+report can account for every fault as retried, degraded, or typed-error
+(``tools/check_chaos.py``) — none silent.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..obs import metrics as _obs_metrics
+
+#: The named injection points.  ``fire()`` on an unknown point raises —
+#: a typo'd point would otherwise be chaos that never happens.
+POINTS = ("compile", "execute", "plan_cache_write", "measure",
+          "result_corrupt_nan", "dispatch")
+
+#: Injection modes: how a scheduled hit manifests at the call site.
+#:   transient — raises :class:`InjectedTransientError` (classified
+#:     retryable by ``resilience.policy.is_transient``: a transport-type
+#:     exception carrying a documented-transient marker);
+#:   permanent — raises :class:`InjectedFaultError` (never retried —
+#:     the "doomed executor" fixture for breaker tests);
+#:   oserror — raises ``OSError`` (the plan-cache write failure class);
+#:   corrupt — does not raise; ``corrupt(point)`` returns True and the
+#:     call site poisons its own result (NaN injection).
+MODES = ("transient", "permanent", "oserror", "corrupt")
+
+_M_INJECTED = _obs_metrics.counter(
+    "tpu_jordan_faults_injected_total",
+    "faults fired by an active FaultPlan, labeled by injection point")
+
+
+class InjectedFaultError(RuntimeError):
+    """A permanent injected fault: NOT transient-classified, so retry
+    policies propagate it immediately — the deterministic stand-in for
+    a doomed executor / poisoned program."""
+
+
+class InjectedTransientError(ConnectionError):
+    """A transient injected fault.  ``ConnectionError`` + the
+    "INTERNAL" marker is exactly what ``resilience.policy.is_transient``
+    classifies as the documented-transient remote-compile/transport
+    failure class, so the production retry path handles it with zero
+    test-only special cases."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One point's schedule: fire on the given 1-based call indices."""
+
+    point: str
+    calls: tuple[int, ...]
+    mode: str = "transient"
+
+    def __post_init__(self):
+        if self.point not in POINTS:
+            raise ValueError(f"unknown fault point {self.point!r}; "
+                             f"choose from {'/'.join(POINTS)}")
+        if self.mode not in MODES:
+            raise ValueError(f"unknown fault mode {self.mode!r}; "
+                             f"choose from {'/'.join(MODES)}")
+        if any(c < 1 for c in self.calls):
+            raise ValueError("call indices are 1-based")
+
+
+class FaultPlan:
+    """A set of :class:`FaultSpec` schedules plus the per-point call
+    counters.  Thread-safe (the serve dispatcher and caller threads both
+    cross injection points).  ``injections`` records every fired fault
+    ``(point, call_index, mode)`` in firing order — the chaos report's
+    ground truth."""
+
+    def __init__(self, specs):
+        self._lock = threading.Lock()
+        self._calls: dict[str, int] = {}
+        self._sched: dict[str, dict[int, str]] = {}
+        self.specs = tuple(specs)
+        for spec in self.specs:
+            sched = self._sched.setdefault(spec.point, {})
+            for c in spec.calls:
+                if c in sched:
+                    raise ValueError(
+                        f"duplicate schedule for {spec.point!r} call {c}")
+                sched[c] = spec.mode
+        self.injections: list[tuple[str, int, str]] = []
+
+    @classmethod
+    def seeded(cls, seed: int, horizon: int = 20,
+               points: dict | None = None) -> "FaultPlan":
+        """Derive nth-call schedules from a seed: for each point, pick
+        ``count`` distinct call indices uniformly in [1, horizon] with a
+        ``np.random.default_rng(seed)`` stream.  Same seed, same points
+        dict -> byte-identical plan, run after run.  This is THE seeded
+        schedule builder — the chaos demo parameterizes it rather than
+        forking its own derivation.
+
+        ``points`` maps point name -> injection count, or
+        -> ``(count, horizon)`` to bound a point's schedule by how often
+        that point is actually reached (e.g. ``compile`` fires ~once
+        per bucket, ``execute`` once per dispatched batch); the default
+        is the chaos-demo mix (compile failures, transient execute
+        errors, NaN result corruption, plan-cache write failures — the
+        ISSUE 5 acceptance set).  Seeded modes: ``plan_cache_write`` ->
+        oserror, ``result_corrupt_nan`` -> corrupt, everything else
+        transient (permanent faults are a deliberate hand-built choice,
+        never a seeded surprise).
+        """
+        if points is None:
+            points = {"compile": 1, "execute": 3,
+                      "result_corrupt_nan": 2, "plan_cache_write": 1}
+        rng = np.random.default_rng(seed)
+        specs = []
+        # Deterministic iteration order: sorted point names, so the rng
+        # stream consumption (and therefore the plan) is seed-only.
+        for point in sorted(points):
+            spec = points[point]
+            count, h = spec if isinstance(spec, tuple) else (spec, horizon)
+            if count < 1:
+                continue
+            count = min(count, h)
+            calls = tuple(sorted(
+                int(c) + 1
+                for c in rng.choice(h, size=count, replace=False)))
+            mode = ("oserror" if point == "plan_cache_write"
+                    else "corrupt" if point == "result_corrupt_nan"
+                    else "transient")
+            specs.append(FaultSpec(point, calls, mode))
+        return cls(specs)
+
+    # ---- firing ------------------------------------------------------
+
+    def _hit(self, point: str) -> str | None:
+        """Count one call at ``point``; return the scheduled mode if
+        this call index fires, else None."""
+        if point not in POINTS:
+            raise ValueError(f"unknown fault point {point!r}")
+        with self._lock:
+            idx = self._calls.get(point, 0) + 1
+            self._calls[point] = idx
+            mode = self._sched.get(point, {}).get(idx)
+            if mode is not None:
+                self.injections.append((point, idx, mode))
+        if mode is not None:
+            _M_INJECTED.inc(point=point)
+        return mode
+
+    def fire(self, point: str) -> None:
+        """Count a call at a raise-style point; raise per the schedule."""
+        mode = self._hit(point)
+        if mode is None or mode == "corrupt":
+            # A corrupt schedule on a raise point is a no-op rather than
+            # an error: the raise points cannot poison a result.
+            return
+        msg = f"injected {mode} fault at point {point!r}"
+        if mode == "transient":
+            raise InjectedTransientError(f"INTERNAL: {msg}")
+        if mode == "oserror":
+            raise OSError(28, f"{msg} (simulated disk full)")
+        raise InjectedFaultError(msg)
+
+    def corrupt(self, point: str) -> bool:
+        """Count a call at a corrupt-style point; True when this call's
+        result should be poisoned by the call site."""
+        return self._hit(point) == "corrupt"
+
+    # ---- reporting ---------------------------------------------------
+
+    @property
+    def injected_total(self) -> int:
+        with self._lock:
+            return len(self.injections)
+
+    def calls(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._calls)
+
+    def report(self) -> dict:
+        """Plain-JSON view for the chaos report: per-point injected
+        counts plus the full firing log."""
+        with self._lock:
+            by_point: dict[str, int] = {}
+            for point, _, _ in self.injections:
+                by_point[point] = by_point.get(point, 0) + 1
+            return {
+                "injected_total": len(self.injections),
+                "injected_by_point": by_point,
+                "calls_by_point": dict(self._calls),
+                "log": [{"point": p, "call": c, "mode": m}
+                        for p, c, m in self.injections],
+            }
+
+
+#: THE active plan (module global, visible across threads — the serve
+#: dispatcher must see the plan the test thread activated).  None means
+#: every injection point is a single attribute-load no-op.
+_ACTIVE: FaultPlan | None = None
+_ACTIVE_LOCK = threading.Lock()
+
+
+@contextlib.contextmanager
+def activate(plan: FaultPlan):
+    """Install ``plan`` as the process-wide active fault plan for the
+    duration of the block.  Nesting is rejected: two overlapping chaos
+    scopes would make nth-call counting ambiguous."""
+    global _ACTIVE
+    with _ACTIVE_LOCK:
+        if _ACTIVE is not None:
+            raise RuntimeError("a FaultPlan is already active; chaos "
+                               "scopes do not nest")
+        _ACTIVE = plan
+    try:
+        yield plan
+    finally:
+        with _ACTIVE_LOCK:
+            _ACTIVE = None
+
+
+def active() -> FaultPlan | None:
+    return _ACTIVE
+
+
+def fire(point: str) -> None:
+    """The raise-style injection point hook.  No active plan: one
+    global load, zero work (the warm-path contract)."""
+    plan = _ACTIVE
+    if plan is not None:
+        plan.fire(point)
+
+
+def corrupt(point: str) -> bool:
+    """The corrupt-style injection point hook; False when quiescent."""
+    plan = _ACTIVE
+    return False if plan is None else plan.corrupt(point)

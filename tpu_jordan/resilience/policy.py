@@ -1,0 +1,310 @@
+"""Policy-driven retry / deadline / circuit breaking (ISSUE 5 tentpole
+part 2) — ONE home for the failure-handling logic that previously lived
+as three private islands: the typed transient classifier in
+``tuning/measure.py`` (promoted here verbatim; measure.py and bench.py
+now import it), the plan-cache corruption fallback, and the serve
+overload backpressure.
+
+Pieces:
+
+  * ``is_transient`` / ``retry_transient`` — the typed transient
+    classifier (a runtime/transport exception TYPE carrying a
+    documented-transient message marker; both conditions required —
+    substring matching alone once let an accuracy AssertionError that
+    merely quoted "INTERNAL" trigger a full n=16384 re-run).
+  * :class:`RetryPolicy` — bounded retries with exponential backoff and
+    DETERMINISTIC jitter (a pure function of the attempt index — same
+    discipline as the obs fake clocks: no hidden randomness anywhere in
+    the failure path), an injectable ``sleep``/classifier, and every
+    retry counted in ``tpu_jordan_retries_total`` (zero on the
+    fault-free warm path — acceptance-pinned).
+  * :class:`DeadlineExceededError` — the typed per-request deadline
+    failure (queue wait + execute, enforced by the serve dispatcher).
+  * :class:`CircuitBreaker` — closed -> (K consecutive failures) open ->
+    typed fast-fail (:class:`CircuitOpenError`) instead of queueing
+    doomed work -> half-open probe after a cooldown -> closed on probe
+    success, reopened on probe failure.  State exported as the
+    ``tpu_jordan_breaker_state`` gauge.
+  * :class:`ResiliencePolicy` — the umbrella the product surface takes
+    (``solve(policy=)``, ``JordanSolver(policy=)``,
+    ``JordanService(policy=)``): retry knobs, the residual-gate /
+    degradation-ladder knobs (``resilience/degrade.py``), and the
+    breaker knobs.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..obs import metrics as _obs_metrics
+
+_M_RETRIES = _obs_metrics.counter(
+    "tpu_jordan_retries_total",
+    "retries performed by RetryPolicy (transient failures and detected "
+    "result corruption), labeled by component")
+_M_BREAKER_STATE = _obs_metrics.gauge(
+    "tpu_jordan_breaker_state",
+    "circuit breaker state: 0 closed, 1 open, 2 half-open")
+_M_BREAKER_OPEN = _obs_metrics.counter(
+    "tpu_jordan_breaker_open_total",
+    "closed/half-open -> open breaker transitions")
+_M_DEADLINE = _obs_metrics.counter(
+    "tpu_jordan_deadline_exceeded_total",
+    "requests failed by their deadline, labeled by phase (queue|execute)")
+
+#: Documented-transient message markers (tunnel/remote-compile failure
+#: class, benchmarks/PHASES.md).  Marker AND type are both required.
+_RETRYABLE = ("INTERNAL", "remote_compile", "read body", "DEADLINE")
+
+
+class DeadlineExceededError(TimeoutError):
+    """A request's ``deadline_ms`` elapsed (queue wait + execute) before
+    its result could be delivered — the serve dispatcher's typed
+    per-request deadline failure (never a hang, never a silent drop)."""
+
+
+class CircuitOpenError(RuntimeError):
+    """Fast-fail from an OPEN circuit breaker: the bucket's executor has
+    failed K consecutive times and queueing more work at it would be
+    queueing doomed work.  Retry after the cooldown (the breaker then
+    admits a half-open probe)."""
+
+
+class ResultCorruptionError(ArithmeticError):
+    """A computed result failed the integrity gate (non-finite values
+    where the residual machinery promises finite ones) — the typed form
+    of silent corruption, raised so the retry/degradation policy can act
+    instead of a wrong answer reaching a caller."""
+
+
+def is_transient(e: Exception) -> bool:
+    """Transient = a runtime/transport exception TYPE carrying one of
+    the documented-transient message markers.  Both conditions required
+    (module docstring; promoted from ``tuning/measure.py``, ISSUE 5)."""
+    if not any(s in str(e) for s in _RETRYABLE):
+        return False
+    types = [OSError, ConnectionError, TimeoutError]    # tunnel/transport
+    try:
+        from jax.errors import JaxRuntimeError
+        types.append(JaxRuntimeError)
+    except ImportError:
+        pass
+    try:
+        from jaxlib.xla_extension import XlaRuntimeError
+        types.append(XlaRuntimeError)
+    except ImportError:
+        pass
+    return isinstance(e, tuple(types))
+
+
+def retryable(e: Exception) -> bool:
+    """The default RetryPolicy classifier: the transient transport class
+    plus detected result corruption (a re-run clears transient
+    corruption; persistent corruption exhausts the budget and surfaces
+    typed)."""
+    return isinstance(e, ResultCorruptionError) or is_transient(e)
+
+
+def _jitter_fraction(attempt: int) -> float:
+    """Deterministic jitter in [0, 1): a Weyl sequence over the attempt
+    index (golden-ratio multiplier) — well spread, zero state, and
+    byte-reproducible run to run (the fake-clock discipline)."""
+    return (attempt * 0.6180339887498949) % 1.0
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded retry with exponential backoff and deterministic jitter.
+
+    ``call(fn)`` runs ``fn`` up to ``1 + max_retries`` times; an
+    exception the ``classify`` predicate rejects propagates immediately
+    (an accuracy assertion must never be retried into a pass).  The
+    delay before retry k (0-based) is
+    ``min(max_backoff_s, backoff_s * multiplier**k)`` stretched by up to
+    ``jitter_pct`` percent of itself via the deterministic jitter —
+    injectable ``sleep`` (and the zero default base) keep tests and the
+    serve dispatcher's drain path instantaneous.
+    """
+
+    max_retries: int = 1
+    backoff_s: float = 0.0
+    multiplier: float = 2.0
+    max_backoff_s: float = 2.0
+    jitter_pct: float = 10.0
+    classify: Any = None          # predicate(exc) -> bool; None = retryable
+    sleep: Any = None             # injectable; None = time.sleep
+
+    def delay_s(self, attempt: int) -> float:
+        """The deterministic pre-retry delay for 0-based ``attempt``."""
+        base = min(self.max_backoff_s,
+                   self.backoff_s * (self.multiplier ** attempt))
+        return base * (1.0 + self.jitter_pct / 100.0
+                       * _jitter_fraction(attempt))
+
+    def call(self, fn, component: str = "default", on_retry=None):
+        """Run ``fn()`` under the policy.  ``on_retry(exc, attempt)``
+        (optional) runs before each re-attempt — the hook call sites use
+        to rebuild donated input buffers."""
+        classify = self.classify if self.classify is not None else retryable
+        sleep = self.sleep if self.sleep is not None else time.sleep
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except Exception as e:              # noqa: BLE001
+                if attempt >= self.max_retries or not classify(e):
+                    raise
+                _M_RETRIES.inc(component=component)
+                delay = self.delay_s(attempt)
+                if delay > 0:
+                    sleep(delay)
+                if on_retry is not None:
+                    on_retry(e, attempt)
+                attempt += 1
+
+
+#: The historical one-shot contract (formerly ``tuning/measure.py``):
+#: one retry, no backoff, strict transient classification only.
+_ONE_SHOT = RetryPolicy(max_retries=1, backoff_s=0.0, classify=is_transient)
+
+
+def retry_transient(fn):
+    """One retry on the documented-transient remote-compile failure
+    class (benchmarks/PHASES.md: the same program passes minutes later;
+    the round-4 headline capture was lost to exactly one such failure).
+    Anything else — including accuracy/singularity assertions — is a
+    real result and propagates immediately.  Now a thin veneer over
+    :class:`RetryPolicy` (ISSUE 5 satellite: one classifier, one
+    backoff implementation, retries counted in
+    ``tpu_jordan_retries_total``)."""
+    return _ONE_SHOT.call(fn, component="measure")
+
+
+class CircuitBreaker:
+    """Per-resource circuit breaker (serve buckets hold one each).
+
+    closed --K consecutive failures--> open --cooldown--> half-open
+    --probe success--> closed; --probe failure--> open again.
+
+    ``allow()`` is the admission check (False = fast-fail with
+    :class:`CircuitOpenError` at the call site); ``record_success`` /
+    ``record_failure`` are the outcome feedback.  ``clock`` is any
+    zero-arg monotonic callable (tests inject a fake — the obs
+    discipline); state transitions export the
+    ``tpu_jordan_breaker_state`` gauge and count opens in
+    ``tpu_jordan_breaker_open_total``.
+    """
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+    _GAUGE = {CLOSED: 0.0, OPEN: 1.0, HALF_OPEN: 2.0}
+
+    def __init__(self, failures: int = 3, cooldown_s: float = 5.0,
+                 clock=None, name: str = ""):
+        if failures < 1:
+            raise ValueError("failures must be >= 1")
+        self.failures = int(failures)
+        self.cooldown_s = float(cooldown_s)
+        self.clock = clock if clock is not None else time.monotonic
+        self.name = str(name)
+        self._lock = threading.Lock()
+        self._state = self.CLOSED
+        self._consecutive = 0
+        self._opened_at = 0.0
+        self._export()
+
+    def _export(self):
+        _M_BREAKER_STATE.set(self._GAUGE[self._state], breaker=self.name)
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            # Surface the half-open transition even if nobody called
+            # allow() yet — the gauge should reflect admissibility.
+            if (self._state == self.OPEN
+                    and self.clock() - self._opened_at >= self.cooldown_s):
+                return self.HALF_OPEN
+            return self._state
+
+    def allow(self) -> bool:
+        """Admission check; flips open -> half-open once the cooldown
+        has elapsed (the next admitted request IS the probe)."""
+        with self._lock:
+            if self._state == self.OPEN:
+                if self.clock() - self._opened_at < self.cooldown_s:
+                    return False
+                self._state = self.HALF_OPEN
+                self._export()
+            return True
+
+    def _open(self):
+        self._state = self.OPEN
+        self._opened_at = self.clock()
+        self._consecutive = 0
+        self._export()
+        _M_BREAKER_OPEN.inc(breaker=self.name)
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._state = self.CLOSED
+            self._consecutive = 0
+            self._export()
+
+    def record_failure(self) -> None:
+        with self._lock:
+            if self._state == self.HALF_OPEN:
+                self._open()                 # failed probe: straight back
+                return
+            self._consecutive += 1
+            if self._state == self.CLOSED \
+                    and self._consecutive >= self.failures:
+                self._open()
+
+
+@dataclass
+class ResiliencePolicy:
+    """The umbrella policy the product surface takes.
+
+    Retry: ``retry`` (a :class:`RetryPolicy`) wraps compile, execute,
+    and measurement calls wherever the policy is threaded.
+
+    Residual gate / degradation ladder (``resilience/degrade.py``,
+    driver solves): a result whose ``rel_residual`` exceeds
+    ``gate_tol * eps * n * kappa`` (eps of ``gate_dtype`` when set, else
+    of the solve's own result dtype; NaN always fails) escalates —
+    ``refine_steps`` of Newton-Schulz iterative refinement first, then
+    (``escalate=True``) a higher-precision re-solve up the PRECISIONS /
+    dtype ladder — with every rung recorded on ``SolveResult.recovery``
+    and as ``recover`` span children.  A ladder that exhausts without
+    passing raises :class:`ResidualGateError`: a wrong inverse is never
+    returned silently.
+
+    Breaker (serve): ``breaker_failures`` consecutive terminal executor
+    failures open a per-bucket breaker for ``breaker_cooldown_s``.
+    """
+
+    retry: RetryPolicy = field(default_factory=RetryPolicy)
+    gate_tol: float = 16.0
+    gate_dtype: Any = None
+    refine_steps: int = 2
+    escalate: bool = True
+    breaker_failures: int = 3
+    breaker_cooldown_s: float = 5.0
+
+
+class ResidualGateError(ArithmeticError):
+    """The degradation ladder exhausted every rung (refine, then the
+    escalated re-solve) without the residual gate passing — surfaced
+    typed instead of returning a known-bad inverse."""
+
+    def __init__(self, msg: str, recovery: tuple = ()):
+        super().__init__(msg)
+        self.recovery = recovery
+
+
+#: The defaults the serving layer uses when no policy is passed: two
+#: retries with a short capped backoff, the standard gate, K=3 breaker.
+DEFAULT_POLICY = ResiliencePolicy(
+    retry=RetryPolicy(max_retries=2, backoff_s=0.01, max_backoff_s=0.25))

@@ -21,20 +21,33 @@ Four parts (docs/SERVING.md is the operator guide):
   * ``stats`` — per-bucket counters (requests, batches, mean occupancy,
     compiles, cache hits, singular count) and p50/p95/p99 queue +
     execute latency percentiles, surfaced via ``service.stats()``.
+
+Resilience (ISSUE 5, docs/RESILIENCE.md): ``JordanService(policy=,
+default_deadline_ms=)`` attaches transient retry + a result-integrity
+gate on batch execution, typed per-request deadlines
+(:class:`DeadlineExceededError` over queue wait + execute), and
+per-bucket circuit breakers (:class:`CircuitOpenError` fast-fail while
+open, half-open probe after the cooldown) — on by default via
+``resilience.DEFAULT_POLICY``.  ``chaos_demo`` (CLI ``--chaos-demo``)
+proves the whole stack against a fault-free replay under seeded
+deterministic fault injection.
 """
 
+from ..resilience.policy import (CircuitOpenError, DeadlineExceededError,
+                                 ResultCorruptionError)
 from .batcher import (InvertResult, MicroBatcher, ServiceClosedError,
                       ServiceOverloadedError)
 from .executors import (MIN_BUCKET_N, BucketExecutor, ExecutorCache,
                         ExecutorKey, bucket_for)
-from .service import JordanService, serve_demo
+from .service import JordanService, chaos_demo, serve_demo
 from .stats import ServeStats
 
 __all__ = [
     "InvertResult", "MicroBatcher", "ServiceClosedError",
     "ServiceOverloadedError",
+    "CircuitOpenError", "DeadlineExceededError", "ResultCorruptionError",
     "MIN_BUCKET_N", "BucketExecutor", "ExecutorCache", "ExecutorKey",
     "bucket_for",
-    "JordanService", "serve_demo",
+    "JordanService", "chaos_demo", "serve_demo",
     "ServeStats",
 ]

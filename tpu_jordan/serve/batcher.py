@@ -21,6 +21,28 @@ Admission control is the caller's thread: a full bounded queue raises
 :class:`ServiceOverloadedError` at ``submit`` time — typed backpressure,
 never a silent drop.  An execution failure resolves every future of its
 batch with the exception, same contract.
+
+Resilience (ISSUE 5, docs/RESILIENCE.md) — when a
+``resilience.ResiliencePolicy`` is attached:
+
+  * **Retry + integrity gate** — the per-batch executable run is
+    wrapped in ``policy.retry``: transient failures (and the
+    ``execute`` fault point) re-run the batch; a non-finite
+    rel_residual on any real, non-singular element (real corruption, or
+    the ``result_corrupt_nan`` fault point) raises the typed
+    :class:`~..resilience.policy.ResultCorruptionError`, which the
+    retry absorbs — a re-run clears transient corruption, so riders
+    still receive the bit-exact fault-free result.
+  * **Deadlines** — ``submit(..., deadline_s=)`` covers queue wait AND
+    execute: a request whose deadline passed is failed with the typed
+    :class:`~..resilience.policy.DeadlineExceededError` at dispatch
+    (before riding a doomed batch) or at fan-out (its batch finished
+    too late) — never a hang, never a silent drop.
+  * **Circuit breaker** — per-bucket (held by the
+    :class:`~.executors.ExecutorCache`): K consecutive terminal batch
+    failures open the bucket; ``submit`` then fast-fails with
+    :class:`~..resilience.policy.CircuitOpenError` instead of queueing
+    doomed work, until a half-open probe succeeds after the cooldown.
 """
 
 from __future__ import annotations
@@ -32,6 +54,11 @@ from concurrent.futures import Future
 from dataclasses import dataclass
 
 import numpy as np
+
+from ..obs import metrics as _obs_metrics
+from ..resilience import faults as _faults
+from ..resilience.policy import (CircuitOpenError, DeadlineExceededError,
+                                 ResultCorruptionError)
 
 
 class ServiceOverloadedError(RuntimeError):
@@ -68,6 +95,7 @@ class _Request:
     bucket_n: int
     t_enqueue: float
     future: Future
+    t_deadline: float | None = None   # absolute perf_counter deadline
 
 
 class MicroBatcher:
@@ -79,7 +107,7 @@ class MicroBatcher:
     def __init__(self, executors, stats, batch_cap: int = 8,
                  max_wait_ms: float = 2.0, max_queue: int = 256,
                  block_size: int | None = None, autostart: bool = True,
-                 telemetry=None):
+                 telemetry=None, policy=None):
         from ..obs.spans import NULL
 
         if batch_cap < 1:
@@ -88,6 +116,10 @@ class MicroBatcher:
             raise ValueError("max_queue must be >= 1")
         self.executors = executors
         self.stats = stats
+        # Resilience policy (ISSUE 5): retry/integrity-gate on the batch
+        # execution, deadline enforcement, breaker feedback.  None keeps
+        # the pre-resilience behavior exactly.
+        self.policy = policy
         # Telemetry (ISSUE 4): each dispatched batch is an "execute"
         # span (dispatcher-thread root; bucket/occupancy attrs), so the
         # wall time fanned to futures IS the span duration.
@@ -106,8 +138,22 @@ class MicroBatcher:
 
     # ---- caller side -------------------------------------------------
 
-    def submit(self, padded: np.ndarray, n: int, bucket_n: int) -> Future:
-        req = _Request(padded, n, bucket_n, time.perf_counter(), Future())
+    def submit(self, padded: np.ndarray, n: int, bucket_n: int,
+               deadline_s: float | None = None) -> Future:
+        br = self.executors.breaker(bucket_n) \
+            if self.policy is not None else None
+        if br is not None and not br.allow():
+            # Typed fast-fail instead of queueing doomed work: the
+            # bucket's executor has failed K consecutive times; a
+            # half-open probe is admitted once the cooldown elapses.
+            self.stats.rejected(bucket_n)
+            raise CircuitOpenError(
+                f"bucket {bucket_n} circuit open after repeated executor "
+                f"failures — retry after the cooldown")
+        now = time.perf_counter()
+        req = _Request(padded, n, bucket_n, now, Future(),
+                       t_deadline=(None if deadline_s is None
+                                   else now + float(deadline_s)))
         with self._cv:
             if self._closing:
                 raise ServiceClosedError("service is closed")
@@ -201,6 +247,10 @@ class MicroBatcher:
                         # a cancel into InvalidStateError.
                         batch = [r for r in batch
                                  if r.future.set_running_or_notify_cancel()]
+                        # Deadline, phase 1 (queue wait): a request
+                        # already past its deadline must not ride a
+                        # batch — fail it typed, here, before dispatch.
+                        batch = self._fail_expired(batch, "queue")
                         if not batch:
                             continue
                         break
@@ -209,10 +259,30 @@ class MicroBatcher:
                     self._cv.wait(self._next_deadline(now))
             self._execute(bucket, batch, now)
 
+    def _fail_expired(self, batch: list, phase: str) -> list:
+        """Split out requests past their deadline; fail them with the
+        typed error (counted, labeled by phase) and return the rest."""
+        now = time.perf_counter()
+        live = []
+        for req in batch:
+            if req.t_deadline is not None and now > req.t_deadline:
+                _obs_metrics.counter(
+                    "tpu_jordan_deadline_exceeded_total").inc(phase=phase)
+                if not req.future.done():
+                    req.future.set_exception(DeadlineExceededError(
+                        f"deadline exceeded in {phase} "
+                        f"(n={req.n}, bucket={req.bucket_n})"))
+            else:
+                live.append(req)
+        return live
+
     def _execute(self, bucket: int, batch: list, t_dispatch: float) -> None:
         import jax.numpy as jnp
 
+        br = self.executors.breaker(bucket) \
+            if self.policy is not None else None
         try:
+            _faults.fire("dispatch")
             ex = self.executors.get(bucket, self.batch_cap,
                                     self.block_size)
             dtype = jnp.dtype(ex.key.dtype)
@@ -225,27 +295,82 @@ class MicroBatcher:
                 n_real[i] = req.n
             from ..obs.spans import timed_blocking
 
-            (inv, sing, kappa, rel), esp = timed_blocking(
-                ex.run, jnp.asarray(stacked), jnp.asarray(n_real),
-                telemetry=self._tel, name="execute", bucket=bucket,
-                occupancy=len(batch))
-            exec_s = esp.duration
-            sing = np.asarray(sing)
-            kappa = np.asarray(kappa)
-            rel = np.asarray(rel)
+            def run_once():
+                _faults.fire("execute")
+                out, esp = timed_blocking(
+                    ex.run, jnp.asarray(stacked), jnp.asarray(n_real),
+                    telemetry=self._tel, name="execute", bucket=bucket,
+                    occupancy=len(batch))
+                inv, sing, kappa, rel = out
+                sing = np.asarray(sing)
+                kappa = np.asarray(kappa)
+                # Writable host copy: the corruption fault point (and
+                # nothing else) mutates it; np.asarray of a jax array
+                # is read-only.
+                rel = np.array(rel)
+                # Silent-corruption simulation: a corrupted inverse
+                # would carry a corrupted in-launch rel_residual
+                # (batch_metrics runs in the same executable), so
+                # poisoning a rider's rel IS the faithful signature the
+                # gate must catch.  Target the first NON-singular real
+                # element (the gate deliberately ignores singular ones,
+                # whose rel is already meaningless) and only consume the
+                # scheduled injection when such a target exists — an
+                # all-singular batch can't carry detectable corruption.
+                tgt = next((i for i in range(len(batch))
+                            if not sing[i]), None)
+                if tgt is not None \
+                        and _faults.corrupt("result_corrupt_nan"):
+                    rel[tgt] = np.nan
+                # Integrity gate: every real, non-singular element must
+                # report a finite rel_residual — the per-element number
+                # the same launch computed from its own inverse.  A
+                # non-finite one is corruption, typed and retryable
+                # (cheap: len(batch) scalar checks, no extra transfer).
+                bad = [i for i in range(len(batch))
+                       if not sing[i] and not np.isfinite(rel[i])]
+                if bad:
+                    raise ResultCorruptionError(
+                        f"non-finite rel_residual for batch elements "
+                        f"{bad} (bucket {bucket}) — corrupted result "
+                        f"detected by the integrity gate")
+                return inv, sing, kappa, rel, esp.duration
+
+            inv, sing, kappa, rel, exec_s = (
+                self.policy.retry.call(run_once, component="serve.execute")
+                if self.policy is not None else run_once())
         except BaseException as e:                  # noqa: BLE001
             # Fan the failure to every rider — a batch error must be N
             # explicit per-request failures, never a hang or a drop.
+            # ONE terminal-failure count per batch (not per rider): the
+            # unit the chaos accounting reconciles against injected
+            # faults (every raise-style injection either triggered a
+            # counted retry or terminated exactly one attempt chain).
+            _obs_metrics.counter(
+                "tpu_jordan_serve_batch_failures_total",
+                "dispatched batches that terminally failed (after any "
+                "retries) and fanned a typed error to their riders",
+            ).inc(bucket=bucket)
+            if br is not None:
+                br.record_failure()
             for req in batch:
                 if not req.future.done():
                     req.future.set_exception(e)
             return
+        if br is not None:
+            br.record_success()
 
         queue_waits = [t_dispatch - req.t_enqueue for req in batch]
         self.stats.batch(bucket, occupancy=len(batch),
                          exec_seconds=exec_s, queue_seconds=queue_waits,
                          singular=int(sing[:len(batch)].sum()))
+        # Deadline, phase 2 (execute): a batch that finished past a
+        # rider's deadline fails THAT rider typed; batch-mates are
+        # unaffected.
+        live = {id(r) for r in self._fail_expired(batch, "execute")}
         for i, req in enumerate(batch):
+            if id(req) not in live:
+                continue
             req.future.set_result(InvertResult(
                 inverse=inv[i, :req.n, :req.n],
                 n=req.n,

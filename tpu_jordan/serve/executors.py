@@ -33,6 +33,8 @@ import jax
 import jax.numpy as jnp
 
 from ..config import default_block_size
+from ..resilience import faults as _faults
+from ..resilience.policy import CircuitBreaker
 from ..tuning.plan_cache import PlanCache, n_bucket
 from ..tuning.registry import TunePoint
 from ..tuning.tuner import Tuner
@@ -84,6 +86,7 @@ class BucketExecutor:
         self._compiled = self._build()
 
     def _build(self):
+        _faults.fire("compile")
         from ..driver import batch_metrics
         from ..ops import batched_jordan_invert
         from ..ops.jordan import block_jordan_invert
@@ -143,7 +146,8 @@ class ExecutorCache:
     """
 
     def __init__(self, engine: str = "auto", plan_cache: str | None = None,
-                 dtype=jnp.float32, stats=None, telemetry=None):
+                 dtype=jnp.float32, stats=None, telemetry=None,
+                 policy=None, breaker_clock=None):
         from ..driver import resolve_engine
         from ..obs.spans import NULL
 
@@ -153,6 +157,14 @@ class ExecutorCache:
         self.engine, self.group = resolve_engine(engine, 0)
         self.dtype = jnp.dtype(dtype).name
         self.stats = stats
+        # Resilience (ISSUE 5): the policy drives compile-retry here and
+        # sizes the per-bucket circuit breakers the batcher consults
+        # (K consecutive terminal executor failures open a bucket;
+        # half-open probe after the cooldown).  ``breaker_clock`` is the
+        # injectable monotonic clock (tests fake the cooldown).
+        self.policy = policy
+        self._breaker_clock = breaker_clock
+        self._breakers: dict[int, CircuitBreaker] = {}
         # Telemetry (ISSUE 4): compiles are recorded as distinct
         # "compile" spans, so a warm server's trace has NONE — the
         # AOT-cache contract made visible.
@@ -165,6 +177,25 @@ class ExecutorCache:
         self._resolved: dict[tuple, tuple] = {}
         cache = PlanCache.load(plan_cache) if plan_cache else None
         self.tuner = Tuner(cache=cache)
+
+    def breaker(self, bucket_n: int) -> CircuitBreaker | None:
+        """The bucket's circuit breaker (created on demand; None when no
+        policy is attached — resilience off, nothing to trip)."""
+        if self.policy is None:
+            return None
+        with self._lock:
+            br = self._breakers.get(bucket_n)
+            if br is None:
+                br = self._breakers[bucket_n] = CircuitBreaker(
+                    failures=self.policy.breaker_failures,
+                    cooldown_s=self.policy.breaker_cooldown_s,
+                    clock=self._breaker_clock,
+                    name=f"serve_bucket_{bucket_n}")
+            return br
+
+    def breaker_states(self) -> dict[int, str]:
+        with self._lock:
+            return {b: br.state for b, br in self._breakers.items()}
 
     @property
     def measurements(self) -> int:
@@ -202,7 +233,15 @@ class ExecutorCache:
                 return ex
             with self._tel.span("compile", bucket=bucket_n,
                                 engine=engine, batch_cap=batch_cap):
-                ex = BucketExecutor(key, plan)
+                # Transient compile failures (the remote-compile class,
+                # or the `compile` fault point) are retried per the
+                # policy; a terminal failure propagates to the caller
+                # (the dispatcher fans it to the batch's riders).
+                def build():
+                    return BucketExecutor(key, plan)
+                ex = (self.policy.retry.call(build,
+                                             component="serve.compile")
+                      if self.policy is not None else build())
             self._executors[key] = ex
             if self.stats is not None:
                 self.stats.compile(bucket_n)

@@ -36,6 +36,7 @@ from concurrent.futures import Future
 import jax.numpy as jnp
 import numpy as np
 
+from ..resilience.policy import DEFAULT_POLICY
 from .batcher import (InvertResult, MicroBatcher, ServiceClosedError,
                       ServiceOverloadedError)
 from .executors import ExecutorCache, bucket_for
@@ -68,34 +69,57 @@ class JordanService:
         execute spans (a warm server's trace shows ZERO compile spans),
         and every counter mirrors into the process-wide
         ``obs.metrics.REGISTRY`` regardless (docs/OBSERVABILITY.md).
+      policy: the ``resilience.ResiliencePolicy`` (ISSUE 5,
+        docs/RESILIENCE.md).  The default ("default") is
+        ``resilience.DEFAULT_POLICY``: transient batch failures and
+        detected result corruption retried (2 retries, capped backoff),
+        per-bucket circuit breakers (K=3, typed ``CircuitOpenError``
+        fast-fail while open, half-open probe after the cooldown).
+        Pass ``None`` to turn the resilience layer off entirely.
+      default_deadline_ms: deadline applied to every ``submit``/
+        ``invert`` that doesn't pass its own ``deadline_ms`` — covers
+        queue wait + execute; an exceeded deadline resolves the future
+        with the typed ``DeadlineExceededError``.  None (default) means
+        no deadline.
     """
 
     def __init__(self, engine: str = "auto", plan_cache: str | None = None,
                  dtype=jnp.float32, batch_cap: int = 8,
                  max_wait_ms: float = 2.0, max_queue: int = 256,
                  block_size: int | None = None, autostart: bool = True,
-                 telemetry=None):
+                 telemetry=None, policy="default",
+                 default_deadline_ms: float | None = None):
         self.dtype = jnp.dtype(dtype)
         self.batch_cap = int(batch_cap)
         self.telemetry = telemetry
+        self.policy = DEFAULT_POLICY if policy == "default" else policy
+        self.default_deadline_ms = default_deadline_ms
         self._stats = ServeStats()
         self.executors = ExecutorCache(engine=engine, plan_cache=plan_cache,
                                        dtype=self.dtype, stats=self._stats,
-                                       telemetry=telemetry)
+                                       telemetry=telemetry,
+                                       policy=self.policy)
         self._batcher = MicroBatcher(
             self.executors, self._stats, batch_cap=batch_cap,
             max_wait_ms=max_wait_ms, max_queue=max_queue,
             block_size=block_size, autostart=autostart,
-            telemetry=telemetry)
+            telemetry=telemetry, policy=self.policy)
         self._closed = False
 
     # ---- request path ------------------------------------------------
 
-    def submit(self, a) -> Future:
+    def submit(self, a, deadline_ms: float | None = None) -> Future:
         """Queue one (n, n) matrix; returns a future resolving to
         :class:`InvertResult`.  Raises :class:`ServiceOverloadedError`
-        when the bounded queue is full (backpressure — retry later) and
-        :class:`ServiceClosedError` after ``close()``."""
+        when the bounded queue is full (backpressure — retry later),
+        :class:`~..resilience.policy.CircuitOpenError` while the
+        bucket's breaker is open (fast-fail — doomed work is not
+        queued), and :class:`ServiceClosedError` after ``close()``.
+
+        ``deadline_ms`` (default: the service's ``default_deadline_ms``)
+        bounds queue wait + execute; exceeding it resolves the future
+        with the typed
+        :class:`~..resilience.policy.DeadlineExceededError`."""
         a = np.asarray(a, self.dtype)
         if a.ndim != 2 or a.shape[0] != a.shape[1]:
             raise ValueError(f"expected a square (n, n) matrix, "
@@ -104,20 +128,26 @@ class JordanService:
         bucket = bucket_for(n)
         padded = np.asarray(np.eye(bucket, dtype=self.dtype))
         padded[:n, :n] = a
-        return self._batcher.submit(padded, n, bucket)
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        return self._batcher.submit(
+            padded, n, bucket,
+            deadline_s=(None if deadline_ms is None
+                        else float(deadline_ms) / 1e3))
 
     @staticmethod
     def result(future: Future, timeout: float | None = None) -> InvertResult:
         """Block on a submitted future (sugar over ``future.result``)."""
         return future.result(timeout)
 
-    def invert(self, a, timeout: float | None = None) -> InvertResult:
+    def invert(self, a, timeout: float | None = None,
+               deadline_ms: float | None = None) -> InvertResult:
         """Synchronous submit + wait.  Raises
         :class:`~..driver.SingularMatrixError` when THIS request's
         element was flagged (batch-mates are unaffected either way —
         the async ``submit`` path reports the flag on the result
         instead, for callers that want to inspect rather than raise)."""
-        res = self.submit(a).result(timeout)
+        res = self.submit(a, deadline_ms=deadline_ms).result(timeout)
         if res.singular:
             from ..driver import SingularMatrixError
 
@@ -175,6 +205,8 @@ class JordanService:
         snap["measurements"] = self.executors.measurements
         snap["batch_cap"] = self.batch_cap
         snap["queued"] = self._batcher.queued
+        snap["breakers"] = {str(b): s for b, s
+                            in self.executors.breaker_states().items()}
         return snap
 
 
@@ -240,3 +272,205 @@ def serve_demo(n: int, block_size: int | None = None, requests: int = 64,
         "elapsed_s": round(elapsed, 3),
         "stats": stats,
     }
+
+
+def _chaos_requests(n: int, requests: int, seed: int, dtype):
+    """The deterministic mixed request stream both chaos-demo passes
+    share: sizes cycle {n, n/2} (>= 2 shape buckets at n >= 2·MIN),
+    well-conditioned standard-normal fixtures from one seeded stream,
+    plus deliberately singular (rank-1) matrices sprinkled at fixed
+    indices — their typed per-element flags must survive the chaos."""
+    rng = np.random.default_rng(seed)
+    sizes = [max(1, n), max(1, n // 2)]
+    mats = []
+    for i in range(requests):
+        s = sizes[i % len(sizes)]
+        if i % 17 == 5:
+            mats.append(np.ones((s, s), dtype))      # rank 1: singular
+        else:
+            mats.append(rng.standard_normal((s, s)).astype(dtype))
+    return mats
+
+
+def _run_stream(svc, mats, timeout: float = 600.0):
+    """Submit a staged request stream (deterministic batching: queue
+    everything, then start the dispatcher) and classify every response:
+    ("ok", inverse-bytes, singular) or ("error", type-name).  A typed
+    submit-time rejection (breaker fast-fail, backpressure) is an
+    "error" outcome like any other — never an unhandled crash."""
+    futs = []
+    for a in mats:
+        try:
+            futs.append(svc.submit(a))
+        except Exception as e:                       # noqa: BLE001
+            futs.append(e)
+    svc.start()
+    out = []
+    for f in futs:
+        if isinstance(f, Exception):
+            out.append(("error", type(f).__name__, None))
+            continue
+        try:
+            r = f.result(timeout)
+            out.append(("ok", np.asarray(r.inverse).tobytes(),
+                        bool(r.singular)))
+        except Exception as e:                       # noqa: BLE001
+            out.append(("error", type(e).__name__, None))
+    return out
+
+
+def chaos_demo(n: int = 96, block_size: int | None = None,
+               requests: int = 50, batch_cap: int = 4,
+               max_wait_ms: float = 2.0, seed: int = 0,
+               dtype=jnp.float32, plan_cache: str | None = None,
+               telemetry=None) -> dict:
+    """The ``--chaos-demo`` CLI mode's engine (ISSUE 5 acceptance): the
+    same deterministic mixed request stream is served twice — once
+    fault-free (the replay baseline), once under a seeded
+    :class:`~..resilience.faults.FaultPlan` injecting compile failures,
+    transient execute errors, NaN result corruption, and plan-cache
+    write failures — and every chaos response must either bit-match the
+    fault-free run of the same request or carry a typed error.  The
+    report accounts for every injected fault as retried, degraded, or
+    typed-error (``tools/check_chaos.py`` validates; none silent).
+    """
+    import tempfile
+    import time
+
+    from ..obs.metrics import REGISTRY
+    from ..resilience import FaultPlan, ResiliencePolicy
+    from ..resilience import activate as _activate
+    from ..resilience.policy import RetryPolicy
+
+    t0 = time.perf_counter()
+    mats = _chaos_requests(n, requests, seed, jnp.dtype(dtype))
+    shapes = sorted({a.shape[0] for a in mats})
+    # Retry budget sized so every seeded injection is absorbable even if
+    # the schedule lands several faults on ONE dispatch (each retry
+    # advances the nth-call counter): execute(3) + corrupt(2) worst-case
+    # stack on a single batch, plus headroom.
+    policy = ResiliencePolicy(
+        retry=RetryPolicy(max_retries=6, backoff_s=0.0))
+
+    def make_service(cache_path):
+        svc = JordanService(engine="auto", plan_cache=cache_path,
+                            dtype=dtype, batch_cap=batch_cap,
+                            max_wait_ms=max_wait_ms,
+                            max_queue=max(requests, 1),
+                            block_size=block_size, autostart=False,
+                            telemetry=telemetry, policy=policy)
+        svc.warmup(shapes=shapes)
+        return svc
+
+    # ---- pass 1: the fault-free replay baseline ---------------------
+    with make_service(None) as svc:
+        baseline = _run_stream(svc, mats)
+
+    # ---- the seeded fault plan (FaultPlan.seeded — the ONE schedule
+    # builder).  Per-point horizons sized to how often each point is
+    # actually reached: compile/plan_cache_write fire during the
+    # 2-bucket warmup (~2 calls each), execute/corrupt once per
+    # dispatched batch (>= requests / batch_cap).
+    exec_horizon = max(4, requests // max(1, batch_cap) // 2)
+    plan = FaultPlan.seeded(seed, points={
+        "compile": (1, 2),
+        "execute": (3, exec_horizon),
+        "result_corrupt_nan": (2, exec_horizon),
+        "plan_cache_write": (1, 2),
+    })
+
+    # ---- pass 2: the same stream under injected chaos ---------------
+    def counters():
+        return {
+            "retries": REGISTRY.counter(
+                "tpu_jordan_retries_total").total(),
+            "plan_cache_write_failures": REGISTRY.counter(
+                "tpu_jordan_plan_cache_write_failures_total").total(),
+            "recovery_rungs": REGISTRY.counter(
+                "tpu_jordan_recovery_rungs_total").total(),
+            "breaker_opens": REGISTRY.counter(
+                "tpu_jordan_breaker_open_total").total(),
+            "deadline_exceeded": REGISTRY.counter(
+                "tpu_jordan_deadline_exceeded_total").total(),
+            "batch_failures": REGISTRY.counter(
+                "tpu_jordan_serve_batch_failures_total").total(),
+        }
+
+    before = counters()
+    cache_dir = None
+    if plan_cache is None:
+        cache_dir = tempfile.mkdtemp(prefix="tpu_jordan_chaos_")
+        plan_cache = f"{cache_dir}/plans.json"
+    try:
+        with _activate(plan):
+            with make_service(plan_cache) as svc:
+                chaos = _run_stream(svc, mats)
+    finally:
+        if cache_dir is not None:
+            import shutil
+
+            shutil.rmtree(cache_dir, ignore_errors=True)
+    delta = {k: counters()[k] - before[k] for k in before}
+
+    # ---- compare against the fault-free replay ----------------------
+    matched = singular = 0
+    typed_errors: dict[str, int] = {}
+    mismatches = []
+    for i, (base, under) in enumerate(zip(baseline, chaos)):
+        if under[0] == "error":
+            typed_errors[under[1]] = typed_errors.get(under[1], 0) + 1
+            continue
+        if base[0] != "ok":
+            mismatches.append({"request": i, "why": (
+                f"baseline failed ({base[1]}) but chaos succeeded")})
+            continue
+        if under[2] != base[2]:
+            mismatches.append({"request": i,
+                               "why": "singular flag diverged"})
+        elif under[1] != base[1]:
+            mismatches.append({"request": i,
+                               "why": "inverse bits diverged"})
+        else:
+            matched += 1
+            singular += int(under[2])
+
+    # ---- fault accounting: none silent ------------------------------
+    # Units are FAULT EVENTS, not rider responses: every raise-style or
+    # corrupt injection either triggered one counted retry or
+    # terminated exactly one attempt chain (one terminal batch failure,
+    # however many riders it fanned to), and plan-cache write faults
+    # degraded.  So injected == retried + degraded + terminal holds
+    # exactly for an honest run — a positive remainder is a silently
+    # absorbed fault, and per-rider fan-out can no longer mask one by
+    # driving the ledger negative.
+    injected = plan.injected_total
+    typed_total = sum(typed_errors.values())
+    degraded = delta["plan_cache_write_failures"] + delta["recovery_rungs"]
+    terminal = delta["batch_failures"]
+    unaccounted = int(injected - delta["retries"] - degraded - terminal)
+    report = {
+        "metric": "chaos_demo",
+        "requests": requests,
+        "request_sizes": sorted({a.shape[0] for a in mats}, reverse=True),
+        "seed": seed,
+        "batch_cap": batch_cap,
+        "faults": plan.report(),
+        "accounting": {
+            "injected": injected,
+            "retried": delta["retries"],
+            "degraded": degraded,
+            "terminal_failures": terminal,
+            "typed_error_responses": typed_total,
+            "unaccounted": unaccounted,
+        },
+        "counters_delta": delta,
+        "matched_bitwise": matched,
+        "singular_flagged": singular,
+        "typed_errors": typed_errors,
+        "mismatches": mismatches,
+        # Negative unaccounted (more retries/failures than injections —
+        # a REAL transient happened during the run) is not corruption.
+        "silent_corruption": bool(mismatches) or unaccounted > 0,
+        "elapsed_s": round(time.perf_counter() - t0, 3),
+    }
+    return report

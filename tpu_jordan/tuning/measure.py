@@ -24,12 +24,16 @@ What "robust" means here, in order of the failure modes it closes:
     ``variance_flag`` string is set so a noisy session can never
     masquerade as a code regression (or improvement).
   * **Transient retry via a typed classifier** — ``is_transient`` /
-    ``retry_transient`` (moved here from bench.py, which now imports
-    them): one retry on the documented-transient remote-compile/transport
-    failure class, and ONLY when the exception TYPE is a runtime or
-    transport error — substring matching alone once let an accuracy
-    AssertionError that merely quoted "INTERNAL" trigger a full n=16384
-    re-run (ADVICE r5).
+    ``retry_transient`` now live in ``resilience/policy.py`` (ISSUE 5
+    satellite: ONE classifier, ONE backoff implementation, retries
+    counted in ``tpu_jordan_retries_total``) and are re-exported here
+    for compatibility: one retry on the documented-transient
+    remote-compile/transport failure class, and ONLY when the exception
+    TYPE is a runtime or transport error — substring matching alone
+    once let an accuracy AssertionError that merely quoted "INTERNAL"
+    trigger a full n=16384 re-run (ADVICE r5).  The ``measure`` fault
+    point (``resilience/faults.py``) fires inside every timed call, so
+    the retry path is deterministically testable.
 """
 
 from __future__ import annotations
@@ -39,43 +43,17 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..resilience import faults as _faults
+from ..resilience.policy import (RetryPolicy, is_transient,  # noqa: F401
+                                 retry_transient)
+
 VARIANCE_FLAG_PCT = 10.0     # accepted-sample spread above this is noisy
 
-_RETRYABLE = ("INTERNAL", "remote_compile", "read body", "DEADLINE")
-
-
-def is_transient(e: Exception) -> bool:
-    """Transient = a runtime/transport exception TYPE carrying one of the
-    documented-transient message markers.  Both conditions required (see
-    module docstring for why substring matching alone is not enough)."""
-    if not any(s in str(e) for s in _RETRYABLE):
-        return False
-    types = [OSError, ConnectionError, TimeoutError]    # tunnel/transport
-    try:
-        from jax.errors import JaxRuntimeError
-        types.append(JaxRuntimeError)
-    except ImportError:
-        pass
-    try:
-        from jaxlib.xla_extension import XlaRuntimeError
-        types.append(XlaRuntimeError)
-    except ImportError:
-        pass
-    return isinstance(e, tuple(types))
-
-
-def retry_transient(fn):
-    """One retry on the documented-transient remote-compile failure class
-    (benchmarks/PHASES.md: the same program passes minutes later; the
-    round-4 headline capture was lost to exactly one such failure).
-    Anything else — including accuracy/singularity assertions — is a real
-    result and propagates immediately."""
-    try:
-        return fn()
-    except Exception as e:                      # noqa: BLE001
-        if is_transient(e):
-            return fn()
-        raise
+# The measurement core's own retry discipline, expressed as the shared
+# policy object (bench.py and the tuner both ride this): one retry, no
+# backoff, strict transient classification.
+MEASURE_RETRY = RetryPolicy(max_retries=1, backoff_s=0.0,
+                            classify=is_transient)
 
 
 @dataclass(frozen=True)
@@ -130,14 +108,19 @@ def robust_stats(samples, flag_pct: float = VARIANCE_FLAG_PCT
 def measure_direct(fn, samples: int = 5, warmup: int = 1) -> Measurement:
     """Time ``fn()`` (which must block until its work is done) ``samples``
     times after ``warmup`` untimed calls; each call gets the one-shot
-    transient retry.  The tuner's measurement primitive for full engine
+    transient retry (``MEASURE_RETRY``) and crosses the ``measure``
+    fault point.  The tuner's measurement primitive for full engine
     executions."""
+    def call():
+        _faults.fire("measure")
+        return fn()
+
     for _ in range(warmup):
-        retry_transient(fn)
+        MEASURE_RETRY.call(call, component="measure")
     ts = []
     for _ in range(samples):
         t0 = time.perf_counter()
-        retry_transient(fn)
+        MEASURE_RETRY.call(call, component="measure")
         ts.append(time.perf_counter() - t0)
     return robust_stats(ts)
 

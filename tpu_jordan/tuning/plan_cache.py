@@ -25,9 +25,17 @@ import os
 import tempfile
 from dataclasses import dataclass, field, asdict
 
+from ..obs import metrics as _obs_metrics
+from ..resilience import faults as _faults
 from .registry import TunePoint
 
 CACHE_VERSION = 1
+
+_M_WRITE_FAILS = _obs_metrics.counter(
+    "tpu_jordan_plan_cache_write_failures_total",
+    "plan-cache saves that failed (disk full / read-only dir) and "
+    "degraded to in-memory plans — a warning, never an exception out "
+    "of a successful solve")
 
 
 def n_bucket(n: int) -> int:
@@ -114,6 +122,10 @@ class PlanCache:
         #: None on a clean load.  Surfaced so operators can see that a
         #: cache was ignored rather than silently empty.
         self.fallback_reason = fallback_reason
+        #: the last save failure (OSError string); None while writes
+        #: succeed.  In-memory plans keep serving either way (ISSUE 5
+        #: satellite: a full disk degrades, it does not crash a solve).
+        self.last_write_error: str | None = None
 
     @classmethod
     def load(cls, path: str) -> "PlanCache":
@@ -150,24 +162,40 @@ class PlanCache:
 
     def save(self, path: str | None = None) -> None:
         """Atomic write (tmp file + ``os.replace`` in the destination
-        directory) of the versioned document."""
+        directory) of the versioned document.
+
+        A write failure (disk full, read-only dir — simulated by the
+        ``plan_cache_write`` fault point) DEGRADES instead of raising:
+        the in-memory plans keep serving every subsequent selection,
+        ``tpu_jordan_plan_cache_write_failures_total`` counts the
+        warning, and ``last_write_error`` carries the diagnostic.  A
+        failed persistence must never fail the successful solve that
+        triggered it (ISSUE 5 satellite); later saves retry — transient
+        disk pressure may clear."""
         path = path or self.path
         if path is None:
             return
         doc = {"version": CACHE_VERSION,
                "plans": {k: p.to_json() for k, p in
                          sorted(self.plans.items())}}
-        d = os.path.dirname(os.path.abspath(path))
-        os.makedirs(d, exist_ok=True)
-        fd, tmp = tempfile.mkstemp(dir=d, suffix=".plan.tmp")
         try:
-            with os.fdopen(fd, "w") as f:
-                json.dump(doc, f, indent=1, sort_keys=True)
-                f.write("\n")
-            os.replace(tmp, path)
-        except BaseException:
+            _faults.fire("plan_cache_write")
+            d = os.path.dirname(os.path.abspath(path))
+            os.makedirs(d, exist_ok=True)
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".plan.tmp")
             try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            raise
+                with os.fdopen(fd, "w") as f:
+                    json.dump(doc, f, indent=1, sort_keys=True)
+                    f.write("\n")
+                os.replace(tmp, path)
+            except BaseException:
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+                raise
+        except OSError as e:
+            self.last_write_error = str(e)
+            _M_WRITE_FAILS.inc()
+            return
+        self.last_write_error = None
