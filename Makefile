@@ -13,7 +13,7 @@ WORKERS  ?= 1
 REQUESTS  ?= 64
 BATCH_CAP ?= 8
 
-.PHONY: all native tpu test smoke serve-demo chaos-demo metrics-demo bench clean
+.PHONY: all native tpu test smoke serve-demo chaos-demo metrics-demo bench bench-dip clean
 
 all: native
 
@@ -72,6 +72,14 @@ metrics-demo:
 
 bench: native
 	python bench.py
+
+# The 4096² dip guard row alone (ISSUE 6 satellite; BASELINE.md "The
+# r04→r05 4096² dip"): plain + fused-Pallas 4096² captures with
+# median-of-3 spread, compared against the BENCH_r04 11.8 TF/s
+# reference — `regressed` flips only when the shortfall exceeds 10%
+# AND the session's own spread cannot explain it.
+bench-dip: native
+	python bench.py --dip-guard
 
 clean:
 	rm -f tpu_jordan/_native.so

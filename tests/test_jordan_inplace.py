@@ -12,6 +12,7 @@ from tpu_jordan.ops.jordan_inplace import (
     block_jordan_invert_inplace_fori,
     block_jordan_invert_inplace_grouped,
     block_jordan_invert_inplace_grouped_fori,
+    block_jordan_invert_inplace_grouped_pallas,
 )
 
 
@@ -235,6 +236,64 @@ class TestInplaceForiEngine:
         nz = jnp.isfinite(x_u) & jnp.isfinite(x_f)
         assert bool(jnp.all(jnp.where(nz, x_u == x_f, True)))
         assert bool(jnp.all(jnp.isfinite(x_u) == jnp.isfinite(x_f)))
+
+    @pytest.mark.parametrize("n,m,k", [
+        (64, 16, 2),     # the production group size
+        (50, 8, 4),      # ragged n + tail group (Nr % k != 0)
+        # tier-1 headroom (the 870 s rule): the wider-group and k=3
+        # closing-step variants run nightly; tier-1 keeps the
+        # production k=2 + the ragged/tail case + both generators.
+        pytest.param(96, 16, 4, marks=pytest.mark.slow),
+        pytest.param(64, 16, 3, marks=pytest.mark.slow)])
+    def test_grouped_pallas_bitmatches_grouped(self, rng, n, m, k):
+        """ISSUE 6 bit-match pin (the swap-free-pin pattern from PR 1):
+        the fused-Pallas-update engine at fp32 must reproduce the XLA
+        grouped engine bit for bit on nonsingular matrices — same pivot
+        sequence, element-for-element identical arithmetic in the fused
+        kernel's full-contraction dots."""
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float32)
+        x_g, s_g = block_jordan_invert_inplace_grouped(
+            a, block_size=m, group=k)
+        x_p, s_p = block_jordan_invert_inplace_grouped_pallas(
+            a, block_size=m, group=k, interpret=True)
+        assert bool(s_g) == bool(s_p) is False
+        assert bool(jnp.all(x_g == x_p)), (
+            f"grouped_pallas diverged bitwise at n={n} m={m} k={k}")
+
+    @pytest.mark.parametrize("gen", [
+        "absdiff",        # zero diagonal: every group needs real swaps
+        pytest.param("rand", marks=pytest.mark.slow)])
+    def test_grouped_pallas_bitmatch_generators(self, gen):
+        # absdiff: zero diagonal — every group needs real pivot swaps,
+        # so the kernel's swap-following bookkeeping is exercised.
+        a = generate(gen, (96, 96), jnp.float32)
+        x_g, s_g = block_jordan_invert_inplace_grouped(a, block_size=16,
+                                                       group=2)
+        x_p, s_p = block_jordan_invert_inplace_grouped_pallas(
+            a, block_size=16, group=2, interpret=True)
+        assert bool(s_g) == bool(s_p) is False
+        assert bool(jnp.all(x_g == x_p))
+
+    def test_grouped_pallas_singular_flag(self):
+        _, sing = block_jordan_invert_inplace_grouped_pallas(
+            jnp.ones((32, 32), jnp.float32), block_size=8, group=2,
+            interpret=True)
+        assert bool(sing)
+
+    def test_grouped_pallas_bf16_inverts(self, rng):
+        # The bf16 mode is NOT bit-matched (operands are rounded by
+        # design); it must still invert a bf16-well-conditioned matrix
+        # to bf16-grade accuracy.  κ·eps_bf16 must stay << 1 for bf16
+        # compute to have any digits, hence the dominant diagonal.
+        n = 64
+        a = jnp.asarray(rng.standard_normal((n, n)) + n * np.eye(n),
+                        jnp.float32)
+        x, sing = block_jordan_invert_inplace_grouped_pallas(
+            a, block_size=16, group=2, mode="bf16", interpret=True)
+        assert not bool(sing)
+        res = np.max(np.abs(np.asarray(a, np.float64)
+                            @ np.asarray(x, np.float64) - np.eye(n)))
+        assert res < 0.05
 
     def test_driver_routes_large_nr_through_fori(self):
         # single_device_invert must hand Nr > MAX_UNROLL_NR to the 2N³

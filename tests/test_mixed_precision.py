@@ -100,3 +100,94 @@ def test_cli_precision_flag():
     from tpu_jordan.__main__ import main
 
     assert main(["64", "16", "--precision", "mixed", "--quiet"]) == 0
+
+
+class TestGroupedPallasBf16Path:
+    """ISSUE 6: the bf16-compute/fp32-accumulate fused-kernel path, end
+    to end through the driver — every bf16 result either passes the
+    residual gate or carries a recovery record, never a silent degraded
+    inverse (the arXiv:2112.09017 bf16 + iterative-refinement recipe
+    with the PR 5 ladder as the safety net)."""
+
+    def _well_conditioned_file(self, tmp_path, n):
+        # κ·eps_bf16 << 1 is the precondition for bf16 compute to carry
+        # any digits: a dominant diagonal keeps κ∞ at a few.
+        from tpu_jordan.io import write_matrix_file
+
+        rng = np.random.default_rng(3)
+        a = rng.standard_normal((n, n)) + n * np.eye(n)
+        path = str(tmp_path / "wc.mat")
+        write_matrix_file(path, a)
+        return path
+
+    def test_well_conditioned_passes_gate_zero_rungs(self, tmp_path):
+        # The default policy is auto-attached (no policy argument): the
+        # gate runs at bf16 eps and a bf16-grade residual on a
+        # bf16-well-conditioned matrix is a PASS — zero ladder rungs.
+        n = 64
+        path = self._well_conditioned_file(tmp_path, n)
+        r = solve(n, 16, file=path, engine="grouped_pallas_bf16")
+        assert r.engine == "grouped_pallas_bf16"
+        assert r.recovery == ()
+        assert r.rel_residual < 0.05          # bf16-grade, honest number
+
+    @pytest.mark.slow   # tier-1 keeps the resolve-rung pin below plus
+    # PR 5's refine→resolve walk on the generic path
+    # (test_resilience.py::test_bf16_fails_gate_recovers_refine_then_fp32)
+    def test_ill_conditioned_recovers_refine_or_resolve(self, tmp_path):
+        # An fp32-strict accuracy SLO (gate_dtype) on a bf16 solve:
+        # the bf16-grade residual fails the gate and the ladder must
+        # recover — rungs recorded on SolveResult.recovery, final gate
+        # passed, never an exception and never a silent bf16-grade
+        # return.
+        from tpu_jordan.resilience.policy import ResiliencePolicy
+
+        # gate_tol=1e-3: κ∞ computed from the bf16-grade inverse is
+        # inflated ~30x (‖X‖∞ carries the error), which at the default
+        # tol=16 pushes even the fp32-eps gate past the bf16 residual;
+        # the tighter SLO is the realistic "I need fp32-grade numbers"
+        # setting (threshold ≈ 3.6e-3 here vs the bf16 rel ≈ 7.7e-2).
+        pol = ResiliencePolicy(gate_dtype="float32", gate_tol=1e-3)
+        r = solve(n=96, block_size=16, engine="grouped_pallas_bf16",
+                  policy=pol)
+        assert len(r.recovery) >= 1
+        assert r.recovery[-1]["passed"]
+        assert [x["rung"] for x in r.recovery][-1] in ("refine", "resolve")
+        # The recovered number is fp32-grade (the SLO's whole point).
+        assert r.rel_residual < 1e-3
+
+    def test_resolve_rung_escalates_to_fp32_engine(self):
+        # refine_steps=0 forces the ladder straight to the re-solve
+        # rung, which must escalate the ENGINE to the fp32 fused-kernel
+        # sibling (full-precision dots), recorded with its dtype.
+        from tpu_jordan.resilience.policy import ResiliencePolicy
+
+        pol = ResiliencePolicy(gate_dtype="float32", gate_tol=1e-3,
+                               refine_steps=0)
+        r = solve(n=96, block_size=16, engine="grouped_pallas_bf16",
+                  policy=pol)
+        assert [x["rung"] for x in r.recovery] == ["resolve"]
+        assert r.recovery[0]["passed"]
+        assert r.recovery[0]["dtype"] == "float32"
+        assert r.rel_residual < 1e-3
+
+    @pytest.mark.slow       # tier-1 keeps the cheap threshold pin below
+    def test_no_inverse_never_passes_gate(self):
+        # The gaussian fixture at n=96 has κ·eps_bf16 >> 1: bf16
+        # compute produces ‖I−AX‖ ≈ ‖I‖ — no inverse.  The 0.5 gate
+        # ceiling (resilience/degrade.py) must catch it even at bf16
+        # eps, and the auto-attached ladder must deliver a real
+        # (recovered) inverse with the walk on record.
+        r = solve(n=96, block_size=16, engine="grouped_pallas_bf16",
+                  generator="rand")
+        assert len(r.recovery) >= 1
+        assert r.recovery[-1]["rung"] == "resolve"
+        assert r.recovery[-1]["passed"]
+        assert r.rel_residual < 1e-3
+
+    def test_gate_threshold_capped(self):
+        from tpu_jordan.resilience.degrade import gate_threshold
+        from tpu_jordan.resilience.policy import DEFAULT_POLICY
+
+        assert gate_threshold(DEFAULT_POLICY, 96, 1e9,
+                              jnp.bfloat16) == 0.5

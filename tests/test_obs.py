@@ -243,6 +243,58 @@ class TestSolveTelemetry:
         r = solve(32, 16)
         assert r.trace is None
 
+    def test_attribute_phases_measured_partitions_execute(self):
+        from tpu_jordan.obs.spans import attribute_phases_measured
+
+        tel = Telemetry(clock=FakeClock())
+        with tel.span("execute") as ex:
+            pass
+        kids = attribute_phases_measured(
+            ex, {"pivot": 0.5, "permute": 0.1, "eliminate": 0.4})
+        assert [k.name for k in kids] == list(PHASES)
+        assert kids[0].t_start == ex.t_start
+        assert kids[-1].t_end == ex.t_end
+        for a, b in zip(kids, kids[1:]):
+            assert a.t_end == b.t_start
+        for k in kids:
+            assert k.attrs["measured"] is True
+            assert k.attrs["source"] == "kernel_bracket"
+            assert "modeled" not in k.attrs
+        assert abs(sum(k.attrs["fraction"] for k in kids) - 1.0) < 1e-5
+
+    def test_checker_rejects_modeled_phases_in_pallas_trace(self):
+        """ISSUE 6 satellite: a fused-kernel engine's execute span with
+        MODEL-attributed phase children is an attribution regression —
+        tools/check_telemetry.py must fail the trace (and accept the
+        measured form)."""
+        from tpu_jordan.obs.spans import attribute_phases_measured
+
+        tel = Telemetry(clock=FakeClock())
+        with tel.span("solve"):
+            with tel.span("execute", engine="grouped_pallas") as ex:
+                pass
+        attribute_phases(ex, 96, 16)             # the WRONG attribution
+        bad = json.dumps(export.to_chrome_trace(tel))
+        with pytest.raises(AssertionError, match="modeled phase child"):
+            check_telemetry.check_chrome_trace(bad, "<test>")
+
+        tel2 = Telemetry(clock=FakeClock())
+        with tel2.span("solve"):
+            with tel2.span("execute", engine="grouped_pallas") as ex2:
+                pass
+        attribute_phases_measured(
+            ex2, {"pivot": 0.3, "permute": 0.2, "eliminate": 0.5})
+        good = json.dumps(export.to_chrome_trace(tel2))
+        assert check_telemetry.check_chrome_trace(good, "<test>") > 0
+        # A pure-XLA engine's modeled children remain legal.
+        tel3 = Telemetry(clock=FakeClock())
+        with tel3.span("solve"):
+            with tel3.span("execute", engine="inplace") as ex3:
+                pass
+        attribute_phases(ex3, 96, 16)
+        xla = json.dumps(export.to_chrome_trace(tel3))
+        assert check_telemetry.check_chrome_trace(xla, "<test>") > 0
+
     def test_auto_select_records_select_span(self):
         from tpu_jordan.tuning.tuner import auto_select
 

@@ -122,6 +122,17 @@ def test_chunk_candidates_divisor_property():
             assert cg * m * 2 * m * 4 <= pbi._W_BUDGET or cg == 1
 
 
+# The production-size parity tier re-lists the kernels with the panel
+# (v2) and inplace (v3) experiments slow-marked: both are recorded
+# NON-dispatched experiments (measured slower everywhere, module
+# docstring) and their m=32 parity/flag/poison tier above stays tier-1
+# — the production-size duplicates are nightly-only (the 870 s rule,
+# ISSUE 6 budget pass).
+KERNELS_PROD = ["dispatch", "rank1", "fused",
+                pytest.param("panel", marks=pytest.mark.slow),
+                pytest.param("inplace", marks=pytest.mark.slow)]
+
+
 class TestProductionSizeParity:
     """Parity of every kernel with the XLA reference at production block
     sizes (m=64/128); the small-m tests above use m=32."""
@@ -130,13 +141,15 @@ class TestProductionSizeParity:
         # tier-1 headroom (ISSUE 3): m=64 is below the production
         # fused-panel sizes (128/256/384) — nightly only.
         pytest.param(64, marks=pytest.mark.slow), 128])
-    @pytest.mark.parametrize("kernel", KERNELS)
+    @pytest.mark.parametrize("kernel", KERNELS_PROD)
     def test_matches_xla(self, rng, m, kernel):
         blocks = rng.standard_normal((4, m, m))
         sing = _check_parity(blocks, kernel=kernel)
         assert not sing.any()
 
-    @pytest.mark.parametrize("kernel", ["rank1", "panel", "inplace", "fused"])
+    @pytest.mark.parametrize("kernel", [
+        "rank1", pytest.param("panel", marks=pytest.mark.slow),
+        pytest.param("inplace", marks=pytest.mark.slow), "fused"])
     def test_matches_dispatch_kernel(self, rng, kernel):
         m = 64
         blocks = jnp.asarray(rng.standard_normal((4, m, m)), jnp.float32)
@@ -151,7 +164,7 @@ class TestProductionSizeParity:
         np.testing.assert_allclose(np.asarray(inv_p), np.asarray(inv_r),
                                    rtol=2e-3, atol=1e-3)
 
-    @pytest.mark.parametrize("kernel", KERNELS)
+    @pytest.mark.parametrize("kernel", KERNELS_PROD)
     def test_singular_flags_and_zero_diag(self, rng, kernel):
         m = 64
         blocks = rng.standard_normal((4, m, m))

@@ -1,0 +1,212 @@
+"""Fused normalize-and-eliminate kernel (ops/pallas_update.py) and the
+grouped_pallas engine plumbing (ISSUE 6).
+
+Interpret-mode parity on CPU, same policy as test_pallas_probe.py: the
+kernel is the production group-closing superstep on TPU; these tests pin
+its semantics — bitwise against the XLA grouped engine's own matmul
+sequence at fp32 — so a Mosaic/tiling regression can't silently change
+results on hardware.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from tpu_jordan.ops import pallas_update as pu
+from tpu_jordan.ops.pallas_update import (
+    fused_normalize_eliminate,
+    measured_phase_fractions,
+)
+
+HI = lax.Precision.HIGHEST
+
+
+def _operands(rng, Nr, m, k, j, t):
+    """Random operands honoring the engine's caller contract: U pivot
+    rows zeroed, P's closing slot (row-block j) zero, P's pivot-column
+    block of earlier rows zeroed."""
+    N, KM = Nr * m, k * m
+    V = jnp.asarray(rng.standard_normal((N, N)), jnp.float32)
+    U = np.asarray(rng.standard_normal((N, KM)), np.float32)
+    U[t * m:(t + 1) * m] = 0.0
+    P = np.asarray(rng.standard_normal((KM, N)), np.float32)
+    P[j * m:(j + 1) * m] = 0.0
+    P[:j * m, t * m:(t + 1) * m] = 0.0
+    H = jnp.asarray(rng.standard_normal((m, m)), jnp.float32)
+    rows_p = jnp.asarray(rng.standard_normal((m, N)), jnp.float32)
+    return V, jnp.asarray(U), jnp.asarray(P), H, rows_p
+
+
+def _reference_update(V, U, P, H, rows_p, t, j, m):
+    """The XLA grouped engine's group-closing sequence, verbatim
+    (ops/jordan_inplace.py): normalize, insert H, zero the pivot
+    column, write the pivot rows, record P, subtract U·P."""
+    prow = jnp.matmul(H, rows_p, precision=HI)
+    prow = prow.at[:, t * m:(t + 1) * m].set(H)
+    V = V.at[:, t * m:(t + 1) * m].set(0.0)
+    V = V.at[t * m:(t + 1) * m, :].set(prow)
+    P = P.at[j * m:(j + 1) * m, :].set(prow)
+    return V - jnp.matmul(U, P, precision=HI)
+
+
+class TestFusedKernelParity:
+    @pytest.mark.parametrize("Nr,m,k,j,t", [
+        (4, 16, 2, 1, 1),            # mid-matrix pivot
+        (4, 16, 2, 1, 3),            # last block row
+        (4, 16, 2, 0, 0),            # j=0: P has no earlier rows
+        (6, 16, 4, 3, 3),            # wider group
+        # tier-1 headroom (the 870 s rule): two geometry variants run
+        # nightly; the four above cover j=0/closing, tail-tile pivots,
+        # and the wider group.
+        pytest.param(6, 16, 2, 1, 5,
+                     marks=pytest.mark.slow),   # pivot in final tile
+        pytest.param(2, 8, 2, 1, 1,
+                     marks=pytest.mark.slow),   # tiny blocks
+    ])
+    def test_bitwise_matches_xla_sequence(self, rng, Nr, m, k, j, t):
+        V, U, P, H, rows_p = _operands(rng, Nr, m, k, j, t)
+        ref = _reference_update(V, U, P, H, rows_p, t, j, m)
+        out = fused_normalize_eliminate(V, U, P, H, rows_p, t=t, j=j,
+                                        m=m, interpret=True)
+        assert bool(jnp.all(out == ref)), "fused kernel diverged bitwise"
+
+    def test_tiled_grid_bitwise(self, rng, monkeypatch):
+        # Shrink the VMEM budget so the launch genuinely tiles (several
+        # programs per axis) and the tiling must not change a single
+        # bit — the full-contraction-per-element design.
+        Nr, m, k, j, t = 6, 8, 2, 1, 2
+        V, U, P, H, rows_p = _operands(rng, Nr, m, k, j, t)
+        ref = fused_normalize_eliminate(V, U, P, H, rows_p, t=t, j=j,
+                                        m=m, interpret=True)
+        monkeypatch.setattr(pu, "_UPD_BUDGET", pu._tile_bytes(8, 8, 16, 8))
+        jax.clear_caches()
+        try:
+            assert pu._update_tiles(Nr * m, k * m, m) == (m, m)
+            out = fused_normalize_eliminate(V, U, P, H, rows_p, t=t,
+                                            j=j, m=m, interpret=True)
+            assert bool(jnp.all(out == ref))
+        finally:
+            jax.clear_caches()
+
+    def test_update_tiles_properties(self):
+        for N, KM, m in [(512, 256, 128), (2048, 256, 128),
+                         (768, 512, 256), (96, 32, 16), (64, 16, 8)]:
+            R, C = pu._update_tiles(N, KM, m)
+            assert R == C and R % m == 0 and N % R == 0
+            assert (pu._tile_bytes(R, C, KM, m) <= pu._UPD_BUDGET
+                    or R == m)
+            assert R <= pu._MAX_TILE
+
+    def test_bf16_mode_rounds_operands(self, rng):
+        Nr, m, k, j, t = 4, 16, 2, 1, 1
+        V, U, P, H, rows_p = _operands(rng, Nr, m, k, j, t)
+        f32 = fused_normalize_eliminate(V, U, P, H, rows_p, t=t, j=j,
+                                        m=m, interpret=True)
+        b16 = fused_normalize_eliminate(V, U, P, H, rows_p, t=t, j=j,
+                                        m=m, mode="bf16", interpret=True)
+        assert b16.dtype == jnp.float32          # fp32 accumulate/storage
+        assert not bool(jnp.all(f32 == b16))     # operands were rounded
+        # bf16-grade agreement: relative to the update's magnitude.
+        scale = float(jnp.max(jnp.abs(f32)))
+        assert float(jnp.max(jnp.abs(f32 - b16))) < 0.05 * scale
+        # The pivot rows carry the fp32-accumulated normalized row in
+        # BOTH modes' storage; the H insertion is exact in both.
+        np.testing.assert_allclose(
+            np.asarray(b16[t * m:(t + 1) * m, t * m:(t + 1) * m]),
+            np.asarray(H), rtol=0, atol=0)
+
+    def test_unknown_mode_rejected(self, rng):
+        V, U, P, H, rows_p = _operands(rng, 2, 8, 2, 1, 0)
+        with pytest.raises(ValueError, match="precision mode"):
+            fused_normalize_eliminate(V, U, P, H, rows_p, t=0, j=1,
+                                      m=8, mode="fp64", interpret=True)
+
+
+class TestMeasuredPhaseFractions:
+    def test_fractions_partition_and_cache(self):
+        pu._PHASE_FRACTIONS_CACHE.clear()
+        fr = measured_phase_fractions(64, 16, 2, interpret=True)
+        assert set(fr) == {"pivot", "permute", "eliminate"}
+        assert abs(sum(fr.values()) - 1.0) < 1e-9
+        assert all(v > 0 for v in fr.values())
+        # Second call is a cache hit: the same dict object, no launches.
+        assert measured_phase_fractions(64, 16, 2, interpret=True) is fr
+
+    def test_capped_bracket_twin(self, monkeypatch):
+        # Beyond _BRACKET_MAX_N the brackets run on a size-capped twin
+        # (same m/group) with per-phase work-ratio scaling — the OOM
+        # guard for telemetry'd 16384-class solves.  Force the cap low
+        # so the scaling path runs at test sizes.
+        monkeypatch.setattr(pu, "_BRACKET_MAX_N", 32)
+        pu._PHASE_FRACTIONS_CACHE.clear()
+        try:
+            fr = measured_phase_fractions(128, 8, 2, interpret=True)
+            assert abs(sum(fr.values()) - 1.0) < 1e-9
+            assert all(v > 0 for v in fr.values())
+        finally:
+            pu._PHASE_FRACTIONS_CACHE.clear()
+            jax.clear_caches()
+
+
+class TestDriverPlumbing:
+    def test_distributed_rejected(self):
+        from tpu_jordan.driver import UsageError, solve
+
+        with pytest.raises(UsageError, match="single-device"):
+            solve(n=64, block_size=8, workers=4, engine="grouped_pallas")
+
+    def test_solver_distributed_rejected(self):
+        from tpu_jordan.driver import UsageError
+        from tpu_jordan.models import JordanSolver
+
+        with pytest.raises(UsageError, match="single-device"):
+            JordanSolver(n=64, block_size=8, workers=4,
+                         engine="grouped_pallas")
+
+    def test_beyond_unroll_cap_rejected(self):
+        from tpu_jordan.driver import UsageError, single_device_invert
+        from tpu_jordan.parallel.sharded_inplace import MAX_UNROLL_NR
+
+        n = 8 * (MAX_UNROLL_NR + 4)
+        with pytest.raises(UsageError, match="unrolled-only"):
+            single_device_invert(n, 8, "grouped_pallas", 2)
+
+    def test_float64_rejected(self, rng):
+        from tpu_jordan.ops import block_jordan_invert_inplace_grouped_pallas
+
+        a = jnp.asarray(rng.standard_normal((32, 32)), jnp.float64)
+        with pytest.raises(ValueError, match="fp32"):
+            block_jordan_invert_inplace_grouped_pallas(
+                a, block_size=8, interpret=True)
+
+    def test_resolve_engine_defaults_group2(self):
+        from tpu_jordan.driver import resolve_engine
+
+        assert resolve_engine("grouped_pallas", 0) == ("grouped_pallas", 2)
+        assert resolve_engine("grouped_pallas", 4) == ("grouped_pallas", 4)
+        assert resolve_engine("grouped_pallas_bf16", 0) == (
+            "grouped_pallas_bf16", 2)
+
+    def test_measured_phase_spans_on_trace(self):
+        # The Pallas path's execute children are MEASURED (kernel
+        # brackets), never modeled — the obs-layer tentpole contract,
+        # enforced artifact-side by tools/check_telemetry.py.
+        from tpu_jordan.driver import solve
+        from tpu_jordan.obs.spans import PHASES, Telemetry
+
+        tel = Telemetry()
+        r = solve(n=64, block_size=16, engine="grouped_pallas",
+                  telemetry=tel)
+        ex = r.trace.find("execute")
+        kids = {c.name: c.attrs for c in ex.children}
+        assert set(kids) == set(PHASES)
+        for attrs in kids.values():
+            assert attrs.get("measured") is True
+            assert attrs.get("source") == "kernel_bracket"
+            assert "modeled" not in attrs
+        # The children tile the execute span exactly.
+        assert ex.children[0].t_start == ex.t_start
+        assert ex.children[-1].t_end == ex.t_end

@@ -88,6 +88,92 @@ class TestRegistry:
             params["chips"])
 
 
+class TestPallasRegistry:
+    """ISSUE 6: the fused-kernel engine configurations — legality
+    predicates, cost hooks, and the selection behavior they encode
+    (plan-cache keys are point-keyed, so registering them changes no
+    existing cache entry — pinned by TestPlanKey staying green)."""
+
+    def test_legality_predicates(self):
+        gp = REGISTRY["grouped_pallas"]
+        gb = REGISTRY["grouped_pallas_bf16"]
+        ok = TunePoint.create(4096, 128, jnp.float32, 1, True)
+        assert gp.legal(ok)
+        # bf16 compute is auto-candidate ONLY at sub-fp32 storage: an
+        # fp32 request must never be silently served by rounded dots.
+        assert not gb.legal(ok)
+        assert gb.legal(TunePoint.create(4096, 128, jnp.bfloat16, 1,
+                                         True))
+        # Distributed, float64, sub-probe block sizes, and Nr beyond
+        # the unrolled cap are all out.
+        assert not gp.legal(TunePoint.create(4096, 128, jnp.float32,
+                                             (2, 4), True))
+        assert not gp.legal(TunePoint.create(4096, 128, jnp.float64, 1,
+                                             True))
+        assert not gp.legal(TunePoint.create(64, 8, jnp.float32, 1,
+                                             True))
+        assert not gp.legal(TunePoint.create(4096, 8, jnp.float32, 1,
+                                             True))        # Nr = 512
+        # Batched points (the serve executors' TunePoints) are out:
+        # the fused-kernel engines have no vmapped variant, so a
+        # batched plan naming them would be unbuildable by
+        # serve/executors.py.
+        assert not gp.legal(TunePoint.create(8192, 128, jnp.float32, 1,
+                                             True, batch=16))
+        batched16 = TunePoint(n=8192, block_size=128, dtype="bfloat16",
+                              backend="tpu", chip="v5e", batch=16)
+        assert select_by_cost(batched16).name == "grouped2"
+
+    def test_cost_hooks(self):
+        import math as _math
+
+        gp = REGISTRY["grouped_pallas"]
+        gb = REGISTRY["grouped_pallas_bf16"]
+        g2 = REGISTRY["grouped2"]
+        # Off-TPU the kernels run interpreted: never cost-preferred.
+        cpu = TunePoint.create(8192, 128, jnp.float32, 1, True)
+        assert cpu.backend == "cpu" and _math.isinf(gp.cost(cpu))
+        # On a TPU point the fp32 kernel is priced just ABOVE the
+        # measured grouped champion (finite -> inside tune=True's
+        # survivor cut; above -> cost-only auto keeps the champion
+        # until measured evidence promotes the new kernel).
+        tpu = TunePoint(n=8192, block_size=128, dtype="float32",
+                        backend="tpu", chip="v5e")
+        assert g2.cost(tpu) < gp.cost(tpu) < _math.inf
+        assert gp.cost(tpu) / g2.cost(tpu) == pytest.approx(1.02)
+        # The bf16 variant undercuts fp32 (the recipe's MXU advantage);
+        # below the grouped floor both stay priors.
+        tpu16 = TunePoint(n=8192, block_size=128, dtype="bfloat16",
+                          backend="tpu", chip="v5e")
+        assert gb.cost(tpu16) < gp.cost(tpu16)
+        small = TunePoint(n=4096, block_size=128, dtype="float32",
+                          backend="tpu", chip="v5e")
+        assert _math.isinf(gp.cost(small))
+
+    def test_auto_selects_bf16_kernel_at_bf16_tpu_points(self):
+        # A bf16-storage point on TPU at n >= 8192: the bf16 fused
+        # kernel is the cost pick (the caller already accepted
+        # bf16-grade numbers, and the driver still auto-attaches the
+        # residual-gate ladder on that engine).
+        pt = TunePoint(n=8192, block_size=128, dtype="bfloat16",
+                       backend="tpu", chip="v5e")
+        assert select_by_cost(pt).name == "grouped_pallas_bf16"
+        # The same point at fp32 keeps the measured champion.
+        pt32 = TunePoint(n=8192, block_size=128, dtype="float32",
+                         backend="tpu", chip="v5e")
+        assert select_by_cost(pt32).name == "grouped2"
+
+    def test_explicit_engine_runs_without_registry_gate(self):
+        # Explicit engine="grouped_pallas" bypasses legality (it is a
+        # direct request, like every other explicit engine) and solves
+        # correctly on CPU via the interpreter.
+        from tpu_jordan.driver import solve
+
+        r = solve(n=64, block_size=16, engine="grouped_pallas")
+        assert r.engine == "grouped_pallas" and r.group == 2
+        assert r.rel_residual < 1e-4
+
+
 class TestPlanKey:
     def test_n_bucket(self):
         assert n_bucket(4096) == 4096

@@ -17,6 +17,13 @@ otherwise):
     list, every event has a known phase (complete ``X`` events carry a
     numeric ``dur``; duration events come as matched ``B``/``E`` pairs
     per (pid, tid, name)), and at least one event exists.
+  * Pallas-path attribution honesty (ISSUE 6 satellite): an ``execute``
+    event whose ``args.engine`` is a fused-kernel engine
+    (``grouped_pallas*``) must not contain MODEL-attributed hot-loop
+    phase children — the kernels give the host real brackets
+    (``measured=True``/``source``), so a ``modeled=True`` pivot/permute/
+    eliminate event nested inside such an execute span is a regression
+    to the flops model and fails the check.
 """
 
 from __future__ import annotations
@@ -31,6 +38,10 @@ SAMPLE_RE = re.compile(
     r"[+-]?Inf)$")
 _SUFFIXES = ("_sum", "_count")
 _TYPES = {"counter", "gauge", "summary", "histogram", "untyped"}
+#: The paper's hot-loop phases (obs.spans.PHASES) and the engines whose
+#: execute spans must carry MEASURED (never modeled) phase children.
+_PHASE_NAMES = {"pivot", "permute", "eliminate"}
+_PALLAS_ENGINE_PREFIX = "grouped_pallas"
 
 
 def check_prometheus(text: str, path: str) -> int:
@@ -90,6 +101,27 @@ def check_chrome_trace(text: str, path: str) -> int:
                 f"{path}: E before B for {key}"
     bad = {k: v for k, v in open_be.items() if v != 0}
     assert not bad, f"{path}: unmatched B/E events: {bad}"
+
+    # Pallas-path attribution honesty: no modeled phase children inside
+    # a fused-kernel engine's execute bracket.
+    pallas_execs = [
+        ev for ev in events
+        if ev.get("ph") == "X" and ev.get("name") == "execute"
+        and str(ev.get("args", {}).get("engine", ""))
+        .startswith(_PALLAS_ENGINE_PREFIX)]
+    for ex in pallas_execs:
+        t0, t1 = ex["ts"], ex["ts"] + ex["dur"]
+        for ev in events:
+            if (ev.get("ph") == "X" and ev.get("name") in _PHASE_NAMES
+                    and ev.get("pid") == ex.get("pid")
+                    and ev.get("tid") == ex.get("tid")
+                    and t0 <= ev.get("ts", -1) and
+                    ev["ts"] + ev.get("dur", 0) <= t1 + 1e-6):
+                assert not ev.get("args", {}).get("modeled"), (
+                    f"{path}: modeled phase child {ev['name']!r} inside "
+                    f"a {_PALLAS_ENGINE_PREFIX}* execute span — the "
+                    f"Pallas path must emit measured brackets "
+                    f"(obs/spans.attribute_phases_measured)")
     return len(events)
 
 

@@ -93,7 +93,7 @@ class SolveResult:
 # The engine vocabulary is DERIVED from the declarative registry
 # (tuning/registry.py — name, legality, cost hook per configuration);
 # tests/test_tuning.py lints that the two can never drift.
-from .tuning.registry import ENGINES
+from .tuning.registry import ENGINES, PALLAS_ENGINES
 
 
 def _record_compile(compile_span, component: str) -> None:
@@ -111,6 +111,36 @@ def _record_compile(compile_span, component: str) -> None:
         "tpu_jordan_compile_seconds",
         "wall seconds spent in AOT lowering+compilation",
     ).observe(compile_span.duration, component=component)
+
+
+def _attribute_solve_phases(tel, esp, engine: str, n: int,
+                            block_size: int, group: int = 0) -> None:
+    """Phase attribution under the ``execute`` span (single-device
+    solves): the fused-kernel engines get MEASURED children — the
+    probe, swap, and update kernels are separately launchable, so the
+    host brackets each once per configuration and scales the measured
+    fractions onto the execute span (``measured=True``,
+    ``source="kernel_bracket"``) — while the pure-XLA engines keep the
+    flops-model split (``modeled=True``; the host cannot bracket inside
+    one fused XLA executable).  tools/check_telemetry.py fails any
+    Pallas-path trace that still carries modeled phase children.
+
+    The kernel brackets cost three timed launches per (n, m, group,
+    mode) configuration — size-capped at a 4096-edge bracket twin so
+    they can never OOM a large solve (pallas_update._BRACKET_MAX_N) —
+    and only run when the telemetry actually retains spans
+    (``NullTelemetry`` keeps the warm path free)."""
+    if engine in PALLAS_ENGINES and getattr(tel, "retain", False):
+        from .obs.spans import attribute_phases_measured
+        from .ops.pallas_update import measured_phase_fractions
+
+        mode = "bf16" if engine.endswith("bf16") else "fp32"
+        fractions = measured_phase_fractions(n, block_size,
+                                             group or 2, mode=mode)
+        attribute_phases_measured(esp, fractions,
+                                  source="kernel_bracket")
+    else:
+        attribute_phases(esp, n, block_size)
 
 
 def _solve_metrics(n: int, elapsed: float, exec_span,
@@ -182,6 +212,10 @@ def resolve_engine(engine: str, group: int):
         raise UsageError("the swap-free engine has no grouped variant")
     if engine == "grouped":
         return "grouped", (group if group > 1 else 2)
+    if engine in PALLAS_ENGINES:
+        # The fused-kernel engines are grouped engines (the kernel IS
+        # the group-closing superstep); same default k=2 as "grouped".
+        return engine, (group if group > 1 else 2)
     if engine == "auto" and group > 1:
         return "grouped", group
     return engine, 0
@@ -238,10 +272,16 @@ def solve(
     benchmarks/PHASES.md for the measured accuracy ladder).
 
     ``engine``/``group`` select the elimination engine (resolve_engine:
-    "auto" | "inplace" | "grouped" | "augmented" | "swapfree"; the
-    measured dispatch policy lives in its docstring).  Engines differ
-    in speed and summation order only — same pivot rule, same results
-    to rounding.
+    "auto" | "inplace" | "grouped" | "augmented" | "swapfree" |
+    "grouped_pallas" | "grouped_pallas_bf16"; the measured dispatch
+    policy lives in its docstring).  Engines differ in speed and
+    summation order only — same pivot rule, same results to rounding.
+    The fused-kernel engines are single-device; ``grouped_pallas_bf16``
+    (bf16-compute/fp32-accumulate dots, arXiv:2112.09017) auto-attaches
+    ``DEFAULT_POLICY`` when no ``policy`` is given and judges the
+    residual gate at bf16 eps (capped at 0.5), so a bf16-grade miss
+    walks the recovery ladder — refine, then an fp32 re-solve on the
+    fp32 fused sibling — and is never returned silently degraded.
 
     ``engine="auto"`` resolves through the autotuner ladder
     (tuning/tuner.py): a ``plan_cache`` JSON hit costs zero
@@ -308,6 +348,21 @@ def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
                                           gather, tune=tune,
                                           plan_cache=plan_cache,
                                           telemetry=tel)
+
+    if distributed and engine in PALLAS_ENGINES:
+        raise UsageError(
+            f"engine={engine!r} is a single-device fused-kernel engine "
+            "(the Pallas update kernel has no sharded variant yet); "
+            "use engine='grouped' on distributed meshes")
+    if engine == "grouped_pallas_bf16" and policy is None:
+        # The bf16 path NEVER runs unguarded: without an explicit
+        # policy the default residual-gate ladder is attached, so a
+        # bf16-grade miss walks refine -> fp32 re-solve (recorded on
+        # SolveResult.recovery) instead of reaching the caller as a
+        # silently degraded inverse (ISSUE 6 acceptance).
+        from .resilience.policy import DEFAULT_POLICY
+
+        policy = DEFAULT_POLICY
 
     def load():
         if file is not None:
@@ -376,7 +431,7 @@ def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
                           on_retry=_reload_donated)
         if policy is not None else _execute())
     elapsed = esp.duration
-    attribute_phases(esp, n, block_size)
+    _attribute_solve_phases(tel, esp, engine, n, block_size, group)
     _solve_metrics(n, elapsed, esp, singular=bool(singular))
     if _faults.corrupt("result_corrupt_nan"):
         # Silent-corruption simulation: poison the computed inverse so
@@ -413,13 +468,26 @@ def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
         def _escalated_resolve():
             esc_dtype = (jnp.float32
                          if jnp.dtype(dtype).itemsize < 4 else dtype)
+            # The bf16 fused-kernel engine escalates to its fp32
+            # sibling: same pivot rule and kernel, full-precision dots
+            # — the "fp32 re-solve" rung of the bf16 recipe
+            # (arXiv:2112.09017).
+            esc_engine = ("grouped_pallas"
+                          if engine == "grouped_pallas_bf16" else engine)
             return _solve_impl(n, block_size, file, generator, esc_dtype,
                                refine, workers, device, False, gather,
-                               "highest", engine, group, False, None, tel)
+                               "highest", esc_engine, group, False, None,
+                               tel)
 
+        # The gate judges a bf16-computed inverse at bf16 eps (a
+        # bf16-grade residual on a well-conditioned matrix is a PASS,
+        # not a ladder walk) unless the policy pins an explicit
+        # gate_dtype SLO — gate_threshold prefers policy.gate_dtype.
+        gate_dtype = (jnp.bfloat16
+                      if engine == "grouped_pallas_bf16" else dtype)
         inv, residual, norm_a, kappa, recovery = maybe_recover(
             policy, tel, a_fresh=a_fresh, inv=inv, residual=residual,
-            norm_a=norm_a, kappa=kappa, n=n, dtype=dtype,
+            norm_a=norm_a, kappa=kappa, n=n, dtype=gate_dtype,
             resolve=_escalated_resolve)
 
     if verbose:
@@ -640,11 +708,30 @@ def single_device_invert(n: int, block_size: int, engine: str = "auto",
         block_jordan_invert_inplace_fori,
         block_jordan_invert_inplace_grouped,
         block_jordan_invert_inplace_grouped_fori,
+        block_jordan_invert_inplace_grouped_pallas,
     )
     from .parallel.sharded_inplace import MAX_UNROLL_NR
 
     Nr = -(-n // min(block_size, n))
     unroll = Nr <= MAX_UNROLL_NR
+    if engine in PALLAS_ENGINES:
+        if not unroll:
+            raise UsageError(
+                f"engine={engine!r} is unrolled-only (the fused kernel's "
+                f"mask geometry is compile-time) and Nr={Nr} exceeds "
+                f"MAX_UNROLL_NR={MAX_UNROLL_NR}; use engine='grouped' "
+                "(its fori twin) or a larger block_size")
+        mode = "bf16" if engine.endswith("bf16") else "fp32"
+        kg = group if group > 1 else 2
+
+        def fn_pl(a, block_size=None, refine=0,
+                  precision=_lax.Precision.HIGHEST):
+            return block_jordan_invert_inplace_grouped_pallas(
+                a, block_size=block_size, refine=refine,
+                precision=precision, group=kg, mode=mode)
+
+        return jax.jit(fn_pl, static_argnames=("block_size", "refine",
+                                               "precision"))
     if engine == "augmented":
         from .ops import block_jordan_invert
 

@@ -124,6 +124,13 @@ class JordanSolver:
         if not self._distributed and self.engine == "swapfree":
             raise UsageError("engine='swapfree' is a distributed engine "
                              "(its win is collective bytes); use workers=p")
+        from ..tuning.registry import PALLAS_ENGINES
+
+        if self._distributed and self.engine in PALLAS_ENGINES:
+            raise UsageError(
+                f"engine={self.engine!r} is a single-device fused-kernel "
+                "engine (no sharded variant yet); use engine='grouped' "
+                "on distributed meshes")
         if self._distributed:
             from ..driver import make_distributed_backend
 

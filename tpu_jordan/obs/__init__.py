@@ -23,7 +23,8 @@ from .export import (profiler_trace, to_chrome_trace, to_json_line,
                      to_prometheus, write_chrome_trace, write_metrics)
 from .metrics import REGISTRY, MetricsRegistry, Reservoir
 from .spans import (NULL, NullTelemetry, Span, Telemetry,
-                    attribute_phases, timed_blocking)
+                    attribute_phases, attribute_phases_measured,
+                    timed_blocking)
 
 __all__ = [
     "export", "metrics", "spans",
@@ -31,5 +32,5 @@ __all__ = [
     "write_chrome_trace", "write_metrics",
     "REGISTRY", "MetricsRegistry", "Reservoir",
     "NULL", "NullTelemetry", "Span", "Telemetry", "attribute_phases",
-    "timed_blocking",
+    "attribute_phases_measured", "timed_blocking",
 ]

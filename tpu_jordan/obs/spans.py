@@ -195,6 +195,40 @@ def timed_blocking(fn, *args, telemetry=None, name: str = "execute",
     return out, sp
 
 
+def attribute_phases_measured(span: Span, fractions: dict,
+                              source: str = "kernel_bracket"
+                              ) -> list[Span]:
+    """Subdivide a measured ``execute`` span into the hot-loop phases
+    using MEASURED fractions (``measured=True`` + ``source`` on every
+    child — and no ``modeled`` attr, which is how
+    tools/check_telemetry.py tells the two apart).
+
+    The Pallas-path engines earn this: their probe, swap, and fused
+    update kernels are separately launchable, so the host brackets each
+    once per configuration (``ops/pallas_update.measured_phase_
+    fractions`` — real ``timed_blocking`` walls of the actual kernels)
+    and scales the measured fractions onto the solve's execute span.
+    The pure-XLA engines cannot be bracketed inside one fused
+    executable and keep the flops model (:func:`attribute_phases`,
+    ``modeled=True``).
+
+    ``fractions`` maps each of :data:`PHASES` to its measured share;
+    they are renormalized defensively so the children always tile the
+    span exactly."""
+    total = sum(float(fractions[p]) for p in PHASES)
+    out = []
+    t = span.t_start
+    for i, phase in enumerate(PHASES):
+        frac = (float(fractions[phase]) / total) if total > 0 else (
+            1.0 / len(PHASES))
+        t1 = (span.t_end if i == len(PHASES) - 1
+              else t + frac * span.duration)
+        out.append(span.child(phase, t, t1, measured=True, source=source,
+                              fraction=round(frac, 6)))
+        t = t1
+    return out
+
+
 def attribute_phases(span: Span, n: int, block_size: int,
                      distributed: bool = False) -> list[Span]:
     """Subdivide a measured ``execute`` span into the paper's hot-loop

@@ -7,6 +7,7 @@ from .jordan_inplace import (
     block_jordan_invert_inplace_fori,
     block_jordan_invert_inplace_grouped,
     block_jordan_invert_inplace_grouped_fori,
+    block_jordan_invert_inplace_grouped_pallas,
 )
 from .norms import block_inf_norms, condition_inf, inf_norm
 from .padding import pad_with_identity, unpad
@@ -25,6 +26,7 @@ __all__ = [
     "block_jordan_invert_inplace_fori",
     "block_jordan_invert_inplace_grouped",
     "block_jordan_invert_inplace_grouped_fori",
+    "block_jordan_invert_inplace_grouped_pallas",
     "gauss_jordan_inverse",
     "generate",
     "hilbert",

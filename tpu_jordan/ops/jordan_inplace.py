@@ -315,6 +315,156 @@ def block_jordan_invert_inplace_grouped(
     return x, singular
 
 
+@partial(jax.jit, static_argnames=(
+    "block_size", "eps", "precision", "refine", "use_pallas", "group",
+    "mode", "interpret"))
+def block_jordan_invert_inplace_grouped_pallas(
+    a: jnp.ndarray,
+    block_size: int | None = None,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    refine: int = 0,
+    use_pallas: bool | None = None,
+    group: int = 4,
+    mode: str = "fp32",
+    interpret: bool | None = None,
+):
+    """The delayed-group-update engine with the group-closing superstep
+    — pivot-row normalize + trailing eliminate sweep + in-place
+    bookkeeping writes — fused into ONE Pallas kernel launch
+    (ops/pallas_update.py; ISSUE 6 tentpole).
+
+    Identical pivot choices and BIT-IDENTICAL fp32 results to
+    ``block_jordan_invert_inplace_grouped`` (pinned by
+    tests/test_jordan_inplace.py): the probe, swaps, eager side-updates
+    and non-closing bookkeeping are the same code, and the kernel's
+    fused pass computes element-for-element the same full-contraction
+    dots as the XLA engine's ``jnp.matmul`` sequence — only the HBM
+    pass structure changes (the normalize, the pivot-column zeroing,
+    the pivot-row write-back and the group-end ``V − U·P`` collapse
+    from separate XLA sweeps into one VMEM-resident read+write of V).
+
+    ``mode="bf16"`` is the mixed-precision path (arXiv:2112.09017):
+    kernel dot operands rounded to bf16, fp32 accumulation, fp32
+    storage; the pivot PROBE stays fp32, so pivot quality never
+    degrades.  A bf16 inverse is bf16-grade accurate — the driver
+    attaches the PR 5 residual-gate ladder by default so a failed gate
+    walks refine → fp32 re-solve instead of returning silently degraded
+    numbers (driver.py, docs/RESILIENCE.md).
+
+    Unrolled-only (every superstep's pivot block index is static — the
+    kernel's mask geometry is compile-time): compile cost scales with
+    Nr like the other unrolled engines, so the driver gates it to
+    Nr <= MAX_UNROLL_NR and larger problems keep the grouped-fori
+    engine.
+    """
+    from .pallas_update import fused_normalize_eliminate, interpret_default
+
+    precision, refine = resolve_precision(precision, refine)
+    n = a.shape[-1]
+    in_dtype = a.dtype
+    if jnp.dtype(in_dtype).itemsize < 4:
+        x, singular = block_jordan_invert_inplace_grouped_pallas(
+            a.astype(jnp.float32), block_size, eps, precision, refine,
+            use_pallas, group, mode, interpret,
+        )
+        return x.astype(in_dtype), singular
+    if jnp.dtype(in_dtype).itemsize > 4:
+        raise ValueError(
+            "the grouped_pallas engines compute in fp32 (the fused "
+            "kernel is fp32-only, like the probe kernel); use "
+            "engine='grouped' for float64")
+    dtype = a.dtype
+    if block_size is None:
+        block_size = default_block_size(n)
+    m = min(block_size, n)
+    if eps is None:
+        eps = eps_for(dtype)
+    Nr = -(-n // m)
+    N = Nr * m
+    k = max(1, min(group, Nr))
+    V = pad_with_identity(a, N)
+    if use_pallas is None:
+        use_pallas = _use_pallas_default(dtype) and m % 8 == 0 and m >= 32
+    if interpret is None:
+        interpret = interpret_default()
+    from .block_inverse import probe_blocks
+
+    singular = jnp.asarray(False)
+    rswaps = []
+    for t0 in range(0, Nr, k):
+        kg = min(k, Nr - t0)                   # this group's width
+        U = jnp.zeros((N, kg * m), dtype)
+        P = jnp.zeros((kg * m, N), dtype)
+        for j in range(kg):
+            t = t0 + j
+            nc = Nr - t
+            # --- EAGER CANDIDATE COLUMN / PROBE / SWAP: the grouped
+            # engine's own steps, verbatim (bit-match contract).
+            col = lax.slice(V, (0, t * m), (N, (t + 1) * m))
+            if j:
+                col = col - jnp.matmul(
+                    U[:, :j * m], P[:j * m, t * m:(t + 1) * m],
+                    precision=precision)
+            cands = col[t * m:].reshape(nc, m, m)
+            invs, sing = probe_blocks(cands, eps, use_pallas)
+            key = jnp.where(sing, jnp.asarray(jnp.inf, dtype),
+                            block_inf_norms(invs))
+            rel = jnp.argmin(key)              # ties -> lowest row
+            singular = singular | jnp.all(sing)
+            H = jnp.take(invs, rel, axis=0).astype(dtype)
+            piv = t + rel
+
+            rows_t = lax.slice(V, (t * m, 0), ((t + 1) * m, N))
+            rows_p = lax.dynamic_slice(V, (piv * m, 0), (m, N))
+            V = lax.dynamic_update_slice(V, rows_t, (piv * m, 0))
+            u_t = lax.slice(U, (t * m, 0), ((t + 1) * m, kg * m))
+            u_p = lax.dynamic_slice(U, (piv * m, 0), (m, kg * m))
+            U = lax.dynamic_update_slice(U, u_t, (piv * m, 0))
+
+            # --- EAGER PIVOT ROW: old piv row minus pending panels.
+            if j:
+                rows_p = rows_p - jnp.matmul(u_p[:, :j * m], P[:j * m],
+                                             precision=precision)
+
+            # --- RECORD the panel column (same bookkeeping either way).
+            col_t_blk = col[t * m:(t + 1) * m]
+            col = lax.dynamic_update_slice(col, col_t_blk, (piv * m, 0))
+            col = col.at[t * m:(t + 1) * m].set(jnp.asarray(0, dtype))
+            if j:
+                P = P.at[:j * m, t * m:(t + 1) * m].set(
+                    jnp.asarray(0, dtype))
+            U = U.at[t * m:(t + 1) * m, :].set(jnp.asarray(0, dtype))
+            U = U.at[:, j * m:(j + 1) * m].set(col)
+            rswaps.append(piv)
+
+            if j < kg - 1:
+                # Non-closing step: normalize + V bookkeeping in XLA,
+                # exactly the grouped engine's writes (P row j feeds the
+                # NEXT steps' eager side-updates, so it must exist now).
+                prow = jnp.matmul(H, rows_p, precision=precision)
+                prow = prow.at[:, t * m:(t + 1) * m].set(H)
+                V = V.at[:, t * m:(t + 1) * m].set(jnp.asarray(0, dtype))
+                V = V.at[t * m:(t + 1) * m, :].set(prow)
+                P = P.at[j * m:(j + 1) * m, :].set(prow)
+            else:
+                # --- GROUP-CLOSING SUPERSTEP, FUSED: normalize
+                # (H @ rows_p + H insertion), pivot-column zeroing,
+                # pivot-row write-back, and the group-end trailing
+                # eliminate V − U·[P; prow] — one kernel launch, one
+                # VMEM-resident pass over V.
+                V = fused_normalize_eliminate(
+                    V, U, P, H, rows_p, t=t, j=j, m=m, mode=mode,
+                    precision=precision, interpret=interpret)
+
+    # --- Unscramble: the composed swap permutation, one blocked gather.
+    V = apply_col_perm(V, compose_swap_perm(jnp.stack(rswaps), Nr), m)
+
+    x = unpad(V, n)
+    x = newton_schulz(a, x, refine, lax.Precision.HIGHEST)
+    return x, singular
+
+
 def _grouped_step(t, j: int, V, U, P, singular, swaps, *, Nr: int, N: int,
                   m: int, eps, precision, use_pallas: bool):
     """One inner elimination step of a delayed-group-update group.

@@ -1,0 +1,363 @@
+"""Pallas TPU kernel: the fused normalize-and-eliminate superstep update.
+
+The paper's hot loop (main.cpp:1136-1194) normalizes the pivot block-row
+by the already-inverted pivot block and then sweeps the rank-one-block
+eliminate ``A[i,:] -= A[i,k] @ pivot_row`` over the whole local row
+panel.  In the XLA engines those two GEMMs — plus the in-place
+bookkeeping writes around them (zero the pivot column, insert the pivot
+block, write the normalized row back) — are whatever XLA happens to
+fuse; this kernel makes the fusion explicit: each grid program owns one
+(R, C) tile of the working matrix, computes the normalized pivot row for
+its column strip (``prow = H @ rows_p``, with H inserted at the pivot
+block columns by an exact one-hot MXU dot), and applies the trailing
+update ``V ← V − U·[P; prow]`` in ONE VMEM-resident read+write pass —
+the bookkeeping masks (pivot column zeroing, pivot-row write-back) fold
+into the same pass instead of costing separate HBM sweeps.
+
+It is the group-closing superstep of the delayed-group-update engine
+(ops/jordan_inplace.py): at the last step j of a group the freshly
+normalized pivot row joins the pending panel stack and the group-end
+trailing update retires immediately after, so both fuse into one launch.
+The arithmetic is element-for-element identical to the XLA engine's
+``jnp.matmul`` sequence (one full-contraction dot per output element,
+same operand order), which is what makes the fp32 path bit-match the
+existing grouped engine — pinned by tests/test_jordan_inplace.py.
+
+Mixed precision (``mode="bf16"``): the recipe of *Large Scale
+Distributed Linear Algebra With TPUs* (arXiv:2112.09017) — dot operands
+rounded to bf16, accumulation kept fp32 (``preferred_element_type``),
+working storage fp32 throughout.  The pivot PROBE stays fp32 regardless
+(ops/refine.py's measured verdict: sub-fp32 probes lose Schur
+complements), and the driver never returns a bf16-computed inverse
+unguarded: the PR 5 residual-gate ladder (refine → fp32 re-solve) is
+attached by default (driver.py).
+
+Tile/VMEM budgeting extends the machinery proven in
+``ops/pallas_block_inverse.py``: tiles are the largest multiples of the
+block size dividing N whose resident set (V in+out, U strip, P strip,
+pivot-row strip, one-hot scatter temporaries) fits a fixed VMEM budget.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+#: Per-program VMEM budget (bytes) for the fused update's resident tile
+#: set.  Full VMEM is ~16 MB; the budget leaves headroom for Mosaic's
+#: own temporaries, mirroring pallas_block_inverse._W_BUDGET.
+_UPD_BUDGET = 6 * 1024 * 1024
+
+#: Hard cap on a tile edge: beyond 512 the MXU sees no larger effective
+#: tiles and the VMEM bill grows quadratically.
+_MAX_TILE = 512
+
+
+def _tile_bytes(R: int, C: int, KM: int, m: int) -> int:
+    """fp32 bytes resident per grid program: V tile in+out + one dot
+    temporary (3·R·C), the U row strip (R·KM), the P column strip
+    (KM·C), the raw pivot-row strip + normalized prow + one-hot insert
+    (3·m·C), the row-scatter one-hot (R·m), and H (m²)."""
+    return 4 * (3 * R * C + R * KM + KM * C + 3 * m * C + R * m + m * m)
+
+
+def _update_tiles(N: int, KM: int, m: int,
+                  budget: int | None = None) -> tuple[int, int]:
+    """Square (R, C) tile for the fused update: the largest multiple of
+    ``m`` that divides N, is at most ``_MAX_TILE``, and fits the VMEM
+    budget; falls back to (m, m) when even that is over budget (the
+    caller's problem sizes keep m² far below it)."""
+    if budget is None:
+        budget = _UPD_BUDGET           # resolved at call time (tests patch)
+    best = m
+    t = m
+    while t <= min(N, _MAX_TILE):
+        if N % t == 0 and _tile_bytes(t, t, KM, m) <= budget:
+            best = t
+        t += m
+    return best, best
+
+
+def _fused_update_kernel(v_ref, u_ref, p_ref, h_ref, rows_ref, out_ref,
+                         *, m, t, j, R, C, mode, precision):
+    """One (R, C) tile of ``V ← V − U·[P; prow]`` with the pivot-row
+    normalize fused (see module docstring).
+
+    Static parameters: ``t`` (global pivot block index — the engines
+    unroll the group loop, so every superstep's t is a Python int),
+    ``j`` (position of the closing step inside its group), tile sizes.
+    All pivot-block masks compare global iotas (tile iota + program
+    offset) against the static block bounds; H / prow placements at
+    dynamic tile-relative offsets ride exact 0/1 one-hot MXU dots — the
+    same Mosaic-proven idiom as the probe kernels' unscramble step
+    (dynamic LANE indexing is illegal, one-hot contraction is not).
+    """
+    f32 = jnp.float32
+    dn = (((1,), (0,)), ((), ()))                   # plain 2D matmul
+    row0 = pl.program_id(0) * R
+    col0 = pl.program_id(1) * C
+    tm0, tm1 = t * m, (t + 1) * m
+
+    h = h_ref[...]                                  # (m, m)
+    rp = rows_ref[...]                              # (m, C)
+    if mode == "bf16":
+        # bf16 compute, fp32 accumulate: operands rounded, the dot
+        # accumulates in f32 via preferred_element_type.
+        hd, rpd = h.astype(jnp.bfloat16), rp.astype(jnp.bfloat16)
+    else:
+        hd, rpd = h, rp
+    # --- NORMALIZE: prow = H @ rows_p for this column strip.
+    prow = jax.lax.dot_general(hd, rpd, dimension_numbers=dn,
+                               preferred_element_type=f32,
+                               precision=precision)          # (m, C)
+    # Insert H at the pivot block columns (prow[:, tm0:tm1] = H): an
+    # exact 0/1 scatter via the MXU — S[k, c] = 1 iff global column
+    # col0+c is tm0+k.
+    ccol = lax.broadcasted_iota(jnp.int32, (m, C), 1) + col0
+    kio = lax.broadcasted_iota(jnp.int32, (m, C), 0)
+    S = (ccol == kio + tm0).astype(f32)
+    hins = jax.lax.dot_general(h, S, dimension_numbers=dn,
+                               preferred_element_type=f32,
+                               precision=lax.Precision.HIGHEST)
+    in_tblk_c = (ccol >= tm0) & (ccol < tm1)
+    prow = jnp.where(in_tblk_c, hins, prow)
+
+    # --- Assemble the panel stack [P; prow]: the closing step's slot
+    # (rows j·m:(j+1)·m, zeros by the caller's contract) takes prow —
+    # static-j sublane masks, exact one-hot placement.
+    KM = p_ref.shape[0]
+    pk = p_ref[...]                                 # (KM, C)
+    kio_km = lax.broadcasted_iota(jnp.int32, (KM, m), 0)
+    iio_km = lax.broadcasted_iota(jnp.int32, (KM, m), 1)
+    Sp = (kio_km == iio_km + j * m).astype(f32)     # (KM, m) 0/1
+    prow_slot = jax.lax.dot_general(Sp, prow, dimension_numbers=dn,
+                                    preferred_element_type=f32,
+                                    precision=lax.Precision.HIGHEST)
+    rio_km = lax.broadcasted_iota(jnp.int32, (KM, C), 0)
+    in_jblk = (rio_km >= j * m) & (rio_km < (j + 1) * m)
+    p_eff = jnp.where(in_jblk, prow_slot, pk)
+
+    u = u_ref[...]                                  # (R, KM)
+    if mode == "bf16":
+        u, p_eff = u.astype(jnp.bfloat16), p_eff.astype(jnp.bfloat16)
+    upd = jax.lax.dot_general(u, p_eff, dimension_numbers=dn,
+                              preferred_element_type=f32,
+                              precision=precision)  # (R, C)
+
+    # --- ELIMINATE with the bookkeeping masks folded in: the pivot
+    # COLUMN block reads as zero (the in-place engines zero it so the
+    # update writes the inverse-building column −E·H there), and the
+    # pivot ROW block takes prow verbatim (U's pivot rows are zeroed by
+    # the engine, so the uniform formula would subtract an exact 0 —
+    # the masked write is the same value, one fewer dependency).
+    v = v_ref[...]
+    grow = lax.broadcasted_iota(jnp.int32, (R, C), 0) + row0
+    gcol = lax.broadcasted_iota(jnp.int32, (R, C), 1) + col0
+    v = jnp.where((gcol >= tm0) & (gcol < tm1), jnp.float32(0.0), v)
+    out = v - upd
+    # prow scattered to its global rows: Srow[r, i] = 1 iff global row
+    # row0+r is tm0+i (only the owning row tile has any 1s).
+    rio = lax.broadcasted_iota(jnp.int32, (R, m), 0) + row0
+    iio = lax.broadcasted_iota(jnp.int32, (R, m), 1)
+    Srow = (rio == iio + tm0).astype(f32)
+    prow_pad = jax.lax.dot_general(Srow, prow, dimension_numbers=dn,
+                                   preferred_element_type=f32,
+                                   precision=lax.Precision.HIGHEST)
+    out_ref[...] = jnp.where((grow >= tm0) & (grow < tm1), prow_pad, out)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("t", "j", "m", "mode", "precision", "interpret"))
+def fused_normalize_eliminate(V, U, P, H, rows_p, *, t: int, j: int,
+                              m: int, mode: str = "fp32",
+                              precision=lax.Precision.HIGHEST,
+                              interpret: bool = False):
+    """The fused superstep update: ``V ← V − U·[P; H@rows_p]`` with the
+    pivot-row normalize, H insertion, pivot-column zeroing, and
+    pivot-row write-back all in one VMEM-resident pass.
+
+    Caller contract (the grouped engine's group-closing step, after its
+    probe/swap/record bookkeeping):
+
+      * ``V`` (N, N) fp32 — post-swap working matrix;
+      * ``U`` (N, kg·m) — pending panel columns, pivot-block rows
+        zeroed, column-block ``j`` already holding this step's eager
+        eliminate column;
+      * ``P`` (kg·m, N) — pending normalized pivot rows, row-block
+        ``j`` all zeros (the kernel fills it with the freshly
+        normalized row), pivot-column block of earlier rows zeroed;
+      * ``H`` (m, m) — the inverted pivot block;
+      * ``rows_p`` (m, N) — the raw (eagerly updated) pivot block-row;
+      * ``t``/``j`` static: global pivot block index / position of the
+        closing step in its group.
+
+    ``mode="bf16"`` rounds the dot operands to bf16 and accumulates
+    fp32; ``mode="fp32"`` is element-for-element identical to the XLA
+    ``jnp.matmul`` sequence (bit-match pinned).
+    """
+    if mode not in ("fp32", "bf16"):
+        raise ValueError(f"unknown kernel precision mode {mode!r}")
+    N = V.shape[0]
+    KM = U.shape[1]
+    V = V.astype(jnp.float32)
+    R, C = _update_tiles(N, KM, m)
+    kernel = functools.partial(_fused_update_kernel, m=m, t=t, j=j,
+                               R=R, C=C, mode=mode, precision=precision)
+    return pl.pallas_call(
+        kernel,
+        grid=(N // R, N // C),
+        in_specs=[
+            pl.BlockSpec((R, C), lambda i, k: (i, k),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((R, KM), lambda i, k: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((KM, C), lambda i, k: (0, k),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, m), lambda i, k: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((m, C), lambda i, k: (0, k),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((R, C), lambda i, k: (i, k),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((N, N), jnp.float32),
+        interpret=interpret,
+    )(V, U.astype(jnp.float32), P.astype(jnp.float32),
+      H.astype(jnp.float32), rows_p.astype(jnp.float32))
+
+
+def interpret_default() -> bool:
+    """Pallas runs interpreted on CPU (the tier-1 runs); compiled on
+    accelerator backends — same convention as the probe's
+    ``_use_pallas_default`` (``not in ("cpu",)``): the bench host
+    reaches its TPU through the experimental "axon" platform, so a
+    ``== "tpu"`` test would silently interpret-mode the kernel on real
+    hardware.  Shared by the engine and the phase bracketer."""
+    return jax.default_backend() in ("cpu",)
+
+
+# ---------------------------------------------------------------------------
+# Measured phase brackets (the obs-layer tentpole piece): because the
+# Pallas path's probe and update are separately launchable kernels, the
+# host CAN bracket them — unlike the fused XLA engines, where the
+# pivot/permute/eliminate split under `execute` is a flops MODEL
+# (obs/spans.attribute_phases, modeled=True).  The fractions below come
+# from real timed launches of the actual kernels at the solve's own
+# (n, m, group) configuration, cached per configuration so a telemetry'd
+# solve pays the bracketing cost once per process.
+# ---------------------------------------------------------------------------
+
+_PHASE_FRACTIONS_CACHE: dict = {}
+
+#: Largest matrix edge the bracket operands materialize.  The brackets
+#: run on the SOLVE path (between execute and the residual reload, with
+#: the inverse still resident) on chips where the driver donates A
+#:  precisely because one extra N² buffer decides OOM at 16384²+ — so
+#: the bracket problem is capped (64 MB fp32 at the cap) and the
+#: per-launch measurements are scaled to the real configuration by the
+#: known per-phase work ratios (below).  At n <= the cap the ratios are
+#: all 1 and the fractions are pure measurement.
+_BRACKET_MAX_N = 4096
+
+
+def measured_phase_fractions(n: int, block_size: int, group: int,
+                             mode: str = "fp32",
+                             interpret: bool | None = None) -> dict:
+    """Measured pivot/permute/eliminate wall fractions for the
+    grouped-pallas engine at one configuration.
+
+    Brackets (one warmup + one timed call each, host-blocked via
+    ``obs.spans.timed_blocking`` — the shared wall bracket):
+
+      * ``pivot`` — the pivot-candidate probe kernel on a full-window
+        candidate stack;
+      * ``permute`` — the block-row swap pair (two dynamic row updates
+        on the working matrix);
+      * ``eliminate`` — the fused normalize-and-eliminate kernel on a
+        representative group-closing superstep (the non-closing steps'
+        eager side matmuls ride this bucket too — they are
+        eliminate-phase work).
+
+    Beyond ``_BRACKET_MAX_N`` the brackets run on a capped twin of the
+    configuration (same m, same group, same tile geometry) and each
+    measured per-launch wall is scaled by its phase's work ratio —
+    probe programs ∝ stack size, swap bytes ∝ N·m, update tiles ∝ N² —
+    times the real per-solve launch counts.  Still measurement-sourced
+    (the flops MODEL never enters); the scaling is recorded per phase.
+
+    Returns ``{"pivot": f, "permute": f, "eliminate": f}`` summing to 1.
+    """
+    import math
+
+    from ..config import eps_for
+    from ..obs.spans import timed_blocking
+    from .block_inverse import probe_blocks
+
+    m = min(block_size, n)
+    Nr = -(-n // m)
+    N = Nr * m
+    k = max(1, min(group, Nr))
+    if interpret is None:
+        interpret = interpret_default()
+    key = (N, m, k, mode, jax.default_backend())
+    if key in _PHASE_FRACTIONS_CACHE:
+        return _PHASE_FRACTIONS_CACHE[key]
+
+    use_pallas = not interpret
+    eps = eps_for(jnp.float32)
+    km = k * m
+    # The capped bracket twin: same m/group (tile geometry preserved),
+    # matrix edge at most _BRACKET_MAX_N.
+    Nr_b = min(Nr, max(k, _BRACKET_MAX_N // m))
+    Nb = Nr_b * m
+
+    # Deterministic well-conditioned operands (index-based, no RNG).
+    ii = jnp.arange(Nb, dtype=jnp.float32)
+    V = (jnp.eye(Nb, dtype=jnp.float32) * jnp.float32(Nb)
+         + jnp.sin(ii)[:, None] * jnp.cos(ii)[None, :])
+    cands = V[:, :m].reshape(Nr_b, m, m)
+    H = jnp.eye(m, dtype=jnp.float32) + 1e-3 * jnp.outer(
+        jnp.sin(ii[:m]), jnp.cos(ii[:m])).astype(jnp.float32)
+    rows_p = V[:m]
+    U = V[:, :km] * jnp.float32(1e-3)
+    P = jnp.zeros((km, Nb), jnp.float32)
+
+    def _probe():
+        return probe_blocks(cands, eps, use_pallas)
+
+    @jax.jit
+    def _swap(v):
+        rows_t = lax.dynamic_slice(v, (0, 0), (m, Nb))
+        rows_b = lax.dynamic_slice(v, (Nb - m, 0), (m, Nb))
+        v = lax.dynamic_update_slice(v, rows_t, (Nb - m, 0))
+        return lax.dynamic_update_slice(v, rows_b, (0, 0))
+
+    def _update():
+        return fused_normalize_eliminate(
+            V, U, P, H, rows_p, t=0, j=k - 1, m=m, mode=mode,
+            interpret=interpret)
+
+    # Per-solve multipliers: real launch counts x the capped twin's
+    # work ratio for that phase.
+    scale = {
+        "pivot": Nr * (Nr / Nr_b),           # probe programs ∝ stack
+        "permute": Nr * (N / Nb),            # swap bytes ∝ N·m
+        "eliminate": max(1, Nr // k) * (N / Nb) ** 2,   # tiles ∝ N²
+    }
+    brackets = {}
+    for name, fn in (("pivot", _probe),
+                     ("permute", lambda: _swap(V)),
+                     ("eliminate", _update)):
+        fn()                                   # warmup: compile excluded
+        _, sp = timed_blocking(fn, name=f"bracket_{name}")
+        brackets[name] = max(sp.duration, 1e-9) * scale[name]
+    total = math.fsum(brackets.values())
+    fractions = {p: brackets[p] / total for p in brackets}
+    _PHASE_FRACTIONS_CACHE[key] = fractions
+    return fractions

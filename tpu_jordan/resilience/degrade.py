@@ -63,7 +63,15 @@ def gate_threshold(policy: ResiliencePolicy, n: int, kappa: float,
     driver documents (rel residual ≈ eps·n·κ∞ for a healthy solve),
     widened by the policy's tolerance.  κ is floored at 1 (a gate must
     never tighten below eps·n) and a non-finite κ (corrupt inverse)
-    yields a NaN threshold, which fails the gate as intended."""
+    yields a NaN threshold, which fails the gate as intended.
+
+    The threshold is CAPPED at 0.5 (bench.py's dynamic-gate ceiling,
+    same rationale): a rel residual ≥ 0.5 means ‖I−AX‖ ≈ ‖I‖ — no
+    inverse at all, whatever κ claims.  The cap is what keeps the gate
+    non-vacuous at bf16 eps (ISSUE 6): with eps_bf16 ≈ 7.8e-3 the
+    eps·n·κ model exceeds 1 for any κ ≳ 1/(tol·eps·n), and without the
+    ceiling a bf16-computed non-inverse would "pass" — exactly the
+    silent degradation the ladder exists to prevent."""
     eps = gate_eps(policy.gate_dtype if policy.gate_dtype is not None
                    else dtype)
     if not math.isfinite(kappa):
@@ -71,7 +79,7 @@ def gate_threshold(policy: ResiliencePolicy, n: int, kappa: float,
         # (note max(1.0, nan) would silently return 1.0 — NaN compares
         # false both ways — so the guard is explicit).
         return float("nan")
-    return policy.gate_tol * eps * max(1, n) * max(1.0, kappa)
+    return min(policy.gate_tol * eps * max(1, n) * max(1.0, kappa), 0.5)
 
 
 def gate_passes(rel_residual: float, threshold: float) -> bool:

@@ -23,15 +23,16 @@ Product surface: ``solve(engine="auto", tune=..., plan_cache=...)``,
 from .measure import (Measurement, is_transient, measure_direct,
                       measure_slope, retry_transient, robust_stats)
 from .plan_cache import CACHE_VERSION, Plan, PlanCache, n_bucket, plan_key
-from .registry import (CONFIGS, ENGINES, REGISTRY, EngineConfig,
-                       TunePoint, candidates, select_by_cost)
+from .registry import (CONFIGS, ENGINES, PALLAS_ENGINES, REGISTRY,
+                       EngineConfig, TunePoint, candidates,
+                       select_by_cost)
 from .tuner import Tuner, auto_select, measure_config
 
 __all__ = [
     "Measurement", "is_transient", "measure_direct", "measure_slope",
     "retry_transient", "robust_stats",
     "CACHE_VERSION", "Plan", "PlanCache", "n_bucket", "plan_key",
-    "CONFIGS", "ENGINES", "REGISTRY", "EngineConfig", "TunePoint",
-    "candidates", "select_by_cost",
+    "CONFIGS", "ENGINES", "PALLAS_ENGINES", "REGISTRY", "EngineConfig",
+    "TunePoint", "candidates", "select_by_cost",
     "Tuner", "auto_select", "measure_config",
 ]

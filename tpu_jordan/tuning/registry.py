@@ -217,6 +217,63 @@ def _cost_swapfree(pt: TunePoint) -> float:
     return projected_seconds(pt, swapfree=True)
 
 
+def _cost_grouped_pallas(pt: TunePoint) -> float:
+    # The fused-kernel engine is a TPU perf path (off-TPU it runs the
+    # Pallas interpreter — a correctness/debug route, never
+    # cost-preferred) and its Mosaic-proven lane geometry is
+    # m % 128 == 0 (the probe kernels' measured compile envelope).
+    # Until a measured TPU session validates the new kernel at scale it
+    # is priced just ABOVE the grouped engine: a brand-new kernel must
+    # not displace the measured champion by model fiat, but the finite
+    # cost keeps it inside tune=True's survivor cut, so measured
+    # evidence (and the plan cache) can promote it — the same
+    # evidence-beats-priors ladder as everywhere else in this module.
+    if (pt.backend not in ("tpu", "axon")   # axon: the TPU tunnel backend
+            or pt.block_size % 128 != 0
+            or pt.n < GROUPED_MIN_SINGLE_CHIP_N):
+        return math.inf
+    return 1.02 * projected_seconds(pt, group=2)
+
+
+def _cost_grouped_pallas_bf16(pt: TunePoint) -> float:
+    # Legal only at sub-fp32 storage points (the caller already
+    # accepted bf16-grade numbers); there the bf16-compute kernel is
+    # modeled at ~0.75x the fp32 fused path — the v5p-class bf16:fp32
+    # MXU advantage the 2112.09017 recipe banks on.  On v5e fp32-HIGHEST
+    # already runs as bf16 passes (BASELINE.md re-scope), so the
+    # measured tuner is expected to refute this prior there — which is
+    # exactly what drift recording is for.
+    base = _cost_grouped_pallas(pt)
+    return math.inf if math.isinf(base) else 0.75 * base
+
+
+def _legal_grouped_pallas(pt: TunePoint) -> bool:
+    # Single-device UNBATCHED solves only (the serve executors build
+    # vmapped batch engines, which the fused-kernel engines have no
+    # variant of — a batched plan naming them would be unbuildable),
+    # <= 4-byte float storage, probe-legal block size, and
+    # unrolled-reach Nr (the kernel's mask geometry is static).
+    from ..parallel.sharded_inplace import MAX_UNROLL_NR
+
+    m = min(pt.block_size, pt.n)
+    Nr = -(-pt.n // m)
+    return (not pt.distributed
+            and getattr(pt, "batch", 1) == 1
+            and pt.dtype in ("float32", "bfloat16", "float16")
+            and m % 8 == 0 and m >= 32
+            and Nr <= MAX_UNROLL_NR)
+
+
+def _legal_grouped_pallas_bf16(pt: TunePoint) -> bool:
+    # bf16 COMPUTE is only auto-candidate when the point's own storage
+    # dtype is sub-fp32: an fp32 request must never be silently served
+    # by rounded-operand dots.  (An EXPLICIT engine="grouped_pallas_bf16"
+    # bypasses registry legality and is guarded by the auto-attached
+    # residual-gate ladder instead — driver.py.)
+    return (_legal_grouped_pallas(pt)
+            and pt.dtype in ("bfloat16", "float16"))
+
+
 def _always(pt: TunePoint) -> bool:
     return True
 
@@ -242,6 +299,19 @@ CONFIGS: tuple[EngineConfig, ...] = (
         "implicit-permutation engine: no row-swap broadcast, bucketed "
         "ppermute deferred repairs — the pod-scale comm design, legal "
         "under either gather mode"),
+    EngineConfig(
+        "grouped_pallas", "grouped_pallas", 2, _legal_grouped_pallas,
+        _cost_grouped_pallas,
+        "delayed group updates with the group-closing superstep "
+        "(normalize + eliminate sweep + bookkeeping) fused into one "
+        "Pallas kernel (ops/pallas_update.py); fp32 bit-matches the "
+        "grouped engine"),
+    EngineConfig(
+        "grouped_pallas_bf16", "grouped_pallas_bf16", 2,
+        _legal_grouped_pallas_bf16, _cost_grouped_pallas_bf16,
+        "the fused kernel with bf16-compute/fp32-accumulate dots "
+        "(arXiv:2112.09017); auto-candidate only at sub-fp32 storage "
+        "points, always guarded by the residual-gate ladder"),
 )
 
 REGISTRY: dict[str, EngineConfig] = {c.name: c for c in CONFIGS}
@@ -252,6 +322,13 @@ assert len(REGISTRY) == len(CONFIGS), "duplicate registry names"
 # dedups while preserving registration order; "auto" is the tuner.
 ENGINES: tuple[str, ...] = ("auto",) + tuple(
     dict.fromkeys(c.engine for c in CONFIGS))
+
+#: The single-device fused-kernel engines (ops/pallas_update.py): the
+#: driver gates them off distributed meshes, dispatches their grouped
+#: Pallas implementation, and gives their execute spans MEASURED phase
+#: children (the kernels are separately launchable, so the host has a
+#: real bracket — obs/spans.attribute_phases_measured).
+PALLAS_ENGINES: tuple[str, ...] = ("grouped_pallas", "grouped_pallas_bf16")
 
 
 def get(name: str) -> EngineConfig:
