@@ -13,7 +13,9 @@ WORKERS  ?= 1
 REQUESTS  ?= 64
 BATCH_CAP ?= 8
 
-.PHONY: all native tpu test smoke serve-demo chaos-demo metrics-demo bench bench-dip clean
+.PHONY: all native tpu test smoke serve-demo chaos-demo fleet-demo metrics-demo bench bench-dip clean
+
+REPLICAS ?= 3
 
 all: native
 
@@ -55,6 +57,21 @@ chaos-demo:
 	  --serve-requests $(REQUESTS) --batch-cap 4 --quiet \
 	  > /tmp/tpu_jordan_chaos.json
 	python tools/check_chaos.py /tmp/tpu_jordan_chaos.json
+
+# Fleet demo + validation (docs/FLEET.md): single-replica vs N-replica
+# throughput on the same deterministic stream, then the SAME stream
+# under seeded replica_kill chaos — the supervisor warm-replaces each
+# victim with zero compiles (shared executor store) and zero
+# measurements (read-only pre-tuned plan cache), the router re-queues
+# the victim's queued work, and the checker proves every response
+# bit-matched the fault-free replay or carried a typed error (exit 2 =
+# silent loss).  On parallel hardware pass a demanding scaling floor:
+# make fleet-demo FLEET_ARGS="--scaling-floor 2.5".
+fleet-demo:
+	python -m tpu_jordan 96 32 --fleet-demo --replicas $(REPLICAS) \
+	  --serve-requests 60 --batch-cap 4 --quiet $(FLEET_ARGS) \
+	  > /tmp/tpu_jordan_fleet.json
+	python tools/check_fleet.py /tmp/tpu_jordan_fleet.json
 
 # Telemetry demo + validation (docs/OBSERVABILITY.md): a small solve
 # and a serve burst, each exporting the process-wide tpu_jordan_*

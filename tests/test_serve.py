@@ -58,6 +58,24 @@ class TestBuckets:
         assert cache.get(64, 2, block_size=8) is e8
 
 
+class TestServeStatsLabels:
+    def test_reserved_label_keys_refused_typed(self):
+        """A user label colliding with the keys ServeStats stamps itself
+        ('bucket'/'component') — or with the metric APIs' own 'value'
+        parameter — must fail fast at construction with the typed
+        UsageError, not TypeError on the first request."""
+        from tpu_jordan.driver import UsageError
+        from tpu_jordan.serve import ServeStats
+
+        for key in ("bucket", "component", "value"):
+            with pytest.raises(UsageError, match="reserved metric label"):
+                ServeStats(labels={key: "x"})
+        # Non-reserved labels still work end to end.
+        s = ServeStats(labels={"replica": "r0"})
+        s.request(64)
+        assert s.snapshot()["buckets"]["64"]["requests"] == 1
+
+
 class TestExecutorCache:
     def test_one_compile_per_key_then_hits(self):
         from tpu_jordan.serve import ExecutorCache, ServeStats
@@ -109,6 +127,41 @@ class TestExecutorCache:
 
         with pytest.raises(UsageError, match="swapfree|unknown"):
             ExecutorCache(engine="swapfree").get(64, 2)
+
+    def test_slow_build_does_not_stall_other_buckets(self, monkeypatch):
+        """ISSUE 7 review hardening: the wait on the store's per-key
+        build happens OUTSIDE the cache-wide lock — one bucket's slow
+        (or retrying) compile must not stall this cache's dispatch and
+        warmup of other, independent buckets."""
+        import threading
+        import time
+
+        from tpu_jordan.serve import executors as ex_mod
+
+        gate = threading.Event()
+        building = threading.Event()
+        real = ex_mod.BucketExecutor
+
+        class Slow(real):
+            def _build(self):
+                if self.key.bucket_n == 64:
+                    building.set()
+                    gate.wait(30)      # a long in-flight compile
+                return super()._build()
+
+        monkeypatch.setattr(ex_mod, "BucketExecutor", Slow)
+        cache = ex_mod.ExecutorCache(engine="inplace", dtype=jnp.float32)
+        t = threading.Thread(target=lambda: cache.get(64, 1), daemon=True)
+        t.start()
+        try:
+            assert building.wait(30)   # 64's build holds its key lock
+            ex128 = cache.get(128, 1)  # ...and 128 must not wait on it
+            assert ex128.key.bucket_n == 128
+            assert t.is_alive()        # 64 was still building throughout
+        finally:
+            gate.set()
+        t.join(60)
+        assert cache.get(64, 1).key.bucket_n == 64
 
 
 class TestServiceRoundTrip:
@@ -231,6 +284,92 @@ class TestBackpressureAndShutdown:
         for f in futs:
             with pytest.raises(ServiceClosedError):
                 f.result(10)
+
+    def test_close_is_idempotent_and_thread_safe(self, rng):
+        """ISSUE 7 satellite: the fleet supervisor and a with-block
+        __exit__ may race to close the same service — every racer must
+        return cleanly (the first does the work, the rest no-op after
+        it finishes), and queued work is still drained exactly once."""
+        import threading
+
+        svc = JordanService(batch_cap=4, max_wait_ms=10_000.0,
+                            autostart=False)
+        futs = [svc.submit(m) for m in _mats(rng, [24], copies=3)]
+        errs = []
+
+        def closer():
+            try:
+                svc.close(drain=True)
+            except Exception as e:            # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=closer) for _ in range(6)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        svc.close()                           # and again, sequentially
+        assert errs == []
+        assert all(not f.result(1).singular for f in futs)
+
+    def test_close_error_factory_types_queued_failures(self, rng):
+        """ISSUE 7 satellite: ``close(drain=False, error=...)`` fails
+        queued futures with the caller's typed error (the replica-kill
+        path passes ReplicaKilledError so the fleet router re-queues)
+        instead of the generic ServiceClosedError."""
+        class WorkerGone(RuntimeError):
+            pass
+
+        svc = JordanService(batch_cap=4, max_wait_ms=10_000.0,
+                            autostart=False)
+        futs = [svc.submit(m) for m in _mats(rng, [24], copies=2)]
+        svc.close(drain=False, error=lambda: WorkerGone("died"))
+        for f in futs:
+            with pytest.raises(WorkerGone):
+                f.result(10)
+
+    def test_bounded_join_abandons_wedged_dispatcher(self):
+        """ISSUE 7 review hardening: killing a replica whose dispatcher
+        is stuck mid-execute (the real production wedge) must not block
+        the closer forever — ``close(join_timeout_s=...)`` abandons the
+        daemon thread (counted) instead of joining it unbounded."""
+        import threading
+        import time
+
+        from tpu_jordan.obs.metrics import REGISTRY
+        from tpu_jordan.serve.batcher import MicroBatcher
+        from tpu_jordan.serve.stats import ServeStats
+
+        gate = threading.Event()
+
+        class StuckExecutors:
+            def breaker(self, bucket):
+                return None
+
+            def get(self, bucket, batch_cap, block_size):
+                gate.wait(30)          # the hung device call
+                raise RuntimeError("released")
+
+        mb = MicroBatcher(StuckExecutors(), ServeStats(),
+                          batch_cap=1, max_wait_ms=0.1)
+        fut = mb.submit(np.eye(4, dtype=np.float32), 4, 64)
+        deadline = time.monotonic() + 10
+        while not mb.progress()[1] and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert mb.progress()[1]        # dispatcher is out executing
+        abandoned = REGISTRY.counter(
+            "tpu_jordan_serve_dispatcher_abandoned_total")
+        before = abandoned.total()
+        t0 = time.monotonic()
+        mb.close(drain=False, join_timeout_s=0.2)
+        assert time.monotonic() - t0 < 5      # returned, never froze
+        assert abandoned.total() == before + 1
+        # Unwedge: the abandoned daemon fans its batch and exits.
+        gate.set()
+        with pytest.raises(RuntimeError, match="released"):
+            fut.result(30)
+        if mb._thread is not None:
+            mb._thread.join(30)
 
 
 class TestSustainedThroughput:

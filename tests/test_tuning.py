@@ -258,6 +258,81 @@ class TestPlanCache:
         assert PlanCache.load(str(path)).get("k") == self._plan()
 
 
+class TestPlanCacheReadOnly:
+    """ISSUE 7 satellite: a fleet replica opens the shared pre-tuned
+    cache read-only — reads are lock-free dict hits, any write attempt
+    is the typed UsageError, and the tuner skips its write-back instead
+    of tripping it."""
+
+    def _pretuned(self, tmp_path):
+        path = str(tmp_path / "plans.json")
+        cache = PlanCache(path)
+        cache.put("k", Plan(config="inplace", engine="inplace", group=0,
+                            source="cost_model", seconds=None,
+                            projected=1e-3, drift=None, trials=()))
+        cache.save()
+        return path
+
+    def test_reads_work_writes_are_typed_usage_errors(self, tmp_path):
+        from tpu_jordan.driver import UsageError
+
+        path = self._pretuned(tmp_path)
+        ro = PlanCache.load(path, read_only=True)
+        assert ro.read_only and ro.get("k").engine == "inplace"
+        with pytest.raises(UsageError, match="read-only"):
+            ro.put("k2", ro.get("k"))
+        with pytest.raises(UsageError, match="read-only"):
+            ro.save()
+        # The file is untouched by the refused writes.
+        assert PlanCache.load(path).plans.keys() == {"k"}
+
+    def test_read_only_missing_file_is_typed_usage_error(self, tmp_path):
+        """Read-only mode serves a pre-tuned FILE: a typoed path must
+        fail fast, not silently become an empty cache that serves the
+        whole fleet off cost ranking."""
+        from tpu_jordan.driver import UsageError
+
+        missing = str(tmp_path / "plnas.json")
+        with pytest.raises(UsageError, match="does not exist"):
+            PlanCache.load(missing, read_only=True)
+        # Writable mode keeps the documented empty-cache fallback.
+        assert PlanCache.load(missing).plans == {}
+
+    def test_tuner_skips_write_back_on_read_only_cache(self, tmp_path):
+        path = self._pretuned(tmp_path)
+        before = (tmp_path / "plans.json").read_text()
+        t = Tuner(cache=PlanCache.load(path, read_only=True))
+        point = TunePoint.create(64, 8, jnp.float64, 8, gather=False,
+                                 backend="cpu")
+        plan = t.select(point)              # cache miss -> cost ranking
+        assert plan.source == "cost_model"
+        # Selection succeeded WITHOUT writing the shared file (the
+        # put/save pair a writable cache would get is skipped).
+        assert (tmp_path / "plans.json").read_text() == before
+
+    def test_concurrent_readers_share_one_pretuned_file(self, tmp_path):
+        import threading
+
+        path = self._pretuned(tmp_path)
+        caches = [PlanCache.load(path, read_only=True) for _ in range(4)]
+        hits, errs = [], []
+
+        def reader(cache):
+            try:
+                for _ in range(200):
+                    hits.append(cache.get("k").engine)
+            except Exception as e:            # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=reader, args=(c,))
+                   for c in caches]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert errs == [] and len(hits) == 800
+
+
 def _fake_measure(timings):
     """Injected measurement: per-config fixed fake seconds (the
     deterministic-selection satellite) shaped like the robust core's

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Validate a ``--fleet-demo`` report (ISSUE 7 CI satellite) — the
+fleet analogue of ``check_chaos.py``.
+
+Usage: ``python tools/check_fleet.py report.json [...]`` (or ``-`` for
+stdin).  No jax import — this is the ``make fleet-demo`` gate and runs
+anywhere.  Exit codes: 0 = valid, 1 = bound/structure violations,
+2 = SILENT LOSS (a response that neither bit-matched the fault-free
+replay nor carried a typed error, or a request the ledger lost — the
+alarm that must never be downgraded).
+
+What a valid fleet report must prove (docs/FLEET.md):
+
+  * chaos actually happened — >= 1 seeded ``replica_kill`` fired,
+    every death was supervised (deaths >= kills, restarts cover the
+    deaths that a closed restart breaker did not deliberately strand);
+  * the warm rolling restart was FREE — ``tpu_jordan_compiles_total``
+    delta == 0 after warmup across the whole chaos pass (replacement
+    replicas compiled nothing: shared executor store) and zero
+    plan-cache measurements (read-only pre-tuned plans);
+  * zero silent errors — every chaos response bit-matched the
+    fault-free replay or carried a typed error, the request ledger
+    adds up exactly (submitted == resolved, outstanding == 0);
+  * throughput held its bound — ``scaling_x >= scaling_floor`` (the
+    floor is explicit in the report; >= 0.5 so it cannot be vacuous)
+    at a bounded p99 (``fleet_p99_ms <= p99_bound_ms``), chaos p99
+    included: a kill mid-stream must not wedge latency.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+#: The floor below which a scaling bound proves nothing at all: a
+#: fleet that HALVES throughput is broken whatever the hardware.
+MIN_HONEST_SCALING_FLOOR = 0.5
+
+
+def check(report: dict) -> tuple[list[str], list[str]]:
+    """Return (violations, silent_loss_violations); both empty = valid."""
+    errs: list[str] = []
+    silent: list[str] = []
+    if report.get("metric") != "fleet_demo":
+        return ([f"not a fleet_demo report (metric="
+                 f"{report.get('metric')!r})"], [])
+
+    chaos = report.get("chaos", {})
+    ledger = report.get("ledger", {})
+    thr = report.get("throughput", {})
+    pc = report.get("plan_cache", {})
+
+    # ---- the kill ledger -------------------------------------------
+    kills = chaos.get("kills_injected", 0)
+    deaths = chaos.get("deaths", 0)
+    restarts = chaos.get("restarts", 0)
+    if kills <= 0:
+        errs.append("no replica_kill injected — the chaos run was "
+                    "vacuous")
+    if deaths < kills:
+        errs.append(f"{kills} kills injected but only {deaths} replica "
+                    f"deaths recorded — a kill was swallowed")
+    if restarts < 1:
+        errs.append("no supervisor restart happened — the warm "
+                    "rolling-restart path was never exercised")
+    covered = (restarts + chaos.get("restart_failures", 0)
+               + chaos.get("stranded_by_breaker", 0))
+    if covered < deaths:
+        errs.append(f"{deaths} deaths but only {restarts} restarts + "
+                    f"{chaos.get('restart_failures', 0)} counted "
+                    f"restart failures + "
+                    f"{chaos.get('stranded_by_breaker', 0)} breaker-"
+                    f"stranded slots — a dead slot was silently "
+                    f"abandoned")
+
+    # ---- the zero-compile / zero-measurement warm-restart pin ------
+    if chaos.get("compiles_delta_after_warmup", 1) != 0:
+        errs.append(f"replacement replicas compiled "
+                    f"{chaos.get('compiles_delta_after_warmup')} "
+                    f"executable(s) — the warm rolling restart was not "
+                    f"free (shared-store pin broken)")
+    if pc.get("measurements", 1) != 0:
+        errs.append(f"{pc.get('measurements')} plan-cache "
+                    f"measurement(s) during serving — the read-only "
+                    f"pre-tuned cache pin broke")
+    if not pc.get("read_only", False):
+        errs.append("fleet plan cache was not opened read-only")
+
+    # ---- zero silent errors (the exit-2 class) ---------------------
+    requests = report.get("requests", 0)
+    matched = report.get("matched_bitwise", 0)
+    typed = sum(report.get("typed_errors", {}).values())
+    mism = report.get("mismatches", [{"missing": True}])
+    if mism:
+        silent.append(f"{len(mism)} response(s) diverged from the "
+                      f"fault-free replay without a typed error: "
+                      f"{mism[:3]}")
+    if matched + typed + len(mism) != requests:
+        silent.append(f"response ledger does not add up: {matched} "
+                      f"matched + {typed} typed + {len(mism)} "
+                      f"mismatched != {requests} requests")
+    if ledger.get("outstanding", 1) != 0:
+        silent.append(f"{ledger.get('outstanding')} request(s) "
+                      f"outstanding after the drain — lost in flight")
+    if (ledger.get("resolved_ok", -1) + ledger.get("resolved_error", -1)
+            != ledger.get("submitted", 0)):
+        silent.append(f"fleet ledger does not add up: {ledger}")
+    if report.get("silent_loss", True):
+        silent.append("silent_loss flagged by the demo itself")
+
+    # ---- throughput + latency bounds -------------------------------
+    floor = thr.get("scaling_floor", 0)
+    if floor < MIN_HONEST_SCALING_FLOOR:
+        errs.append(f"scaling_floor {floor} < "
+                    f"{MIN_HONEST_SCALING_FLOOR} — the bound is "
+                    f"vacuous")
+    if thr.get("scaling_x", 0) < floor:
+        errs.append(f"throughput scaling {thr.get('scaling_x')}x "
+                    f"below the report's own floor {floor}x")
+    bound = thr.get("p99_bound_ms", 0)
+    if bound <= 0:
+        errs.append("p99_bound_ms missing/zero — the latency bound is "
+                    "vacuous")
+    for key in ("fleet_p99_ms", "chaos_p99_ms"):
+        if thr.get(key, bound + 1) > bound:
+            errs.append(f"{key} {thr.get(key)} exceeds the bound "
+                        f"{bound} ms")
+    return errs, silent
+
+
+def main(argv) -> int:
+    if not argv:
+        print("usage: check_fleet.py report.json [...]", file=sys.stderr)
+        return 1
+    rc = 0
+    for path in argv:
+        try:
+            if path == "-":
+                report = json.load(sys.stdin)
+            else:
+                with open(path) as f:
+                    report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"FAIL {path}: unreadable report ({e})", file=sys.stderr)
+            rc = max(rc, 1)
+            continue
+        errs, silent = check(report)
+        for e in silent:
+            print(f"SILENT-LOSS {path}: {e}", file=sys.stderr)
+        for e in errs:
+            print(f"FAIL {path}: {e}", file=sys.stderr)
+        if silent:
+            rc = 2
+        elif errs:
+            rc = max(rc, 1)
+        else:
+            chaos = report["chaos"]
+            thr = report["throughput"]
+            print(f"OK {path}: {report['requests']} requests x "
+                  f"{report['replicas']} replicas, "
+                  f"{chaos['kills_injected']} kill(s) -> "
+                  f"{chaos['restarts']:.0f} warm restart(s) "
+                  f"({chaos['reroutes']:.0f} re-queued), 0 compiles "
+                  f"after warmup, {report['matched_bitwise']} "
+                  f"bit-matched the fault-free replay, scaling "
+                  f"{thr['scaling_x']}x >= {thr['scaling_floor']}x, "
+                  f"0 silent")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

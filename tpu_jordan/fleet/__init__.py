@@ -1,0 +1,38 @@
+"""tpu_jordan.fleet — the supervised serving replica pool (ISSUE 7
+tentpole; docs/FLEET.md is the operator guide).
+
+Five parts:
+
+  * ``replica`` — one worker wrapping its own
+    :class:`~..serve.service.JordanService` (dispatcher, bounded queue,
+    per-bucket breakers, heartbeat) with kill/drain hooks and the
+    seeded ``replica_kill`` fault point on its dispatch path.
+  * ``router`` — bucket-affinity dispatch with breaker-aware load
+    shedding: an open per-bucket breaker means no traffic for that
+    bucket on that replica; fleet-wide saturation is typed
+    :class:`~..serve.batcher.ServiceOverloadedError` backpressure —
+    never a silent drop.  Death-class failures re-queue to a healthy
+    replica within the PR 5 retry/deadline budget.
+  * ``supervisor`` — heartbeat liveness + wedge detection, warm rolling
+    restarts against the fleet-shared executor store and the read-only
+    pre-tuned plan cache (a replacement performs zero compiles and
+    zero measurements), and a per-slot restart breaker against crash
+    loops.
+  * ``pool`` — :class:`JordanFleet`: the ``JordanService`` surface
+    (``submit``/``invert``/``warmup``/``close``) fleet-wide, plus the
+    request ledger and per-slot lineage in ``stats()``.
+  * ``demo`` — ``fleet_demo``: the ``--fleet-demo`` CLI engine; its
+    report is validated by ``tools/check_fleet.py`` (exit 2 = silent
+    loss).
+"""
+
+from .demo import fleet_demo
+from .pool import JordanFleet
+from .replica import Replica, ReplicaKilledError
+from .router import Router
+from .supervisor import Supervisor
+
+__all__ = [
+    "JordanFleet", "Replica", "ReplicaKilledError", "Router",
+    "Supervisor", "fleet_demo",
+]

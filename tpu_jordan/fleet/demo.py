@@ -1,0 +1,330 @@
+"""``fleet_demo`` — the ``--fleet-demo`` CLI mode's engine (ISSUE 7
+acceptance).
+
+One self-contained run proves the fleet contract end to end, in four
+phases sharing ONE :class:`~..serve.executors.ExecutorStore` and ONE
+pre-tuned read-only plan cache (so compile accounting spans the whole
+demo):
+
+  0. **pretune** — a throwaway writable service warms every bucket,
+     compiling each (bucket, batch_cap) executable exactly once into
+     the shared store and writing the engine plans to the plan-cache
+     file.  Every later phase opens that file ``read_only=True`` (the
+     fleet contract: N readers, zero writes — a write attempt would be
+     the typed ``UsageError``).
+  1. **baseline** — the deterministic mixed request stream (the
+     chaos-demo builder: sizes {n, n/2}, seeded fixtures, rank-1
+     singulars at fixed indices) through a 1-replica fleet: the
+     single-replica throughput + latency reference.
+  2. **fleet, fault-free** — the same stream through an N-replica
+     fleet: throughput scaling + the bit-exact replay baseline (shared
+     executables make every replica's answer for a given element
+     byte-identical).
+  3. **fleet, chaos** — the same stream again, staged (queued before
+     dispatch — so a killed replica provably holds queued work), under
+     a seeded :class:`~..resilience.faults.FaultPlan` whose
+     ``replica_kill`` schedule crashes replicas mid-stream.  The
+     supervisor warm-replaces each victim against the shared store
+     (``tpu_jordan_compiles_total`` delta == 0 after warmup — the
+     acceptance pin); the router re-queues the victim's queued
+     requests.  Every response must bit-match phase 2 or carry a typed
+     error — zero silent errors, and the ledger must add up
+     (``tools/check_fleet.py`` validates; exit 2 = silent loss).
+
+Honest-scaling note: the in-process worker backend shares one Python
+interpreter (GIL) and one device between replicas, so wall-clock
+throughput scaling is hardware-conditional — near 1x on a small shared
+CPU host, approaching Nx only where replicas map to real parallel
+devices.  The report records the measured ``scaling_x`` against an
+explicit ``scaling_floor`` (default 0.6: a fleet must never cost
+material throughput versus one replica; operators on parallel hardware
+pass a demanding floor, e.g. ``--scaling-floor 2.5`` for the 3-replica
+~3x claim).  The bound is explicit in the report — never a silent
+pass (docs/FLEET.md; the BASELINE.md v5e-negative discipline).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs.metrics import REGISTRY, percentiles
+from ..resilience import FaultPlan, ResiliencePolicy
+from ..resilience import activate as _activate
+from ..resilience.policy import RetryPolicy
+from ..serve.executors import ExecutorStore
+from ..serve.service import (JordanService, _chaos_requests,
+                             _classify_response)
+from .pool import JordanFleet
+from .replica import READY
+
+#: Default scaling floor for the shared-interpreter worker backend: the
+#: fleet must not cost material single-replica throughput (measured
+#: 2-core-host spread is ~0.7-1.1x with median-of-3 laps, so the floor
+#: leaves noise margin without going vacuous).  The ~Nx linear claim is
+#: a parallel-hardware claim — pass an explicit floor there
+#: (docs/FLEET.md).
+DEFAULT_SCALING_FLOOR = 0.6
+
+
+def _run_fleet_stream(fleet: JordanFleet, mats, staged: bool,
+                      timeout: float = 300.0):
+    """Run the stream; classify every response; return
+    (outcomes, elapsed_s, latencies_ms).  ``staged=True`` queues
+    everything before starting the dispatchers (deterministic queue
+    depth at a mid-stream kill); latencies are then measured from
+    dispatch start, not submit."""
+    futs = []
+    t_submit = []
+    t0 = time.perf_counter()
+    for a in mats:
+        t_submit.append(time.perf_counter())
+        try:
+            futs.append(fleet.submit(a))
+        except Exception as e:                        # noqa: BLE001
+            futs.append(e)
+    if staged:
+        fleet.start()
+        t_start = time.perf_counter()
+        t_submit = [t_start] * len(futs)
+    out, lat_ms = [], []
+    for ts, f in zip(t_submit, futs):
+        out.append(_classify_response(f, timeout))
+        if not isinstance(f, Exception):
+            lat_ms.append((time.perf_counter() - ts) * 1e3)
+    return out, time.perf_counter() - t0, lat_ms
+
+
+def _counters():
+    c = REGISTRY.counter
+    return {
+        "compiles": c("tpu_jordan_compiles_total").total(),
+        "deaths": c("tpu_jordan_fleet_replica_deaths_total").total(),
+        "restarts": c("tpu_jordan_fleet_restarts_total").total(),
+        "restart_failures":
+            c("tpu_jordan_fleet_restart_failures_total").total(),
+        "measurements": c("tpu_jordan_tuner_measurements_total").total(),
+        "reroutes": c("tpu_jordan_fleet_reroutes_total").total(),
+        "shed_dead": c("tpu_jordan_fleet_shed_total").value(reason="dead"),
+        "shed_breaker":
+            c("tpu_jordan_fleet_shed_total").value(reason="breaker"),
+        "shed_overload":
+            c("tpu_jordan_fleet_shed_total").value(reason="overload"),
+        "faults_injected": c("tpu_jordan_faults_injected_total").total(),
+    }
+
+
+def fleet_demo(n: int = 96, replicas: int = 3, requests: int = 60,
+               batch_cap: int = 4, max_wait_ms: float = 2.0,
+               kills: int = 2, seed: int = 0, block_size: int | None = None,
+               dtype=jnp.float32, plan_cache: str | None = None,
+               scaling_floor: float | None = None,
+               p99_bound_ms: float | None = None,
+               telemetry=None) -> dict:
+    """Run the four-phase fleet acceptance demo; returns the one-line
+    JSON report ``tools/check_fleet.py`` validates.  ``plan_cache``
+    None = a temp pre-tuned cache built by phase 0 and deleted after."""
+    t_all = time.perf_counter()
+    if replicas < 2:
+        raise ValueError("fleet_demo needs replicas >= 2 (the scaling "
+                         "and kill phases are fleet properties)")
+    mats = _chaos_requests(n, requests, seed, jnp.dtype(dtype))
+    shapes = sorted({a.shape[0] for a in mats})
+    store = ExecutorStore()
+    # Reroute/retry budget sized like the chaos demo: each kill can
+    # re-queue a victim's whole backlog, and a request may be re-queued
+    # once per kill it is unlucky enough to chase.
+    policy = ResiliencePolicy(
+        retry=RetryPolicy(max_retries=max(4, kills + 2), backoff_s=0.0))
+    scaling_floor = (DEFAULT_SCALING_FLOOR if scaling_floor is None
+                     else float(scaling_floor))
+
+    cache_dir = None
+    if plan_cache is None:
+        cache_dir = tempfile.mkdtemp(prefix="tpu_jordan_fleet_")
+        plan_cache = os.path.join(cache_dir, "plans.json")
+    try:
+        # ---- phase 0: pretune (the only writer, ever) ---------------
+        with JordanService(engine="auto", plan_cache=plan_cache,
+                           dtype=dtype, batch_cap=batch_cap,
+                           max_wait_ms=max_wait_ms, autostart=False,
+                           block_size=block_size, policy=policy,
+                           shared_executors=store,
+                           telemetry=telemetry) as svc:
+            svc.warmup(shapes=shapes)
+            pretuned_keys = len(store)
+        counters_pretune = _counters()
+        compiles_pretune = counters_pretune["compiles"]
+
+        fleet_kw = dict(
+            engine="auto", plan_cache=plan_cache,
+            plan_cache_read_only=True, dtype=dtype, batch_cap=batch_cap,
+            max_wait_ms=max_wait_ms, max_queue=max(requests * 2, 64),
+            block_size=block_size, policy=policy, telemetry=telemetry,
+            executor_store=store, stable_after_s=0.2,
+            liveness_deadline_s=5.0)
+
+        # ---- phase 1: single-replica baseline -----------------------
+        # One untimed warm lap first: the demo's first real executions
+        # pay one-time process costs (jax dispatch caches, allocator)
+        # that would deflate the single-replica reference and INFLATE
+        # scaling_x — the throughput comparison must be steady state
+        # vs steady state.  Then median-of-3 timed laps (the
+        # tuning/measure variance discipline): a single lap's wall
+        # clock on a small shared host is too noisy to bound against.
+        with JordanFleet(replicas=1, **fleet_kw) as one:
+            one.warmup(shapes)
+            _run_fleet_stream(one, mats, staged=False)
+            laps1 = [_run_fleet_stream(one, mats, staged=False)
+                     for _ in range(3)]
+        _, el1, lat1 = sorted(laps1, key=lambda r: r[1])[1]
+        single_rps = requests / el1
+
+        # ---- phase 2: N-replica fleet, fault-free -------------------
+        with JordanFleet(replicas=replicas, **fleet_kw) as flt:
+            flt.warmup(shapes)
+            _run_fleet_stream(flt, mats, staged=False)
+            laps2 = [_run_fleet_stream(flt, mats, staged=False)
+                     for _ in range(3)]
+        baseline, el2, lat2 = sorted(laps2, key=lambda r: r[1])[1]
+        fleet_rps = requests / el2
+        scaling_x = fleet_rps / single_rps
+
+        # ---- the seeded kill schedule -------------------------------
+        # Horizon = the routed-call window the kills land in: past the
+        # first few calls (so the victim provably holds queued work in
+        # the staged run) but well inside the stream.
+        horizon = max(4, requests // 2)
+        plan = FaultPlan.seeded(seed,
+                                points={"replica_kill": (kills, horizon)})
+
+        # ---- phase 3: N-replica fleet under seeded replica_kill -----
+        before = _counters()
+        chaos_fleet = JordanFleet(replicas=replicas, autostart=False,
+                                  **fleet_kw)
+        try:
+            chaos_fleet.warmup(shapes)
+            after_warm = _counters()
+            with _activate(plan):
+                chaos, el3, lat3 = _run_fleet_stream(chaos_fleet, mats,
+                                                     staged=True)
+            chaos_stats = chaos_fleet.stats()
+        finally:
+            chaos_fleet.close()
+        after = _counters()
+    finally:
+        if cache_dir is not None:
+            shutil.rmtree(cache_dir, ignore_errors=True)
+
+    delta = {k: after[k] - before[k] for k in before}
+    compiles_after_warmup = after["compiles"] - after_warm["compiles"]
+
+    # ---- compare chaos vs the fault-free replay ---------------------
+    matched = singular = 0
+    typed_errors: dict[str, int] = {}
+    mismatches = []
+    for i, (base, under) in enumerate(zip(baseline, chaos)):
+        if under[0] == "error":
+            typed_errors[under[1]] = typed_errors.get(under[1], 0) + 1
+            continue
+        if base[0] != "ok":
+            mismatches.append({"request": i, "why": (
+                f"fault-free run failed ({base[1]}) but chaos "
+                f"succeeded")})
+        elif under[2] != base[2]:
+            mismatches.append({"request": i,
+                               "why": "singular flag diverged"})
+        elif under[1] != base[1]:
+            mismatches.append({"request": i,
+                               "why": "inverse bits diverged"})
+        else:
+            matched += 1
+            singular += int(under[2])
+
+    ledger = chaos_stats["ledger"]
+    typed_total = sum(typed_errors.values())
+    silent_loss = (bool(mismatches)
+                   or ledger["outstanding"] != 0
+                   or matched + typed_total + len(mismatches) != requests)
+    # Process-wide delta over EVERY serving phase (not a sum over the
+    # surviving replicas' tuners — a killed replica's counter would be
+    # discarded with it and hide a measurement from the pin).
+    measurements = after["measurements"] - counters_pretune["measurements"]
+    # Deaths an OPEN restart breaker deliberately left unfilled at
+    # stats time: the checker's restart-coverage ledger must count the
+    # designed degraded state, not flag it as an abandoned slot.
+    stranded_by_breaker = sum(
+        1 for s in chaos_stats["slots"]
+        if s["restart_breaker"] == "open"
+        and (s["replica"] is None or s["replica"]["state"] != READY))
+
+    def p99(xs):
+        v = percentiles(xs)["p99"]
+        return 0.0 if v is None else float(v)
+
+    fleet_p99_ms = p99(lat2)
+    if p99_bound_ms is None:
+        # Generous runaway guard, not a perf SLO: the closed-loop p99
+        # is ~the whole stream's drain time, so bound it by a multiple
+        # of the measured single-replica drain + slack.
+        p99_bound_ms = max(2000.0, 5e3 * el1)
+
+    return {
+        "metric": "fleet_demo",
+        "n": n,
+        "requests": requests,
+        "request_sizes": shapes,
+        "replicas": replicas,
+        "batch_cap": batch_cap,
+        "seed": seed,
+        "worker_backend": "in-process-threads",
+        "plan_cache": {
+            "pretuned_keys": pretuned_keys,
+            "read_only": True,
+            "measurements": measurements,
+            "compiles_pretune": compiles_pretune,
+        },
+        "throughput": {
+            "single_rps": round(single_rps, 1),
+            "fleet_rps": round(fleet_rps, 1),
+            "scaling_x": round(scaling_x, 3),
+            "scaling_floor": scaling_floor,
+            "scaling_note": (
+                "in-process worker backend: replicas share one "
+                "interpreter and one device — ~Nx wall-clock scaling "
+                "is a parallel-hardware claim (docs/FLEET.md); the "
+                "floor pins 'a fleet never costs material throughput'"),
+            "single_p99_ms": round(p99(lat1), 1),
+            "fleet_p99_ms": round(fleet_p99_ms, 1),
+            "chaos_p99_ms": round(p99(lat3), 1),
+            "p99_bound_ms": round(p99_bound_ms, 1),
+        },
+        "chaos": {
+            "faults": plan.report(),
+            "kills_injected": int(delta["faults_injected"]),
+            "deaths": delta["deaths"],
+            "restarts": delta["restarts"],
+            "restart_failures": delta["restart_failures"],
+            "stranded_by_breaker": stranded_by_breaker,
+            "reroutes": delta["reroutes"],
+            "shed": {"dead": delta["shed_dead"],
+                     "breaker": delta["shed_breaker"],
+                     "overload": delta["shed_overload"]},
+            "compiles_delta_after_warmup": compiles_after_warmup,
+            "lineage": {str(s["slot"]): s["lineage"]
+                        for s in chaos_stats["slots"]},
+            "elapsed_s": round(el3, 3),
+        },
+        "ledger": ledger,
+        "matched_bitwise": matched,
+        "singular_flagged": singular,
+        "typed_errors": typed_errors,
+        "mismatches": mismatches,
+        "silent_loss": silent_loss,
+        "elapsed_s": round(time.perf_counter() - t_all, 3),
+    }

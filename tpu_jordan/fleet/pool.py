@@ -1,0 +1,389 @@
+""":class:`JordanFleet` — the supervised replica pool (ISSUE 7
+tentpole).
+
+One ``JordanService`` is a throughput ceiling and a single point of
+failure (ROADMAP open item 2).  The fleet runs N of them as supervised
+worker replicas behind a bucket-affinity router:
+
+  * **shared, immutable**: the compiled bucket executables
+    (:class:`~..serve.executors.ExecutorStore` — one compile per key
+    across the whole pool) and the read-only pre-tuned plan cache
+    (``tuning/plan_cache.py`` — N readers, zero writes, zero lock
+    contention);
+  * **per replica, stateful**: the dispatcher thread, the bounded
+    queue, the per-bucket circuit breakers, the serving stats (mirrored
+    into the process registry with a ``replica`` label);
+  * **supervision**: heartbeat + liveness deadline, warm rolling
+    restarts (a replacement performs zero compiles and zero
+    measurements), a per-slot restart breaker against crash loops, and
+    router-side re-queue of a dead replica's queued requests through
+    the PR 5 retry/deadline budget.
+
+Typed failure surface, fleet-wide: ``ServiceOverloadedError`` when
+every live replica's queue is full (backpressure, never a drop),
+``CircuitOpenError`` when every live replica's breaker for a bucket is
+open, ``DeadlineExceededError``/``ReplicaKilledError`` per request when
+budgets exhaust.  The chaos acceptance (``fleet/demo.py`` +
+``tools/check_fleet.py``) pins: every response under a seeded
+``replica_kill`` bit-matches a fault-free replay or carries a typed
+error — zero silent errors.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import jax.numpy as jnp
+
+from ..obs import metrics as _obs_metrics
+from ..resilience.policy import DEFAULT_POLICY, CircuitBreaker
+from ..serve.executors import ExecutorStore
+from ..serve.service import JordanService
+from ..tuning.plan_cache import PlanCache
+from .replica import READY, Replica
+from .router import Router
+from .supervisor import Supervisor
+
+_M_READY = _obs_metrics.gauge(
+    "tpu_jordan_fleet_replicas_ready",
+    "replicas currently READY and receiving traffic")
+_M_REQUESTS = _obs_metrics.counter(
+    "tpu_jordan_fleet_requests_total",
+    "requests accepted by the fleet router")
+
+
+@dataclass
+class _Slot:
+    """One replica slot: the live replica (swapped by the supervisor),
+    its generation counter, install timestamp, stability credit, and
+    the restart breaker (supervisor-level breaker wiring)."""
+
+    index: int
+    breaker: CircuitBreaker
+    replica: Replica | None = None
+    generation: int = 0
+    installed_at: float = 0.0
+    credited: bool = False
+    lineage: tuple = field(default=())
+
+
+class JordanFleet:
+    """A pool of supervised :class:`JordanService` replicas behind a
+    breaker-aware bucket-affinity router.
+
+    Args mirror :class:`JordanService` where they configure each
+    replica (engine, plan_cache, dtype, batch_cap, max_wait_ms,
+    max_queue — PER REPLICA, block_size, policy, default_deadline_ms,
+    telemetry).  Fleet-specific:
+
+      replicas: slot count (>= 1).
+      plan_cache_read_only: default True — the fleet contract is N
+        replicas reading one shared pre-tuned cache; pass False only
+        for a deliberately writable single-tenant setup.
+      executor_store: a pre-warmed :class:`ExecutorStore` to share
+        (e.g. across demo phases); None builds a fresh one.
+      heartbeat_interval_s / liveness_deadline_s / check_interval_s /
+        stable_after_s: the supervision clock (docs/FLEET.md).
+      restart_failures / restart_cooldown_s: the per-slot restart
+        breaker (a slot in a crash loop stops restarting until the
+        cooldown's half-open probe).
+      autostart: False leaves every replica's dispatcher unstarted
+        (tests stage queues deterministically, then ``start()``).
+      autostart_supervisor: False keeps supervision manual —
+        ``supervisor.check()`` runs one pass inline.
+    """
+
+    def __init__(self, replicas: int = 3, engine: str = "auto",
+                 plan_cache: str | None = None,
+                 plan_cache_read_only: bool = True,
+                 dtype=jnp.float32, batch_cap: int = 8,
+                 max_wait_ms: float = 2.0, max_queue: int = 256,
+                 block_size: int | None = None, policy="default",
+                 default_deadline_ms: float | None = None,
+                 telemetry=None,
+                 executor_store: ExecutorStore | None = None,
+                 heartbeat_interval_s: float = 0.05,
+                 liveness_deadline_s: float = 1.0,
+                 check_interval_s: float = 0.05,
+                 stable_after_s: float = 2.0,
+                 restart_failures: int = 3,
+                 restart_cooldown_s: float = 5.0,
+                 restart_grace_s: float = 2.0,
+                 autostart: bool = True,
+                 autostart_supervisor: bool = True, clock=None):
+        if replicas < 1:
+            raise ValueError("replicas must be >= 1")
+        self.slots = int(replicas)
+        self.clock = clock if clock is not None else time.monotonic
+        self.store = (executor_store if executor_store is not None
+                      else ExecutorStore())
+        self.policy = DEFAULT_POLICY if policy == "default" else policy
+        if plan_cache is not None and plan_cache_read_only:
+            # Load the shared pre-tuned file ONCE: every replica — and
+            # every warm replacement the supervisor ever spawns —
+            # shares this frozen instance.  No per-spawn re-parse, and
+            # no divergence window if the file is re-pretuned
+            # mid-flight (the bit-exact replay contract assumes all
+            # pool-mates serve identical plans).
+            plan_cache = PlanCache.load(plan_cache, read_only=True)
+        self._svc_kw = dict(
+            engine=engine, plan_cache=plan_cache,
+            plan_cache_read_only=plan_cache_read_only, dtype=dtype,
+            batch_cap=batch_cap, max_wait_ms=max_wait_ms,
+            max_queue=max_queue, block_size=block_size,
+            telemetry=telemetry, policy=self.policy,
+            default_deadline_ms=default_deadline_ms,
+            shared_executors=self.store)
+        self._hb_interval = float(heartbeat_interval_s)
+        self.restart_grace_s = float(restart_grace_s)
+        # A Condition, not a bare Lock: router threads that find ZERO
+        # live replicas (a total-loss instant mid rolling-restart) wait
+        # on it for the supervisor's replacement instead of typed-
+        # failing work a warm worker could serve milliseconds later.
+        self._lock = threading.Condition()
+        # Close teardown serializes here (the Condition above must stay
+        # free for grace-waiting routers): a racing second close()
+        # blocks until the first has drained every replica, exactly
+        # like JordanService._close_lock.
+        self._close_lock = threading.Lock()
+        self._close_complete = False
+        self._warm_shapes: set[int] = set()
+        self._submitted = 0
+        self._resolved_ok = 0
+        self._resolved_error = 0
+        self.closing = False
+        self._slots = [
+            _Slot(index=i, breaker=CircuitBreaker(
+                failures=restart_failures, cooldown_s=restart_cooldown_s,
+                clock=self.clock, name=f"fleet_slot_{i}"))
+            for i in range(self.slots)
+        ]
+        self._autostart = bool(autostart)
+        #: once True, every replica installed from then on has its
+        #: dispatcher started at install time — a warm replacement
+        #: entering a RUNNING fleet must never sit with a dead
+        #: dispatcher (requests routed to it would hang).  Staged runs
+        #: (autostart=False) flip it in ``start()``.
+        self._started = self._autostart
+        for slot in self._slots:
+            self._install(slot, self._spawn_replica(slot.index))
+        self.router = Router(
+            self,
+            max_reroutes=(self.policy.retry.max_retries
+                          if self.policy is not None else 1))
+        self.supervisor = Supervisor(
+            self, check_interval_s=check_interval_s,
+            liveness_deadline_s=liveness_deadline_s,
+            stable_after_s=stable_after_s)
+        if autostart_supervisor:
+            self.supervisor.start()
+
+    # ---- replica lifecycle plumbing ---------------------------------
+
+    def _spawn_replica(self, slot_index: int) -> Replica:
+        with self._lock:
+            self._slots[slot_index].generation += 1
+            gen = self._slots[slot_index].generation
+        service = JordanService(
+            autostart=self._autostart,
+            metric_labels={"replica": str(slot_index)}, **self._svc_kw)
+        return Replica(slot_index, gen, service,
+                       heartbeat_interval_s=self._hb_interval,
+                       clock=self.clock, on_death=self._on_death)
+
+    def _install(self, slot: _Slot, replica: Replica) -> None:
+        with self._lock:
+            slot.replica = replica
+            slot.installed_at = self.clock()
+            slot.credited = False
+            slot.lineage = slot.lineage + (replica.name,)
+            started = self._started
+            self._lock.notify_all()     # wake routers awaiting a replica
+        if started:
+            # Covers the replacement-into-a-running-staged-fleet case
+            # (spawned with autostart=False after start() was called):
+            # service.start() is an idempotent no-op when already live.
+            replica.service.start()
+        self._export_ready_gauge()
+
+    def _on_death(self, replica: Replica, reason: str) -> None:
+        """Replica death callback (any thread): count it against the
+        slot's restart breaker and wake the supervisor."""
+        self._slots[replica.slot].breaker.record_failure()
+        self._export_ready_gauge()
+        self._kick_supervisor()
+
+    def _kick_supervisor(self) -> None:
+        self.supervisor.kick()
+
+    def _export_ready_gauge(self) -> None:
+        _M_READY.set(float(sum(
+            1 for s in self._slots
+            if s.replica is not None and s.replica.state == READY)))
+
+    # ---- router plumbing --------------------------------------------
+
+    def slot_table(self):
+        with self._lock:
+            return list(self._slots)
+
+    def live_replicas(self):
+        with self._lock:
+            return [s.replica for s in self._slots
+                    if s.replica is not None
+                    and s.replica.state == READY]
+
+    def wait_for_live_replica(self, timeout_s: float) -> bool:
+        """Block (real time, bounded) until some slot holds a READY
+        replica or the fleet is closing.  The router's total-loss
+        grace: a rolling restart that momentarily empties the pool must
+        absorb re-queued work, not type-fail it (docs/FLEET.md)."""
+        deadline = time.monotonic() + max(0.0, float(timeout_s))
+        with self._lock:
+            while not self.closing:
+                if any(s.replica is not None
+                       and s.replica.state == READY
+                       for s in self._slots):
+                    return True
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._lock.wait(remaining)
+            return False
+
+    def warm_shapes(self):
+        with self._lock:
+            return sorted(self._warm_shapes)
+
+    def _record_bucket(self, bucket: int) -> None:
+        # Buckets only in _warm_shapes: warmup() normalizes raw request
+        # sizes through bucket_for too, so the set never conflates the
+        # two and replacement warmups resolve each bucket exactly once.
+        with self._lock:
+            self._warm_shapes.add(int(bucket))
+
+    def _account_submitted(self) -> None:
+        with self._lock:
+            self._submitted += 1
+        _M_REQUESTS.inc()
+
+    def _account_resolved(self, ok: bool) -> None:
+        with self._lock:
+            if ok:
+                self._resolved_ok += 1
+            else:
+                self._resolved_error += 1
+
+    # ---- request path (the JordanService surface, fleet-wide) -------
+
+    def submit(self, a, deadline_ms: float | None = None):
+        """Route one (n, n) matrix through the fleet; returns a future
+        resolving to :class:`~..serve.batcher.InvertResult`.  Typed
+        rejections: ``ServiceOverloadedError`` (fleet saturated),
+        ``CircuitOpenError`` (every live replica's breaker open for the
+        bucket)."""
+        if deadline_ms is None:
+            deadline_ms = self._svc_kw["default_deadline_ms"]
+        return self.router.submit(a, self._svc_kw["dtype"],
+                                  deadline_ms=deadline_ms)
+
+    def invert(self, a, timeout: float | None = None,
+               deadline_ms: float | None = None):
+        res = self.submit(a, deadline_ms=deadline_ms).result(timeout)
+        if res.singular:
+            from ..driver import SingularMatrixError
+
+            raise SingularMatrixError("singular matrix")
+        return res
+
+    # ---- lifecycle ---------------------------------------------------
+
+    def warmup(self, shapes) -> dict:
+        """Warm every replica against the shared store: the FIRST
+        replica to reach each bucket compiles it (once, fleet-wide);
+        every other replica — and every future replacement — finds it
+        built.  Returns {bucket: engine} from the last replica."""
+        from ..serve.executors import bucket_for
+
+        shapes = [int(s) for s in shapes]
+        with self._lock:
+            # Normalized to buckets — the same coordinates
+            # _record_bucket stores — so stats()["warm_shapes"] reports
+            # what the fleet actually serves and a replacement's warmup
+            # never re-resolves duplicate sizes of one bucket.
+            self._warm_shapes.update(bucket_for(s) for s in shapes)
+        out = {}
+        for replica in self.live_replicas():
+            out = replica.warmup(shapes)
+        return out
+
+    def start(self) -> None:
+        """Start every replica's dispatcher (no-op when
+        ``autostart=True``).  From here on, replacements installed by
+        the supervisor start their dispatcher immediately."""
+        with self._lock:
+            self._started = True
+        for replica in self.live_replicas():
+            replica.service.start()
+
+    def close(self, drain: bool = True) -> None:
+        """Stop supervision (no restarts during shutdown), then close
+        every replica; ``drain=True`` completes all queued and
+        in-flight work first.  Idempotent and thread-safe, like
+        ``JordanService.close``."""
+        with self._lock:
+            self.closing = True
+            self._lock.notify_all()     # release grace-waiting routers
+        with self._close_lock:          # a racing closer blocks here
+            if self._close_complete:    # ... and returns only after the
+                return                  # first has drained everything
+            self.supervisor.stop()
+            for slot in self.slot_table():
+                if slot.replica is not None:
+                    slot.replica.close(drain=drain)
+            self._export_ready_gauge()
+            self._close_complete = True
+
+    def __enter__(self) -> "JordanFleet":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ---- observability ----------------------------------------------
+
+    def stats(self) -> dict:
+        """Fleet-level aggregation: the request ledger (submitted ==
+        ok + typed errors once drained — the zero-silent-loss
+        invariant), per-slot replica snapshots with lineage, restart
+        breaker states, and each live replica's full serving stats."""
+        with self._lock:
+            ledger = {"submitted": self._submitted,
+                      "resolved_ok": self._resolved_ok,
+                      "resolved_error": self._resolved_error,
+                      "outstanding": (self._submitted - self._resolved_ok
+                                      - self._resolved_error)}
+            slots = list(self._slots)
+        per_slot = []
+        ready = 0
+        for s in slots:
+            entry = {"slot": s.index,
+                     "restart_breaker": s.breaker.state,
+                     "lineage": list(s.lineage),
+                     "replica": None}
+            if s.replica is not None:
+                entry["replica"] = s.replica.snapshot()
+                if s.replica.state == READY:
+                    ready += 1
+                    entry["service"] = s.replica.service.stats()
+            per_slot.append(entry)
+        return {
+            "replicas": self.slots,
+            "ready": ready,
+            "ledger": ledger,
+            "warm_shapes": self.warm_shapes(),
+            "executors_compiled": len(self.store),
+            "slots": per_slot,
+        }

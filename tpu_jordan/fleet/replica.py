@@ -1,0 +1,212 @@
+"""One supervised fleet replica (ISSUE 7 tentpole part 1).
+
+A :class:`Replica` is a worker wrapping its own
+:class:`~..serve.service.JordanService` — its own dispatcher thread,
+its own bounded queue, its own per-bucket circuit breakers — while the
+compiled bucket executables live in the fleet-shared
+:class:`~..serve.executors.ExecutorStore` and the engine plans come
+from the shared read-only pre-tuned plan cache.  That split is the
+whole design: everything *stateful about health* is per replica (so one
+sick replica sheds without judging its peers), everything *expensive
+and immutable* is shared (so replacing a replica costs zero compiles
+and zero measurements).
+
+Lifecycle: ``ready`` → (``draining`` →) ``closed`` on a clean
+shutdown, or ``ready`` → ``dead`` on a kill.  A kill is the crash
+simulation (and the supervisor's wedge remedy): the replica stops
+accepting work, its QUEUED requests are failed with the typed
+:class:`ReplicaKilledError` (the router re-queues each one through the
+PR 5 retry/deadline machinery — never lost, never silent), the batch
+already on the device completes and delivers normally (the
+deterministic kill boundary of the in-process worker backend), and the
+supervisor is notified so a warm replacement can take the slot.
+
+The ``replica_kill`` fault point (``resilience/faults.py``) fires on
+the replica's dispatch path — the k-th routed request of a seeded
+:class:`~..resilience.faults.FaultPlan` crashes whichever replica it
+was routed to, byte-identically run after run (the PR 5 chaos
+discipline).
+
+Liveness: a heartbeat thread stamps ``last_beat`` every
+``heartbeat_interval_s`` — but only when the DISPATCHER proves
+liveness (``MicroBatcher.progress()``): idle-parked or advancing its
+tick counter.  A dispatcher stuck mid-execute (the real production
+wedge — a hung device call) keeps ``busy=True`` with a frozen tick
+count, the stamp goes stale, and the supervisor's liveness deadline
+kills and replaces the replica; that kill joins the wedged dispatcher
+with a BOUNDED timeout (``kill_join_timeout_s``) so the supervising
+thread abandons the stuck daemon instead of freezing fleet supervision
+on it.  ``wedge()`` freezes the stamp directly (the deterministic
+wedge fixture for tests — no in-process test should hang a real
+dispatcher on purpose).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..obs import metrics as _obs_metrics
+from ..resilience import faults as _faults
+from ..resilience.faults import InjectedFaultError, InjectedTransientError
+
+#: Replica lifecycle states.
+READY, DRAINING, DEAD, CLOSED = "ready", "draining", "dead", "closed"
+
+_M_DEATHS = _obs_metrics.counter(
+    "tpu_jordan_fleet_replica_deaths_total",
+    "unclean replica deaths (killed/injected/wedged), labeled by reason "
+    "and slot — every one triggers a supervisor replacement attempt")
+
+
+class ReplicaKilledError(RuntimeError):
+    """A replica died (crash, injected ``replica_kill``, or supervisor
+    wedge remedy) while this request was queued at it or being routed
+    to it.  The fleet router treats this as re-queueable: the request
+    is re-dispatched to a healthy replica within its deadline/retry
+    budget — the caller only ever sees it when the budget is exhausted
+    or the whole fleet is gone (typed, never silent)."""
+
+
+class Replica:
+    """One worker in the pool: a :class:`JordanService` plus lifecycle
+    state, a heartbeat, and the kill/drain hooks the supervisor and
+    router drive.  ``service`` is built by the pool (shared executor
+    store, read-only plan cache, per-replica metric labels)."""
+
+    def __init__(self, slot: int, generation: int, service,
+                 heartbeat_interval_s: float = 0.05, clock=None,
+                 on_death=None, kill_join_timeout_s: float = 1.0):
+        self.slot = int(slot)
+        self.generation = int(generation)
+        self.name = f"r{slot}g{generation}"
+        self.service = service
+        self.clock = clock if clock is not None else time.monotonic
+        self._on_death = on_death
+        self._kill_join_timeout_s = float(kill_join_timeout_s)
+        self._lock = threading.Lock()
+        self.state = READY
+        self.started_at = self.clock()
+        self.last_beat = self.clock()
+        self._wedged = False
+        self._hb_stop = threading.Event()
+        self._hb = threading.Thread(
+            target=self._beat_loop, args=(float(heartbeat_interval_s),),
+            name=f"tpu-jordan-fleet-hb-{self.name}", daemon=True)
+        self._hb.start()
+
+    # ---- liveness ----------------------------------------------------
+
+    def _beat_loop(self, interval: float) -> None:
+        # The stamp proves DISPATCHER liveness, not this thread's own:
+        # stamping unconditionally from a dedicated thread would keep a
+        # replica whose dispatcher is stuck mid-execute looking healthy
+        # forever.  Idle (busy=False, parked in the condition wait) is
+        # responsive; busy with an advancing tick count is working;
+        # busy with a frozen tick count is the wedge — no stamp, and
+        # the supervisor's staleness deadline fires.  The liveness
+        # deadline must therefore exceed the longest legitimate batch
+        # execution (docs/FLEET.md).
+        last_ticks = None
+        while not self._hb_stop.wait(interval):
+            ticks, busy = self.service._batcher.progress()
+            if not self._wedged and (not busy or ticks != last_ticks):
+                self.last_beat = self.clock()
+            last_ticks = ticks
+
+    def wedge(self) -> None:
+        """Freeze the heartbeat (test fixture): the replica keeps its
+        thread but stops proving liveness — the supervisor's staleness
+        deadline must catch it and kill/replace."""
+        self._wedged = True
+
+    # ---- request path ------------------------------------------------
+
+    def submit(self, a, deadline_ms: float | None = None):
+        """Route one request into this replica's service.  Raises
+        :class:`ReplicaKilledError` when the replica is not serving —
+        including the case where THIS call is the one the seeded
+        ``replica_kill`` schedule crashes (the request never entered a
+        queue; the router re-dispatches it elsewhere)."""
+        if self.state != READY:
+            raise ReplicaKilledError(
+                f"replica {self.name} is {self.state}, not serving")
+        try:
+            _faults.fire("replica_kill")
+        except (InjectedFaultError, InjectedTransientError) as e:
+            self.kill(reason="injected")
+            raise ReplicaKilledError(
+                f"replica {self.name} crashed at dispatch "
+                f"(injected replica_kill)") from e
+        return self.service.submit(a, deadline_ms=deadline_ms)
+
+    def warmup(self, shapes) -> dict:
+        return self.service.warmup(shapes)
+
+    def breaker_allows(self, bucket_n: int) -> bool:
+        """Router shedding hook: False while this replica's per-bucket
+        breaker is open (it receives no traffic for that bucket; an
+        elapsed cooldown admits the half-open probe here, exactly as at
+        submit)."""
+        br = self.service.executors.breaker(bucket_n)
+        return br is None or br.allow()
+
+    # ---- lifecycle ---------------------------------------------------
+
+    def kill(self, reason: str = "killed") -> bool:
+        """Crash semantics (idempotent; False when already down): mark
+        DEAD, stop the heartbeat, fail every QUEUED request with the
+        typed :class:`ReplicaKilledError` (the in-flight batch on the
+        device completes and delivers — the in-process worker's kill
+        boundary), and notify the supervisor."""
+        with self._lock:
+            if self.state in (DEAD, CLOSED):
+                return False
+            self.state = DEAD
+        self._hb_stop.set()
+        _M_DEATHS.inc(reason=reason, replica=str(self.slot))
+        name = self.name
+        # Bounded join: a kill's whole purpose may be abandoning an
+        # unresponsive worker (the wedge remedy) — joining its stuck
+        # dispatcher unbounded would freeze the supervising thread and
+        # with it all future replacements.
+        self.service.close(
+            drain=False,
+            error=lambda: ReplicaKilledError(
+                f"replica {name} died ({reason}) before this request "
+                f"ran — re-queued by the fleet router"),
+            join_timeout_s=self._kill_join_timeout_s)
+        if self._on_death is not None:
+            self._on_death(self, reason)
+        return True
+
+    def close(self, drain: bool = True) -> None:
+        """Clean shutdown (idempotent): drain in-flight and queued work
+        (``drain=True``), stop the heartbeat, mark CLOSED.  A closed
+        replica is not a death — the supervisor does not replace it."""
+        with self._lock:
+            if self.state in (DEAD, CLOSED):
+                return
+            self.state = DRAINING
+        self._hb_stop.set()
+        self.service.close(drain=drain)
+        with self._lock:
+            self.state = CLOSED
+
+    # ---- observability ----------------------------------------------
+
+    @property
+    def queued(self) -> int:
+        return self.service._batcher.queued
+
+    def snapshot(self) -> dict:
+        """The per-replica slice of ``JordanFleet.stats()``."""
+        return {
+            "name": self.name,
+            "slot": self.slot,
+            "generation": self.generation,
+            "state": self.state,
+            "queued": (self.queued if self.state == READY else 0),
+            "breakers": {str(b): s for b, s in
+                         self.service.executors.breaker_states().items()},
+        }

@@ -1,0 +1,245 @@
+"""Bucket-affinity router with breaker-aware load shedding (ISSUE 7
+tentpole part 2).
+
+Placement: each shape bucket has a *home slot* —
+``bucket.bit_length() % slots`` — so consecutive power-of-two buckets
+home on different replicas and a heterogeneous bucket mix spreads
+across the pool (the MPMD placement idea of arXiv:2412.14374: assign
+heterogeneous stage traffic to workers, don't round-robin blindly).
+A request tries its bucket's home replica first, then the others in
+slot order, skipping:
+
+  * a replica that is not READY (dead/draining — the supervisor is on
+    it), counted as ``shed{reason="dead"}``;
+  * a replica whose per-bucket circuit breaker is open (it receives NO
+    traffic for that bucket until its cooldown admits a half-open
+    probe), counted as ``shed{reason="breaker"}``;
+  * a replica whose bounded queue is full (typed
+    ``ServiceOverloadedError`` from admission), counted as
+    ``shed{reason="overload"}``.
+
+Nothing acceptable anywhere = typed backpressure to the caller —
+:class:`~..serve.batcher.ServiceOverloadedError` when saturation/death
+was the blocker, :class:`~..resilience.policy.CircuitOpenError` when
+every live replica's breaker for the bucket is open.  NEVER a silent
+drop (the PR 3/5 contract, now fleet-wide).
+
+Re-queue on replica death: the router resolves its own *outer* future
+per request from the replica's *inner* future.  When the inner future
+fails with a death-class error (:class:`~.replica.ReplicaKilledError`,
+or ``ServiceClosedError`` from a worker torn down mid-flight), the
+request is re-dispatched to a healthy replica — bounded by the PR 5
+retry budget (``policy.retry.max_retries``), honoring the request's
+ABSOLUTE deadline (the remaining-time window shrinks with each hop;
+``DeadlineExceededError`` stays typed), and counted in
+``tpu_jordan_fleet_reroutes_total``.  Exhausted budget = the typed
+death error to the caller.  Every other failure (deadline, corruption,
+terminal batch error, per-element singularity) propagates typed,
+untouched — a reroute must never retry a REAL answer away.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..obs import metrics as _obs_metrics
+from ..resilience.policy import CircuitOpenError
+from ..serve.batcher import ServiceClosedError, ServiceOverloadedError
+from ..serve.executors import bucket_for
+from .replica import ReplicaKilledError
+
+_M_REROUTES = _obs_metrics.counter(
+    "tpu_jordan_fleet_reroutes_total",
+    "in-flight requests re-queued to another replica after a replica "
+    "death (the supervisor/retry re-queue path), labeled by the dead "
+    "replica's slot")
+_M_SHED = _obs_metrics.counter(
+    "tpu_jordan_fleet_shed_total",
+    "routing decisions that skipped a replica, labeled by reason "
+    "(breaker|overload|dead)")
+
+
+@dataclass
+class _FleetRequest:
+    """One routed request: the raw matrix (re-padded by whichever
+    replica serves it), the caller's ABSOLUTE deadline, the reroute
+    budget spent so far, and the outer future the caller holds."""
+
+    a: np.ndarray
+    n: int
+    bucket: int
+    outer: Future
+    t_deadline: float | None = None      # absolute monotonic deadline
+    attempts: int = 0
+    t_submit: float = field(default=0.0)
+
+    def remaining_ms(self, now: float) -> float | None:
+        if self.t_deadline is None:
+            return None
+        return (self.t_deadline - now) * 1e3
+
+
+class Router:
+    """The fleet's front door.  Holds no replica state of its own —
+    it reads the pool's slot table on every dispatch, so a supervisor
+    replacement is picked up on the very next request."""
+
+    def __init__(self, pool, max_reroutes: int = 2):
+        self.pool = pool
+        self.max_reroutes = max(1, int(max_reroutes))
+
+    # ---- caller side -------------------------------------------------
+
+    def submit(self, a, dtype, deadline_ms: float | None = None) -> Future:
+        a = np.asarray(a, dtype)
+        if a.ndim != 2 or a.shape[0] != a.shape[1]:
+            raise ValueError(f"expected a square (n, n) matrix, "
+                             f"got shape {a.shape}")
+        n = a.shape[0]
+        now = time.monotonic()
+        outer = Future()
+        # Claim immediately (the stdlib executor protocol): the outer
+        # future may be resolved from another thread's callback at any
+        # point after dispatch; a caller cancel() racing that would be
+        # an InvalidStateError crash inside a dispatcher.
+        outer.set_running_or_notify_cancel()
+        req = _FleetRequest(
+            a=a, n=n, bucket=bucket_for(n), outer=outer,
+            t_deadline=(None if deadline_ms is None
+                        else now + float(deadline_ms) / 1e3),
+            t_submit=now)
+        self.pool._record_bucket(req.bucket)
+        self.pool._account_submitted()
+        try:
+            self._dispatch(req)
+        except Exception:
+            self.pool._account_resolved(ok=False)
+            raise
+        return outer
+
+    # ---- dispatch / re-queue ----------------------------------------
+
+    def _candidates(self, bucket: int):
+        """Replicas in affinity order: the bucket's home slot first,
+        then the rest in slot order.  Reads the live slot table — a
+        replacement replica is visible immediately."""
+        replicas = self.pool.live_replicas()
+        if not replicas:
+            return []
+        nslots = self.pool.slots
+        home = bucket.bit_length() % nslots
+        return sorted(replicas,
+                      key=lambda r: (r.slot - home) % nslots)
+
+    def _dispatch(self, req: _FleetRequest) -> None:
+        """Try every candidate once; on acceptance, chain the inner
+        future to the outer.  Raises typed backpressure when nobody
+        accepts (the caller's thread on first submit; resolved onto the
+        outer future on a re-queue hop).
+
+        Total-loss grace: finding ZERO live replicas (every slot dead
+        mid rolling-restart — distinct from saturation, which stays
+        immediate typed backpressure) waits once, bounded by
+        ``pool.restart_grace_s`` and the request's own deadline, for
+        the supervisor's warm replacement, then rescans."""
+        shed_breaker = shed_overload = shed_dead = 0
+        waited = False
+        while True:
+            candidates = self._candidates(req.bucket)
+            down = self.pool.slots - len(candidates)
+            if down:
+                # Routine routing-around: a dead/draining replica (or
+                # an unfilled slot mid rolling-restart) sheds this
+                # request's traffic — the docs/FLEET.md "dead" row, not
+                # just the died-between-scan-and-submit race below.
+                _M_SHED.inc(down, reason="dead")
+                shed_dead += down
+            for replica in candidates:
+                if not replica.breaker_allows(req.bucket):
+                    _M_SHED.inc(reason="breaker")
+                    shed_breaker += 1
+                    continue
+                try:
+                    inner = replica.submit(
+                        req.a,
+                        deadline_ms=req.remaining_ms(time.monotonic()))
+                except (ReplicaKilledError, ServiceClosedError):
+                    # Died between the candidate scan and the submit
+                    # (or THIS submit triggered the seeded kill): not
+                    # this request's problem — next candidate.
+                    _M_SHED.inc(reason="dead")
+                    shed_dead += 1
+                    self.pool._kick_supervisor()
+                    continue
+                except ServiceOverloadedError:
+                    _M_SHED.inc(reason="overload")
+                    shed_overload += 1
+                    continue
+                except CircuitOpenError:
+                    # Breaker flipped between breaker_allows and
+                    # admission.
+                    _M_SHED.inc(reason="breaker")
+                    shed_breaker += 1
+                    continue
+                inner.add_done_callback(
+                    lambda f, req=req, replica=replica:
+                        self._on_inner_done(req, replica, f))
+                return
+            if (not waited and not self.pool.closing
+                    and not self.pool.live_replicas()
+                    # Never grace-wait ON the supervising thread: a
+                    # kill's doomed-future callbacks re-dispatch here
+                    # synchronously, and blocking would starve the one
+                    # thread that can install the replacement.
+                    and not self.pool.supervisor.is_supervising_thread()):
+                waited = True
+                grace = self.pool.restart_grace_s
+                rem = req.remaining_ms(time.monotonic())
+                if rem is not None:
+                    grace = min(grace, max(0.0, rem / 1e3))
+                self.pool._kick_supervisor()
+                if self.pool.wait_for_live_replica(grace):
+                    continue
+            break
+        # Nobody accepted: typed backpressure, never a drop.
+        if shed_overload:
+            raise ServiceOverloadedError(
+                f"fleet saturated for bucket {req.bucket}: every live "
+                f"replica's queue is full — retry later (typed "
+                f"backpressure, nothing dropped)")
+        if shed_breaker:
+            raise CircuitOpenError(
+                f"every live replica's circuit for bucket {req.bucket} "
+                f"is open — retry after the cooldown")
+        raise ServiceOverloadedError(
+            "no live replica (fleet restarting or closed) — retry "
+            "later (typed backpressure, nothing dropped)")
+
+    def _on_inner_done(self, req: _FleetRequest, replica, inner) -> None:
+        """Resolve the outer future, or re-queue after a replica death.
+        Runs on whichever thread resolved the inner future (a replica
+        dispatcher, or a killer failing queued work) — by the batcher's
+        close contract, never under a queue lock."""
+        exc = inner.exception()
+        if exc is None:
+            self.pool._account_resolved(ok=True)
+            req.outer.set_result(inner.result())
+            return
+        if (isinstance(exc, (ReplicaKilledError, ServiceClosedError))
+                and not self.pool.closing
+                and req.attempts < self.max_reroutes):
+            req.attempts += 1
+            _M_REROUTES.inc(replica=str(replica.slot))
+            self.pool._kick_supervisor()
+            try:
+                self._dispatch(req)
+            except Exception as e:           # noqa: BLE001 — typed out
+                self.pool._account_resolved(ok=False)
+                req.outer.set_exception(e)
+            return
+        self.pool._account_resolved(ok=False)
+        req.outer.set_exception(exc)

@@ -1,0 +1,187 @@
+"""The replica supervisor (ISSUE 7 tentpole part 3).
+
+One background thread health-checks the pool and keeps it at strength:
+
+  * **Death detection** — a replica that crashed (the seeded
+    ``replica_kill``, or a real exception path calling ``kill()``)
+    kicks the supervisor immediately via the pool's death callback; no
+    polling latency on the common path.
+  * **Wedge detection** — a replica whose heartbeat stamp is stale past
+    ``liveness_deadline_s`` is declared wedged and killed (its queued
+    work fails typed and is re-queued by the router), then replaced.
+  * **Warm rolling restart** — the replacement replica is built against
+    the fleet-shared :class:`~..serve.executors.ExecutorStore` and the
+    shared read-only pre-tuned plan cache, then ``warmup()`` is run on
+    every bucket the fleet has ever served BEFORE the replica enters
+    the slot table — so the router never routes to a cold worker and
+    the replacement performs ZERO compiles and ZERO measurements
+    (``tpu_jordan_compiles_total`` delta == 0, the acceptance pin).
+  * **Restart breaker** (the supervisor-level breaker wiring) — each
+    slot carries a :class:`~..resilience.policy.CircuitBreaker`: a slot
+    whose replicas keep dying without ever reaching ``stable_after_s``
+    of uptime stops being restarted (open breaker = the fleet runs
+    degraded rather than burning CPU on a crash loop), until the
+    cooldown admits a half-open restart probe.  A replacement that
+    survives ``stable_after_s`` records the success that closes it.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..obs import metrics as _obs_metrics
+from .replica import DEAD, READY
+
+_M_RESTARTS = _obs_metrics.counter(
+    "tpu_jordan_fleet_restarts_total",
+    "warm rolling restarts performed by the supervisor (replacement "
+    "replica entered the slot), labeled by slot")
+_M_RESTART_FAILURES = _obs_metrics.counter(
+    "tpu_jordan_fleet_restart_failures_total",
+    "replacement replicas that failed to build/warm up (counted "
+    "against the slot's restart breaker)")
+
+
+class Supervisor:
+    """The pool's health-check/restart loop.  ``check()`` is the whole
+    policy and is callable inline (tests drive it deterministically
+    with ``autostart_supervisor=False``); the thread just runs it every
+    ``check_interval_s`` or immediately when kicked."""
+
+    def __init__(self, pool, check_interval_s: float = 0.05,
+                 liveness_deadline_s: float = 1.0,
+                 stable_after_s: float = 2.0):
+        self.pool = pool
+        self.check_interval_s = float(check_interval_s)
+        self.liveness_deadline_s = float(liveness_deadline_s)
+        self.stable_after_s = float(stable_after_s)
+        self._kick = threading.Event()
+        self._stop = False
+        self._thread: threading.Thread | None = None
+        # Serializes start/stop: racing closers (both fleet.close
+        # branches call stop()) must each return only after the loop
+        # thread is joined, not crash on a _thread turned None.
+        self._lifecycle = threading.Lock()
+        # The thread currently inside check() (loop thread or an
+        # inline test drive): the router must never grace-wait for a
+        # replacement on the one thread that could install it.
+        self._supervising: threading.Thread | None = None
+
+    # ---- lifecycle ---------------------------------------------------
+
+    def start(self) -> None:
+        with self._lifecycle:
+            if self._thread is None and not self._stop:
+                self._thread = threading.Thread(
+                    target=self._loop, name="tpu-jordan-fleet-supervisor",
+                    daemon=True)
+                self._thread.start()
+
+    def kick(self) -> None:
+        """Wake the loop now (a death just happened — don't wait out
+        the poll interval)."""
+        self._kick.set()
+
+    def stop(self) -> None:
+        self._stop = True
+        self._kick.set()
+        with self._lifecycle:
+            if self._thread is not None:
+                self._thread.join()
+                self._thread = None
+
+    def _loop(self) -> None:
+        while True:
+            self._kick.wait(self.check_interval_s)
+            self._kick.clear()
+            if self._stop:
+                return
+            self.check()
+
+    # ---- the health-check policy ------------------------------------
+
+    def is_supervising_thread(self) -> bool:
+        """True when the calling thread is inside ``check()`` — the
+        router's total-loss grace must not block this thread (it is
+        the only one that can install the replacement it would be
+        waiting for)."""
+        return threading.current_thread() is self._supervising
+
+    def check(self) -> None:
+        """One supervision pass over every slot: wedge detection, slot
+        refill (breaker permitting), stability credit."""
+        pool = self.pool
+        if pool.closing:
+            return
+        self._supervising = threading.current_thread()
+        try:
+            self._check()
+        finally:
+            self._supervising = None
+
+    def _check(self) -> None:
+        pool = self.pool
+        now = pool.clock()
+        for slot in pool.slot_table():
+            replica = slot.replica
+            if replica is not None and replica.state == READY:
+                # Wedge: READY but the heartbeat went stale.
+                if now - replica.last_beat > self.liveness_deadline_s:
+                    self._replace_wedged(slot, replica)
+                elif (not slot.credited
+                      and now - slot.installed_at >= self.stable_after_s):
+                    # Survived the stability window: the success that
+                    # closes the slot's restart breaker.
+                    slot.breaker.record_success()
+                    slot.credited = True
+            replica = slot.replica
+            if replica is None or replica.state == DEAD:
+                self._try_restart(slot)
+        pool._export_ready_gauge()
+
+    def _replace_wedged(self, slot, victim) -> None:
+        """Kill a wedged replica — staging its warm replacement FIRST
+        (breaker permitting).  ``kill()`` fails the victim's queued
+        futures synchronously on THIS thread and their done-callbacks
+        re-dispatch through the router, so the replacement must already
+        be in the slot table when they run: otherwise a momentarily
+        empty pool would grace-wait on the one thread able to install
+        it (a self-deadlock).  When the breaker withholds the
+        replacement, kill anyway — running degraded is the designed
+        crash-loop answer."""
+        pool = self.pool
+        replacement = None
+        if slot.breaker.allow():
+            try:
+                replacement = pool._spawn_replica(slot.index)
+                replacement.warmup(pool.warm_shapes())
+            except Exception:           # noqa: BLE001 — counted, retried
+                _M_RESTART_FAILURES.inc(replica=str(slot.index))
+                slot.breaker.record_failure()
+                if replacement is not None:
+                    replacement.close(drain=False)
+                replacement = None
+        if replacement is not None:
+            pool._install(slot, replacement)
+            _M_RESTARTS.inc(replica=str(slot.index))
+        victim.kill(reason="wedged")
+
+    def _try_restart(self, slot) -> None:
+        """Refill one slot with a warm replacement, breaker permitting.
+        The replacement warms EVERY bucket the fleet has served before
+        entering the slot table (zero compiles — shared store)."""
+        pool = self.pool
+        if not slot.breaker.allow():
+            return                      # crash loop: stay degraded
+        replica = None
+        try:
+            replica = pool._spawn_replica(slot.index)
+            replica.warmup(pool.warm_shapes())
+        except Exception:               # noqa: BLE001 — counted, retried
+            _M_RESTART_FAILURES.inc(replica=str(slot.index))
+            slot.breaker.record_failure()
+            if replica is not None:
+                replica.close(drain=False)   # reap the half-built worker
+            return
+        pool._install(slot, replica)
+        _M_RESTARTS.inc(replica=str(slot.index))

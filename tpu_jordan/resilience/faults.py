@@ -28,6 +28,12 @@ PRs 1-4:
                       simulation: poisons the result so the integrity /
                       residual gates must catch it)
   dispatch            the serve dispatcher, before executor lookup
+  replica_kill        the fleet replica's dispatch path
+                      (``fleet/replica.py``): a scheduled hit crashes
+                      the replica mid-stream — state DEAD, queued work
+                      failed with the typed ``ReplicaKilledError`` (the
+                      router re-queues it), the supervisor warm-replaces
+                      the worker (ISSUE 7)
   ==================  ====================================================
 
 A point with no active plan costs one module-global ``is None`` check —
@@ -51,7 +57,7 @@ from ..obs import metrics as _obs_metrics
 #: The named injection points.  ``fire()`` on an unknown point raises —
 #: a typo'd point would otherwise be chaos that never happens.
 POINTS = ("compile", "execute", "plan_cache_write", "measure",
-          "result_corrupt_nan", "dispatch")
+          "result_corrupt_nan", "dispatch", "replica_kill")
 
 #: Injection modes: how a scheduled hit manifests at the call site.
 #:   transient — raises :class:`InjectedTransientError` (classified
@@ -140,9 +146,11 @@ class FaultPlan:
         is the chaos-demo mix (compile failures, transient execute
         errors, NaN result corruption, plan-cache write failures — the
         ISSUE 5 acceptance set).  Seeded modes: ``plan_cache_write`` ->
-        oserror, ``result_corrupt_nan`` -> corrupt, everything else
-        transient (permanent faults are a deliberate hand-built choice,
-        never a seeded surprise).
+        oserror, ``result_corrupt_nan`` -> corrupt, ``replica_kill`` ->
+        permanent (a process crash is not transient — the replica dies
+        and the supervisor replaces it, ISSUE 7), everything else
+        transient (other permanent faults are a deliberate hand-built
+        choice, never a seeded surprise).
         """
         if points is None:
             points = {"compile": 1, "execute": 3,
@@ -162,6 +170,7 @@ class FaultPlan:
                 for c in rng.choice(h, size=count, replace=False)))
             mode = ("oserror" if point == "plan_cache_write"
                     else "corrupt" if point == "result_corrupt_nan"
+                    else "permanent" if point == "replica_kill"
                     else "transient")
             specs.append(FaultSpec(point, calls, mode))
         return cls(specs)

@@ -129,10 +129,24 @@ class MicroBatcher:
         self.max_queue = int(max_queue)
         self.block_size = block_size
         self._cv = threading.Condition()
+        # Serializes close() itself: the supervisor and a context
+        # manager __exit__ may race to close the same batcher (ISSUE 7
+        # satellite); the second caller must block until the first
+        # finished, then no-op.
+        self._close_lock = threading.Lock()
         self._queues: dict[int, deque] = {}
         self._queued = 0
         self._closing = False
         self._thread: threading.Thread | None = None
+        # Dispatcher-progress signal (ISSUE 7 liveness): ``_ticks``
+        # advances every time the dispatcher returns to the pick/wait
+        # cycle, ``_busy`` is True while it is out executing a batch.
+        # A fleet replica's heartbeat stamps off this (``progress()``),
+        # so a dispatcher stuck mid-execute — the real production
+        # wedge — stops proving liveness and the supervisor's staleness
+        # deadline catches it.
+        self._ticks = 0
+        self._busy = False
         if autostart:
             self.start()
 
@@ -169,43 +183,90 @@ class MicroBatcher:
         return req.future
 
     def start(self) -> None:
-        if self._thread is None:
+        with self._cv:
+            if self._closing or self._thread is not None:
+                return
             self._thread = threading.Thread(
                 target=self._loop, name="tpu-jordan-serve", daemon=True)
             self._thread.start()
 
-    def close(self, drain: bool = True) -> None:
+    def close(self, drain: bool = True, error=None,
+              join_timeout_s: float | None = None) -> None:
         """Stop accepting work.  ``drain=True`` (the default) completes
         every queued request before returning; ``drain=False`` fails
         queued futures with :class:`ServiceClosedError` (explicitly —
-        never silently)."""
-        with self._cv:
-            self._closing = True
-            if not drain:
-                for q in self._queues.values():
-                    while q:
-                        req = q.popleft()
-                        # Claim-then-fail: a future the caller already
-                        # cancelled is left alone (claim fails).
-                        if req.future.set_running_or_notify_cancel():
-                            req.future.set_exception(
-                                ServiceClosedError(
-                                    "service closed before this "
-                                    "request ran"))
-                self._queued = 0
-            self._cv.notify_all()
-        if self._thread is not None:
-            self._thread.join()
-            self._thread = None
-        elif self._queued:
-            # Never started: drain inline on the caller's thread (the
-            # loop exits once closing and empty).
-            self._loop()
+        never silently), or with whatever the zero-arg ``error`` factory
+        builds (the fleet's replica kill passes its typed
+        ``ReplicaKilledError`` so the router re-queues, ISSUE 7).
+
+        Idempotent and thread-safe: concurrent closers serialize on one
+        lock, and the second (and every later) call finds nothing left
+        to do.  Queued futures are failed OUTSIDE the queue lock —
+        their done-callbacks (the fleet router re-dispatches from one)
+        may submit to other services and must never run under this
+        batcher's lock.
+
+        ``join_timeout_s`` bounds the dispatcher-thread join (ISSUE 7
+        kill path): killing a replica whose dispatcher is genuinely
+        wedged must not block the supervising thread forever.  On
+        timeout the daemon thread is abandoned (counted) — it observes
+        ``_closing`` and exits if it ever comes back.  ``None`` (the
+        default, every clean-shutdown path) joins until the drain
+        completes."""
+        with self._close_lock:
+            doomed = []
+            with self._cv:
+                self._closing = True
+                if not drain:
+                    for q in self._queues.values():
+                        while q:
+                            doomed.append(q.popleft())
+                    self._queued = 0
+                self._cv.notify_all()
+            make_error = error if error is not None else (
+                lambda: ServiceClosedError(
+                    "service closed before this request ran"))
+            for req in doomed:
+                # Claim-then-fail: a future the caller already
+                # cancelled is left alone (claim fails).
+                if req.future.set_running_or_notify_cancel():
+                    req.future.set_exception(make_error())
+            if self._thread is not None:
+                self._thread.join(join_timeout_s)
+                if self._thread.is_alive():
+                    # Wedged dispatcher abandoned on the kill path: the
+                    # reference stays so a later (clean) close can join
+                    # again; start() is already fenced by _closing.
+                    _obs_metrics.counter(
+                        "tpu_jordan_serve_dispatcher_abandoned_total",
+                        "dispatcher threads still alive past a bounded "
+                        "close join (wedged mid-execute) — abandoned as "
+                        "daemons by the replica kill path",
+                    ).inc()
+                else:
+                    self._thread = None
+            elif self._queued:
+                # Never started: drain inline on the caller's thread
+                # (the loop exits once closing and empty).
+                self._loop()
 
     @property
     def queued(self) -> int:
         with self._cv:
             return self._queued
+
+    def progress(self) -> tuple[int, bool]:
+        """``(ticks, busy)`` — the dispatcher liveness signal.  An idle
+        dispatcher (parked in the condition wait, ``busy=False``) is
+        responsive; a busy one proves liveness by advancing ``ticks``
+        (it returned from a batch).  ``busy=True`` with a frozen tick
+        count is a dispatcher stuck mid-execute: the caller (the fleet
+        replica's heartbeat) stops stamping and lets the supervisor's
+        staleness deadline declare the wedge.  Safe to call against a
+        wedged dispatcher — it never holds the queue lock while
+        executing."""
+        with self._cv:
+            return self._ticks, self._busy
 
     # ---- dispatcher side ---------------------------------------------
 
@@ -232,6 +293,8 @@ class MicroBatcher:
     def _loop(self) -> None:
         while True:
             with self._cv:
+                self._busy = False
+                self._ticks += 1
                 while True:
                     now = time.perf_counter()
                     bucket = self._pick(now)
@@ -253,6 +316,7 @@ class MicroBatcher:
                         batch = self._fail_expired(batch, "queue")
                         if not batch:
                             continue
+                        self._busy = True
                         break
                     if self._closing and self._queued == 0:
                         return

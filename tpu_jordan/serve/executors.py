@@ -136,6 +136,59 @@ class BucketExecutor:
         return self._compiled(stacked, n_real)
 
 
+class ExecutorStore:
+    """A thread-safe fleet-wide home for compiled :class:`BucketExecutor`
+    objects (ISSUE 7 tentpole).  Each :class:`ExecutorCache` owns a
+    private store by default (the single-service behavior, unchanged);
+    a fleet passes ONE store to every replica's cache so an executable
+    is compiled at most once per key across the whole replica pool —
+    this is what makes a warm rolling restart free: the replacement
+    replica's ``warmup()`` finds every executable already built and
+    performs ZERO compiles (``tpu_jordan_compiles_total`` delta == 0,
+    the acceptance pin).  Compiled executables are stateless to call
+    (jax AOT programs), so concurrent replicas share them safely.
+
+    ``get_or_build`` serializes builds on a PER-KEY lock — exactly one
+    compile per key, never a thundering herd of replicas compiling the
+    same bucket — while builds for *different* keys proceed
+    concurrently: one replica's slow or retrying compile must not
+    stall every other replica's cold bucket (or the supervisor's
+    warm-replacement warmup) behind a single store-wide lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._executors: dict[ExecutorKey, BucketExecutor] = {}
+        self._building: dict[ExecutorKey, threading.Lock] = {}
+
+    def get_or_build(self, key: ExecutorKey, build):
+        """Return ``(executor, built)``: the stored executor for ``key``
+        (``built=False``), or the result of ``build()`` installed under
+        the key's build lock (``built=True``).  A failed ``build()``
+        leaves nothing installed — the next caller for the key retries."""
+        with self._lock:
+            ex = self._executors.get(key)
+            if ex is not None:
+                return ex, False
+            key_lock = self._building.setdefault(key, threading.Lock())
+        with key_lock:
+            with self._lock:
+                ex = self._executors.get(key)
+                if ex is not None:      # a racing builder won
+                    return ex, False
+            ex = build()
+            with self._lock:
+                self._executors[key] = ex
+            return ex, True
+
+    def keys(self):
+        with self._lock:
+            return list(self._executors)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._executors)
+
+
 class ExecutorCache:
     """The service's executable store: ``get()`` compiles at most once
     per key (lock-held; ``compiles``/``cache_hits`` counted per bucket
@@ -143,11 +196,21 @@ class ExecutorCache:
     ladder — plan cache first, registry cost ranking otherwise — at a
     batched tuning point.  ``measurements`` (the tuner's counter) stays
     0 for the service's cost-only ladder; the acceptance test pins it.
-    """
+
+    ``store`` (ISSUE 7): an optional fleet-shared :class:`ExecutorStore`
+    holding the compiled executables; None (the default) keeps a
+    private store — byte-identical single-service behavior.  Breakers,
+    stats, and plan resolution stay PER CACHE either way (a fleet
+    replica's per-bucket breaker is its own health signal; only the
+    immutable compiled programs are shared).  ``plan_cache_read_only``
+    opens the plan-cache path frozen (the fleet's shared pre-tuned
+    plans — ``tuning/plan_cache.py``)."""
 
     def __init__(self, engine: str = "auto", plan_cache: str | None = None,
                  dtype=jnp.float32, stats=None, telemetry=None,
-                 policy=None, breaker_clock=None):
+                 policy=None, breaker_clock=None,
+                 store: ExecutorStore | None = None,
+                 plan_cache_read_only: bool = False):
         from ..driver import resolve_engine
         from ..obs.spans import NULL
 
@@ -170,12 +233,26 @@ class ExecutorCache:
         # AOT-cache contract made visible.
         self._tel = telemetry if telemetry is not None else NULL
         self._lock = threading.Lock()
+        self._store = store if store is not None else ExecutorStore()
+        #: this cache's own view of the executables it resolved — what
+        #: ``entries()``/``stats()`` report per replica even when the
+        #: compiled programs live in a fleet-shared store.
         self._executors: dict[ExecutorKey, BucketExecutor] = {}
         #: memoized (engine, plan) per (bucket_n, batch_cap, block_size):
         #: resolution cannot change for the life of the cache, so the
         #: hot dispatch path never re-walks the tuner ladder.
         self._resolved: dict[tuple, tuple] = {}
-        cache = PlanCache.load(plan_cache) if plan_cache else None
+        # ``plan_cache`` may be a pre-loaded PlanCache instance (the
+        # fleet loads the shared read-only file ONCE and hands every
+        # replica — and every warm replacement — the same frozen
+        # object, the plan analogue of the shared ExecutorStore) or a
+        # path to load here (the single-service behavior).
+        if isinstance(plan_cache, PlanCache):
+            cache = plan_cache
+        else:
+            cache = (PlanCache.load(plan_cache,
+                                    read_only=plan_cache_read_only)
+                     if plan_cache else None)
         self.tuner = Tuner(cache=cache)
 
     def breaker(self, bucket_n: int) -> CircuitBreaker | None:
@@ -227,25 +304,45 @@ class ExecutorCache:
             engine, plan = self._resolved[rkey]
             key = ExecutorKey(bucket_n, batch_cap, self.dtype, engine, m)
             ex = self._executors.get(key)
-            if ex is not None:
-                if self.stats is not None:
-                    self.stats.cache_hit(bucket_n)
-                return ex
+        if ex is not None:
+            if self.stats is not None:
+                self.stats.cache_hit(bucket_n)
+            return ex
+
+        def build():
+            # The compile span wraps the REAL build only — a
+            # shared-store hit must not fake a compile in the trace
+            # (the replacement replica's trace has zero compile
+            # spans, the ISSUE 7 pin).  Transient compile failures
+            # (the remote-compile class, or the `compile` fault
+            # point) are retried per the policy; a terminal failure
+            # propagates to the caller (the dispatcher fans it to
+            # the batch's riders).
             with self._tel.span("compile", bucket=bucket_n,
                                 engine=engine, batch_cap=batch_cap):
-                # Transient compile failures (the remote-compile class,
-                # or the `compile` fault point) are retried per the
-                # policy; a terminal failure propagates to the caller
-                # (the dispatcher fans it to the batch's riders).
-                def build():
+                def one():
                     return BucketExecutor(key, plan)
-                ex = (self.policy.retry.call(build,
-                                             component="serve.compile")
-                      if self.policy is not None else build())
+                return (self.policy.retry.call(
+                            one, component="serve.compile")
+                        if self.policy is not None else one())
+
+        # The wait on the store's per-key build happens OUTSIDE this
+        # cache's lock: one slow or retrying compile of key X must not
+        # stall this replica's dispatch and warmup of every other,
+        # already-warm bucket behind the cache-wide lock (the same
+        # head-of-line guarantee the store's per-key locks give the
+        # fleet).  Two racing same-cache callers both reach the store;
+        # exactly one builds, and installing the same executor twice
+        # below is idempotent.
+        ex, built = self._store.get_or_build(key, build)
+        with self._lock:
             self._executors[key] = ex
-            if self.stats is not None:
+        if self.stats is not None:
+            if built:
                 self.stats.compile(bucket_n)
-            return ex
+            else:
+                self.stats.cache_hit(bucket_n)
+        return ex
 
     def keys(self):
         with self._lock:

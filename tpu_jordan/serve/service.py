@@ -31,6 +31,7 @@ Contract highlights (docs/SERVING.md is the operator guide):
 
 from __future__ import annotations
 
+import threading
 from concurrent.futures import Future
 
 import jax.numpy as jnp
@@ -81,6 +82,24 @@ class JordanService:
         queue wait + execute; an exceeded deadline resolves the future
         with the typed ``DeadlineExceededError``.  None (default) means
         no deadline.
+      shared_executors: optional fleet-shared
+        :class:`~.executors.ExecutorStore` (ISSUE 7) — compiled bucket
+        executables are fetched from / installed into it, so N fleet
+        replicas compile each key at most once between them and a
+        replacement replica warms up with zero compiles.  None (the
+        default): a private store, single-service behavior unchanged.
+      plan_cache_read_only: open ``plan_cache`` frozen (the fleet's
+        shared pre-tuned plans): this replica can never write it, and
+        a write attempt is a typed ``UsageError``
+        (``tuning/plan_cache.py``).  ``plan_cache`` may also be a
+        pre-loaded :class:`~..tuning.plan_cache.PlanCache` instance,
+        used as-is — the fleet passes one frozen instance to every
+        replica instead of re-parsing the file per spawn.
+      metric_labels: extra labels stamped on every process-wide metric
+        series this service mirrors (``serve/stats.py``) — the fleet
+        passes ``{"replica": <slot>}`` so one Prometheus scrape
+        aggregates the pool with per-replica breakdown
+        (docs/FLEET.md).
     """
 
     def __init__(self, engine: str = "auto", plan_cache: str | None = None,
@@ -88,23 +107,29 @@ class JordanService:
                  max_wait_ms: float = 2.0, max_queue: int = 256,
                  block_size: int | None = None, autostart: bool = True,
                  telemetry=None, policy="default",
-                 default_deadline_ms: float | None = None):
+                 default_deadline_ms: float | None = None,
+                 shared_executors=None,
+                 plan_cache_read_only: bool = False,
+                 metric_labels: dict | None = None):
         self.dtype = jnp.dtype(dtype)
         self.batch_cap = int(batch_cap)
         self.telemetry = telemetry
         self.policy = DEFAULT_POLICY if policy == "default" else policy
         self.default_deadline_ms = default_deadline_ms
-        self._stats = ServeStats()
-        self.executors = ExecutorCache(engine=engine, plan_cache=plan_cache,
-                                       dtype=self.dtype, stats=self._stats,
-                                       telemetry=telemetry,
-                                       policy=self.policy)
+        self._stats = ServeStats(labels=metric_labels)
+        self.executors = ExecutorCache(
+            engine=engine, plan_cache=plan_cache,
+            dtype=self.dtype, stats=self._stats,
+            telemetry=telemetry, policy=self.policy,
+            store=shared_executors,
+            plan_cache_read_only=plan_cache_read_only)
         self._batcher = MicroBatcher(
             self.executors, self._stats, batch_cap=batch_cap,
             max_wait_ms=max_wait_ms, max_queue=max_queue,
             block_size=block_size, autostart=autostart,
             telemetry=telemetry, policy=self.policy)
         self._closed = False
+        self._close_lock = threading.Lock()
 
     # ---- request path ------------------------------------------------
 
@@ -174,12 +199,27 @@ class JordanService:
         """Start the dispatcher (no-op when ``autostart=True``)."""
         self._batcher.start()
 
-    def close(self, drain: bool = True) -> None:
+    def close(self, drain: bool = True, error=None,
+              join_timeout_s: float | None = None) -> None:
         """Stop accepting requests; ``drain=True`` completes all queued
-        and in-flight work before returning."""
-        if not self._closed:
-            self._batcher.close(drain=drain)
-            self._closed = True
+        and in-flight work before returning.
+
+        Idempotent and thread-safe (ISSUE 7 satellite): the fleet
+        supervisor and a ``with``-block ``__exit__`` may race to close
+        the same replica — the first caller does the work, every later
+        (or concurrent) call blocks until it finished and then no-ops.
+        ``error`` (a zero-arg exception factory, ``drain=False`` only)
+        types the failure queued requests receive — the replica kill
+        path passes ``ReplicaKilledError`` so the fleet router
+        re-queues them instead of reporting a plain closed service.
+        ``join_timeout_s`` bounds the dispatcher join (the kill path:
+        abandoning a wedged dispatcher beats freezing the supervisor —
+        ``serve/batcher.py``); None joins until drained."""
+        with self._close_lock:
+            if not self._closed:
+                self._batcher.close(drain=drain, error=error,
+                                    join_timeout_s=join_timeout_s)
+                self._closed = True
 
     def __enter__(self) -> "JordanService":
         return self
@@ -292,6 +332,22 @@ def _chaos_requests(n: int, requests: int, seed: int, dtype):
     return mats
 
 
+def _classify_response(f, timeout: float = 600.0):
+    """One response outcome tuple: ("ok", inverse-bytes, singular) or
+    ("error", type-name, None).  ``f`` is a future, or the typed
+    exception a submit-time rejection raised.  The chaos demo and the
+    fleet demo (``fleet/demo.py``) both bit-compare a chaos stream
+    against a fault-free replay of THESE tuples — one shared encoding,
+    or the comparison silently diverges."""
+    if isinstance(f, Exception):
+        return ("error", type(f).__name__, None)
+    try:
+        r = f.result(timeout)
+        return ("ok", np.asarray(r.inverse).tobytes(), bool(r.singular))
+    except Exception as e:                           # noqa: BLE001
+        return ("error", type(e).__name__, None)
+
+
 def _run_stream(svc, mats, timeout: float = 600.0):
     """Submit a staged request stream (deterministic batching: queue
     everything, then start the dispatcher) and classify every response:
@@ -305,18 +361,7 @@ def _run_stream(svc, mats, timeout: float = 600.0):
         except Exception as e:                       # noqa: BLE001
             futs.append(e)
     svc.start()
-    out = []
-    for f in futs:
-        if isinstance(f, Exception):
-            out.append(("error", type(f).__name__, None))
-            continue
-        try:
-            r = f.result(timeout)
-            out.append(("ok", np.asarray(r.inverse).tobytes(),
-                        bool(r.singular)))
-        except Exception as e:                       # noqa: BLE001
-            out.append(("error", type(e).__name__, None))
-    return out
+    return [_classify_response(f, timeout) for f in futs]
 
 
 def chaos_demo(n: int = 96, block_size: int | None = None,

@@ -104,10 +104,30 @@ class _BucketStats:
 class ServeStats:
     """Thread-safe serving scoreboard, keyed by bucket n.  Mutations
     mirror into the process-wide ``obs.metrics.REGISTRY`` with a
-    ``bucket`` label; ``snapshot()`` stays per-instance."""
+    ``bucket`` label; ``snapshot()`` stays per-instance.
 
-    def __init__(self):
+    ``labels`` (ISSUE 7): extra labels stamped on every mirrored series
+    — a fleet replica passes ``{"replica": <slot>}`` so the one
+    process-wide registry aggregates the whole pool while each series
+    stays attributable to its replica (fleet-level Prometheus
+    aggregation over the PR 4 exporters, docs/FLEET.md)."""
+
+    #: Label keys that collide with the mirror calls — ones ServeStats
+    #: stamps itself ("bucket"/"component") or that bind to the metric
+    #: APIs' own ``value`` parameter (``Counter.inc``/``Gauge.set``/
+    #: ``Histogram.observe``).  Any of these as a user label would raise
+    #: TypeError deep in the request path, so refuse up front typed.
+    RESERVED_LABELS = frozenset({"bucket", "component", "value"})
+
+    def __init__(self, labels: dict | None = None):
         self._lock = threading.Lock()
+        self._labels = {str(k): str(v) for k, v in (labels or {}).items()}
+        clash = self.RESERVED_LABELS & set(self._labels)
+        if clash:
+            from ..driver import UsageError
+            raise UsageError(
+                f"reserved metric label(s) {sorted(clash)} — these are "
+                f"stamped by ServeStats itself; pick different names")
         self._buckets: dict[int, _BucketStats] = {}
 
     def _b(self, bucket: int) -> _BucketStats:
@@ -116,22 +136,22 @@ class ServeStats:
     def request(self, bucket: int) -> None:
         with self._lock:
             self._b(bucket).requests += 1
-        _M_REQUESTS.inc(bucket=bucket)
+        _M_REQUESTS.inc(bucket=bucket, **self._labels)
 
     def rejected(self, bucket: int) -> None:
         with self._lock:
             self._b(bucket).rejected += 1
-        _M_REJECTED.inc(bucket=bucket)
+        _M_REJECTED.inc(bucket=bucket, **self._labels)
 
     def compile(self, bucket: int) -> None:
         with self._lock:
             self._b(bucket).compiles += 1
-        _M_COMPILES.inc(component="serve", bucket=bucket)
+        _M_COMPILES.inc(component="serve", bucket=bucket, **self._labels)
 
     def cache_hit(self, bucket: int) -> None:
         with self._lock:
             self._b(bucket).cache_hits += 1
-        _M_CACHE_HITS.inc(bucket=bucket)
+        _M_CACHE_HITS.inc(bucket=bucket, **self._labels)
 
     def batch(self, bucket: int, occupancy: int, exec_seconds: float,
               queue_seconds, singular: int = 0) -> None:
@@ -145,13 +165,15 @@ class ServeStats:
             b.singular += singular
             b.exec_s.add(float(exec_seconds))
             b.queue_s.extend(queue_seconds)
-        _M_BATCHES.inc(bucket=bucket)
-        _M_OCCUPANCY.observe(occupancy, bucket=bucket)
-        _M_EXEC_S.observe(float(exec_seconds), bucket=bucket)
+        _M_BATCHES.inc(bucket=bucket, **self._labels)
+        _M_OCCUPANCY.observe(occupancy, bucket=bucket, **self._labels)
+        _M_EXEC_S.observe(float(exec_seconds), bucket=bucket,
+                          **self._labels)
         for q in queue_seconds:
-            _M_QUEUE_S.observe(q, bucket=bucket)
+            _M_QUEUE_S.observe(q, bucket=bucket, **self._labels)
         if singular:
-            _M_SINGULAR.inc(singular, component="serve", bucket=bucket)
+            _M_SINGULAR.inc(singular, component="serve", bucket=bucket,
+                            **self._labels)
 
     def snapshot(self) -> dict:
         with self._lock:

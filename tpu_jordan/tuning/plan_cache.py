@@ -16,6 +16,16 @@ fields) or a version mismatch is ALSO an empty cache with
 ranking instead of crashing the solve, and the next ``save`` rewrites
 the file cleanly.  Saves are atomic (tmp + ``os.replace``) so a crashed
 writer can never leave a half-written cache for the next reader.
+
+Read-only mode (ISSUE 7 satellite): ``load(path, read_only=True)``
+freezes the cache — every fleet replica opens the shared pre-tuned
+plans this way, so N replicas can read one pod-pretuned file with ZERO
+write traffic and zero lock contention (``get`` is a plain dict read on
+a dict that never mutates again; there is no lock to contend on).  A
+write attempt (``put`` or ``save``) on a read-only cache is a typed
+``UsageError`` — a replica must never scribble over the shared
+pre-tuned plans — and the tuner skips its write-back for read-only
+caches instead of tripping it (``tuning/tuner.py``).
 """
 
 from __future__ import annotations
@@ -115,9 +125,13 @@ class PlanCache:
 
     def __init__(self, path: str | None = None,
                  plans: dict[str, Plan] | None = None,
-                 fallback_reason: str | None = None):
+                 fallback_reason: str | None = None,
+                 read_only: bool = False):
         self.path = path
         self.plans = dict(plans or {})
+        #: frozen cache (the fleet's shared pre-tuned plans): ``put`` /
+        #: ``save`` raise the typed UsageError instead of mutating.
+        self.read_only = bool(read_only)
         #: why a load produced an empty cache (corruption/version skew);
         #: None on a clean load.  Surfaced so operators can see that a
         #: cache was ignored rather than silently empty.
@@ -128,36 +142,59 @@ class PlanCache:
         self.last_write_error: str | None = None
 
     @classmethod
-    def load(cls, path: str) -> "PlanCache":
+    def load(cls, path: str, read_only: bool = False) -> "PlanCache":
         """Load ``path``; NEVER raises for bad cache contents — the
         documented fallback is an empty cache + ``fallback_reason`` (the
-        tuner then ranks by cost model)."""
+        tuner then ranks by cost model).  ``read_only=True`` freezes the
+        result (the fleet's shared pre-tuned cache mode) — and, alone
+        among the fallbacks, a MISSING file is then a typed
+        ``UsageError``: read-only's whole contract is serving an
+        existing pre-tuned file, so a typoed path must not silently
+        become an empty cache serving off cost ranking."""
         if not os.path.exists(path):
-            return cls(path=path)
+            if read_only:
+                from ..driver import UsageError
+                raise UsageError(
+                    f"plan cache {path!r} does not exist — read-only "
+                    f"mode serves a pre-tuned file; check the path or "
+                    f"pretune first")
+            return cls(path=path, read_only=read_only)
         try:
             with open(path, "r") as f:
                 doc = json.load(f)
             version = doc.get("version")
             if version != CACHE_VERSION:
-                return cls(path=path, fallback_reason=(
-                    f"plan cache version {version!r} != "
-                    f"{CACHE_VERSION} — ignoring stale cache"))
+                return cls(path=path, read_only=read_only,
+                           fallback_reason=(
+                               f"plan cache version {version!r} != "
+                               f"{CACHE_VERSION} — ignoring stale cache"))
             plans = {str(k): Plan.from_json(v)
                      for k, v in doc["plans"].items()}
-            return cls(path=path, plans=plans)
+            return cls(path=path, plans=plans, read_only=read_only)
         except (OSError, ValueError, KeyError, TypeError,
                 AttributeError) as e:
             # ValueError covers json.JSONDecodeError; Key/Type/Attribute
             # cover structurally-wrong documents (plans not a dict, plan
             # entries missing fields, scalars where objects belong).
-            return cls(path=path, fallback_reason=(
+            return cls(path=path, read_only=read_only, fallback_reason=(
                 f"corrupt plan cache ({type(e).__name__}: {e}) — "
                 f"falling back to cost-model ranking"))
+
+    def _refuse_write(self, what: str):
+        from ..driver import UsageError
+
+        raise UsageError(
+            f"plan cache {self.path or '<memory>'} is read-only (the "
+            f"fleet's shared pre-tuned plans); {what} is a write — "
+            f"pre-tune with a writable cache (docs/TUNING.md), then "
+            f"serve it read-only")
 
     def get(self, key: str) -> Plan | None:
         return self.plans.get(key)
 
     def put(self, key: str, plan: Plan) -> None:
+        if self.read_only:
+            self._refuse_write(f"put({key!r})")
         self.plans[key] = plan
 
     def save(self, path: str | None = None) -> None:
@@ -171,7 +208,11 @@ class PlanCache:
         warning, and ``last_write_error`` carries the diagnostic.  A
         failed persistence must never fail the successful solve that
         triggered it (ISSUE 5 satellite); later saves retry — transient
-        disk pressure may clear."""
+        disk pressure may clear.  A read-only cache refuses with the
+        typed UsageError instead (ISSUE 7 satellite — that is a caller
+        bug, not disk weather)."""
+        if self.read_only:
+            self._refuse_write("save()")
         path = path or self.path
         if path is None:
             return

@@ -24,7 +24,8 @@ Selection ladder (``Tuner.select``), cheapest evidence first:
      measurements).
 
 Whatever ladder rung produced the plan, it is written back to the cache
-(if one is attached) so the NEXT solve at the same key is rung 1.
+(if one is attached and writable — a read-only shared pre-tuned cache
+is never written, ISSUE 7) so the NEXT solve at the same key is rung 1.
 """
 
 from __future__ import annotations
@@ -129,7 +130,11 @@ class Tuner:
         plan = (self._tune(point) if self.measure
                 else self._rank(point))
         self.last_source = plan.source
-        if self.cache is not None:
+        # Write-back skipped for a read-only cache (the fleet's shared
+        # pre-tuned plans, ISSUE 7 satellite): a replica must never
+        # scribble over the pod-pretuned file, and put/save would raise
+        # the typed UsageError if attempted.
+        if self.cache is not None and not self.cache.read_only:
             self.cache.put(key, plan)
             self.cache.save()
         return plan
