@@ -13,7 +13,7 @@ WORKERS  ?= 1
 REQUESTS  ?= 64
 BATCH_CAP ?= 8
 
-.PHONY: all native tpu test smoke serve-demo chaos-demo fleet-demo metrics-demo bench bench-dip clean
+.PHONY: all native tpu test smoke serve-demo chaos-demo fleet-demo metrics-demo slo-demo blackbox bench bench-dip clean
 
 REPLICAS ?= 3
 
@@ -72,6 +72,29 @@ fleet-demo:
 	  --serve-requests 60 --batch-cap 4 --quiet $(FLEET_ARGS) \
 	  > /tmp/tpu_jordan_fleet.json
 	python tools/check_fleet.py /tmp/tpu_jordan_fleet.json
+
+# SLO demo + validation (docs/OBSERVABILITY.md): the fleet demo with
+# the --slo-report leg — declarative per-bucket availability SLOs
+# evaluated by multi-window burn rate over registry snapshots
+# bracketing the fleet phases; check_slo re-derives every burn rate
+# and page decision from the report's own counts (exit 2 = the fleet
+# is actually burning budget past its thresholds).
+slo-demo:
+	python -m tpu_jordan 96 32 --fleet-demo --replicas $(REPLICAS) \
+	  --serve-requests 60 --batch-cap 4 --quiet --slo-report \
+	  $(FLEET_ARGS) > /tmp/tpu_jordan_slo.json
+	python tools/check_slo.py /tmp/tpu_jordan_slo.json
+	python tools/check_fleet.py /tmp/tpu_jordan_slo.json
+
+# Flight-recorder demo + validation (docs/OBSERVABILITY.md): the chaos
+# demo with the always-on black box dumped via --blackbox-out; the
+# checker reconstructs every request's journey from the raw dump alone
+# and walks each injected fault to its recorded consequence.
+blackbox:
+	python -m tpu_jordan 96 32 --chaos-demo --serve-requests $(REQUESTS) \
+	  --batch-cap 4 --quiet --blackbox-out /tmp/tpu_jordan_blackbox.json \
+	  > /dev/null
+	python tools/check_blackbox.py /tmp/tpu_jordan_blackbox.json
 
 # Telemetry demo + validation (docs/OBSERVABILITY.md): a small solve
 # and a serve burst, each exporting the process-wide tpu_jordan_*

@@ -6,7 +6,11 @@ SAME checker ``make fleet-demo`` runs), router shedding (breaker-open
 replicas receive no bucket traffic; fleet saturation is typed
 backpressure), staged-kill re-queue, wedge detection, the per-slot
 restart breaker against crash loops, and the warm-rolling-restart
-zero-compile pin."""
+zero-compile pin.  ISSUE 8 layers the journey-reconstruction pin onto
+the same cached acceptance run: every request — every typed failure
+and every rerouted success — reconstructible from the embedded
+flight-recorder slice alone, with explanatory hops on every typed
+terminal."""
 
 import importlib.util
 import pathlib
@@ -107,6 +111,37 @@ class TestFleetAcceptance:
         # The deliberately singular fixtures kept their typed
         # per-element flags through kills and reroutes.
         assert report["singular_flagged"] >= 1
+        # ---- journey reconstruction (ISSUE 8 acceptance) -----------
+        # Every request of the chaos pass — every typed failure and
+        # every rerouted success — is reconstructible from the
+        # embedded flight-recorder slice ALONE.
+        bb = report["blackbox"]
+        assert bb["dropped"] == 0
+        journeys = check_fleet._blackbox.journeys(bb["events"])
+        assert len(journeys) == report["requests"]
+        assert report["journey_ledger"]["gaps"] == []
+        assert (report["journey_ledger"]["submitted"]
+                == report["requests"])
+        hops_by_rid = {rid: {e.get("event") for e in evs}
+                       for rid, evs in journeys.items()}
+        # Every typed failure's journey explains itself with its
+        # shed/requeue/retry/... hops (no causal gaps)...
+        explanatory = check_fleet._blackbox.EXPLANATORY_HOPS
+        for rid, evs in journeys.items():
+            terminal = evs[-1]
+            assert terminal.get("event") == "result"
+            if terminal.get("outcome") != "ok":
+                assert hops_by_rid[rid] & explanatory, (
+                    f"typed failure {rid} has no explanatory hop")
+        # ...and the kills demonstrably re-routed work: at least one
+        # journey carries a requeue hop that ended in a clean result
+        # (the fault -> recovery chain, per request).
+        requeued = [rid for rid, hops in hops_by_rid.items()
+                    if "requeue" in hops]
+        assert requeued, "no journey recorded a requeue hop"
+        assert any(
+            journeys[rid][-1].get("outcome") == "ok"
+            for rid in requeued), "no rerouted request recovered"
         # The CI gate agrees (tools/check_fleet.py — same checker the
         # Makefile target runs); no violations, no silent loss.
         assert check_fleet.check(report) == ([], [])
@@ -188,6 +223,10 @@ def test_smoke_fleet_round_trip():
         assert stats["ready"] == 2, "supervisor never refilled the slot"
         assert stats["ledger"]["outstanding"] == 0
         assert stats["ledger"]["resolved_ok"] == 10
+        # The journey-derived ledger (ISSUE 8) agrees with the
+        # response-side one — same requests, zero gaps.
+        assert stats["journey_ledger"]["ok"] == 10
+        assert stats["journey_ledger"]["gaps"] == []
         # The replacement warmed from the shared store: zero compiles.
         assert REGISTRY.counter(
             "tpu_jordan_compiles_total").total() == compiles0
@@ -298,7 +337,9 @@ class TestKillRequeue:
     def test_exhausted_fleet_surfaces_typed_death(self):
         """Queued work on the LAST live replica when it dies (and the
         pool is closing, so no re-dispatch target appears): the caller
-        gets the typed ReplicaKilledError, not a hang or a drop."""
+        gets the typed ReplicaKilledError, not a hang or a drop — and
+        the request's journey explains the terminal (ISSUE 8: a typed
+        failure with no explanatory hop is a causal gap)."""
         with _fleet(replicas=1, autostart=False) as fleet:
             fleet.warmup([16])
             fut = fleet.submit(_mats(1)[0])
@@ -306,6 +347,11 @@ class TestKillRequeue:
             fleet.slot_table()[0].replica.kill(reason="test")
             with pytest.raises(ReplicaKilledError):
                 fut.result(10)
+            (ctx,) = fleet.journey.contexts()
+            assert ctx.outcome() == ("error", "ReplicaKilledError")
+            reject = next(e for e in ctx.events()
+                          if e["event"] == "reject")
+            assert reject["reason"] == "closing"
 
 
 class _StubBatcher:

@@ -1,0 +1,576 @@
+"""Request-journey tracing, flight recorder, and SLO burn-rate units
+(ISSUE 8): deterministic request ids and hop/terminal semantics, the
+bounded always-on recorder's drop accounting and slice brackets,
+multi-window burn-rate math on a fake clock, the ``to_json_line``
+collision guard, async journey lanes in Chrome traces (accept +
+doctored-reject), the check_slo / check_blackbox both-ways gates, and
+the service-level pins: every direct submit journeys to a terminal
+result, typed rejections explain themselves, and the warm-serve
+zero-compile/zero-measurement contract holds with the recorder ON
+(it is never off)."""
+
+import importlib.util
+import json
+import pathlib
+
+import numpy as np
+import pytest
+
+from tpu_jordan.obs import journey as journey_mod
+from tpu_jordan.obs.export import to_chrome_trace, to_json_line
+from tpu_jordan.obs.journey import (JourneyLog, async_trace_events,
+                                    outcome_ledger)
+from tpu_jordan.obs.metrics import REGISTRY, MetricsRegistry
+from tpu_jordan.obs.recorder import RECORDER, FlightRecorder
+from tpu_jordan.obs.slo import SLOMonitor, SLOSpec, bucket_specs
+
+_tools = pathlib.Path(__file__).resolve().parent.parent / "tools"
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(name, _tools / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+check_blackbox = _load("check_blackbox")
+check_slo = _load("check_slo")
+check_telemetry = _load("check_telemetry")
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+def _log(clock=None):
+    """A private journey log writing into a private recorder — unit
+    tests never depend on (or pollute) the process-wide ring."""
+    clock = clock if clock is not None else FakeClock()
+    rec = FlightRecorder(capacity=256, clock=clock)
+    return JourneyLog(prefix="t", clock=clock, recorder=rec), rec, clock
+
+
+class TestRequestContext:
+    def test_deterministic_ids_in_submit_order(self):
+        log, _, _ = _log()
+        first = log.new(16, 16).request_id
+        base = first[:first.index("-")]
+        ids = [first] + [log.new(16, 16).request_id for _ in range(2)]
+        assert ids == [f"{base}-{i:05d}" for i in (1, 2, 3)]
+        # A SECOND log with the same requested prefix mints a distinct
+        # instance prefix: whole-ring exports group purely by
+        # request_id, so ids must never collide across a run's
+        # successive services/fleets (two req-00001 lanes would merge
+        # two different requests into one journey).
+        log2, _, _ = _log()
+        rid2 = log2.new(16, 16).request_id
+        assert rid2.endswith("-00001") and rid2 != ids[0]
+
+    def test_hops_mirror_into_recorder_with_same_timestamp(self):
+        log, rec, clock = _log()
+        ctx = log.new(17, 32)
+        clock.advance(1.5)
+        ctx.event("route", replica="r0g1", slot=0)
+        evs = rec.events(kind="journey")
+        assert [e["event"] for e in evs] == ["submit", "route"]
+        assert evs[1]["t"] == 1.5 and evs[1]["request_id"] == ctx.request_id
+        assert evs[1]["replica"] == "r0g1"
+        # The context's own view carries the SAME instant.
+        assert ctx.events()[1]["t"] == 1.5
+
+    def test_close_is_idempotent_and_feeds_slo_series(self):
+        out = REGISTRY.counter("tpu_jordan_request_outcome_total")
+        before = out.value(outcome="error", bucket=32)
+        log, rec, clock = _log()
+        ctx = log.new(30, 32)
+        clock.advance(0.25)
+        ctx.close("error", error="DeadlineExceededError")
+        ctx.close("ok")                      # late race: first close won
+        ctx.event("late_hop")                # after close: dropped
+        assert ctx.outcome() == ("error", "DeadlineExceededError")
+        assert [e["event"] for e in ctx.events()] == ["submit", "result"]
+        assert out.value(outcome="error", bucket=32) == before + 1
+        assert log.active_count() == 0
+        assert log.ledger()["typed_errors"] == {
+            "DeadlineExceededError": 1}
+
+    def test_event_cap_bounds_pathological_journeys(self, monkeypatch):
+        monkeypatch.setattr(journey_mod, "MAX_EVENTS_PER_REQUEST", 4)
+        log, _, _ = _log()
+        ctx = log.new(16, 16)
+        for i in range(10):
+            ctx.event("hop", i=i)
+        assert len(ctx.events()) == 4        # submit + 3 hops, capped
+
+    def test_close_from_future_maps_outcomes(self):
+        from concurrent.futures import Future
+
+        log, _, _ = _log()
+        ok, bad = Future(), Future()
+        ok.set_result(type("R", (), {"singular": True})())
+        bad.set_exception(ValueError("boom"))
+        c1, c2 = log.new(16, 16), log.new(16, 16)
+        c1.close_from_future(ok)
+        c2.close_from_future(bad)
+        assert c1.outcome() == ("ok", None)
+        assert c1.events()[-1]["singular"] is True
+        assert c2.outcome() == ("error", "ValueError")
+
+
+class TestLedgerAndLanes:
+    def _events(self):
+        log, rec, clock = _log()
+        a, b, c = log.new(16, 16), log.new(16, 16), log.new(16, 16)
+        clock.advance(0.1)
+        a.event("dispatch", cause="full")
+        a.close("ok")
+        b.event("shed", reason="dead")
+        b.close("error", error="ReplicaKilledError")
+        # c never closes: the gap.
+        return rec.events(), (a, b, c)
+
+    def test_outcome_ledger_counts_ok_typed_and_gaps(self):
+        events, (a, b, c) = self._events()
+        led = outcome_ledger(events)
+        assert led["submitted"] == 3 and led["ok"] == 1
+        assert led["typed_errors"] == {"ReplicaKilledError": 1}
+        assert led["gaps"] == [c.request_id]
+
+    def test_async_lanes_one_per_request(self):
+        events, (a, b, c) = self._events()
+        lanes = async_trace_events(events)
+        by_ph = {}
+        for e in lanes:
+            by_ph.setdefault(e["ph"], []).append(e)
+        assert {e["id"] for e in by_ph["b"]} == {
+            a.request_id, b.request_id, c.request_id}
+        assert len(by_ph["b"]) == len(by_ph["e"]) == 3
+        # Every hop is an instant inside its lane, ts in microseconds.
+        shed = next(e for e in by_ph["n"] if e["name"] == "shed")
+        assert shed["id"] == b.request_id
+        assert shed["args"]["reason"] == "dead"
+        assert shed["ts"] == pytest.approx(0.1 * 1e6)
+
+    def test_explanatory_hops_match_checker_copy(self):
+        """The checkers duplicate EXPLANATORY_HOPS (no jax import);
+        this pin is what keeps the two sets from drifting."""
+        assert (journey_mod.EXPLANATORY_HOPS
+                == check_blackbox.EXPLANATORY_HOPS)
+
+
+class TestFlightRecorder:
+    def test_ring_bounded_with_explicit_drop_accounting(self):
+        rec = FlightRecorder(capacity=8, clock=FakeClock())
+        for i in range(20):
+            rec.record("tick", i=i)
+        assert rec.total == 20
+        evs = rec.events()
+        assert len(evs) == 8 and evs[0]["i"] == 12
+        dump = rec.dump()
+        assert dump["retained"] == 8 and dump["dropped"] == 12
+        assert dump["recorded_total"] == 20
+
+    def test_since_brackets_exactly_one_operation(self):
+        rec = FlightRecorder(capacity=64, clock=FakeClock())
+        rec.record("before")
+        mark = rec.total
+        rec.record("inside", x=1)
+        rec.record("inside", x=2)
+        sliced = rec.since(mark)
+        assert [e["x"] for e in sliced] == [1, 2]
+        assert rec.dump(events=sliced)["dropped"] == 0
+
+    def test_write_is_one_json_document(self, tmp_path):
+        rec = FlightRecorder(capacity=8, clock=FakeClock())
+        rec.record("kill", slot=1)
+        path = tmp_path / "bb.json"
+        rec.write(str(path))
+        doc = json.loads(path.read_text())
+        assert doc["metric"] == "blackbox"
+        assert doc["events"][0]["kind"] == "kill"
+
+    def test_capacity_validation(self):
+        with pytest.raises(ValueError):
+            FlightRecorder(capacity=0)
+
+
+def _slo_fixture():
+    """A private registry + fake clock the monitor samples: the test
+    scripts traffic by bumping the outcome counter between samples."""
+    reg = MetricsRegistry()
+    clock = FakeClock()
+    c = reg.counter("tpu_jordan_request_outcome_total")
+    h = reg.histogram("tpu_jordan_request_latency_seconds")
+    return reg, clock, c, h
+
+
+class TestSLOMonitor:
+    def test_healthy_traffic_burns_zero(self):
+        reg, clock, c, _ = _slo_fixture()
+        mon = SLOMonitor([SLOSpec(name="s", availability=0.9)],
+                         registry=reg, clock=clock,
+                         windows=((100.0, 10.0, 2.0),))
+        mon.sample()
+        c.inc(40, outcome="ok", bucket="16")
+        clock.advance(50.0)
+        mon.sample()
+        rep = mon.evaluate()
+        (pair,) = rep["objectives"][0]["windows"]
+        assert pair["long"]["requests"] == 40
+        assert pair["long"]["burn_rate"] == 0.0
+        assert pair["page"] is False and rep["healthy"] is True
+
+    def test_page_requires_long_and_short_window_agreement(self):
+        reg, clock, c, _ = _slo_fixture()
+        mon = SLOMonitor([SLOSpec(name="s", availability=0.9)],
+                         registry=reg, clock=clock,
+                         windows=((1000.0, 10.0, 2.0),))
+        mon.sample()                           # t=0: clean
+        clock.advance(500.0)
+        c.inc(5, outcome="ok", bucket="16")
+        c.inc(5, outcome="error", bucket="16")  # a burst: rate 0.5
+        mon.sample()                           # t=500
+        clock.advance(95.0)
+        c.inc(20, outcome="ok", bucket="16")   # recovered since
+        mon.sample()                           # t=595
+        rep = mon.evaluate()
+        (pair,) = rep["objectives"][0]["windows"]
+        # Long window (truncated to the whole run): 5 errors / 30,
+        # burn 1.67 under threshold... craft it hot instead:
+        assert pair["long"]["errors"] == 5
+        assert pair["short"]["errors"] == 0    # the burst is OVER
+        assert pair["page"] is False           # short window vetoes
+
+    def test_page_fires_when_both_windows_burn(self):
+        reg, clock, c, _ = _slo_fixture()
+        mon = SLOMonitor([SLOSpec(name="s", availability=0.9)],
+                         registry=reg, clock=clock,
+                         windows=((100.0, 10.0, 2.0),))
+        mon.sample()
+        clock.advance(95.0)
+        c.inc(10, outcome="ok", bucket="16")
+        c.inc(30, outcome="error", bucket="16")
+        mon.sample()
+        rep = mon.evaluate()
+        (pair,) = rep["objectives"][0]["windows"]
+        assert pair["long"]["burn_rate"] == pytest.approx(7.5)
+        assert pair["page"] is True
+        assert rep["objectives"][0]["paging"] is True
+        assert rep["healthy"] is False
+
+    def test_bucket_filter_and_p99_objective(self):
+        reg, clock, c, h = _slo_fixture()
+        c.inc(10, outcome="ok", bucket="16")
+        for v in (0.01,) * 9 + (0.5,):
+            h.observe(v, bucket="16")
+        mon = SLOMonitor(
+            [SLOSpec(name="lat", bucket="16", availability=0.9,
+                     p99_latency_ms=100.0)],
+            registry=reg, clock=clock, windows=((100.0, 10.0, 2.0),))
+        mon.sample()
+        clock.advance(1.0)
+        mon.sample()
+        obj = mon.evaluate()["objectives"][0]
+        assert obj["p99_ms"] == pytest.approx(500.0)
+        assert obj["p99_ok"] is False          # 500 ms > the 100 ms SLO
+        assert obj["paging"] is False
+        assert obj["healthy"] is False
+
+    def test_spec_and_window_validation(self):
+        with pytest.raises(ValueError):
+            SLOSpec(name="impossible", availability=1.0)
+        with pytest.raises(ValueError):
+            SLOMonitor([SLOSpec(name="s")], windows=((10.0, 20.0, 1.0),))
+        with pytest.raises(ValueError):
+            SLOMonitor([])
+
+    def test_bucket_specs_rollup(self):
+        specs = bucket_specs([64, 16], availability=0.99)
+        assert [s.name for s in specs] == ["fleet", "bucket_16",
+                                           "bucket_64"]
+        assert specs[0].bucket is None and specs[1].bucket == "16"
+
+
+class TestToJsonLineCollision:
+    """ISSUE 8 satellite: caller extras can no longer silently clobber
+    the payload keys ``to_json_line`` owns."""
+
+    def test_colliding_extra_is_typed_usage_error(self):
+        from tpu_jordan.driver import UsageError
+
+        with pytest.raises(UsageError, match="collide"):
+            to_json_line(registry=REGISTRY, metrics={"doctored": 1})
+        with pytest.raises(UsageError, match="metric"):
+            to_json_line(metric="not_telemetry")
+
+    def test_non_colliding_extras_pass_through(self):
+        doc = json.loads(to_json_line(registry=REGISTRY, run_id="r1"))
+        assert doc["metric"] == "telemetry" and doc["run_id"] == "r1"
+        assert "tpu_jordan_request_outcome_total" in doc["metrics"]
+
+
+class TestJourneyLanesInChromeTrace:
+    """The async journey view rides ``to_chrome_trace`` and must pass
+    the SAME checker ``make metrics-demo`` runs — accept AND
+    doctored-reject (the repo's both-ways checker discipline)."""
+
+    def _trace(self):
+        log, rec, clock = _log()
+        ctx = log.new(16, 16)
+        clock.advance(0.01)
+        ctx.event("dispatch", cause="full")
+        clock.advance(0.01)
+        ctx.close("ok")
+        return to_chrome_trace(None, journey_events=rec.events())
+
+    def test_journeys_only_trace_accepted(self):
+        doc = self._trace()
+        assert check_telemetry.check_chrome_trace(
+            json.dumps(doc), "<test>") == len(doc["traceEvents"])
+
+    def test_doctored_traces_rejected(self):
+        # An instant pushed outside its lane's bracket.
+        doc = self._trace()
+        n = next(e for e in doc["traceEvents"] if e["ph"] == "n")
+        n["ts"] = 1e9
+        with pytest.raises(AssertionError, match="outside lane"):
+            check_telemetry.check_chrome_trace(json.dumps(doc), "<t>")
+        # An async event with no lane id.
+        doc = self._trace()
+        next(e for e in doc["traceEvents"]
+             if e["ph"] == "b").pop("id")
+        with pytest.raises(AssertionError, match="without an id"):
+            check_telemetry.check_chrome_trace(json.dumps(doc), "<t>")
+        # An unbalanced lane (e dropped).
+        doc = self._trace()
+        doc["traceEvents"] = [e for e in doc["traceEvents"]
+                              if e["ph"] != "e"]
+        with pytest.raises(AssertionError, match="unbalanced"):
+            check_telemetry.check_chrome_trace(json.dumps(doc), "<t>")
+        # A request lane with no hop instants explains nothing.
+        doc = self._trace()
+        doc["traceEvents"] = [e for e in doc["traceEvents"]
+                              if e["ph"] != "n"]
+        with pytest.raises(AssertionError, match="no hop"):
+            check_telemetry.check_chrome_trace(json.dumps(doc), "<t>")
+
+
+class TestCheckSLO:
+    def _report(self):
+        reg, clock, c, _ = _slo_fixture()
+        mon = SLOMonitor([SLOSpec(name="s", availability=0.9)],
+                         registry=reg, clock=clock,
+                         windows=((100.0, 10.0, 2.0),))
+        mon.sample()
+        c.inc(18, outcome="ok", bucket="16")
+        c.inc(2, outcome="error", bucket="16")
+        clock.advance(50.0)
+        mon.sample()
+        return mon.evaluate()
+
+    def test_real_report_accepted(self):
+        errs, paging = check_slo.check(self._report())
+        assert errs == [] and paging is False
+        wrapped = {"metric": "fleet_demo", "slo": self._report()}
+        assert check_slo.check(wrapped) == ([], False)
+
+    def test_doctored_reports_rejected(self):
+        rep = self._report()
+        rep["objectives"][0]["windows"][0]["long"]["burn_rate"] = 0.0
+        errs, _ = check_slo.check(rep)
+        assert any("burn_rate" in e for e in errs)
+
+        rep = self._report()
+        rep["objectives"][0]["windows"][0]["page"] = True
+        errs, _ = check_slo.check(rep)
+        assert any("multi-window AND" in e for e in errs)
+
+        rep = self._report()
+        rep["healthy"] = False                 # contradicts objectives
+        errs, _ = check_slo.check(rep)
+        assert any("contradicts the AND" in e for e in errs)
+
+        errs, _ = check_slo.check({"metric": "nope"})
+        assert any("not an slo_report" in e for e in errs)
+
+    def test_paging_report_is_consistent_not_invalid(self):
+        reg, clock, c, _ = _slo_fixture()
+        mon = SLOMonitor([SLOSpec(name="s", availability=0.9)],
+                         registry=reg, clock=clock,
+                         windows=((100.0, 10.0, 2.0),))
+        mon.sample()
+        c.inc(30, outcome="error", bucket="16")
+        clock.advance(50.0)
+        mon.sample()
+        errs, paging = check_slo.check(mon.evaluate())
+        assert errs == [] and paging is True
+
+
+class TestCheckBlackbox:
+    """The causal-chain rules over a black-box slice — accept on a
+    real-shaped event stream, reject every doctored break."""
+
+    def _events(self):
+        log, rec, clock = _log()
+        # A clean request.
+        a = log.new(16, 16)
+        a.event("route", replica="r0g1", slot=0)
+        a.close("ok")
+        # An injected kill -> death -> restart chain, with the victim's
+        # request rerouted and finally typed.
+        rec.record("fault_injected", point="replica_kill", call=3,
+                   mode="permanent")
+        rec.record("replica_death", replica="r1g1", slot=1,
+                   reason="injected")
+        b = log.new(16, 16)
+        b.event("route", replica="r1g1", slot=1)
+        b.event("requeue", from_replica="r1g1", attempt=1)
+        b.event("shed", reason="dead", replica="r1g1")
+        b.close("error", error="ReplicaKilledError")
+        rec.record("restart", slot=1, replica="r1g2", cause="death")
+        self._typed_rid = b.request_id
+        return rec.events()
+
+    def test_real_slice_accepted(self):
+        events = self._events()
+        bb = {"dropped": 0, "events": events}
+        assert check_blackbox.check_journeys(bb, requests=2) == []
+        assert check_blackbox.check_fault_chains(events) == []
+        assert check_blackbox.check_death_coverage(events) == []
+        led = check_blackbox.ledger(events)
+        assert led["ok"] == 1 and led["typed_errors"] == {
+            "ReplicaKilledError": 1}
+        errs, warnings = check_blackbox.check_dump(
+            {"metric": "blackbox", "dropped": 0, "retained": len(events),
+             "events": events})
+        assert errs == [] and warnings == []
+
+    def test_gap_and_causal_breaks_rejected(self):
+        # A journey that never resolves.
+        events = [e for e in self._events()
+                  if not (e.get("request_id") == self._typed_rid
+                          and e.get("event") == "result")]
+        errs = check_blackbox.check_journeys(
+            {"dropped": 0, "events": events}, requests=2)
+        assert any("never resolved" in e for e in errs)
+
+        # A typed failure with no explanatory hop.
+        events = [e for e in self._events()
+                  if e.get("event") not in ("requeue", "shed")]
+        errs = check_blackbox.check_journeys(
+            {"dropped": 0, "events": events}, requests=2)
+        assert any("NO explanatory hop" in e for e in errs)
+
+        # A kill whose death was never recorded.
+        events = [e for e in self._events()
+                  if e.get("kind") != "replica_death"]
+        assert any("causal chain is broken" in e
+                   for e in check_blackbox.check_fault_chains(events))
+
+        # A death no restart/withholding ever covered.
+        events = [e for e in self._events()
+                  if e.get("kind") != "restart"]
+        assert any("supervision chain" in e
+                   for e in check_blackbox.check_death_coverage(events))
+
+        # A window that overflowed cannot prove reconstruction.
+        errs = check_blackbox.check_journeys(
+            {"dropped": 3, "events": self._events()}, requests=2)
+        assert any("gaps" in e for e in errs)
+
+        # A ledger that disagrees with its own events is drift.
+        errs = check_blackbox.reconcile_ledgers(
+            {"submitted": 99}, self._events())
+        assert any("drift" in e for e in errs)
+
+    def test_missing_requests_detected(self):
+        errs = check_blackbox.check_journeys(
+            {"dropped": 0, "events": self._events()}, requests=5)
+        assert any("left no trail" in e for e in errs)
+
+
+class TestServiceJourneys:
+    """Service-level integration (fast, tiny buckets): direct submits
+    journey to a terminal result with the enqueue/dispatch/executor/
+    served path recorded, typed rejections explain themselves, and the
+    warm path stays free with the recorder on."""
+
+    def test_direct_submit_journeys_to_terminal_ok(self, rng):
+        from tpu_jordan.serve import JordanService
+
+        with JordanService(batch_cap=4, max_wait_ms=1.0) as svc:
+            svc.warmup(shapes=[16])
+            futs = [svc.submit(rng.standard_normal(
+                (16, 16)).astype(np.float32)) for _ in range(4)]
+            [f.result(60) for f in futs]
+            # Done callbacks run on the dispatcher thread right after
+            # set_result; close() lands before contexts() is read only
+            # once the callback fires — poll briefly for the race.
+            import time
+            deadline = time.monotonic() + 5
+            while (svc.journey.ledger()["ok"] < 4
+                   and time.monotonic() < deadline):
+                time.sleep(0.01)
+        led = svc.journey.ledger()
+        assert led["ok"] == 4 and led["gaps"] == []
+        ctx = svc.journey.contexts()[0]
+        names = [e["event"] for e in ctx.events()]
+        for hop in ("submit", "enqueue", "dispatch", "executor",
+                    "served", "result"):
+            assert hop in names, f"{hop} missing from {names}"
+        # The executor hop records compile-vs-cache-hit per request.
+        ex = next(e for e in ctx.events() if e["event"] == "executor")
+        assert ex["source"] in ("cached", "compiled", "shared_store")
+
+    def test_overload_rejection_journeys_typed(self, rng):
+        from tpu_jordan.serve import JordanService
+        from tpu_jordan.serve.batcher import ServiceOverloadedError
+
+        svc = JordanService(batch_cap=1, max_queue=1, autostart=False)
+        try:
+            svc.warmup(shapes=[16])
+            mats = [rng.standard_normal((16, 16)).astype(np.float32)
+                    for _ in range(3)]
+            svc.submit(mats[0])
+            with pytest.raises(ServiceOverloadedError):
+                for a in mats[1:]:
+                    svc.submit(a)
+        finally:
+            svc.start()
+            svc.close()
+        rejected = [c for c in svc.journey.contexts()
+                    if (c.outcome() or ("", ""))[0] == "error"]
+        assert len(rejected) == 1
+        names = [e["event"] for e in rejected[0].events()]
+        assert "reject" in names               # the explanatory hop
+        assert rejected[0].outcome() == ("error",
+                                         "ServiceOverloadedError")
+
+    def test_warm_serve_stays_free_with_recorder_on(self, rng):
+        """ISSUE 8 satellite: the recorder has no off switch, so the
+        warm-path pins must hold WITH it recording — zero compiles,
+        zero measurements, bounded ring — while the journey events for
+        the burst demonstrably landed in the ring."""
+        from tpu_jordan.serve import JordanService
+
+        with JordanService(batch_cap=4, max_wait_ms=1.0) as svc:
+            svc.warmup(shapes=[16])
+            compiles = REGISTRY.counter("tpu_jordan_compiles_total")
+            measures = REGISTRY.counter(
+                "tpu_jordan_tuner_measurements_total")
+            c0, m0, r0 = compiles.total(), measures.total(), RECORDER.total
+            futs = [svc.submit(rng.standard_normal(
+                (16, 16)).astype(np.float32)) for _ in range(20)]
+            assert all(not f.result(60).singular for f in futs)
+            assert compiles.total() == c0      # zero compiles
+            assert measures.total() == m0      # zero measurements
+            assert RECORDER.total > r0         # ...and it WAS recording
+            assert len(RECORDER.events()) <= RECORDER.capacity

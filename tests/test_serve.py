@@ -61,13 +61,14 @@ class TestBuckets:
 class TestServeStatsLabels:
     def test_reserved_label_keys_refused_typed(self):
         """A user label colliding with the keys ServeStats stamps itself
-        ('bucket'/'component') — or with the metric APIs' own 'value'
-        parameter — must fail fast at construction with the typed
-        UsageError, not TypeError on the first request."""
+        ('bucket'/'component') — or with the metric APIs' own 'value'/
+        'exemplar' parameters (the latter would silently bind instead
+        of becoming a label series) — must fail fast at construction
+        with the typed UsageError, not TypeError on the first request."""
         from tpu_jordan.driver import UsageError
         from tpu_jordan.serve import ServeStats
 
-        for key in ("bucket", "component", "value"):
+        for key in ("bucket", "component", "value", "exemplar"):
             with pytest.raises(UsageError, match="reserved metric label"):
                 ServeStats(labels={key: "x"})
         # Non-reserved labels still work end to end.
@@ -346,7 +347,7 @@ class TestBackpressureAndShutdown:
             def breaker(self, bucket):
                 return None
 
-            def get(self, bucket, batch_cap, block_size):
+            def get_info(self, bucket, batch_cap, block_size):
                 gate.wait(30)          # the hung device call
                 raise RuntimeError("released")
 

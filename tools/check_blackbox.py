@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Flight-recorder/journey validation (ISSUE 8): the shared causal-
+chain rules ``check_chaos.py`` / ``check_fleet.py`` import (next to
+themselves, no jax — runnable anywhere, the rules exist exactly once),
+plus a standalone CLI for validating a RAW black-box dump
+(``--blackbox-out`` / the automatic exit-2 emission):
+
+    python tools/check_blackbox.py dump.json [...]
+
+A raw dump passes when every journey in the retained window is
+complete (submit -> terminal result, explanatory hops on typed
+failures), every fault chains to its consequence, and every recorded
+replica death is covered by a restart / counted failure / deliberate
+breaker withholding.  The window-level ``dropped`` honesty counter is
+reported but not failed — a long-lived ring legitimately evicts; the
+EMBEDDED report slices are the ones that must be gap-free.
+
+The validated contract (docs/OBSERVABILITY.md):
+
+  * the embedded black-box slice is **gap-free** (``dropped == 0`` — an
+    overflowed ring cannot prove reconstruction);
+  * every request that entered the window is **reconstructible from the
+    dump alone**: its journey starts at ``submit`` and ends at a
+    terminal ``result`` (a submitted-never-resolved journey is the
+    silent-loss signature);
+  * every **typed failure explains itself**: its journey carries at
+    least one explanatory hop (shed / requeue / reject /
+    breaker_fast_fail / deadline / batch_failure / fault / retry) —
+    a typed error with no causal trail is a reconstruction gap;
+  * every **fault has its consequence**: each ``fault_injected`` event
+    is followed (by recorder ``seq``) by the recovery-chain event its
+    point promises (a kill by a death, a death by a restart or a
+    deliberate withholding, an execute fault by a retry, ...).
+"""
+
+from __future__ import annotations
+
+#: Journey hops that explain a typed failure (mirrors
+#: ``tpu_jordan.obs.journey.EXPLANATORY_HOPS`` — duplicated here so the
+#: checkers never import the package; ``tests/test_journey.py`` pins
+#: the two sets equal).
+EXPLANATORY_HOPS = frozenset({
+    "shed", "requeue", "reject", "breaker_fast_fail",
+    "deadline", "batch_failure", "fault", "retry",
+})
+
+#: fault point -> the event kinds that prove its causal consequence
+#: (any one, later in seq order).  ``retry``/``batch_failure``/
+#: ``deadline`` journey hops are folded in as pseudo-kinds
+#: ``journey:<event>``.
+FAULT_CONSEQUENCES = {
+    "replica_kill": ("replica_death",),
+    "compile": ("retry", "journey:batch_failure"),
+    "execute": ("retry", "journey:batch_failure"),
+    "dispatch": ("retry", "journey:batch_failure"),
+    "result_corrupt_nan": ("retry", "recovery_rung",
+                           "journey:batch_failure"),
+    "measure": ("retry",),
+    "plan_cache_write": ("plan_cache_write_failure",),
+}
+
+
+def journeys(events) -> dict:
+    """Group the slice's ``journey`` events by request id (insertion
+    order preserved — the recorder's seq order)."""
+    out: dict = {}
+    for e in events:
+        if e.get("kind") != "journey" or "request_id" not in e:
+            continue
+        out.setdefault(str(e["request_id"]), []).append(e)
+    return out
+
+
+def ledger(events) -> dict:
+    """Recompute the outcome ledger from raw journey events — the
+    checker-side twin of ``obs.journey.outcome_ledger``, used to
+    RECONCILE against the ledger a report embeds (any disagreement is
+    drift between what the demo claims and what its own black box can
+    prove)."""
+    ok = err = 0
+    typed: dict = {}
+    gaps = []
+    for rid, evs in journeys(events).items():
+        terminal = next((e for e in reversed(evs)
+                         if e.get("event") == "result"), None)
+        if terminal is None:
+            gaps.append(rid)
+        elif terminal.get("outcome") == "ok":
+            ok += 1
+        else:
+            err += 1
+            name = str(terminal.get("error", "UnknownError"))
+            typed[name] = typed.get(name, 0) + 1
+    return {"submitted": ok + err + len(gaps), "ok": ok, "error": err,
+            "typed_errors": dict(sorted(typed.items())),
+            "gaps": sorted(gaps)}
+
+
+def check_journeys(blackbox: dict, requests: int | None = None
+                   ) -> list[str]:
+    """The reconstruction rules over an embedded black-box slice;
+    returns violations (empty = every request reconstructible)."""
+    errs: list[str] = []
+    if not isinstance(blackbox, dict) or "events" not in blackbox:
+        return ["no black-box slice embedded in the report "
+                "(reconstruction cannot be proven)"]
+    if blackbox.get("dropped", 1) != 0:
+        errs.append(f"black-box ring dropped "
+                    f"{blackbox.get('dropped')} event(s) inside the "
+                    f"window — reconstruction has gaps")
+    events = blackbox["events"]
+    js = journeys(events)
+    if requests is not None and len(js) != requests:
+        errs.append(f"{len(js)} request journeys in the black box but "
+                    f"{requests} requests submitted — "
+                    f"{requests - len(js)} request(s) left no trail")
+    for rid, evs in js.items():
+        names = [e.get("event") for e in evs]
+        seqs = [e.get("seq", 0) for e in evs]
+        if names[:1] != ["submit"]:
+            errs.append(f"journey {rid} does not start at submit "
+                        f"(events: {names[:4]}...)")
+        if any(b <= a for a, b in zip(seqs, seqs[1:])):
+            errs.append(f"journey {rid} events out of seq order")
+        terminal = next((e for e in reversed(evs)
+                         if e.get("event") == "result"), None)
+        if terminal is None:
+            errs.append(f"journey {rid} has no terminal result — "
+                        f"submitted but never resolved (silent loss)")
+            continue
+        if terminal is not evs[-1]:
+            errs.append(f"journey {rid} has events after its terminal "
+                        f"result")
+        if (terminal.get("outcome") != "ok"
+                and not EXPLANATORY_HOPS.intersection(names)):
+            errs.append(
+                f"journey {rid} failed typed "
+                f"({terminal.get('error')}) with NO explanatory hop "
+                f"(one of {sorted(EXPLANATORY_HOPS)}) — a causal gap")
+    return errs
+
+
+def check_fault_chains(events) -> list[str]:
+    """Every ``fault_injected`` event must be followed, in seq order,
+    by the consequence its point promises — the fault → recovery causal
+    chain, validated event-by-event instead of by counter deltas."""
+    errs: list[str] = []
+    later_kinds: list[tuple[int, str]] = []
+    for e in events:
+        kind = e.get("kind")
+        if kind == "journey":
+            later_kinds.append((e.get("seq", 0),
+                                f"journey:{e.get('event')}"))
+        elif kind is not None:
+            later_kinds.append((e.get("seq", 0), kind))
+    for e in events:
+        if e.get("kind") != "fault_injected":
+            continue
+        point = e.get("point")
+        wanted = FAULT_CONSEQUENCES.get(point)
+        if wanted is None:
+            continue                 # an unmapped point is not a gap
+        seq = e.get("seq", 0)
+        if not any(s > seq and k in wanted for s, k in later_kinds):
+            errs.append(
+                f"injected fault {point!r} (seq {seq}) has no recorded "
+                f"consequence ({' | '.join(wanted)}) later in the "
+                f"black box — the causal chain is broken")
+    return errs
+
+
+def check_death_coverage(events) -> list[str]:
+    """Every recorded replica death must be followed by a restart, a
+    counted restart failure, or a deliberate breaker withholding for
+    its slot — a death with none is an abandoned slot the ledger
+    could only see as degraded throughput."""
+    errs: list[str] = []
+    deaths = [e for e in events if e.get("kind") == "replica_death"]
+    for d in deaths:
+        slot, seq = d.get("slot"), d.get("seq", 0)
+        covered = any(
+            e.get("kind") in ("restart", "restart_failure",
+                              "restart_withheld")
+            and e.get("slot") == slot and e.get("seq", 0) > seq
+            for e in events)
+        if not covered:
+            errs.append(f"replica death at slot {slot} (seq {seq}) has "
+                        f"no restart / restart_failure / "
+                        f"restart_withheld event after it — the "
+                        f"supervision chain is broken")
+    return errs
+
+
+def reconcile_ledgers(report_ledger: dict, events) -> list[str]:
+    """The embedded journey ledger must equal the one recomputed from
+    the embedded events (same helper discipline, checked both sides)."""
+    mine = ledger(events)
+    errs = []
+    for key in ("submitted", "ok", "error", "typed_errors", "gaps"):
+        if report_ledger.get(key) != mine[key]:
+            errs.append(f"journey_ledger[{key!r}] = "
+                        f"{report_ledger.get(key)!r} but the embedded "
+                        f"black box proves {mine[key]!r} — ledger "
+                        f"drift")
+    return errs
+
+
+def check_dump(dump: dict) -> tuple[list[str], list[str]]:
+    """Validate a RAW recorder dump; returns (violations, warnings).
+    Eviction honesty: when ``dropped`` > 0 the ring legitimately lost
+    the window's head, so journey-completeness rules (which would flag
+    truncated journeys as gaps) are skipped with a warning — fault
+    chains and death coverage still run over the retained window."""
+    if dump.get("metric") != "blackbox":
+        return ([f"not a blackbox dump (metric="
+                 f"{dump.get('metric')!r})"], [])
+    events = dump.get("events")
+    if not isinstance(events, list):
+        return (["dump has no events list"], [])
+    warnings: list[str] = []
+    errs: list[str] = []
+    if dump.get("dropped", 0) > 0:
+        warnings.append(f"ring evicted {dump['dropped']} event(s) "
+                        f"before the retained window — journey "
+                        f"completeness not checkable, validating "
+                        f"fault chains over the retained window only")
+    else:
+        errs += check_journeys({"dropped": 0, "events": events})
+    errs += check_fault_chains(events)
+    errs += check_death_coverage(events)
+    return errs, warnings
+
+
+def main(argv) -> int:
+    import json
+    import sys
+
+    if not argv:
+        print("usage: check_blackbox.py dump.json [...]",
+              file=sys.stderr)
+        return 1
+    rc = 0
+    for path in argv:
+        try:
+            if path == "-":
+                dump = json.load(sys.stdin)
+            else:
+                with open(path) as f:
+                    dump = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"FAIL {path}: unreadable dump ({e})",
+                  file=sys.stderr)
+            rc = 1
+            continue
+        errs, warnings = check_dump(dump)
+        for w in warnings:
+            print(f"WARN {path}: {w}", file=sys.stderr)
+        if errs:
+            rc = 1
+            for e in errs:
+                print(f"FAIL {path}: {e}", file=sys.stderr)
+        else:
+            js = journeys(dump.get("events", []))
+            led = ledger(dump.get("events", []))
+            print(f"OK {path}: {dump.get('retained')} events retained "
+                  f"({dump.get('recorded_total')} recorded, "
+                  f"{dump.get('dropped')} dropped), {len(js)} "
+                  f"journey(s) reconstructed ({led['ok']} ok, "
+                  f"{led['error']} typed), causal chains intact")
+    return rc
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main(sys.argv[1:]))

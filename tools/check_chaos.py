@@ -16,13 +16,24 @@ What a valid chaos report must prove (docs/RESILIENCE.md):
   * the replay pin held — zero ``mismatches``: every response either
     bit-matched the fault-free run of the same request or carried a
     typed error;
-  * the response ledger adds up — matched + typed errors == requests.
+  * the response ledger adds up — matched + typed errors == requests;
+  * every request of the chaos pass is reconstructible from the
+    embedded black-box slice alone (ISSUE 8): a gap-free ring, a
+    complete journey per request, and every injected fault chained —
+    event by event, not by counter deltas — to the retry / recovery
+    rung / degradation it caused.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+import check_blackbox as _blackbox  # noqa: E402  (sibling, jax-free)
 
 REQUIRED_POINTS = ("compile", "execute", "result_corrupt_nan",
                    "plan_cache_write")
@@ -66,6 +77,14 @@ def check(report: dict) -> list[str]:
         errs.append(f"response ledger does not add up: {matched} matched "
                     f"+ {typed} typed + {len(mism)} mismatched != "
                     f"{requests} requests")
+
+    # ---- black-box reconstruction (ISSUE 8) ------------------------
+    bb = report.get("blackbox")
+    errs += _blackbox.check_journeys(bb, requests=requests)
+    if isinstance(bb, dict) and "events" in bb:
+        errs += _blackbox.check_fault_chains(bb["events"])
+        errs += _blackbox.reconcile_ledgers(
+            report.get("journey_ledger", {}), bb["events"])
     return errs
 
 
@@ -98,7 +117,10 @@ def main(argv) -> int:
                   f"{acct['degraded']:.0f} degraded, "
                   f"{acct['terminal_failures']:.0f} terminal), "
                   f"{report['matched_bitwise']} bit-matched the "
-                  f"fault-free replay, 0 silent")
+                  f"fault-free replay, "
+                  f"{len(_blackbox.journeys(report.get('blackbox', {}).get('events', [])))}"
+                  f"/{report['requests']} journeys reconstructed, "
+                  f"0 silent")
     return rc
 
 

@@ -21,6 +21,13 @@ What a valid fleet report must prove (docs/FLEET.md):
   * zero silent errors — every chaos response bit-matched the
     fault-free replay or carried a typed error, the request ledger
     adds up exactly (submitted == resolved, outstanding == 0);
+  * every request is RECONSTRUCTIBLE from the embedded black-box slice
+    alone (ISSUE 8): the slice is gap-free, every submitted request's
+    journey reaches a terminal result, every typed failure carries its
+    shed/requeue/retry causal hops, every injected kill chains to a
+    death and every death to a restart (or a deliberate breaker
+    withholding), and the embedded journey ledger equals the one
+    recomputed from the raw events — any break is the exit-2 class;
   * throughput held its bound — ``scaling_x >= scaling_floor`` (the
     floor is explicit in the report; >= 0.5 so it cannot be vacuous)
     at a bounded p99 (``fleet_p99_ms <= p99_bound_ms``), chaos p99
@@ -30,7 +37,13 @@ What a valid fleet report must prove (docs/FLEET.md):
 from __future__ import annotations
 
 import json
+import os
 import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+import check_blackbox as _blackbox  # noqa: E402  (sibling, jax-free)
 
 #: The floor below which a scaling bound proves nothing at all: a
 #: fleet that HALVES throughput is broken whatever the hardware.
@@ -108,6 +121,26 @@ def check(report: dict) -> tuple[list[str], list[str]]:
     if report.get("silent_loss", True):
         silent.append("silent_loss flagged by the demo itself")
 
+    # ---- black-box reconstruction (ISSUE 8, the exit-2 class) ------
+    # Every request of the chaos pass must be reconstructible from the
+    # embedded flight-recorder slice ALONE: gap-free ring, a complete
+    # journey per request, explanatory hops on every typed failure,
+    # fault -> death -> restart causal chains, and a journey ledger
+    # that matches the raw events.
+    bb = report.get("blackbox")
+    silent += _blackbox.check_journeys(bb, requests=requests)
+    if isinstance(bb, dict) and "events" in bb:
+        events = bb["events"]
+        silent += _blackbox.check_fault_chains(events)
+        silent += _blackbox.check_death_coverage(events)
+        silent += _blackbox.reconcile_ledgers(
+            report.get("journey_ledger", {}), events)
+        jl = _blackbox.ledger(events)
+        if jl["error"] != typed:
+            silent.append(f"black box proves {jl['error']} typed "
+                          f"failure(s) but the response ledger counted "
+                          f"{typed}")
+
     # ---- throughput + latency bounds -------------------------------
     floor = thr.get("scaling_floor", 0)
     if floor < MIN_HONEST_SCALING_FLOOR:
@@ -156,6 +189,8 @@ def main(argv) -> int:
         else:
             chaos = report["chaos"]
             thr = report["throughput"]
+            nj = len(_blackbox.journeys(
+                report.get("blackbox", {}).get("events", [])))
             print(f"OK {path}: {report['requests']} requests x "
                   f"{report['replicas']} replicas, "
                   f"{chaos['kills_injected']} kill(s) -> "
@@ -164,7 +199,8 @@ def main(argv) -> int:
                   f"after warmup, {report['matched_bitwise']} "
                   f"bit-matched the fault-free replay, scaling "
                   f"{thr['scaling_x']}x >= {thr['scaling_floor']}x, "
-                  f"0 silent")
+                  f"{nj}/{report['requests']} journeys reconstructed "
+                  f"from the black box, 0 silent")
     return rc
 
 

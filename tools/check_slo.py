@@ -1,0 +1,163 @@
+#!/usr/bin/env python
+"""Validate an SLO burn-rate report (ISSUE 8; the ``make slo-demo``
+gate).
+
+Usage: ``python tools/check_slo.py report.json [...]`` (or ``-`` for
+stdin).  Accepts either a bare ``slo_report`` document
+(``obs.slo.SLOMonitor.evaluate()``) or a ``fleet_demo`` report carrying
+one under ``"slo"`` (the ``--slo-report`` leg).  No jax — runs
+anywhere.
+
+What a valid SLO report must prove (docs/OBSERVABILITY.md):
+
+  * structure — >= 1 objective, each with >= 1 window pair, each pair
+    with a positive threshold and ``long_window > short_window``;
+  * the burn-rate math is INTERNALLY CONSISTENT — for every window,
+    ``error_rate == errors / requests`` (0 when no traffic) and
+    ``burn_rate == error_rate / error_budget``, recomputed here from
+    the window's own counts (a report whose arithmetic does not
+    reproduce is doctored or buggy);
+  * the page decision follows the multi-window AND rule — ``page`` is
+    true iff BOTH the long and the short window burn above the pair's
+    threshold;
+  * the verdicts roll up honestly — an objective is ``healthy`` iff it
+    is not paging and its p99 objective holds; the report-level
+    ``healthy`` is the AND over objectives.
+
+Exit codes: 0 = valid, 1 = structural/consistency violations,
+2 = the report is PAGING (healthy=false) — distinct so CI can treat
+"the math is wrong" and "the fleet is burning budget" differently.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+#: |measured - recomputed| tolerance: reports round burn rates to 4
+#: decimals and error rates to 6.
+EPS = 5e-4
+
+
+def _check_window(w: dict, budget: float, tag: str) -> list[str]:
+    errs = []
+    reqs, errors = w.get("requests", -1), w.get("errors", -1)
+    if reqs < 0 or errors < 0 or errors > reqs:
+        errs.append(f"{tag}: bad counts (requests={reqs}, "
+                    f"errors={errors})")
+        return errs
+    want_rate = (errors / reqs) if reqs else 0.0
+    if abs(w.get("error_rate", -1) - want_rate) > EPS:
+        errs.append(f"{tag}: error_rate {w.get('error_rate')} != "
+                    f"{errors}/{reqs}")
+    want_burn = want_rate / budget
+    if abs(w.get("burn_rate", -1) - want_burn) > max(EPS, EPS * want_burn):
+        errs.append(f"{tag}: burn_rate {w.get('burn_rate')} != "
+                    f"error_rate/budget = {round(want_burn, 4)}")
+    return errs
+
+
+def check(report: dict) -> tuple[list[str], bool]:
+    """Returns (violations, paging); valid = no violations."""
+    if report.get("metric") == "fleet_demo":
+        report = report.get("slo") or {}
+    if report.get("metric") != "slo_report":
+        return ([f"not an slo_report (metric="
+                 f"{report.get('metric')!r})"], False)
+    errs: list[str] = []
+    objectives = report.get("objectives", [])
+    if not objectives:
+        errs.append("no objectives — the SLO evaluation was vacuous")
+    healthy_roll = True
+    for obj in objectives:
+        name = obj.get("name", "?")
+        budget = obj.get("error_budget", 0)
+        if not (0 < budget < 1):
+            errs.append(f"{name}: error_budget {budget} outside (0, 1)")
+            continue
+        target = obj.get("availability_target", 0)
+        if abs((1.0 - target) - budget) > EPS:
+            errs.append(f"{name}: budget {budget} != 1 - availability "
+                        f"target {target}")
+        pairs = obj.get("windows", [])
+        if not pairs:
+            errs.append(f"{name}: no window pairs")
+        paging_roll = False
+        for i, pair in enumerate(pairs):
+            thr = pair.get("threshold", 0)
+            if thr <= 0:
+                errs.append(f"{name}[{i}]: threshold {thr} <= 0")
+            long_w, short_w = pair.get("long", {}), pair.get("short", {})
+            if long_w.get("window_s", 0) <= short_w.get("window_s", 1):
+                errs.append(f"{name}[{i}]: long window "
+                            f"{long_w.get('window_s')}s not longer than "
+                            f"short {short_w.get('window_s')}s")
+            errs += _check_window(long_w, budget, f"{name}[{i}].long")
+            errs += _check_window(short_w, budget, f"{name}[{i}].short")
+            want_page = (long_w.get("burn_rate", 0) > thr
+                         and short_w.get("burn_rate", 0) > thr)
+            if bool(pair.get("page")) != want_page:
+                errs.append(f"{name}[{i}]: page={pair.get('page')} "
+                            f"contradicts the multi-window AND rule "
+                            f"(long {long_w.get('burn_rate')}, short "
+                            f"{short_w.get('burn_rate')}, threshold "
+                            f"{thr})")
+            paging_roll = paging_roll or want_page
+        if bool(obj.get("paging")) != paging_roll:
+            errs.append(f"{name}: paging={obj.get('paging')} "
+                        f"contradicts its own window pairs")
+        p99, p99_target = obj.get("p99_ms"), obj.get("p99_target_ms")
+        want_p99_ok = (p99_target is None or p99 is None
+                       or p99 <= p99_target)
+        if bool(obj.get("p99_ok")) != want_p99_ok:
+            errs.append(f"{name}: p99_ok={obj.get('p99_ok')} "
+                        f"contradicts p99 {p99} vs target {p99_target}")
+        want_healthy = (not paging_roll) and want_p99_ok
+        if bool(obj.get("healthy")) != want_healthy:
+            errs.append(f"{name}: healthy={obj.get('healthy')} "
+                        f"contradicts paging/p99")
+        healthy_roll = healthy_roll and want_healthy
+    if bool(report.get("healthy")) != healthy_roll:
+        errs.append(f"report healthy={report.get('healthy')} "
+                    f"contradicts the AND over its objectives")
+    return errs, not healthy_roll
+
+
+def main(argv) -> int:
+    if not argv:
+        print("usage: check_slo.py report.json [...]", file=sys.stderr)
+        return 1
+    rc = 0
+    for path in argv:
+        try:
+            if path == "-":
+                report = json.load(sys.stdin)
+            else:
+                with open(path) as f:
+                    report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"FAIL {path}: unreadable report ({e})", file=sys.stderr)
+            rc = max(rc, 1)
+            continue
+        errs, paging = check(report)
+        if errs:
+            rc = max(rc, 1)
+            for e in errs:
+                print(f"FAIL {path}: {e}", file=sys.stderr)
+        elif paging:
+            rc = max(rc, 2)
+            print(f"PAGING {path}: the report is internally consistent "
+                  f"and the fleet IS burning error budget past its "
+                  f"thresholds", file=sys.stderr)
+        else:
+            slo = (report.get("slo") if report.get("metric") ==
+                   "fleet_demo" else report) or report
+            n = len(slo.get("objectives", []))
+            print(f"OK {path}: {n} objective(s) evaluated over "
+                  f"{slo.get('samples')} samples, burn-rate math "
+                  f"reproduces, nothing paging")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -17,6 +17,12 @@ otherwise):
     list, every event has a known phase (complete ``X`` events carry a
     numeric ``dur``; duration events come as matched ``B``/``E`` pairs
     per (pid, tid, name)), and at least one event exists.
+  * Async journey lanes (ISSUE 8): nestable ``b``/``e``/``n`` events
+    must carry an ``id`` (the request_id — the lane key Perfetto
+    groups by), ``b``/``e`` must balance per (cat, id), every instant
+    ``n`` must fall inside its lane's ``b``..``e`` bracket, and a lane
+    in the ``tpu_jordan_request`` category must carry at least one
+    hop instant (a request lane with no events explains nothing).
   * Pallas-path attribution honesty (ISSUE 6 satellite): an ``execute``
     event whose ``args.engine`` is a fused-kernel engine
     (``grouped_pallas*``) must not contain MODEL-attributed hot-loop
@@ -85,9 +91,10 @@ def check_chrome_trace(text: str, path: str) -> int:
     assert isinstance(events, list) and events, \
         f"{path}: traceEvents missing or empty"
     open_be: dict = {}
+    lanes: dict = {}
     for ev in events:
         ph = ev.get("ph")
-        assert ph in {"X", "B", "E", "M", "i"}, \
+        assert ph in {"X", "B", "E", "M", "i", "b", "e", "n"}, \
             f"{path}: unknown event phase {ph!r}: {ev}"
         if ph == "X":
             assert isinstance(ev.get("dur"), (int, float)), \
@@ -99,8 +106,36 @@ def check_chrome_trace(text: str, path: str) -> int:
             open_be[key] = open_be.get(key, 0) + (1 if ph == "B" else -1)
             assert open_be[key] >= 0, \
                 f"{path}: E before B for {key}"
+        elif ph in ("b", "e", "n"):
+            # Async nestable lanes (the ISSUE 8 journey view): id is
+            # the lane key — an async event without one renders on no
+            # lane at all.
+            assert ev.get("id") not in (None, ""), \
+                f"{path}: async {ph!r} event without an id: {ev}"
+            assert isinstance(ev.get("ts"), (int, float)), \
+                f"{path}: async event without numeric ts: {ev}"
+            lane = lanes.setdefault((ev.get("cat"), ev["id"]),
+                                    {"b": [], "e": [], "n": []})
+            lane[ph].append(float(ev["ts"]))
     bad = {k: v for k, v in open_be.items() if v != 0}
     assert not bad, f"{path}: unmatched B/E events: {bad}"
+    for (cat, lane_id), tss in lanes.items():
+        assert len(tss["b"]) == len(tss["e"]) >= 1, (
+            f"{path}: async lane {lane_id!r} (cat {cat!r}) has "
+            f"{len(tss['b'])} 'b' vs {len(tss['e'])} 'e' events — "
+            f"unbalanced lane bracket")
+        t0, t1 = min(tss["b"]), max(tss["e"])
+        assert t0 <= t1, \
+            f"{path}: async lane {lane_id!r} ends before it begins"
+        for ts in tss["n"]:
+            assert t0 - 1e-6 <= ts <= t1 + 1e-6, (
+                f"{path}: async instant at ts {ts} outside lane "
+                f"{lane_id!r}'s bracket [{t0}, {t1}] — the hop would "
+                f"render off its request's row")
+        if cat == "tpu_jordan_request":
+            assert tss["n"], (
+                f"{path}: request lane {lane_id!r} has no hop "
+                f"instants — a journey that explains nothing")
 
     # Pallas-path attribution honesty: no modeled phase children inside
     # a fused-kernel engine's execute bracket.

@@ -37,13 +37,52 @@ def _write_telemetry(metrics_out, trace_json, telemetry) -> None:
             write_metrics(metrics_out)
         if trace_json and telemetry is not None:
             from .obs.export import write_chrome_trace
+            from .obs.recorder import RECORDER
 
-            write_chrome_trace(trace_json, telemetry)
+            # The journey slice rides the span trace (ISSUE 8): one
+            # async Perfetto lane per request_id next to the phase
+            # spans, from the always-on flight recorder.
+            write_chrome_trace(
+                trace_json, telemetry,
+                journey_events=RECORDER.events(kind="journey"))
     except OSError as e:
         print(f"warning: telemetry export failed: {e}", file=sys.stderr)
 
 
+def _write_blackbox(path) -> None:
+    """Dump the always-on flight recorder (ISSUE 8): on demand via
+    ``--blackbox-out``, and AUTOMATICALLY on every exit-2 path — the
+    black box exists precisely for the runs that end in the failure
+    taxonomy's "runtime failure" class.  Same never-mask-the-exit-code
+    discipline as ``_write_telemetry``."""
+    try:
+        from .obs.recorder import RECORDER
+
+        RECORDER.write(path)
+        print(f"flight recorder dumped to {path} "
+              f"({RECORDER.total} events recorded)", file=sys.stderr)
+    except OSError as e:
+        print(f"warning: blackbox dump failed: {e}", file=sys.stderr)
+
+
 def main(argv=None) -> int:
+    """Parse-and-run wrapper: the run itself is ``_main``; on the way
+    out, the always-on flight recorder is dumped when the caller asked
+    for it (``--blackbox-out``) or when the run ends in the exit-2
+    runtime-failure class — a crash-forensics artifact for exactly the
+    runs that need one (docs/OBSERVABILITY.md)."""
+    state: dict = {"blackbox_out": None}
+    rc = _main(argv, state)
+    if state["blackbox_out"] or rc == 2:
+        import tempfile
+
+        _write_blackbox(state["blackbox_out"]
+                        or os.path.join(tempfile.gettempdir(),
+                                        "tpu_jordan_blackbox.json"))
+    return rc
+
+
+def _main(argv, state) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tpu_jordan",
         usage="python -m tpu_jordan n m [file]",
@@ -210,7 +249,24 @@ def main(argv=None) -> int:
                          "model-attributed hot-loop phases; serve: "
                          "per-batch compile/execute) and write it as "
                          "Chrome trace-event JSON — open in Perfetto "
-                         "(ui.perfetto.dev) or chrome://tracing")
+                         "(ui.perfetto.dev) or chrome://tracing; "
+                         "serve/fleet runs add one async lane per "
+                         "request_id (the journey view)")
+    ap.add_argument("--blackbox-out", default=None, metavar="PATH",
+                    help="dump the always-on flight recorder (the "
+                         "bounded ring of structured fleet events: "
+                         "route/shed/requeue decisions, kills, "
+                         "restarts, breaker transitions, recovery "
+                         "rungs, injected faults, every per-request "
+                         "journey hop) as one JSON document on exit; "
+                         "without this flag the dump still happens "
+                         "automatically on any exit-2 path "
+                         "(docs/OBSERVABILITY.md)")
+    ap.add_argument("--slo-report", action="store_true",
+                    help="--fleet-demo: embed a multi-window burn-rate "
+                         "SLO evaluation (availability per bucket + "
+                         "fleet-wide, demo-scaled window pairs) in the "
+                         "report, validated by tools/check_slo.py")
     ap.add_argument("--quiet", action="store_true")
     try:
         args = ap.parse_args(argv)
@@ -234,6 +290,7 @@ def main(argv=None) -> int:
         # usage error -> exit 1 like the reference (main.cpp:77-85)
         print("usage: python -m tpu_jordan n m [<file>]", file=sys.stderr)
         return 1
+    state["blackbox_out"] = args.blackbox_out
 
     if os.environ.get("JAX_PLATFORMS"):
         # Honor JAX_PLATFORMS even when the interpreter preloaded jax
@@ -313,7 +370,8 @@ def main(argv=None) -> int:
                 max_wait_ms=args.max_wait_ms, kills=args.kills,
                 seed=args.chaos_seed, block_size=args.m,
                 dtype=jnp.dtype(args.dtype), plan_cache=args.plan_cache,
-                scaling_floor=args.scaling_floor, telemetry=telemetry)
+                scaling_floor=args.scaling_floor, telemetry=telemetry,
+                slo_report=args.slo_report)
             if args.quiet:
                 report["chaos"]["faults"].pop("log", None)
             print(_json.dumps(report))
@@ -323,6 +381,10 @@ def main(argv=None) -> int:
                       f"ledger {report['ledger']}", file=sys.stderr)
                 return 2
             return 0
+        if args.slo_report:
+            raise UsageError("--slo-report is a --fleet-demo leg "
+                             "(the burn-rate monitor evaluates the "
+                             "fleet's request-outcome series)")
         if args.chaos_demo:
             # Chaos demo: same restrictions as --serve-demo (single
             # device, generator-free deterministic fixtures, gathered),

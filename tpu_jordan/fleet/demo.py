@@ -53,13 +53,16 @@ import time
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.journey import outcome_ledger
 from ..obs.metrics import REGISTRY, percentiles
+from ..obs.recorder import RECORDER
+from ..obs.slo import SLOMonitor, bucket_specs
 from ..resilience import FaultPlan, ResiliencePolicy
 from ..resilience import activate as _activate
 from ..resilience.policy import RetryPolicy
-from ..serve.executors import ExecutorStore
+from ..serve.executors import ExecutorStore, bucket_for
 from ..serve.service import (JordanService, _chaos_requests,
-                             _classify_response)
+                             _classify_response, compare_outcomes)
 from .pool import JordanFleet
 from .replica import READY
 
@@ -125,7 +128,7 @@ def fleet_demo(n: int = 96, replicas: int = 3, requests: int = 60,
                dtype=jnp.float32, plan_cache: str | None = None,
                scaling_floor: float | None = None,
                p99_bound_ms: float | None = None,
-               telemetry=None) -> dict:
+               telemetry=None, slo_report: bool = False) -> dict:
     """Run the four-phase fleet acceptance demo; returns the one-line
     JSON report ``tools/check_fleet.py`` validates.  ``plan_cache``
     None = a temp pre-tuned cache built by phase 0 and deleted after."""
@@ -185,6 +188,22 @@ def fleet_demo(n: int = 96, replicas: int = 3, requests: int = 60,
         _, el1, lat1 = sorted(laps1, key=lambda r: r[1])[1]
         single_rps = requests / el1
 
+        # ---- the SLO monitor (ISSUE 8, --slo-report) ----------------
+        # Brackets the FLEET phases (2 + 3): one sample before the
+        # fault-free fleet pass, one after it, one after the chaos
+        # pass — demo-scaled window pairs (a demo lives seconds, not
+        # the SRE workbook's hours; the pairs truncate honestly and
+        # the report says so).  Availability 0.95: the seeded chaos
+        # dose of typed errors must spend budget VISIBLY (non-zero
+        # burn) without paging a healthy fleet.
+        monitor = None
+        if slo_report:
+            monitor = SLOMonitor(
+                bucket_specs((bucket_for(s) for s in shapes),
+                             availability=0.95),
+                windows=((60.0, 10.0, 14.4), (300.0, 60.0, 6.0)))
+            monitor.sample()
+
         # ---- phase 2: N-replica fleet, fault-free -------------------
         with JordanFleet(replicas=replicas, **fleet_kw) as flt:
             flt.warmup(shapes)
@@ -194,6 +213,8 @@ def fleet_demo(n: int = 96, replicas: int = 3, requests: int = 60,
         baseline, el2, lat2 = sorted(laps2, key=lambda r: r[1])[1]
         fleet_rps = requests / el2
         scaling_x = fleet_rps / single_rps
+        if monitor is not None:
+            monitor.sample()
 
         # ---- the seeded kill schedule -------------------------------
         # Horizon = the routed-call window the kills land in: past the
@@ -210,13 +231,23 @@ def fleet_demo(n: int = 96, replicas: int = 3, requests: int = 60,
         try:
             chaos_fleet.warmup(shapes)
             after_warm = _counters()
+            # Black-box window (ISSUE 8): bracket the chaos pass in
+            # the always-on flight recorder — every journey hop, kill,
+            # restart, reroute, and fault of THIS pass lands in the
+            # embedded slice, so the checker reconstructs each
+            # request's causal chain from the report alone.
+            bb_mark = RECORDER.total
             with _activate(plan):
                 chaos, el3, lat3 = _run_fleet_stream(chaos_fleet, mats,
                                                      staged=True)
             chaos_stats = chaos_fleet.stats()
         finally:
             chaos_fleet.close()
+        blackbox = RECORDER.dump(events=RECORDER.since(bb_mark))
+        journey_ledger = outcome_ledger(blackbox["events"])
         after = _counters()
+        if monitor is not None:
+            monitor.sample()
     finally:
         if cache_dir is not None:
             shutil.rmtree(cache_dir, ignore_errors=True)
@@ -225,32 +256,20 @@ def fleet_demo(n: int = 96, replicas: int = 3, requests: int = 60,
     compiles_after_warmup = after["compiles"] - after_warm["compiles"]
 
     # ---- compare chaos vs the fault-free replay ---------------------
-    matched = singular = 0
-    typed_errors: dict[str, int] = {}
-    mismatches = []
-    for i, (base, under) in enumerate(zip(baseline, chaos)):
-        if under[0] == "error":
-            typed_errors[under[1]] = typed_errors.get(under[1], 0) + 1
-            continue
-        if base[0] != "ok":
-            mismatches.append({"request": i, "why": (
-                f"fault-free run failed ({base[1]}) but chaos "
-                f"succeeded")})
-        elif under[2] != base[2]:
-            mismatches.append({"request": i,
-                               "why": "singular flag diverged"})
-        elif under[1] != base[1]:
-            mismatches.append({"request": i,
-                               "why": "inverse bits diverged"})
-        else:
-            matched += 1
-            singular += int(under[2])
+    # ONE shared comparator with the chaos demo (ISSUE 8 satellite):
+    # what "matched" means can never drift between the two checkers.
+    matched, singular, typed_errors, mismatches = compare_outcomes(
+        baseline, chaos)
 
     ledger = chaos_stats["ledger"]
     typed_total = sum(typed_errors.values())
+    # A journey GAP — a request the black box saw submitted but never
+    # saw resolve — is silent loss by definition, whatever the
+    # response-side ledger claims (ISSUE 8 acceptance).
     silent_loss = (bool(mismatches)
                    or ledger["outstanding"] != 0
-                   or matched + typed_total + len(mismatches) != requests)
+                   or matched + typed_total + len(mismatches) != requests
+                   or bool(journey_ledger["gaps"]))
     # Process-wide delta over EVERY serving phase (not a sum over the
     # surviving replicas' tuners — a killed replica's counter would be
     # discarded with it and hide a measurement from the pin).
@@ -321,10 +340,17 @@ def fleet_demo(n: int = 96, replicas: int = 3, requests: int = 60,
             "elapsed_s": round(el3, 3),
         },
         "ledger": ledger,
+        # The journey-derived ledger of the SAME chaos pass (ISSUE 8:
+        # the one shared outcome_ledger helper over the embedded
+        # black-box slice) — the checker reconciles it against the
+        # response ledger and walks every request's causal chain.
+        "journey_ledger": journey_ledger,
+        "blackbox": blackbox,
         "matched_bitwise": matched,
         "singular_flagged": singular,
         "typed_errors": typed_errors,
         "mismatches": mismatches,
         "silent_loss": silent_loss,
+        **({"slo": monitor.evaluate()} if monitor is not None else {}),
         "elapsed_s": round(time.perf_counter() - t_all, 3),
     }

@@ -38,6 +38,7 @@ from dataclasses import dataclass, field
 import jax.numpy as jnp
 
 from ..obs import metrics as _obs_metrics
+from ..obs.journey import JourneyLog
 from ..resilience.policy import DEFAULT_POLICY, CircuitBreaker
 from ..serve.executors import ExecutorStore
 from ..serve.service import JordanService
@@ -160,6 +161,11 @@ class JordanFleet:
                 clock=self.clock, name=f"fleet_slot_{i}"))
             for i in range(self.slots)
         ]
+        # Fleet-level journey log (ISSUE 8): the router mints ONE
+        # context per request at the fleet front door and threads it
+        # through every replica the request visits — a replica's own
+        # service never mints a second id for fleet traffic.
+        self.journey = JourneyLog(prefix="fleet")
         self._autostart = bool(autostart)
         #: once True, every replica installed from then on has its
         #: dispatcher started at install time — a warm replacement
@@ -383,6 +389,12 @@ class JordanFleet:
             "replicas": self.slots,
             "ready": ready,
             "ledger": ledger,
+            # The journey-derived view of the same ledger (ISSUE 8):
+            # derived purely from per-request journey events through
+            # the ONE shared helper, so it can never drift from what
+            # the black-box dump can prove.  ``gaps`` non-empty while
+            # drained = silent loss.
+            "journey_ledger": self.journey.ledger(),
             "warm_shapes": self.warm_shapes(),
             "executors_compiled": len(self.store),
             "slots": per_slot,

@@ -47,6 +47,7 @@ import threading
 import time
 
 from ..obs import metrics as _obs_metrics
+from ..obs import recorder as _recorder
 from ..resilience import faults as _faults
 from ..resilience.faults import InjectedFaultError, InjectedTransientError
 
@@ -122,23 +123,31 @@ class Replica:
 
     # ---- request path ------------------------------------------------
 
-    def submit(self, a, deadline_ms: float | None = None):
+    def submit(self, a, deadline_ms: float | None = None, ctx=None):
         """Route one request into this replica's service.  Raises
         :class:`ReplicaKilledError` when the replica is not serving —
         including the case where THIS call is the one the seeded
         ``replica_kill`` schedule crashes (the request never entered a
-        queue; the router re-dispatches it elsewhere)."""
+        queue; the router re-dispatches it elsewhere).  ``ctx`` is the
+        fleet-level journey context (ISSUE 8), threaded through so one
+        request keeps ONE journey across replicas."""
         if self.state != READY:
             raise ReplicaKilledError(
                 f"replica {self.name} is {self.state}, not serving")
         try:
             _faults.fire("replica_kill")
         except (InjectedFaultError, InjectedTransientError) as e:
+            if ctx is not None:
+                # The request that pulled the trigger journeys the
+                # crash it caused (it never entered a queue; the
+                # router's shed/requeue hops follow).
+                ctx.event("fault", point="replica_kill",
+                          replica=self.name)
             self.kill(reason="injected")
             raise ReplicaKilledError(
                 f"replica {self.name} crashed at dispatch "
                 f"(injected replica_kill)") from e
-        return self.service.submit(a, deadline_ms=deadline_ms)
+        return self.service.submit(a, deadline_ms=deadline_ms, _ctx=ctx)
 
     def warmup(self, shapes) -> dict:
         return self.service.warmup(shapes)
@@ -165,6 +174,8 @@ class Replica:
             self.state = DEAD
         self._hb_stop.set()
         _M_DEATHS.inc(reason=reason, replica=str(self.slot))
+        _recorder.record("replica_death", replica=self.name,
+                         slot=self.slot, reason=reason)
         name = self.name
         # Bounded join: a kill's whole purpose may be abandoning an
         # unresponsive worker (the wedge remedy) — joining its stuck

@@ -67,7 +67,9 @@ _M_SHED = _obs_metrics.counter(
 class _FleetRequest:
     """One routed request: the raw matrix (re-padded by whichever
     replica serves it), the caller's ABSOLUTE deadline, the reroute
-    budget spent so far, and the outer future the caller holds."""
+    budget spent so far, the outer future the caller holds, and the
+    fleet-level journey context (ISSUE 8 — ONE journey per request,
+    however many replicas it visits)."""
 
     a: np.ndarray
     n: int
@@ -76,11 +78,20 @@ class _FleetRequest:
     t_deadline: float | None = None      # absolute monotonic deadline
     attempts: int = 0
     t_submit: float = field(default=0.0)
+    ctx: object = None                   # obs.journey.RequestContext
 
     def remaining_ms(self, now: float) -> float | None:
         if self.t_deadline is None:
             return None
         return (self.t_deadline - now) * 1e3
+
+    @property
+    def rid(self) -> str | None:
+        return None if self.ctx is None else self.ctx.request_id
+
+    def hop(self, event: str, **attrs) -> None:
+        if self.ctx is not None:
+            self.ctx.event(event, **attrs)
 
 
 class Router:
@@ -107,17 +118,20 @@ class Router:
         # point after dispatch; a caller cancel() racing that would be
         # an InvalidStateError crash inside a dispatcher.
         outer.set_running_or_notify_cancel()
+        bucket = bucket_for(n)
         req = _FleetRequest(
-            a=a, n=n, bucket=bucket_for(n), outer=outer,
+            a=a, n=n, bucket=bucket, outer=outer,
             t_deadline=(None if deadline_ms is None
                         else now + float(deadline_ms) / 1e3),
-            t_submit=now)
+            t_submit=now,
+            ctx=self.pool.journey.new(n, bucket))
         self.pool._record_bucket(req.bucket)
         self.pool._account_submitted()
         try:
             self._dispatch(req)
-        except Exception:
+        except Exception as e:
             self.pool._account_resolved(ok=False)
+            req.ctx.close("error", error=type(e).__name__)
             raise
         return outer
 
@@ -156,34 +170,50 @@ class Router:
                 # an unfilled slot mid rolling-restart) sheds this
                 # request's traffic — the docs/FLEET.md "dead" row, not
                 # just the died-between-scan-and-submit race below.
-                _M_SHED.inc(down, reason="dead")
+                _M_SHED.inc(down, reason="dead", exemplar=req.rid)
                 shed_dead += down
+                req.hop("shed", reason="dead", slots_down=down)
             for replica in candidates:
                 if not replica.breaker_allows(req.bucket):
-                    _M_SHED.inc(reason="breaker")
+                    _M_SHED.inc(reason="breaker", exemplar=req.rid)
                     shed_breaker += 1
+                    req.hop("shed", reason="breaker",
+                            replica=replica.name)
                     continue
+                # The route decision journeys BEFORE the replica sees
+                # the request — WHICH replica, on WHICH attempt (0 =
+                # first dispatch, >0 = a post-death re-queue hop) — so
+                # a failed hand-off reads causally: route -> shed ->
+                # route elsewhere.
+                req.hop("route", replica=replica.name,
+                        slot=replica.slot, attempt=req.attempts)
                 try:
                     inner = replica.submit(
                         req.a,
-                        deadline_ms=req.remaining_ms(time.monotonic()))
+                        deadline_ms=req.remaining_ms(time.monotonic()),
+                        ctx=req.ctx)
                 except (ReplicaKilledError, ServiceClosedError):
                     # Died between the candidate scan and the submit
                     # (or THIS submit triggered the seeded kill): not
                     # this request's problem — next candidate.
-                    _M_SHED.inc(reason="dead")
+                    _M_SHED.inc(reason="dead", exemplar=req.rid)
                     shed_dead += 1
+                    req.hop("shed", reason="dead", replica=replica.name)
                     self.pool._kick_supervisor()
                     continue
                 except ServiceOverloadedError:
-                    _M_SHED.inc(reason="overload")
+                    _M_SHED.inc(reason="overload", exemplar=req.rid)
                     shed_overload += 1
+                    req.hop("shed", reason="overload",
+                            replica=replica.name)
                     continue
                 except CircuitOpenError:
                     # Breaker flipped between breaker_allows and
                     # admission.
-                    _M_SHED.inc(reason="breaker")
+                    _M_SHED.inc(reason="breaker", exemplar=req.rid)
                     shed_breaker += 1
+                    req.hop("shed", reason="breaker",
+                            replica=replica.name)
                     continue
                 inner.add_done_callback(
                     lambda f, req=req, replica=replica:
@@ -205,16 +235,21 @@ class Router:
                 if self.pool.wait_for_live_replica(grace):
                     continue
             break
-        # Nobody accepted: typed backpressure, never a drop.
+        # Nobody accepted: typed backpressure, never a drop.  The
+        # reject hop explains WHY before the journey closes (the
+        # submit/-requeue-failure paths close with the error type).
         if shed_overload:
+            req.hop("reject", reason="saturated")
             raise ServiceOverloadedError(
                 f"fleet saturated for bucket {req.bucket}: every live "
                 f"replica's queue is full — retry later (typed "
                 f"backpressure, nothing dropped)")
         if shed_breaker:
+            req.hop("reject", reason="breaker")
             raise CircuitOpenError(
                 f"every live replica's circuit for bucket {req.bucket} "
                 f"is open — retry after the cooldown")
+        req.hop("reject", reason="no_live_replica")
         raise ServiceOverloadedError(
             "no live replica (fleet restarting or closed) — retry "
             "later (typed backpressure, nothing dropped)")
@@ -227,19 +262,37 @@ class Router:
         exc = inner.exception()
         if exc is None:
             self.pool._account_resolved(ok=True)
-            req.outer.set_result(inner.result())
+            res = inner.result()
+            if req.ctx is not None:
+                req.ctx.close("ok", singular=bool(
+                    getattr(res, "singular", False)))
+            req.outer.set_result(res)
             return
         if (isinstance(exc, (ReplicaKilledError, ServiceClosedError))
                 and not self.pool.closing
                 and req.attempts < self.max_reroutes):
             req.attempts += 1
-            _M_REROUTES.inc(replica=str(replica.slot))
+            _M_REROUTES.inc(replica=str(replica.slot), exemplar=req.rid)
+            req.hop("requeue", from_replica=replica.name,
+                    attempt=req.attempts, error=type(exc).__name__)
             self.pool._kick_supervisor()
             try:
                 self._dispatch(req)
             except Exception as e:           # noqa: BLE001 — typed out
                 self.pool._account_resolved(ok=False)
+                if req.ctx is not None:
+                    req.ctx.close("error", error=type(e).__name__)
                 req.outer.set_exception(e)
             return
+        if isinstance(exc, (ReplicaKilledError, ServiceClosedError)):
+            # A death-class failure the router did NOT re-queue: the
+            # journey must still explain why (the checker's no-causal-
+            # gap rule) — budget spent, or the fleet is closing.
+            req.hop("reject",
+                    reason=("closing" if self.pool.closing
+                            else "reroute_budget_exhausted"),
+                    attempt=req.attempts)
         self.pool._account_resolved(ok=False)
+        if req.ctx is not None:
+            req.ctx.close("error", error=type(exc).__name__)
         req.outer.set_exception(exc)

@@ -30,6 +30,7 @@ from __future__ import annotations
 import threading
 
 from ..obs import metrics as _obs_metrics
+from ..obs import recorder as _recorder
 from .replica import DEAD, READY
 
 _M_RESTARTS = _obs_metrics.counter(
@@ -66,6 +67,11 @@ class Supervisor:
         # inline test drive): the router must never grace-wait for a
         # replacement on the one thread that could install it.
         self._supervising: threading.Thread | None = None
+        # Slots whose withheld-restart was already black-box-recorded
+        # this episode: the poll loop re-visits an open breaker every
+        # check_interval_s, and re-recording each pass would flood the
+        # bounded ring with "still degraded" (cleared on restart).
+        self._withheld_recorded: set[int] = set()
 
     # ---- lifecycle ---------------------------------------------------
 
@@ -127,6 +133,11 @@ class Supervisor:
             if replica is not None and replica.state == READY:
                 # Wedge: READY but the heartbeat went stale.
                 if now - replica.last_beat > self.liveness_deadline_s:
+                    _recorder.record(
+                        "heartbeat_stale", replica=replica.name,
+                        slot=slot.index,
+                        stale_s=round(now - replica.last_beat, 6),
+                        deadline_s=self.liveness_deadline_s)
                     self._replace_wedged(slot, replica)
                 elif (not slot.credited
                       and now - slot.installed_at >= self.stable_after_s):
@@ -155,15 +166,29 @@ class Supervisor:
             try:
                 replacement = pool._spawn_replica(slot.index)
                 replacement.warmup(pool.warm_shapes())
-            except Exception:           # noqa: BLE001 — counted, retried
+            except Exception as e:      # noqa: BLE001 — counted, retried
                 _M_RESTART_FAILURES.inc(replica=str(slot.index))
+                _recorder.record("restart_failure", slot=slot.index,
+                                 error=type(e).__name__)
                 slot.breaker.record_failure()
                 if replacement is not None:
                     replacement.close(drain=False)
                 replacement = None
+        else:
+            # Same per-episode dedup as _try_restart: the poll loop
+            # will revisit this DEAD slot every pass while the breaker
+            # stays open, and must not record a second withholding for
+            # the same episode.
+            if slot.index not in self._withheld_recorded:
+                self._withheld_recorded.add(slot.index)
+                _recorder.record("restart_withheld", slot=slot.index,
+                                 breaker=slot.breaker.state)
         if replacement is not None:
             pool._install(slot, replacement)
             _M_RESTARTS.inc(replica=str(slot.index))
+            self._withheld_recorded.discard(slot.index)
+            _recorder.record("restart", slot=slot.index,
+                             replica=replacement.name, cause="wedged")
         victim.kill(reason="wedged")
 
     def _try_restart(self, slot) -> None:
@@ -172,16 +197,30 @@ class Supervisor:
         entering the slot table (zero compiles — shared store)."""
         pool = self.pool
         if not slot.breaker.allow():
-            return                      # crash loop: stay degraded
+            # Crash loop: stay degraded.  Recorded ONCE per episode so
+            # the black box can prove the unfilled slot is DESIGNED
+            # degradation, not an abandoned death (check_fleet's
+            # stranded accounting) — without flooding the ring on
+            # every poll pass.
+            if slot.index not in self._withheld_recorded:
+                self._withheld_recorded.add(slot.index)
+                _recorder.record("restart_withheld", slot=slot.index,
+                                 breaker=slot.breaker.state)
+            return
         replica = None
         try:
             replica = pool._spawn_replica(slot.index)
             replica.warmup(pool.warm_shapes())
-        except Exception:               # noqa: BLE001 — counted, retried
+        except Exception as e:          # noqa: BLE001 — counted, retried
             _M_RESTART_FAILURES.inc(replica=str(slot.index))
+            _recorder.record("restart_failure", slot=slot.index,
+                             error=type(e).__name__)
             slot.breaker.record_failure()
             if replica is not None:
                 replica.close(drain=False)   # reap the half-built worker
             return
         pool._install(slot, replica)
         _M_RESTARTS.inc(replica=str(slot.index))
+        self._withheld_recorded.discard(slot.index)
+        _recorder.record("restart", slot=slot.index,
+                         replica=replica.name, cause="death")

@@ -15,22 +15,42 @@ counter, ``serve/stats``' per-instance dicts):
   * ``export`` — one-line JSON, Prometheus text, Chrome trace-event
     JSON (Perfetto), plus the jax.profiler kernel tier.
 
+ISSUE 8 adds the request-scoped triad:
+
+  * ``journey`` — per-request journey tracing: a deterministic
+    ``request_id`` minted at submit, every routing/queueing/execution
+    hop a timestamped event, exported as one Chrome-trace async lane
+    per request and summarized by the shared outcome-ledger helper.
+  * ``recorder`` — the always-on bounded flight recorder (black box):
+    structured fleet events dumped on failure and validated
+    event-by-event by the chaos/fleet checkers.
+  * ``slo`` — declarative per-bucket SLOs evaluated by multi-window
+    burn rate over registry snapshots (``tools/check_slo.py``).
+
 Operator guide: ``docs/OBSERVABILITY.md``.
 """
 
-from . import export, metrics, spans
+from . import export, journey, metrics, recorder, slo, spans
 from .export import (profiler_trace, to_chrome_trace, to_json_line,
                      to_prometheus, write_chrome_trace, write_metrics)
+from .journey import (JourneyLog, RequestContext, async_trace_events,
+                      journeys_from_events, outcome_ledger)
 from .metrics import REGISTRY, MetricsRegistry, Reservoir
+from .recorder import RECORDER, FlightRecorder
+from .slo import SLOMonitor, SLOSpec, bucket_specs
 from .spans import (NULL, NullTelemetry, Span, Telemetry,
                     attribute_phases, attribute_phases_measured,
                     timed_blocking)
 
 __all__ = [
-    "export", "metrics", "spans",
+    "export", "journey", "metrics", "recorder", "slo", "spans",
     "profiler_trace", "to_chrome_trace", "to_json_line", "to_prometheus",
     "write_chrome_trace", "write_metrics",
+    "JourneyLog", "RequestContext", "async_trace_events",
+    "journeys_from_events", "outcome_ledger",
     "REGISTRY", "MetricsRegistry", "Reservoir",
+    "RECORDER", "FlightRecorder",
+    "SLOMonitor", "SLOSpec", "bucket_specs",
     "NULL", "NullTelemetry", "Span", "Telemetry", "attribute_phases",
     "attribute_phases_measured", "timed_blocking",
 ]

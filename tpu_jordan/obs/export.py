@@ -79,14 +79,21 @@ def to_prometheus(registry: "_metrics.MetricsRegistry | None" = None
     return "\n".join(lines) + ("\n" if lines else "")
 
 
-def to_chrome_trace(telemetry) -> dict:
+def to_chrome_trace(telemetry, journey_events=None) -> dict:
     """The span tree as a Chrome trace-event document: one complete
     ("X") event per finished span, microsecond timestamps on the
     telemetry's own clock base.  Model-attributed phase children carry
     their ``modeled``/``fraction`` attrs in ``args`` so Perfetto shows
-    the attribution honestly."""
+    the attribution honestly.
+
+    ``journey_events`` (ISSUE 8): an iterable of flight-recorder
+    ``journey`` events — appended as async nestable lanes (one Perfetto
+    row per ``request_id`` showing the request's full path; see
+    ``obs/journey.async_trace_events``).  ``telemetry`` may be None for
+    a journeys-only trace."""
     events = []
-    for root in telemetry.roots:
+    roots = telemetry.roots if telemetry is not None else []
+    for root in roots:
         for sp in root.walk():
             events.append({
                 "name": sp.name,
@@ -101,17 +108,35 @@ def to_chrome_trace(telemetry) -> dict:
                              else str(v))
                          for k, v in sp.attrs.items()},
             })
+    if journey_events is not None:
+        from .journey import async_trace_events
+
+        events.extend(async_trace_events(journey_events))
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 def to_json_line(registry=None, telemetry=None, **extra) -> str:
     """ONE JSON line — the ``--serve-demo`` report convention: metrics
-    snapshot and/or span trees plus any caller extras."""
+    snapshot and/or span trees plus any caller extras.
+
+    Caller extras may NOT collide with the payload keys this function
+    owns (``metric``/``metrics``/``spans``): a colliding ``**extra``
+    used to silently clobber the metrics or span payload — now a typed
+    ``UsageError`` (ISSUE 8 satellite)."""
     doc: dict = {"metric": "telemetry"}
     if registry is not None:
         doc["metrics"] = registry.snapshot()
     if telemetry is not None:
         doc["spans"] = [r.to_dict() for r in telemetry.roots]
+    clash = sorted(set(extra) & set(doc))
+    if clash:
+        from ..driver import UsageError
+
+        raise UsageError(
+            f"to_json_line extra key(s) {clash} collide with the "
+            f"telemetry payload keys {sorted(doc)} — a collision would "
+            f"silently clobber the metrics/span payload; rename the "
+            f"extras")
     doc.update(extra)
     return json.dumps(doc)
 
@@ -122,11 +147,15 @@ def write_metrics(path: str, registry=None) -> None:
         f.write(to_prometheus(registry))
 
 
-def write_chrome_trace(path: str, telemetry) -> None:
+def write_chrome_trace(path: str, telemetry,
+                       journey_events=None) -> None:
     """Write the Chrome trace-event JSON to ``path`` (``--trace-json``);
-    open the file in Perfetto to see the phase spans on a timeline."""
+    open the file in Perfetto to see the phase spans on a timeline —
+    plus, when ``journey_events`` is passed (the CLI passes the flight
+    recorder's journey slice), one async lane per request."""
     with open(path, "w") as f:
-        json.dump(to_chrome_trace(telemetry), f)
+        json.dump(to_chrome_trace(telemetry,
+                                  journey_events=journey_events), f)
 
 
 @contextlib.contextmanager

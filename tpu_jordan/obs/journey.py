@@ -1,0 +1,329 @@
+"""Request-journey tracing (ISSUE 8 tentpole part 1).
+
+PR 4 answers "where did this solve's milliseconds go" and PR 7 answers
+"did any request get silently lost" — this module answers "what
+happened to *this* request".  Every request entering the serving
+surface (``JordanService.submit`` / ``JordanFleet.submit``) gets a
+:class:`RequestContext` carrying a deterministic ``request_id``; every
+hop of its life appends a timestamped journey event:
+
+  ==================  =================================================
+  event               recorded by
+  ==================  =================================================
+  submit              the journey log, at context creation
+  route               fleet router, on replica acceptance (slot, attempt)
+  shed                fleet router, per skipped replica (reason)
+  requeue             fleet router, after a replica-death re-dispatch
+  reject              router/service, on a typed submit-time rejection
+  enqueue             the micro-batcher's bounded-queue admission
+  breaker_fast_fail   the batcher's circuit-breaker fast-fail
+  dispatch            the dispatcher (batch occupancy + cause:
+                      full | deadline | drain)
+  executor            the dispatcher (bucket + source:
+                      compiled | shared_store | cached)
+  retry               the dispatcher's per-batch retry (attempt, error)
+  deadline            the typed deadline failure (phase: queue | execute)
+  batch_failure       a terminal batch error fanned to this rider
+  fault               a request-scoped injected fault (replica_kill)
+  served              the replica-level result fan-out (singular, secs)
+  result              TERMINAL — outcome ok|error, written by close()
+  ==================  =================================================
+
+Every event is mirrored into the always-on flight recorder
+(``obs/recorder.py``, kind ``journey``) with the same timestamp, so a
+request's whole path is reconstructible from the black-box dump alone
+— the ISSUE 8 acceptance pin — and exportable as one Chrome-trace
+async lane per request (:func:`async_trace_events`; Perfetto renders
+one row per ``request_id`` with every hop as an instant).
+
+Determinism: ``request_id`` is ``<prefix>-<seq>`` from the log's own
+counter — submit order, not wall clock or randomness — so a seeded
+demo produces byte-identical ids run after run (the FaultPlan
+discipline).  Terminal outcomes feed ``tpu_jordan_request_outcome_total``
+(the series ``obs/slo.py`` burn-rates over) and
+``tpu_jordan_request_latency_seconds``; both demos derive their outcome
+ledgers from journey events through ONE helper (:func:`outcome_ledger`)
+so demo ledgers and checker inputs can never drift.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+
+from . import metrics as _metrics
+from . import recorder as _recorder
+
+#: Journey events that explain a typed failure (the checker's
+#: "no gap" rule: a typed-failure journey must carry at least one).
+EXPLANATORY_HOPS = frozenset({
+    "shed", "requeue", "reject", "breaker_fast_fail",
+    "deadline", "batch_failure", "fault", "retry",
+})
+
+#: Completed contexts retained per log (the obs bounded-window policy).
+MAX_COMPLETED = 4096
+
+#: Defensive per-request event cap: a pathological requeue loop must
+#: not grow one context without bound (the budget bounds it anyway).
+MAX_EVENTS_PER_REQUEST = 256
+
+_M_OUTCOME = _metrics.counter(
+    "tpu_jordan_request_outcome_total",
+    "terminal request outcomes from journey close (ok | error), "
+    "labeled by outcome and bucket — the availability series the SLO "
+    "burn-rate monitor evaluates")
+_M_LATENCY = _metrics.histogram(
+    "tpu_jordan_request_latency_seconds",
+    "submit-to-terminal-outcome wall seconds per request (journey "
+    "close), labeled by bucket — the latency series behind the SLO "
+    "p99 objective")
+
+
+class RequestContext:
+    """One request's identity + journey.  Created by
+    :meth:`JourneyLog.new`; threaded through the router, replica,
+    batcher, and executors; closed exactly once with the terminal
+    outcome."""
+
+    __slots__ = ("request_id", "n", "bucket", "t_created", "_log",
+                 "_lock", "_events", "_closed")
+
+    def __init__(self, request_id: str, n: int, bucket: int, log):
+        self.request_id = request_id
+        self.n = int(n)
+        self.bucket = int(bucket)
+        self._log = log
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self._closed = False
+        self.t_created = log.clock()
+        self.event("submit", n=self.n, bucket=self.bucket)
+
+    def event(self, name: str, **attrs) -> None:
+        """One journey hop: appended to this context AND mirrored into
+        the flight recorder with the same timestamp (reconstruction
+        from the dump alone must never disagree with the live view)."""
+        t = self._log.clock()
+        ev = {"t": t, "event": str(name)}
+        ev.update(attrs)
+        with self._lock:
+            if self._closed or len(self._events) >= MAX_EVENTS_PER_REQUEST:
+                return
+            self._events.append(ev)
+        self._log.recorder.record(
+            "journey", t=t, request_id=self.request_id, event=str(name),
+            **attrs)
+
+    def close(self, outcome: str, error: str | None = None,
+              **attrs) -> None:
+        """Record the terminal ``result`` event (idempotent — the first
+        closer wins under the lock; a late requeue/deadline race cannot
+        re-open a finished journey) and feed the SLO outcome/latency
+        series."""
+        t = self._log.clock()
+        payload = dict(attrs, outcome=str(outcome))
+        if error is not None:
+            payload["error"] = str(error)
+        ev = dict(payload, t=t, event="result")
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            self._events.append(ev)
+        self._log.recorder.record("journey", t=t,
+                                  request_id=self.request_id,
+                                  event="result", **payload)
+        _M_OUTCOME.inc(outcome=str(outcome), bucket=self.bucket)
+        _M_LATENCY.observe(t - self.t_created, bucket=self.bucket)
+        self._log._complete(self)
+
+    def close_from_future(self, future) -> None:
+        """Terminal-outcome adapter for a ``concurrent.futures`` done
+        callback (the standalone-service path; the fleet router closes
+        its contexts explicitly)."""
+        exc = future.exception() if not future.cancelled() else None
+        if future.cancelled():
+            self.close("error", error="Cancelled")
+        elif exc is not None:
+            self.close("error", error=type(exc).__name__)
+        else:
+            res = future.result()
+            self.close("ok", singular=bool(getattr(res, "singular",
+                                                   False)))
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return [dict(e) for e in self._events]
+
+    def outcome(self) -> tuple[str, str | None] | None:
+        """("ok"|"error", error-type-or-None), or None while open."""
+        for e in reversed(self.events()):
+            if e["event"] == "result":
+                return e["outcome"], e.get("error")
+        return None
+
+    def to_dict(self) -> dict:
+        return {"request_id": self.request_id, "n": self.n,
+                "bucket": self.bucket, "events": self.events()}
+
+
+#: Instances minted per requested prefix, process-wide: journey ids
+#: must be unique across EVERY log in the process — the whole-ring
+#: exports (``--trace-json`` lanes, ``--blackbox-out`` dumps) group
+#: purely by ``request_id``, and two services both minting
+#: ``req-00001`` would merge two different requests into one journey.
+_PREFIX_LOCK = threading.Lock()
+_PREFIX_COUNTS: dict = {}
+
+
+class JourneyLog:
+    """The per-service/per-fleet context factory and retention window.
+    ``new()`` mints deterministic ids in submit order; completed
+    contexts are retained in a bounded ring (active ones are tracked
+    until closed).
+
+    The SECOND log constructed with a given prefix gets an instance
+    suffix (``req``, ``req2``, ``req3``, ...): construction order is
+    deterministic in a seeded demo, so ids stay byte-identical run to
+    run while never colliding across a run's successive services or
+    fleets."""
+
+    def __init__(self, prefix: str = "req", clock=None,
+                 max_completed: int = MAX_COMPLETED, recorder=None):
+        prefix = str(prefix)
+        with _PREFIX_LOCK:
+            _PREFIX_COUNTS[prefix] = _PREFIX_COUNTS.get(prefix, 0) + 1
+            inst = _PREFIX_COUNTS[prefix]
+        self.prefix = prefix if inst == 1 else f"{prefix}{inst}"
+        self.clock = clock if clock is not None else time.perf_counter
+        self.recorder = (recorder if recorder is not None
+                         else _recorder.RECORDER)
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._active: dict[str, RequestContext] = {}
+        self._completed: deque = deque(maxlen=int(max_completed))
+
+    def new(self, n: int, bucket: int) -> RequestContext:
+        with self._lock:
+            self._seq += 1
+            rid = f"{self.prefix}-{self._seq:05d}"
+        ctx = RequestContext(rid, n, bucket, self)
+        with self._lock:
+            self._active[rid] = ctx
+        return ctx
+
+    def _complete(self, ctx: RequestContext) -> None:
+        with self._lock:
+            self._active.pop(ctx.request_id, None)
+            self._completed.append(ctx)
+
+    def contexts(self) -> list[RequestContext]:
+        """Completed (oldest first) then still-active contexts."""
+        with self._lock:
+            return list(self._completed) + list(self._active.values())
+
+    def active_count(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+    def ledger(self) -> dict:
+        """The journey-derived outcome ledger (ISSUE 8 satellite: both
+        demos derive their ledgers through this ONE helper)."""
+        return outcome_ledger(e for ctx in self.contexts()
+                              for e in _ctx_journey_events(ctx))
+
+
+def _ctx_journey_events(ctx: RequestContext):
+    for e in ctx.events():
+        ev = dict(e)
+        ev["request_id"] = ctx.request_id
+        yield ev
+
+
+def journeys_from_events(events) -> dict[str, list[dict]]:
+    """Group flight-recorder ``journey`` events (or any dicts carrying
+    ``request_id``/``event``) by request id, preserving order — the
+    reconstruction primitive the checkers and the async-lane exporter
+    share."""
+    out: dict[str, list[dict]] = {}
+    for e in events:
+        if e.get("kind") not in (None, "journey"):
+            continue
+        rid = e.get("request_id")
+        if rid is None:
+            continue
+        out.setdefault(str(rid), []).append(e)
+    return out
+
+
+def outcome_ledger(events) -> dict:
+    """The outcome ledger derived purely from journey events: how many
+    requests were submitted, how many reached a terminal ``result``
+    (ok vs typed error, with the per-type breakdown), and how many are
+    gaps (submitted, never resolved — the silent-loss signature).
+
+    ONE implementation for the chaos demo, the fleet demo, and the
+    checkers: a ledger computed any other way can drift from what the
+    black box can actually prove."""
+    journeys = journeys_from_events(events)
+    ok = errors = 0
+    typed: dict[str, int] = {}
+    gaps: list[str] = []
+    singular = 0
+    for rid, evs in journeys.items():
+        terminal = next((e for e in reversed(evs)
+                         if e.get("event") == "result"), None)
+        if terminal is None:
+            gaps.append(rid)
+        elif terminal.get("outcome") == "ok":
+            ok += 1
+            singular += int(bool(terminal.get("singular")))
+        else:
+            errors += 1
+            name = str(terminal.get("error", "UnknownError"))
+            typed[name] = typed.get(name, 0) + 1
+    return {
+        "submitted": len(journeys),
+        "ok": ok,
+        "error": errors,
+        "typed_errors": dict(sorted(typed.items())),
+        "singular_flagged": singular,
+        "gaps": sorted(gaps),
+    }
+
+
+def async_trace_events(events, cat: str = "tpu_jordan_request",
+                       pid: int = 0) -> list[dict]:
+    """Chrome-trace ASYNC events from journey events: one lane per
+    request (nestable ``b``/``e`` bracketing the journey, a nestable
+    instant ``n`` per hop), grouped by ``id`` — Perfetto renders one
+    row per request showing the full path (docs/OBSERVABILITY.md).
+
+    ``events`` is any iterable of journey-event dicts (a flight-
+    recorder slice, a report's ``blackbox.events``, or a
+    ``JourneyLog``'s contexts via :func:`journeys_from_events`)."""
+    out: list[dict] = []
+    for rid, evs in sorted(journeys_from_events(events).items()):
+        ts = [float(e["t"]) for e in evs]
+        t0, t1 = min(ts), max(ts)
+        base = {"cat": cat, "id": rid, "pid": pid, "tid": 0}
+        out.append(dict(base, name=rid, ph="b",
+                        ts=round(t0 * 1e6, 3)))
+        for e in evs:
+            args = {k: (v if isinstance(v, (str, int, float, bool,
+                                            type(None))) else str(v))
+                    for k, v in e.items()
+                    if k not in ("t", "kind", "seq", "request_id")}
+            out.append(dict(base, name=str(e["event"]), ph="n",
+                            ts=round(float(e["t"]) * 1e6, 3),
+                            args=args))
+        out.append(dict(base, name=rid, ph="e",
+                        ts=round(t1 * 1e6, 3)))
+    return out

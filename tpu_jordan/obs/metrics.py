@@ -105,11 +105,25 @@ class Metric:
         self.help = help
         self._lock = threading.Lock()
         self._series: dict[tuple, object] = {}
+        #: last exemplar per series (ISSUE 8): a ``request_id`` sample
+        #: attached at mutation time, so an operator going from "the
+        #: shed counter moved" to "show me ONE affected request" has a
+        #: journey id to pull from the flight recorder.
+        self._exemplars: dict[tuple, str] = {}
 
     def series(self) -> dict:
         """{label_key_tuple: value-or-reservoir} snapshot."""
         with self._lock:
             return dict(self._series)
+
+    def exemplar(self, **labels) -> str | None:
+        """The most recent exemplar recorded for the series, or None."""
+        with self._lock:
+            return self._exemplars.get(_label_key(labels))
+
+    def exemplars(self) -> dict:
+        with self._lock:
+            return dict(self._exemplars)
 
     def value(self, **labels) -> float:
         with self._lock:
@@ -119,12 +133,19 @@ class Metric:
 class Counter(Metric):
     kind = "counter"
 
-    def inc(self, value: float = 1.0, **labels) -> None:
+    def inc(self, value: float = 1.0, *, exemplar: str | None = None,
+            **labels) -> None:
+        """``exemplar`` (keyword-only, never a label) attaches a
+        request-id sample to the series — the journey layer's
+        shed/reroute/retry counters pass the affected request's id so
+        a counter movement is traceable to one concrete journey."""
         if value < 0:
             raise ValueError("counters only go up; use a gauge")
         key = _label_key(labels)
         with self._lock:
             self._series[key] = self._series.get(key, 0.0) + float(value)
+            if exemplar is not None:
+                self._exemplars[key] = str(exemplar)
 
     def total(self) -> float:
         """Sum over every label series (the headline scalar)."""
@@ -215,6 +236,7 @@ class MetricsRegistry:
         out = {}
         for m in self.collect():
             series = []
+            exemplars = m.exemplars()
             for key, val in m.series().items():
                 entry: dict = {"labels": dict(key)}
                 if isinstance(val, Reservoir):
@@ -223,6 +245,8 @@ class MetricsRegistry:
                     entry.update(val.percentiles())
                 else:
                     entry["value"] = val
+                if key in exemplars:
+                    entry["exemplar"] = exemplars[key]
                 series.append(entry)
             out[m.name] = {"type": m.kind, "help": m.help,
                            "series": series}

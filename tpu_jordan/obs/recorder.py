@@ -1,0 +1,145 @@
+"""The always-on flight recorder (ISSUE 8 tentpole part 2).
+
+A production fleet's worst failures are the ones the process does not
+survive to explain: by the time ``--fleet-demo`` exits 2 the replicas
+are gone, the span trees were per-``Telemetry`` opt-ins, and the only
+evidence left is an end-state ledger.  The flight recorder is the
+black box: a bounded ring buffer of STRUCTURED fleet events that is
+always recording — route decisions, replica kills, heartbeat-staleness
+wedges, breaker transitions, degradation-ladder rungs, injected
+faults, and every per-request journey hop (``obs/journey.py``) — so a
+post-mortem reconstructs the causal chain (fault → retry/reroute/rung
+→ clean response) from the dump alone, without re-running the demo.
+
+Design contract:
+
+  * **always on, near-zero warm cost** — recording is one dict build +
+    one lock + one deque append; there is no sampling decision, no I/O,
+    no formatting until ``dump()``.  The warm-serve pins (zero
+    compiles, zero measurements) run WITH the recorder on.
+  * **bounded** — a ring of ``capacity`` events (oldest dropped first);
+    ``recorded_total`` vs the retained window makes any drop explicit
+    in the dump (``dropped``), never silent.
+  * **ordered** — every event carries a process-wide monotone ``seq``,
+    so causal chains are checkable even when the wall clock is fake
+    (the obs injectable-clock discipline: ``clock`` is any zero-arg
+    monotonic callable).
+  * **dumped on failure** — the CLI writes the ring on every exit-2
+    path automatically, and on demand via ``--blackbox-out PATH``; the
+    fleet/chaos demos embed their chaos window's slice in the report,
+    which ``tools/check_fleet.py`` / ``tools/check_chaos.py`` validate
+    event-by-event (docs/OBSERVABILITY.md).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+#: Ring capacity: a 3-replica/60-request chaos window records a few
+#: hundred events; 8192 keeps several windows of history without the
+#: recorder ever becoming a memory concern.
+DEFAULT_CAPACITY = 8192
+
+
+class FlightRecorder:
+    """The bounded, thread-safe event ring.  ``record(kind, **fields)``
+    appends ``{"seq", "t", "kind", **fields}``; ``since(seq)`` slices
+    the window a demo wants to embed in its report."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, clock=None):
+        if capacity < 1:
+            raise ValueError("capacity must be >= 1")
+        self.capacity = int(capacity)
+        self.clock = clock if clock is not None else time.perf_counter
+        self._lock = threading.Lock()
+        self._ring: deque = deque(maxlen=self.capacity)
+        self._seq = 0
+
+    def record(self, kind: str, t: float | None = None, **fields) -> int:
+        """Append one event; returns its ``seq``.  ``t`` lets a caller
+        that already read its own clock (a journey hop) stamp both
+        stores with the SAME instant."""
+        ev = dict(fields)
+        ev["kind"] = str(kind)
+        ev["t"] = float(t) if t is not None else self.clock()
+        with self._lock:
+            self._seq += 1
+            ev["seq"] = self._seq
+            self._ring.append(ev)
+            return self._seq
+
+    @property
+    def total(self) -> int:
+        """Events recorded over the recorder's lifetime (monotone; the
+        next ``record`` gets ``total + 1`` — ``since(total)`` before an
+        operation therefore brackets exactly that operation's events)."""
+        with self._lock:
+            return self._seq
+
+    def events(self, kind: str | None = None) -> list[dict]:
+        """The retained window (oldest first), optionally filtered."""
+        with self._lock:
+            evs = list(self._ring)
+        if kind is not None:
+            evs = [e for e in evs if e["kind"] == kind]
+        return evs
+
+    def since(self, seq: int) -> list[dict]:
+        """Events with ``seq`` strictly greater than ``seq`` (the slice
+        a demo embeds: ``mark = recorder.total`` before the window,
+        ``recorder.since(mark)`` after)."""
+        with self._lock:
+            return [e for e in self._ring if e["seq"] > seq]
+
+    def dump(self, events: list[dict] | None = None) -> dict:
+        """The black-box document: the retained window (or an explicit
+        slice) plus the honesty counters — ``dropped`` > 0 means the
+        ring overflowed and reconstruction may have gaps."""
+        with self._lock:
+            window = list(self._ring) if events is None else list(events)
+            total = self._seq
+        # seq is dense and monotone, so a window is gap-free iff it is
+        # contiguous; events evicted by the ring before the window's
+        # first retained seq are the drop count (0 for an explicit
+        # slice that was taken before eviction could reach it).
+        if events is None:
+            dropped = (window[0]["seq"] - 1) if window else total
+        else:
+            seqs = [e["seq"] for e in window]
+            dropped = (seqs[-1] - seqs[0] + 1 - len(seqs)) if seqs else 0
+        return {
+            "metric": "blackbox",
+            "capacity": self.capacity,
+            "recorded_total": total,
+            "retained": len(window),
+            "dropped": dropped,
+            "events": window,
+        }
+
+    def write(self, path: str, events: list[dict] | None = None) -> None:
+        """Write ``dump()`` as one JSON document (the ``--blackbox-out``
+        / exit-2 emission)."""
+        with open(path, "w") as f:
+            json.dump(self.dump(events), f)
+
+    def reset(self) -> None:
+        """Drop the ring and the seq counter (TESTS ONLY — a black box
+        that can be wiped in production is not a black box)."""
+        with self._lock:
+            self._ring.clear()
+            self._seq = 0
+
+
+#: THE process-wide recorder: always on, bounded, shared by the fleet,
+#: the serve path, and the resilience layer.  Library code records
+#: through :func:`record`; demos slice it with ``since``/``dump``.
+RECORDER = FlightRecorder()
+
+
+def record(kind: str, t: float | None = None, **fields) -> int:
+    """Record one event into the process-wide ring (the module-level
+    convenience every instrumented call site uses)."""
+    return RECORDER.record(kind, t=t, **fields)

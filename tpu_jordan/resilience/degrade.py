@@ -39,6 +39,7 @@ from __future__ import annotations
 import math
 
 from ..obs import metrics as _obs_metrics
+from ..obs import recorder as _recorder
 from .policy import ResidualGateError, ResiliencePolicy
 
 _M_RUNGS = _obs_metrics.counter(
@@ -113,6 +114,8 @@ def maybe_recover(policy: ResiliencePolicy, tel, *, a_fresh, inv,
         return inv, residual, norm_a, kappa, ()
 
     _M_GATE_FAIL.inc()
+    _recorder.record("residual_gate_failure", n=n,
+                     rel_residual=float(rel), threshold=float(threshold))
     recovery = []
     with tel.span("recover", n=n, rel_residual=float(rel),
                   threshold=float(threshold)) as rsp:
@@ -135,6 +138,9 @@ def maybe_recover(policy: ResiliencePolicy, tel, *, a_fresh, inv,
             })
             _M_RUNGS.inc(rung="refine",
                          outcome="passed" if passed else "failed")
+            _recorder.record("recovery_rung", rung="refine",
+                             outcome="passed" if passed else "failed",
+                             rel_residual=float(rel2))
             if passed:
                 rsp.attrs["recovered_by"] = "refine"
                 return inv2, res2, norm2, kap2, tuple(recovery)
@@ -156,6 +162,9 @@ def maybe_recover(policy: ResiliencePolicy, tel, *, a_fresh, inv,
             })
             _M_RUNGS.inc(rung="resolve",
                          outcome="passed" if passed else "failed")
+            _recorder.record("recovery_rung", rung="resolve",
+                             outcome="passed" if passed else "failed",
+                             rel_residual=float(rel3))
             if passed:
                 rsp.attrs["recovered_by"] = "resolve"
                 return (res.inverse, res.residual, res._norm_a,

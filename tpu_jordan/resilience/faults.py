@@ -53,6 +53,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..obs import metrics as _obs_metrics
+from ..obs import recorder as _recorder
 
 #: The named injection points.  ``fire()`` on an unknown point raises —
 #: a typo'd point would otherwise be chaos that never happens.
@@ -190,6 +191,12 @@ class FaultPlan:
                 self.injections.append((point, idx, mode))
         if mode is not None:
             _M_INJECTED.inc(point=point)
+            # Black box (ISSUE 8): every fired injection is a recorded
+            # event, so the chaos checkers can validate the CAUSAL
+            # chain (fault -> retry/reroute/rung -> clean response)
+            # instead of only reconciling end-state counters.
+            _recorder.record("fault_injected", point=point, call=idx,
+                             mode=mode)
         return mode
 
     def fire(self, point: str) -> None:

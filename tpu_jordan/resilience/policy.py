@@ -40,6 +40,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from ..obs import metrics as _obs_metrics
+from ..obs import recorder as _recorder
 
 _M_RETRIES = _obs_metrics.counter(
     "tpu_jordan_retries_total",
@@ -144,10 +145,14 @@ class RetryPolicy:
         return base * (1.0 + self.jitter_pct / 100.0
                        * _jitter_fraction(attempt))
 
-    def call(self, fn, component: str = "default", on_retry=None):
+    def call(self, fn, component: str = "default", on_retry=None,
+             exemplar: str | None = None):
         """Run ``fn()`` under the policy.  ``on_retry(exc, attempt)``
         (optional) runs before each re-attempt — the hook call sites use
-        to rebuild donated input buffers."""
+        to rebuild donated input buffers.  ``exemplar`` (ISSUE 8) is an
+        affected request id attached to the retry counter and the
+        flight-recorder retry events (the serve dispatcher passes one
+        of the batch's riders)."""
         classify = self.classify if self.classify is not None else retryable
         sleep = self.sleep if self.sleep is not None else time.sleep
         attempt = 0
@@ -157,7 +162,11 @@ class RetryPolicy:
             except Exception as e:              # noqa: BLE001
                 if attempt >= self.max_retries or not classify(e):
                     raise
-                _M_RETRIES.inc(component=component)
+                _M_RETRIES.inc(component=component, exemplar=exemplar)
+                _recorder.record("retry", component=component,
+                                 attempt=attempt, error=type(e).__name__,
+                                 **({"request_id": exemplar}
+                                    if exemplar else {}))
                 delay = self.delay_s(attempt)
                 if delay > 0:
                     sleep(delay)
@@ -237,6 +246,8 @@ class CircuitBreaker:
                     return False
                 self._state = self.HALF_OPEN
                 self._export()
+                _recorder.record("breaker_transition", breaker=self.name,
+                                 state=self.HALF_OPEN)
             return True
 
     def _open(self):
@@ -245,12 +256,21 @@ class CircuitBreaker:
         self._consecutive = 0
         self._export()
         _M_BREAKER_OPEN.inc(breaker=self.name)
+        _recorder.record("breaker_transition", breaker=self.name,
+                         state=self.OPEN)
 
     def record_success(self) -> None:
         with self._lock:
+            transitioned = self._state != self.CLOSED
             self._state = self.CLOSED
             self._consecutive = 0
             self._export()
+        if transitioned:
+            # Only TRANSITIONS are black-box events: record_success
+            # fires on every healthy batch, and a flight recorder full
+            # of "still closed" would evict the events that matter.
+            _recorder.record("breaker_transition", breaker=self.name,
+                             state=self.CLOSED)
 
     def record_failure(self) -> None:
         with self._lock:

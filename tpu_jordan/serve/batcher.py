@@ -96,6 +96,13 @@ class _Request:
     t_enqueue: float
     future: Future
     t_deadline: float | None = None   # absolute perf_counter deadline
+    ctx: object = None        # obs.journey.RequestContext (ISSUE 8)
+
+    def hop(self, event: str, **attrs) -> None:
+        """One journey event for this rider (no-op without a context —
+        the batcher never requires journeys to function)."""
+        if self.ctx is not None:
+            self.ctx.event(event, **attrs)
 
 
 class MicroBatcher:
@@ -153,7 +160,7 @@ class MicroBatcher:
     # ---- caller side -------------------------------------------------
 
     def submit(self, padded: np.ndarray, n: int, bucket_n: int,
-               deadline_s: float | None = None) -> Future:
+               deadline_s: float | None = None, ctx=None) -> Future:
         br = self.executors.breaker(bucket_n) \
             if self.policy is not None else None
         if br is not None and not br.allow():
@@ -161,21 +168,31 @@ class MicroBatcher:
             # bucket's executor has failed K consecutive times; a
             # half-open probe is admitted once the cooldown elapses.
             self.stats.rejected(bucket_n)
+            if ctx is not None:
+                ctx.event("breaker_fast_fail", bucket=bucket_n)
             raise CircuitOpenError(
                 f"bucket {bucket_n} circuit open after repeated executor "
                 f"failures — retry after the cooldown")
         now = time.perf_counter()
         req = _Request(padded, n, bucket_n, now, Future(),
                        t_deadline=(None if deadline_s is None
-                                   else now + float(deadline_s)))
+                                   else now + float(deadline_s)),
+                       ctx=ctx)
         with self._cv:
             if self._closing:
+                req.hop("reject", reason="closed")
                 raise ServiceClosedError("service is closed")
             if self._queued >= self.max_queue:
                 self.stats.rejected(bucket_n)
+                req.hop("reject", reason="overload", queued=self._queued)
                 raise ServiceOverloadedError(
                     f"request queue full ({self.max_queue} pending) — "
                     f"retry later (typed backpressure, nothing dropped)")
+            # The enqueue hop is recorded BEFORE the queue append (and
+            # under _cv): the dispatcher's "dispatch" hop can otherwise
+            # race ahead of "enqueue" in the journey.  Lock order is
+            # _cv -> ctx -> recorder, never reversed.
+            req.hop("enqueue", bucket=bucket_n, queued=self._queued + 1)
             self._queues.setdefault(bucket_n, deque()).append(req)
             self._queued += 1
             self.stats.request(bucket_n)
@@ -270,20 +287,29 @@ class MicroBatcher:
 
     # ---- dispatcher side ---------------------------------------------
 
-    def _pick(self, now: float) -> int | None:
-        """The bucket to dispatch: any full batch, else the bucket whose
-        head request has aged past the deadline (oldest head first);
-        when draining, any nonempty bucket."""
+    def _pick(self, now: float) -> tuple[int, str] | None:
+        """The ``(bucket, cause)`` to dispatch: any full batch
+        (``cause="full"``), else the bucket whose head request has aged
+        past the deadline (``"deadline"``, oldest head first); when
+        draining, any nonempty bucket (``"drain"``).  The cause lands
+        on every rider's journey — WHY a batch went when it did is the
+        occupancy-vs-latency dial made per-request-visible."""
         best = None
         for b, q in self._queues.items():
             if not q:
                 continue
             age = now - q[0].t_enqueue
-            if (len(q) >= self.batch_cap or self._closing
-                    or age >= self.max_wait):
-                if best is None or age > best[1]:
-                    best = (b, age)
-        return None if best is None else best[0]
+            if len(q) >= self.batch_cap:
+                cause = "full"
+            elif age >= self.max_wait:
+                cause = "deadline"
+            elif self._closing:
+                cause = "drain"
+            else:
+                continue
+            if best is None or age > best[1]:
+                best = (b, age, cause)
+        return None if best is None else (best[0], best[2])
 
     def _next_deadline(self, now: float) -> float | None:
         waits = [self.max_wait - (now - q[0].t_enqueue)
@@ -297,8 +323,9 @@ class MicroBatcher:
                 self._ticks += 1
                 while True:
                     now = time.perf_counter()
-                    bucket = self._pick(now)
-                    if bucket is not None:
+                    picked = self._pick(now)
+                    if picked is not None:
+                        bucket, cause = picked
                         q = self._queues[bucket]
                         take = min(len(q), self.batch_cap)
                         batch = [q.popleft() for _ in range(take)]
@@ -321,6 +348,8 @@ class MicroBatcher:
                     if self._closing and self._queued == 0:
                         return
                     self._cv.wait(self._next_deadline(now))
+            for req in batch:
+                req.hop("dispatch", cause=cause, occupancy=len(batch))
             self._execute(bucket, batch, now)
 
     def _fail_expired(self, batch: list, phase: str) -> list:
@@ -331,7 +360,11 @@ class MicroBatcher:
         for req in batch:
             if req.t_deadline is not None and now > req.t_deadline:
                 _obs_metrics.counter(
-                    "tpu_jordan_deadline_exceeded_total").inc(phase=phase)
+                    "tpu_jordan_deadline_exceeded_total").inc(
+                        phase=phase,
+                        exemplar=(req.ctx.request_id
+                                  if req.ctx is not None else None))
+                req.hop("deadline", phase=phase)
                 if not req.future.done():
                     req.future.set_exception(DeadlineExceededError(
                         f"deadline exceeded in {phase} "
@@ -347,8 +380,14 @@ class MicroBatcher:
             if self.policy is not None else None
         try:
             _faults.fire("dispatch")
-            ex = self.executors.get(bucket, self.batch_cap,
-                                    self.block_size)
+            ex, source = self.executors.get_info(bucket, self.batch_cap,
+                                                 self.block_size)
+            for req in batch:
+                # Compile-vs-cache-hit is a per-request journey fact
+                # (ISSUE 8): "my request paid a compile" is exactly the
+                # warm-path violation the zero-compile pin guards.
+                req.hop("executor", bucket=bucket, source=source,
+                        engine=ex.key.engine)
             dtype = jnp.dtype(ex.key.dtype)
             cap = self.batch_cap
             stacked = np.broadcast_to(
@@ -400,8 +439,20 @@ class MicroBatcher:
                         f"detected by the integrity gate")
                 return inv, sing, kappa, rel, esp.duration
 
+            def on_retry(exc, attempt):
+                # Every rider of the retried batch journeys the retry
+                # (the chaos acceptance: an injected execute fault must
+                # appear as a retry hop on the requests it touched).
+                for req in batch:
+                    req.hop("retry", attempt=attempt,
+                            error=type(exc).__name__)
+
             inv, sing, kappa, rel, exec_s = (
-                self.policy.retry.call(run_once, component="serve.execute")
+                self.policy.retry.call(
+                    run_once, component="serve.execute",
+                    on_retry=on_retry,
+                    exemplar=(batch[0].ctx.request_id
+                              if batch[0].ctx is not None else None))
                 if self.policy is not None else run_once())
         except BaseException as e:                  # noqa: BLE001
             # Fan the failure to every rider — a batch error must be N
@@ -418,6 +469,7 @@ class MicroBatcher:
             if br is not None:
                 br.record_failure()
             for req in batch:
+                req.hop("batch_failure", error=type(e).__name__)
                 if not req.future.done():
                     req.future.set_exception(e)
             return
@@ -435,6 +487,8 @@ class MicroBatcher:
         for i, req in enumerate(batch):
             if id(req) not in live:
                 continue
+            req.hop("served", singular=bool(sing[i]),
+                    seconds=round(exec_s, 6))
             req.future.set_result(InvertResult(
                 inverse=inv[i, :req.n, :req.n],
                 n=req.n,

@@ -295,6 +295,17 @@ class ExecutorCache:
         """The executor for a bucket — compiled on first use, a cache
         hit forever after (ISSUE 3: a warm server performs zero
         recompiles; the per-bucket ``compiles`` counter is the pin)."""
+        return self.get_info(bucket_n, batch_cap, block_size)[0]
+
+    def get_info(self, bucket_n: int, batch_cap: int,
+                 block_size: int | None = None
+                 ) -> tuple[BucketExecutor, str]:
+        """``get`` plus HOW the executor was obtained — ``"cached"``
+        (this cache's own view), ``"shared_store"`` (another replica
+        compiled it), or ``"compiled"`` (this call built it).  The
+        dispatcher stamps the source on each rider's journey (ISSUE 8:
+        compile-vs-cache-hit is a per-request fact, not just a
+        counter)."""
         m = min(block_size if block_size is not None
                 else default_block_size(bucket_n), bucket_n)
         with self._lock:
@@ -307,7 +318,7 @@ class ExecutorCache:
         if ex is not None:
             if self.stats is not None:
                 self.stats.cache_hit(bucket_n)
-            return ex
+            return ex, "cached"
 
         def build():
             # The compile span wraps the REAL build only — a
@@ -342,7 +353,7 @@ class ExecutorCache:
                 self.stats.compile(bucket_n)
             else:
                 self.stats.cache_hit(bucket_n)
-        return ex
+        return ex, ("compiled" if built else "shared_store")
 
     def keys(self):
         with self._lock:

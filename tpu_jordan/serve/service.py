@@ -37,6 +37,7 @@ from concurrent.futures import Future
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.journey import JourneyLog
 from ..resilience.policy import DEFAULT_POLICY
 from .batcher import (InvertResult, MicroBatcher, ServiceClosedError,
                       ServiceOverloadedError)
@@ -128,12 +129,18 @@ class JordanService:
             max_wait_ms=max_wait_ms, max_queue=max_queue,
             block_size=block_size, autostart=autostart,
             telemetry=telemetry, policy=self.policy)
+        # Request-journey log (ISSUE 8, always on): deterministic
+        # ``request_id``s in submit order; every hop mirrors into the
+        # process-wide flight recorder.  A fleet replica does NOT mint
+        # ids — the router passes the fleet-level context through.
+        self.journey = JourneyLog(prefix="req")
         self._closed = False
         self._close_lock = threading.Lock()
 
     # ---- request path ------------------------------------------------
 
-    def submit(self, a, deadline_ms: float | None = None) -> Future:
+    def submit(self, a, deadline_ms: float | None = None,
+               _ctx=None) -> Future:
         """Queue one (n, n) matrix; returns a future resolving to
         :class:`InvertResult`.  Raises :class:`ServiceOverloadedError`
         when the bounded queue is full (backpressure — retry later),
@@ -144,7 +151,13 @@ class JordanService:
         ``deadline_ms`` (default: the service's ``default_deadline_ms``)
         bounds queue wait + execute; exceeding it resolves the future
         with the typed
-        :class:`~..resilience.policy.DeadlineExceededError`."""
+        :class:`~..resilience.policy.DeadlineExceededError`.
+
+        ``_ctx`` (internal, ISSUE 8): an existing journey
+        :class:`~..obs.journey.RequestContext` to thread through — the
+        fleet router passes the fleet-level context so one request has
+        ONE journey across reroutes; when None (every direct caller)
+        the service mints its own and closes it with the future."""
         a = np.asarray(a, self.dtype)
         if a.ndim != 2 or a.shape[0] != a.shape[1]:
             raise ValueError(f"expected a square (n, n) matrix, "
@@ -155,10 +168,25 @@ class JordanService:
         padded[:n, :n] = a
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
-        return self._batcher.submit(
-            padded, n, bucket,
-            deadline_s=(None if deadline_ms is None
-                        else float(deadline_ms) / 1e3))
+        own_ctx = _ctx is None
+        ctx = self.journey.new(n, bucket) if own_ctx else _ctx
+        try:
+            fut = self._batcher.submit(
+                padded, n, bucket,
+                deadline_s=(None if deadline_ms is None
+                            else float(deadline_ms) / 1e3),
+                ctx=ctx)
+        except Exception as e:
+            if own_ctx:
+                ctx.close("error", error=type(e).__name__)
+            raise
+        if own_ctx:
+            # The terminal outcome rides the future: the done callback
+            # writes the journey's "result" event and feeds the SLO
+            # outcome/latency series.  Fleet contexts are closed by the
+            # router (the OUTER future is the request's terminal).
+            fut.add_done_callback(ctx.close_from_future)
+        return fut
 
     @staticmethod
     def result(future: Future, timeout: float | None = None) -> InvertResult:
@@ -348,6 +376,38 @@ def _classify_response(f, timeout: float = 600.0):
         return ("error", type(e).__name__, None)
 
 
+def compare_outcomes(baseline, under):
+    """Bit-compare a chaos stream's outcome tuples against the
+    fault-free replay's (both from :func:`_classify_response`) —
+    returns ``(matched, singular, typed_errors, mismatches)``.
+
+    ONE implementation for the chaos demo and the fleet demo (ISSUE 8
+    satellite): the two previously hand-rolled twin loops, which could
+    drift apart and silently change what "matched" means between the
+    two checkers."""
+    matched = singular = 0
+    typed_errors: dict[str, int] = {}
+    mismatches: list[dict] = []
+    for i, (base, chaos) in enumerate(zip(baseline, under)):
+        if chaos[0] == "error":
+            typed_errors[chaos[1]] = typed_errors.get(chaos[1], 0) + 1
+            continue
+        if base[0] != "ok":
+            mismatches.append({"request": i, "why": (
+                f"fault-free run failed ({base[1]}) but chaos "
+                f"succeeded")})
+        elif chaos[2] != base[2]:
+            mismatches.append({"request": i,
+                               "why": "singular flag diverged"})
+        elif chaos[1] != base[1]:
+            mismatches.append({"request": i,
+                               "why": "inverse bits diverged"})
+        else:
+            matched += 1
+            singular += int(chaos[2])
+    return matched, singular, typed_errors, mismatches
+
+
 def _run_stream(svc, mats, timeout: float = 600.0):
     """Submit a staged request stream (deterministic batching: queue
     everything, then start the dispatcher) and classify every response:
@@ -382,7 +442,9 @@ def chaos_demo(n: int = 96, block_size: int | None = None,
     import tempfile
     import time
 
+    from ..obs.journey import outcome_ledger
     from ..obs.metrics import REGISTRY
+    from ..obs.recorder import RECORDER
     from ..resilience import FaultPlan, ResiliencePolicy
     from ..resilience import activate as _activate
     from ..resilience.policy import RetryPolicy
@@ -446,6 +508,11 @@ def chaos_demo(n: int = 96, block_size: int | None = None,
     if plan_cache is None:
         cache_dir = tempfile.mkdtemp(prefix="tpu_jordan_chaos_")
         plan_cache = f"{cache_dir}/plans.json"
+    # Black-box window (ISSUE 8): bracket the CHAOS pass in the
+    # process-wide flight recorder, so the report carries the causal
+    # evidence (fault -> retry/degradation -> clean response) the
+    # checker validates event-by-event.
+    bb_mark = RECORDER.total
     try:
         with _activate(plan):
             with make_service(plan_cache) as svc:
@@ -456,28 +523,12 @@ def chaos_demo(n: int = 96, block_size: int | None = None,
 
             shutil.rmtree(cache_dir, ignore_errors=True)
     delta = {k: counters()[k] - before[k] for k in before}
+    blackbox = RECORDER.dump(events=RECORDER.since(bb_mark))
+    journey_ledger = outcome_ledger(blackbox["events"])
 
     # ---- compare against the fault-free replay ----------------------
-    matched = singular = 0
-    typed_errors: dict[str, int] = {}
-    mismatches = []
-    for i, (base, under) in enumerate(zip(baseline, chaos)):
-        if under[0] == "error":
-            typed_errors[under[1]] = typed_errors.get(under[1], 0) + 1
-            continue
-        if base[0] != "ok":
-            mismatches.append({"request": i, "why": (
-                f"baseline failed ({base[1]}) but chaos succeeded")})
-            continue
-        if under[2] != base[2]:
-            mismatches.append({"request": i,
-                               "why": "singular flag diverged"})
-        elif under[1] != base[1]:
-            mismatches.append({"request": i,
-                               "why": "inverse bits diverged"})
-        else:
-            matched += 1
-            singular += int(under[2])
+    matched, singular, typed_errors, mismatches = compare_outcomes(
+        baseline, chaos)
 
     # ---- fault accounting: none silent ------------------------------
     # Units are FAULT EVENTS, not rider responses: every raise-style or
@@ -513,9 +564,18 @@ def chaos_demo(n: int = 96, block_size: int | None = None,
         "singular_flagged": singular,
         "typed_errors": typed_errors,
         "mismatches": mismatches,
+        # The journey-derived view of the SAME chaos pass (ISSUE 8:
+        # one shared ledger helper) — the checker reconciles it against
+        # the response-side ledger above, and validates the embedded
+        # black box's causal chains request by request.
+        "journey_ledger": journey_ledger,
+        "blackbox": blackbox,
         # Negative unaccounted (more retries/failures than injections —
         # a REAL transient happened during the run) is not corruption.
-        "silent_corruption": bool(mismatches) or unaccounted > 0,
+        # A journey GAP (a request the black box saw submitted but
+        # never resolved) is silent corruption by definition.
+        "silent_corruption": (bool(mismatches) or unaccounted > 0
+                              or bool(journey_ledger["gaps"])),
         "elapsed_s": round(time.perf_counter() - t0, 3),
     }
     return report

@@ -114,10 +114,13 @@ class ServeStats:
 
     #: Label keys that collide with the mirror calls — ones ServeStats
     #: stamps itself ("bucket"/"component") or that bind to the metric
-    #: APIs' own ``value`` parameter (``Counter.inc``/``Gauge.set``/
-    #: ``Histogram.observe``).  Any of these as a user label would raise
-    #: TypeError deep in the request path, so refuse up front typed.
-    RESERVED_LABELS = frozenset({"bucket", "component", "value"})
+    #: APIs' own parameters (``value`` on ``Counter.inc``/``Gauge.set``/
+    #: ``Histogram.observe``; ``exemplar`` on ``Counter.inc``, ISSUE 8).
+    #: Any of these as a user label would raise TypeError — or silently
+    #: bind to the parameter instead of becoming a label series — deep
+    #: in the request path, so refuse up front typed.
+    RESERVED_LABELS = frozenset({"bucket", "component", "value",
+                                 "exemplar"})
 
     def __init__(self, labels: dict | None = None):
         self._lock = threading.Lock()

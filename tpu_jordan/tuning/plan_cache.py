@@ -36,6 +36,7 @@ import tempfile
 from dataclasses import dataclass, field, asdict
 
 from ..obs import metrics as _obs_metrics
+from ..obs import recorder as _recorder
 from ..resilience import faults as _faults
 from .registry import TunePoint
 
@@ -238,5 +239,9 @@ class PlanCache:
         except OSError as e:
             self.last_write_error = str(e)
             _M_WRITE_FAILS.inc()
+            # Black box (ISSUE 8): the degradation is a recorded event,
+            # so check_chaos can tie a plan_cache_write fault to the
+            # in-memory fallback it caused instead of only counting.
+            _recorder.record("plan_cache_write_failure", error=str(e))
             return
         self.last_write_error = None
