@@ -13,7 +13,7 @@ WORKERS  ?= 1
 REQUESTS  ?= 64
 BATCH_CAP ?= 8
 
-.PHONY: all native tpu test smoke serve-demo chaos-demo fleet-demo metrics-demo slo-demo blackbox bench bench-dip clean
+.PHONY: all native tpu test smoke serve-demo chaos-demo fleet-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
 
 REPLICAS ?= 3
 
@@ -110,6 +110,17 @@ metrics-demo:
 	python tools/check_telemetry.py /tmp/tpu_jordan_solve.prom \
 	  /tmp/tpu_jordan_serve.prom /tmp/tpu_jordan_solve_trace.json
 
+# Numerics-observatory demo + validation (docs/OBSERVABILITY.md,
+# ISSUE 10): one seeded ill-conditioned bf16 solve with the full
+# per-superstep numerics trace — the residual gate fails, refine
+# diverges, the fp32 re-solve recovers — and the checker proves every
+# degradation rung is causally preceded by a numerics_spike event in
+# the flight recorder (exit 2 = an unexplained rung).
+numerics-demo:
+	python -m tpu_jordan 16 8 --numerics-demo --quiet \
+	  > /tmp/tpu_jordan_numerics.json
+	python tools/check_numerics.py /tmp/tpu_jordan_numerics.json
+
 bench: native
 	python bench.py
 
@@ -120,6 +131,15 @@ bench: native
 # AND the session's own spread cannot explain it.
 bench-dip: native
 	python bench.py --dip-guard
+
+# The BENCH trajectory regression sentinel (ISSUE 10; docs/
+# OBSERVABILITY.md): compares the newest round's steady-state rows —
+# never first-call compile-inclusive times — against the best prior
+# round, flagging only shortfalls the rows' own spread/variance_flag
+# cannot explain (exit 2 = unexplained regression; rows without
+# robust-capture stats are unknown, not regressed).
+bench-check:
+	python tools/check_bench.py BENCH_r*.json
 
 clean:
 	rm -f tpu_jordan/_native.so

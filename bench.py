@@ -41,6 +41,17 @@ The measured path is the in-place blocked Gauss-Jordan
 (benchmarks/PHASES.md) — same condition-based pivot rule as the
 reference.
 
+FLOP accounting (ISSUE 10): the headline GFLOP/s keeps the hand 2n³
+convention — changing the unit would orphan the BENCH_r01+ trajectory
+and the 6.8 GFLOP/s baseline — but every row now ALSO records the
+compiled executable's own ``cost_analysis()`` numbers
+(``*_xla_flops`` / ``*_xla_gflops`` / ``*_xla_vs_2n3`` /
+``*_arithmetic_intensity``, the arXiv:2112.09017 accounting
+discipline; ``tpu_jordan/obs/hwcost.py``), plus an ``env`` fingerprint
+(jax/jaxlib versions, device kind, host cores) so cross-round
+comparisons — and the ``tools/check_bench.py`` regression sentinel —
+are interpretable.
+
 Timing methodology: this environment tunnels to the TPU with ~100ms RTT
 and a readback-pipelining quirk, so the inversion is repeated K times
 inside a single jitted fori_loop (data-dependent chaining, no host round
@@ -71,6 +82,30 @@ def _retry_transient(fn):
     from tpu_jordan.resilience.policy import retry_transient
 
     return retry_transient(fn)
+
+
+def _aot_first_call(fn, a):
+    """ONE compile-inclusive first call (the ISSUE 4 row policy:
+    recorded NEXT TO the steady-state slope so compile-time changes
+    can't masquerade as execution regressions), AOT-lowered so the row
+    also carries the executable's OWN cost_analysis accounting
+    (ISSUE 10) — same trace+compile+run total as a jit-cache first
+    call, zero extra compiles.  Returns ``((result, cost), span)``;
+    the executable reference is dropped before returning."""
+    import jax
+
+    from tpu_jordan.obs import hwcost as _hwcost
+    from tpu_jordan.obs.spans import timed_blocking
+
+    def _first():
+        compiled = jax.jit(fn).lower(a).compile()
+        return compiled, compiled(a)
+
+    (compiled, out), sp = timed_blocking(
+        _first, name="first_call_compile_inclusive")
+    cost = _hwcost.executable_cost(compiled)
+    del compiled
+    return (out, cost), sp
 
 
 def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
@@ -128,19 +163,13 @@ def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
         engine = partial(grouped, group=group)
     else:
         engine = block_jordan_invert_inplace
-    from tpu_jordan.obs.spans import timed_blocking
 
     a = generate(generator, (n, n), jnp.float32)
     # Invert ONCE before the timing campaign: the knife-edge fallback
     # (_Singular) must fire from this cheap call, not after r2 timed
-    # repetitions of a result that would be discarded.  The call is
-    # bracketed as a compile-inclusive first-call span (ISSUE 4
-    # satellite): BENCH_*.json rows record it NEXT TO the steady-state
-    # slope so a compile-time change can never masquerade as (or mask)
-    # an execution regression across capture rounds.
-    (inv, sing), first_sp = timed_blocking(
-        lambda: engine(a, block_size=m),
-        name="first_call_compile_inclusive")
+    # repetitions of a result that would be discarded.
+    ((inv, sing), cost), first_sp = _aot_first_call(
+        lambda v: engine(v, block_size=m), a)
     if bool(sing):
         raise _Singular(f"benchmark matrix flagged singular (n={n} m={m})")
     # The robust measurement core (tuning/measure.py, shared with the
@@ -205,6 +234,18 @@ def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
         acc["iqr_rejected_samples"] = len(meas.rejected)
     if meas.variance_flag:
         acc["variance_flag"] = meas.variance_flag
+    # The compiled executable's OWN accounting next to the hand
+    # convention (ISSUE 10: the arXiv:2112.09017 discipline — achieved
+    # rates attributed from compiler-counted flops, the hand 2n³
+    # headline kept ONLY for cross-round/BASELINE comparability).
+    # Absent when the backend exposes no analysis — never modeled.
+    if cost.available and cost.flops:
+        acc["xla_flops"] = cost.flops
+        acc["xla_gflops"] = round(cost.flops / per_call / 1e9, 1)
+        acc["xla_vs_2n3"] = round(cost.flops / (2.0 * n**3), 3)
+        ai = cost.arithmetic_intensity
+        if ai is not None:
+            acc["arithmetic_intensity"] = round(ai, 1)
     if refine:
         refined = newton_schulz(a, inv, refine)
         rel_ref = float(residual_inf_norm(a, refined)) / norm_a
@@ -271,6 +312,11 @@ def _record_spread(extra, prefix, acc):
         extra[f"{prefix}_iqr_rejected_samples"] = acc["iqr_rejected_samples"]
     if "variance_flag" in acc:
         extra[f"{prefix}_variance_flag"] = acc["variance_flag"]
+    # Compiler-counted accounting (ISSUE 10), when the backend gave it.
+    for key in ("xla_flops", "xla_gflops", "xla_vs_2n3",
+                "arithmetic_intensity"):
+        if key in acc:
+            extra[f"{prefix}_{key}"] = acc[key]
 
 
 def _batched_row(extra, B, n, m, r1, r2, baseline_gflops, label):
@@ -292,8 +338,6 @@ def _batched_row(extra, B, n, m, r1, r2, baseline_gflops, label):
     from tpu_jordan.ops import batched_jordan_invert, generate
     from tpu_jordan.tuning.measure import measure_slope
 
-    from tpu_jordan.obs.spans import timed_blocking
-
     # The solve_batch fixture convention: per-element index offsets give
     # distinct matrices under the 'rand' generator.
     offs = jnp.arange(B, dtype=jnp.int32) * n
@@ -302,10 +346,10 @@ def _batched_row(extra, B, n, m, r1, r2, baseline_gflops, label):
                            col_offset=o)
     ))(offs)
     # Compile-inclusive first call recorded next to the steady-state
-    # slope (ISSUE 4 satellite — same policy as _measure).
-    (inv, sing), first_sp = timed_blocking(
-        lambda: batched_jordan_invert(a, block_size=m),
-        name="first_call_compile_inclusive")
+    # slope (the shared _aot_first_call bracket — same policy as
+    # _measure, cost_analysis included).
+    ((inv, sing), cost), first_sp = _aot_first_call(
+        lambda v: batched_jordan_invert(v, block_size=m), a)
     extra[f"batched_{label}_first_call_compile_inclusive_s"] = round(
         first_sp.duration, 3)
     nsing = int(jnp.sum(sing))
@@ -329,6 +373,10 @@ def _batched_row(extra, B, n, m, r1, r2, baseline_gflops, label):
     gf = 2.0 * n**3 * B / meas.seconds / 1e9
     extra[f"batched_{label}_steady_state_s"] = round(meas.seconds, 6)
     extra[f"batched_{label}_f32_gflops"] = round(gf, 1)
+    if cost.available and cost.flops:
+        extra[f"batched_{label}_xla_flops"] = cost.flops
+        extra[f"batched_{label}_xla_gflops"] = round(
+            cost.flops / meas.seconds / 1e9, 1)
     extra[f"batched_{label}_vs_baseline"] = round(gf / baseline_gflops, 1)
     extra[f"batched_{label}_rel_residual0"] = f"{rel0:.1e}"
     extra[f"batched_{label}_kappa0"] = f"{kappa0:.3e}"
@@ -509,9 +557,17 @@ def main(argv=None):
     dip_only = "--dip-guard" in argv
     baseline_gflops = 6.8  # BASELINE.md: reference fp64, m=48, 1 CPU core
 
+    # Environment fingerprint FIRST (ISSUE 10 satellite): jax/jaxlib
+    # versions, device kind, host cores — what makes cross-round BENCH
+    # comparisons (and the tools/check_bench.py sentinel's variance
+    # judgments) interpretable.  The sentinel treats missing env in old
+    # rounds as unknown, never as regressed.
+    from tpu_jordan.obs.hwcost import runtime_env
+
     gf_4096, acc_4096 = _retry_transient(
         lambda: _measure(4096, 128, r1=8, r2=24))
     extra = {
+        "env": runtime_env(),
         "rel_residual_4096": acc_4096["rel_residual"],
         "kappa_4096": acc_4096["kappa"],
     }
@@ -589,8 +645,20 @@ def main(argv=None):
                                   baseline_gflops=baseline_gflops,
                                   vs_key="vs_baseline_16384")
     if acc16 is not None:
+        # Robust-capture + cost keys in the shared PREFIX style
+        # (invert_16384_spread_pct, ...) so tools/check_bench.py's
+        # exact-stem variance lookup finds them (ISSUE 10: the suffix
+        # style spread_pct_16384 was invisible to the sentinel);
+        # accuracy keys keep the historical suffix names.
+        _record_spread(extra, "invert_16384", acc16)
+        _RECORDED = {"gflops_minmax", "spread_pct",
+                     "iqr_rejected_samples", "variance_flag",
+                     "first_call_compile_inclusive_s", "steady_state_s",
+                     "xla_flops", "xla_gflops", "xla_vs_2n3",
+                     "arithmetic_intensity"}
         for k, v in acc16.items():
-            extra[f"{k}_16384"] = v
+            if k not in _RECORDED:
+                extra[f"{k}_16384"] = v
 
     # Batched tiers (ISSUE 3 satellite / VERDICT r5 item 5): the
     # 512×512² dedicated-engine row and the largest-fitting B×2048²

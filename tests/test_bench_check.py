@@ -1,0 +1,255 @@
+"""ISSUE 10 tentpole part 3 — the BENCH trajectory regression sentinel.
+
+Both-ways pins (the check_fleet/check_chaos discipline): the sentinel
+passes the REAL r01–r05 trajectory checked into the repo (the r04→r05
+4096² dip is single-sample/no-spread — UNKNOWN, never a page), and
+exit-2s on a doctored steady-state regression whose own low spread
+cannot explain it.  First-call compile-inclusive times are never
+compared; rows without robust-capture stats are unknown, not
+regressed (backfill tolerance); high-variance sessions — on either
+end of the comparison — explain their own dips.  No jax import in the
+checker itself.
+"""
+
+import importlib.util
+import json
+import pathlib
+
+_repo = pathlib.Path(__file__).resolve().parent.parent
+_tool = _repo / "tools" / "check_bench.py"
+_spec = importlib.util.spec_from_file_location("check_bench", _tool)
+check_bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_bench)
+
+
+def _round(value, extra=None, metric="invert_4096x4096_f32_gflops"):
+    return {"metric": metric, "value": value, "unit": "GFLOP/s",
+            "extra": extra or {}}
+
+
+def _write(tmp_path, name, row):
+    p = tmp_path / name
+    p.write_text(json.dumps({"rc": 0, "tail": "", "parsed": row}))
+    return str(p)
+
+
+class TestRealTrajectory:
+    def test_real_r01_r05_passes(self):
+        """The acceptance pin: the checked-in trajectory — including
+        the diagnosed r04→r05 dip — exits 0."""
+        files = sorted(str(p) for p in _repo.glob("BENCH_r0*.json"))
+        assert len(files) >= 5
+        assert check_bench.main(files) == 0
+
+    def test_real_rounds_load(self):
+        row = check_bench.load_round(str(_repo / "BENCH_r05.json"))
+        assert row["metric"] == "invert_4096x4096_f32_gflops"
+        keys = check_bench.comparable_keys(row)
+        assert "invert_4096x4096_f32_gflops" in keys
+        assert not any("first_call" in k for k in keys)
+
+
+class TestRegressionRules:
+    def test_doctored_quiet_regression_exits_2(self, tmp_path):
+        """The exit-2 class: a 30% steady-state shortfall with 2%
+        recorded spread — the session's own variance cannot explain
+        it."""
+        files = [
+            _write(tmp_path, "BENCH_r01.json", _round(10000.0)),
+            _write(tmp_path, "BENCH_r02.json", _round(
+                7000.0, {"invert_4096_spread_pct": 2.0})),
+        ]
+        assert check_bench.main(files) == 2
+
+    def test_missing_spread_is_unknown_not_regressed(self, tmp_path):
+        """Backfill tolerance (the r04→r05 class): a shortfall on a
+        row WITHOUT robust-capture stats cannot be attributed — warn,
+        never page."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0)),
+            _write(tmp_path, "r2.json", _round(7000.0)),
+        ]
+        assert check_bench.main(files) == 0
+
+    def test_high_variance_session_explains_its_dip(self, tmp_path):
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0)),
+            _write(tmp_path, "r2.json", _round(
+                7000.0, {"invert_4096_spread_pct": 31.0})),
+        ]
+        assert check_bench.main(files) == 0
+
+    def test_variance_flag_explains_its_dip(self, tmp_path):
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0)),
+            _write(tmp_path, "r2.json", _round(
+                7000.0, {"invert_4096_spread_pct": 3.0,
+                         "invert_4096_variance_flag":
+                             "spread 3% but bimodal"})),
+        ]
+        assert check_bench.main(files) == 0
+
+    def test_noisy_high_water_mark_explains_the_dip(self, tmp_path):
+        """The reference round itself was noisy: its inflated best is
+        not a page-worthy baseline."""
+        files = [
+            _write(tmp_path, "r1.json", _round(
+                10000.0, {"invert_4096_spread_pct": 40.0})),
+            _write(tmp_path, "r2.json", _round(
+                7000.0, {"invert_4096_spread_pct": 2.0})),
+        ]
+        assert check_bench.main(files) == 0
+
+    def test_small_shortfall_within_tolerance(self, tmp_path):
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0)),
+            _write(tmp_path, "r2.json", _round(
+                9200.0, {"invert_4096_spread_pct": 1.0})),
+        ]
+        assert check_bench.main(files) == 0
+
+    def test_first_call_keys_never_compared(self, tmp_path):
+        """A 100x first-call regression (a compile-time change) with
+        flat steady-state rows is NOT a regression — the exact
+        conflation the PR 4 row split exists to prevent."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "invert_4096_first_call_compile_inclusive_s": 1.0,
+                "invert_4096_spread_pct": 1.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "invert_4096_first_call_compile_inclusive_s": 100.0,
+                "invert_4096_spread_pct": 1.0})),
+        ]
+        assert check_bench.main(files) == 0
+
+    def test_extra_gflops_rows_compared_by_key(self, tmp_path):
+        """Rows compare like-for-like by key: a regressed extra row
+        pages even when the headline is healthy — and an exact-stem
+        spread sibling is found first."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "invert_8192x8192_f32_m256_gflops": 14000.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "invert_8192x8192_f32_m256_gflops": 9000.0,
+                "invert_8192x8192_f32_m256_spread_pct": 1.5})),
+        ]
+        assert check_bench.main(files) == 2
+
+    def test_grouped_row_never_binds_the_plain_siblings_spread(
+            self, tmp_path):
+        """Fuzzy variance lookup is configuration-aware (review
+        finding): the grouped2 row's quiet 1% spread — not the plain
+        |i-j| row's noisy 25% — judges the grouped regression, so it
+        pages."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "invert_8192_f32_m128_grouped2_rand_gflops": 16000.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "invert_8192_f32_m128_grouped2_rand_gflops": 12000.0,
+                "invert_8192_spread_pct": 25.0,
+                "invert_8192_grouped_spread_pct": 1.0})),
+        ]
+        assert check_bench.main(files) == 2
+        row = {"extra": {"invert_8192_spread_pct": 25.0,
+                         "invert_8192_grouped_spread_pct": 1.0}}
+        spread, _ = check_bench._variance_context(
+            "invert_8192_f32_m128_grouped2_rand_gflops", row)
+        assert spread == 1.0
+
+    def test_suffix_style_spread_keys_recognized(self, tmp_path):
+        """The 16384 scale row's historical suffix naming
+        (spread_pct_16384) is visible to the sentinel (review
+        finding): a quiet suffix spread pages a real regression, a
+        noisy one explains it."""
+        key = "invert_16384_f32_m128_grouped2_rand_gflops"
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {key: 22000.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                key: 15000.0, "spread_pct_16384": 1.2})),
+        ]
+        assert check_bench.main(files) == 2
+        files[1] = _write(tmp_path, "r2b.json", _round(10000.0, {
+            key: 15000.0, "spread_pct_16384": 30.0}))
+        assert check_bench.main(files) == 0
+
+    def test_xla_gflops_accounting_rows_never_compared(self, tmp_path):
+        """A compiler upgrade that recounts flops for the SAME
+        execution (fusion changes) must not page: the *_xla_gflops
+        accounting rows are excluded from comparison, like first-call
+        times (review finding)."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "invert_4096_xla_gflops": 13000.0,
+                "invert_4096_spread_pct": 1.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "invert_4096_xla_gflops": 9000.0,
+                "invert_4096_spread_pct": 1.0})),
+        ]
+        assert check_bench.main(files) == 0
+        keys = check_bench.comparable_keys(
+            {"metric": "m", "value": 1.0,
+             "extra": {"invert_4096_xla_gflops": 9000.0,
+                       "invert_4096_f32_gflops": 9000.0}})
+        assert "invert_4096_f32_gflops" in keys
+        assert "invert_4096_xla_gflops" not in keys
+
+    def test_renamed_config_is_a_new_row(self, tmp_path):
+        """A config migration renames its key (m256 vs m384): the
+        sentinel never diffs different configurations."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "invert_8192x8192_f32_m384_gflops": 14000.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "invert_8192x8192_f32_m256_gflops": 5000.0,
+                "invert_8192x8192_f32_m256_spread_pct": 1.0})),
+        ]
+        assert check_bench.main(files) == 0
+
+
+class TestStructure:
+    def test_unreadable_latest_exits_1(self, tmp_path):
+        bad = tmp_path / "bad.json"
+        bad.write_text("not json")
+        assert check_bench.main([str(bad)]) == 1
+
+    def test_single_round_nothing_to_compare(self, tmp_path):
+        files = [_write(tmp_path, "r1.json", _round(10000.0))]
+        assert check_bench.main(files) == 0
+
+    def test_failed_round_skipped_mid_trajectory(self, tmp_path):
+        """A round whose bench crashed (no parseable row) is skipped;
+        the comparison spans the usable rounds around it."""
+        p = tmp_path / "r2.json"
+        p.write_text(json.dumps({"rc": 1, "tail": "Traceback ..."}))
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0)),
+            str(p),
+            _write(tmp_path, "r3.json", _round(
+                6000.0, {"invert_4096_spread_pct": 1.0})),
+        ]
+        assert check_bench.main(files) == 2
+
+    def test_tail_fallback_parses_json_line(self, tmp_path):
+        p = tmp_path / "r1.json"
+        p.write_text(json.dumps({
+            "rc": 0,
+            "tail": "WARNING: noise\n" + json.dumps(_round(9000.0))}))
+        row = check_bench.load_round(str(p))
+        assert row["value"] == 9000.0
+
+    def test_env_fingerprint_reported_as_context(self, tmp_path):
+        rounds = [
+            ("r1", _round(10000.0)),
+            ("r2", _round(10000.0, {"env": {
+                "jax": "0.4.37", "jaxlib": "0.4.36",
+                "device_kind": "cpu", "device_count": 8,
+                "host_cpu_count": 4}})),
+        ]
+        regs, warns, notes = check_bench.check_trajectory(rounds)
+        assert not regs and not warns
+        assert any("jax 0.4.37" in n for n in notes)
+        # Missing env in old rows: unknown context, never a gate.
+        regs2, _, notes2 = check_bench.check_trajectory(
+            [("r1", _round(10000.0)), ("r2", _round(10000.0))])
+        assert not regs2
+        assert any("unknown" in n for n in notes2)

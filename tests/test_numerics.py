@@ -1,0 +1,336 @@
+"""ISSUE 10 tentpole part 1 — the per-superstep numerics observatory.
+
+Pins: the trace rides the SAME executable and never changes the
+inverse's bits; the per-step records are the paper's own selection
+evidence (pivot id in the live window, the chosen criterion value is
+the candidate minimum); both non-off modes mirror into the
+``tpu_jordan_pivot_condition``/``growth_factor``/``residual``
+histograms; spikes land in the flight recorder BEFORE any recovery
+rung (the causal-chain acceptance, checker-validated both ways); and
+the ``off`` default costs the warm path nothing — no report, no
+recorder events, no histogram series.
+"""
+
+import importlib.util
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_jordan.driver import UsageError, solve
+from tpu_jordan.obs import numerics as obs_numerics
+from tpu_jordan.obs.metrics import REGISTRY
+from tpu_jordan.obs.recorder import RECORDER
+
+_tool = (pathlib.Path(__file__).resolve().parent.parent / "tools"
+         / "check_numerics.py")
+_spec = importlib.util.spec_from_file_location("check_numerics", _tool)
+check_numerics = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_numerics)
+
+
+def _hist_count(name, **labels):
+    h = REGISTRY.histogram(name)
+    res = h._series.get(tuple(sorted((str(k), str(v))
+                                     for k, v in labels.items())))
+    return 0 if res is None else res.count
+
+
+class TestModes:
+    def test_resolve_mode_vocabulary(self):
+        assert obs_numerics.resolve_mode(None) == "off"
+        for m in ("off", "summary", "trace"):
+            assert obs_numerics.resolve_mode(m) == m
+        with pytest.raises(UsageError):
+            obs_numerics.resolve_mode("verbose")
+
+    def test_off_default_costs_nothing(self):
+        """The warm-path pin: the default solve produces no report, no
+        recorder events, and moves no numerics histogram."""
+        before_ev = RECORDER.total
+        before_res = _hist_count("tpu_jordan_residual", engine="inplace")
+        r = solve(48, 16, generator="rand", engine="inplace")
+        assert r.numerics is None
+        assert RECORDER.total == before_ev
+        assert _hist_count("tpu_jordan_residual",
+                           engine="inplace") == before_res
+
+
+class TestTrace:
+    def test_trace_records_every_superstep_and_bitmatches(self):
+        """One record per superstep; the pivot id sits in the live
+        window; the chosen criterion value is the candidate minimum;
+        and the inverse BIT-MATCHES the uninstrumented solve — the
+        stats are reads, never a different computation."""
+        plain = solve(48, 16, generator="rand", engine="inplace")
+        r = solve(48, 16, generator="rand", engine="inplace",
+                  numerics="trace")
+        rep = r.numerics
+        nr = 3
+        assert rep.mode == "trace" and rep.trace_engine == "inplace"
+        assert len(rep.pivot_block) == nr
+        for t, p in enumerate(rep.pivot_block):
+            assert t <= p < nr
+        for mn, mx in zip(rep.pivot_inv_norm, rep.cand_norm_max):
+            assert np.isfinite(mn) and mn <= mx
+        assert all(s == 0 for s in rep.singular_candidates)
+        assert len(rep.growth) == nr
+        # growth is a running watermark: non-decreasing.
+        assert all(a <= b + 1e-12 for a, b in zip(rep.growth,
+                                                  rep.growth[1:]))
+        assert rep.growth_factor is not None and rep.growth_factor > 0
+        # The MODELED field is named as modeled — nothing else is.
+        assert rep.modeled_fields == ("residual_est",)
+        assert len(rep.residual_est) == nr
+        np.testing.assert_array_equal(np.asarray(plain.inverse),
+                                      np.asarray(r.inverse))
+
+    def test_grouped_trace_same_pivot_sequence(self):
+        """The grouped engine's eager side-updates preserve the pivot
+        RULE (ISSUE 6 contract): its trace shows the same pivot
+        sequence as the plain engine on the same fixture."""
+        a = solve(64, 16, generator="rand", engine="inplace",
+                  numerics="trace")
+        b = solve(64, 16, generator="rand", engine="grouped",
+                  numerics="trace")
+        assert b.numerics.trace_engine == "grouped"
+        assert a.numerics.pivot_block == b.numerics.pivot_block
+
+    def test_trace_mirrors_into_registry(self):
+        before_p = _hist_count("tpu_jordan_pivot_condition",
+                               engine="inplace")
+        before_g = _hist_count("tpu_jordan_growth_factor",
+                               engine="inplace")
+        r = solve(48, 16, generator="rand", engine="inplace",
+                  numerics="trace")
+        nr = len(r.numerics.pivot_block)
+        assert _hist_count("tpu_jordan_pivot_condition",
+                           engine="inplace") == before_p + nr
+        assert _hist_count("tpu_jordan_growth_factor",
+                           engine="inplace") == before_g + 1
+
+    def test_trace_refusals_are_typed(self):
+        """No silently different trace: the host-opaque paths refuse."""
+        from tpu_jordan.driver import single_device_invert
+
+        with pytest.raises(UsageError, match="augmented"):
+            single_device_invert(64, 16, "augmented",
+                                 collect_stats=True)
+        with pytest.raises(UsageError, match="bf16"):
+            single_device_invert(64, 16, "grouped_pallas_bf16", 2,
+                                 collect_stats=True)
+        with pytest.raises(UsageError, match="MAX_UNROLL_NR"):
+            single_device_invert(65 * 8, 8, "inplace",
+                                 collect_stats=True)
+        with pytest.raises(UsageError, match="distributed"):
+            solve(32, 8, generator="rand", workers=2, numerics="trace")
+
+    def test_pallas_fp32_traces_through_grouped_twin(self):
+        """The fp32 fused engine's trace instruments its bit-matching
+        XLA twin — the returned callable exists and is the grouped
+        instrumented path (no UsageError)."""
+        from tpu_jordan.driver import single_device_invert
+
+        fn = single_device_invert(64, 16, "grouped_pallas", 2,
+                                  collect_stats=True)
+        assert fn is not None
+
+
+class TestSummary:
+    def test_summary_reads_only_returned_numbers(self):
+        r = solve(48, 16, generator="rand", engine="inplace",
+                  numerics="summary")
+        rep = r.numerics
+        assert rep.mode == "summary"
+        assert rep.rel_residual == pytest.approx(r.rel_residual)
+        assert rep.kappa == pytest.approx(r.kappa)
+        assert rep.pivot_block is None and rep.growth is None
+        assert rep.to_json()["mode"] == "summary"
+
+    def test_summary_on_distributed_mesh(self):
+        r = solve(32, 8, generator="rand", workers=2,
+                  numerics="summary")
+        assert r.numerics is not None
+        assert r.numerics.mode == "summary"
+        assert np.isfinite(r.numerics.rel_residual)
+
+
+class TestSpikes:
+    def test_healthy_solve_spikes_nothing(self):
+        r = solve(48, 16, generator="rand", engine="inplace",
+                  numerics="trace")
+        assert r.numerics.spikes == []
+
+    def test_ill_conditioned_ladder_causally_explained(self, tmp_path):
+        """THE ISSUE 10 acceptance pin: a seeded ill-conditioned bf16
+        solve under the fp32-SLO policy walks refine -> fp32 re-solve,
+        and every recovery_rung / residual_gate_failure event in the
+        flight recorder is preceded (by seq) by a numerics_spike."""
+        from tpu_jordan.io import write_matrix_file
+        from tpu_jordan.resilience import ResiliencePolicy
+
+        n = 16
+        path = str(tmp_path / "ill.mat")
+        write_matrix_file(path, obs_numerics.ill_conditioned(n))
+        mark = RECORDER.total
+        pol = ResiliencePolicy(gate_dtype="float32")
+        r = solve(n, 8, file=path, dtype=jnp.bfloat16, policy=pol,
+                  numerics="trace")
+        assert [x["rung"] for x in r.recovery] == ["refine", "resolve"]
+        events = RECORDER.since(mark)
+        spike_seqs = [e["seq"] for e in events
+                      if e["kind"] == "numerics_spike"]
+        assert spike_seqs, "an ill-conditioned trace must spike"
+        rungs = [e for e in events
+                 if e["kind"] in ("recovery_rung",
+                                  "residual_gate_failure")]
+        assert len(rungs) == 3      # gate failure + 2 rungs
+        for e in rungs:
+            assert any(s < e["seq"] for s in spike_seqs), \
+                f"{e['kind']} seq {e['seq']} has no preceding spike"
+        # The report carries the spike ledger too.
+        assert any(s["signal"] == "residual"
+                   for s in r.numerics.spikes)
+
+    def test_policy_gate_threshold_bounds_spike_threshold(self):
+        """With a policy attached the residual spike threshold IS the
+        gate threshold — a gate failure can never outrun its spike."""
+        from tpu_jordan.resilience import ResiliencePolicy
+        from tpu_jordan.resilience.degrade import gate_threshold
+
+        pol = ResiliencePolicy(gate_dtype="float32")
+        rep = obs_numerics.summary_report(
+            n=16, block_size=8, engine="inplace", rel_residual=0.4,
+            kappa=1e4, norm_a=3.0, dtype=jnp.float32)
+        thr = obs_numerics.SpikeThresholds(
+            residual=gate_threshold(pol, 16, 1e4, jnp.float32))
+        spikes = obs_numerics.record_spikes(
+            rep, thr, recorder=lambda *a, **k: None)
+        # rel 0.4 > gate 16*eps*16*1e4 ~ 3e-2 -> must spike.
+        assert [s["signal"] for s in spikes] == ["residual"]
+
+
+@pytest.fixture(scope="module")
+def demo_report():
+    """ONE cached demo run for every checker test (the test_fleet
+    cached-report discipline — no extra solves per assertion)."""
+    return obs_numerics.numerics_demo(n=16, block_size=8, seed=7)
+
+
+class TestDemoAndChecker:
+    def test_demo_report_passes_checker(self, demo_report):
+        errs, unexplained = check_numerics.check(demo_report)
+        assert errs == [] and unexplained == []
+        assert demo_report["silent_rung"] is False
+        assert demo_report["rung_count"] == 2
+
+    def test_checker_rejects_stripped_spikes(self, demo_report):
+        """Both-ways: delete the spike events and the causal chain
+        breaks — the exit-2 class."""
+        import copy
+
+        doctored = copy.deepcopy(demo_report)
+        doctored["blackbox"]["events"] = [
+            e for e in doctored["blackbox"]["events"]
+            if e["kind"] != "numerics_spike"]
+        doctored["spike_count"] = 0
+        errs, unexplained = check_numerics.check(doctored)
+        assert unexplained, "stripped spikes must be unexplained rungs"
+
+    def test_checker_rejects_modeled_masquerade(self, demo_report):
+        """A report whose modeled-field ledger drifts (a modeled number
+        posing as measured, or vice versa) fails structurally."""
+        import copy
+
+        doctored = copy.deepcopy(demo_report)
+        doctored["numerics"]["modeled_fields"] = []
+        errs, _ = check_numerics.check(doctored)
+        assert any("modeled" in e for e in errs)
+
+    def test_checker_cli_exit_taxonomy(self, demo_report, tmp_path):
+        import copy
+        import json
+
+        good = tmp_path / "good.json"
+        good.write_text(json.dumps(demo_report))
+        assert check_numerics.main([str(good)]) == 0
+        doctored = copy.deepcopy(demo_report)
+        doctored["blackbox"]["events"] = [
+            e for e in doctored["blackbox"]["events"]
+            if e["kind"] != "numerics_spike"]
+        doctored["spike_count"] = 0
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(doctored))
+        assert check_numerics.main([str(bad)]) == 2
+        garbage = tmp_path / "garbage.json"
+        garbage.write_text("not json")
+        assert check_numerics.main([str(garbage)]) == 1
+
+
+class TestCliFlagContract:
+    """Review findings: --numerics-demo excludes the other demo modes,
+    and --numerics is never silently ignored — demo modes that cannot
+    honor it refuse typed (exit 1), the serve demo threads it."""
+
+    def test_numerics_demo_excludes_fleet_demo(self):
+        from tpu_jordan.__main__ import main
+
+        assert main(["16", "8", "--numerics-demo", "--fleet-demo",
+                     "--quiet"]) == 1
+
+    def test_chaos_demo_refuses_numerics(self):
+        from tpu_jordan.__main__ import main
+
+        assert main(["96", "32", "--chaos-demo", "--numerics",
+                     "summary", "--quiet"]) == 1
+
+    def test_fleet_demo_refuses_numerics(self):
+        from tpu_jordan.__main__ import main
+
+        assert main(["96", "32", "--fleet-demo", "--numerics",
+                     "summary", "--quiet"]) == 1
+
+    def test_serve_demo_refuses_trace(self):
+        """serve_demo threads --numerics into JordanService, whose
+        trace refusal is typed — never a silently-off observatory."""
+        from tpu_jordan.__main__ import main
+
+        assert main(["96", "32", "--serve-demo", "--numerics",
+                     "trace", "--quiet"]) == 1
+
+
+class TestServeNumerics:
+    def test_off_is_the_serve_default(self):
+        """The serve-path default is off (the acceptance wording): the
+        warm-path pins in test_obs/test_serve all run through this
+        default, so the observatory costs the hot path nothing."""
+        from tpu_jordan.serve import JordanService
+
+        svc = JordanService(autostart=False)
+        try:
+            assert svc.numerics == "off"
+            assert svc._batcher.numerics == "off"
+        finally:
+            svc.close()
+
+    def test_trace_is_a_typed_refusal(self):
+        from tpu_jordan.serve import JordanService
+
+        with pytest.raises(UsageError, match="trace"):
+            JordanService(numerics="trace", autostart=False)
+
+    def test_summary_observes_rider_residuals(self):
+        from tpu_jordan.serve import JordanService
+
+        before = _hist_count("tpu_jordan_residual", engine="inplace")
+        with JordanService(engine="inplace", batch_cap=2,
+                           numerics="summary") as svc:
+            rng = np.random.default_rng(3)
+            a = rng.standard_normal((24, 24)).astype(np.float32)
+            a += 24 * np.eye(24, dtype=np.float32)
+            res = svc.invert(a)
+        assert not res.singular
+        assert _hist_count("tpu_jordan_residual",
+                           engine="inplace") == before + 1

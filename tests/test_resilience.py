@@ -342,14 +342,10 @@ class TestDriverPolicy:
         np.testing.assert_allclose(np.asarray(inv), np.eye(32) / 2.0)
 
 
-def _ill_conditioned(n: int, kappa_decades: float = 4.5,
-                     seed: int = 7) -> np.ndarray:
-    """A deliberately ill-conditioned (κ∞ ~ 10^decades) but well-scaled
-    dense matrix: rotated graded diagonal."""
-    rng = np.random.default_rng(seed)
-    q1, _ = np.linalg.qr(rng.standard_normal((n, n)))
-    q2, _ = np.linalg.qr(rng.standard_normal((n, n)))
-    return (q1 * np.logspace(0, -kappa_decades, n)) @ q2
+# The deliberately ill-conditioned rotated-graded-diagonal fixture was
+# promoted to obs/numerics.py (ISSUE 10) so the ladder-acceptance tests
+# and the numerics demo exercise ONE recipe that can never drift.
+from tpu_jordan.obs.numerics import ill_conditioned as _ill_conditioned  # noqa: E402,E501
 
 
 class TestDegradationLadderAcceptance:

@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Variance-aware BENCH trajectory regression sentinel (ISSUE 10
+tentpole part 3).
+
+Usage: ``python tools/check_bench.py BENCH_r01.json BENCH_r02.json ...``
+(trajectory order = argument order; a shell glob sorts round files
+correctly).  No jax import — this is the ``make bench-check`` gate and
+runs anywhere.
+
+The r04→r05 4096² dip burned a whole diagnosis round because nothing
+watched the BENCH_r*.json trajectory — and the dip turned out to be
+single-sample session-lottery noise (BASELINE.md).  This sentinel
+generalizes the PR 6 dip guard from one hardcoded row to every
+steady-state row of the trajectory, with the same variance discipline:
+
+  * **steady-state only, never first-call** — compared rows are the
+    ``*_gflops`` keys (slope-derived per-call rates on the cached
+    executable) and the headline ``value``; ``first_call_compile_
+    inclusive_s`` keys are never compared (a compile-time change is
+    not an execution regression — the exact conflation PR 4 separated
+    the rows to prevent);
+  * **flag only what the rows' own spread cannot explain** — a
+    shortfall beyond ``--tolerance-pct`` (default 10) against the best
+    prior round is a regression ONLY when the latest row carries
+    robust-capture stats (``spread_pct`` / ``variance_flag``) showing
+    a quiet session (< ``--high-variance-pct``, default 10) on BOTH
+    ends of the comparison.  A noisy session explains its own dip; a
+    row WITHOUT spread stats (every pre-ISSUE-4 round — the diagnosed
+    r04→r05 class) is UNKNOWN, not regressed: a single-sample capture
+    cannot distinguish noise from regression, which is precisely why
+    it must not page (backfill tolerance, ISSUE 10 satellite);
+  * **rows compare like-for-like by key** — a config change renames
+    its key (``m256`` vs ``m384``), so tuning migrations never diff
+    against each other.
+
+Environment fingerprints (``extra.env``: jax/jaxlib versions, device
+kind, host cores — recorded by bench.py since ISSUE 10) are printed as
+context; missing in old rounds = unknown, never a gate.
+
+Exit taxonomy (the check_fleet/check_slo convention): 0 = trajectory
+healthy (or nothing comparable), 1 = unreadable/unjudgeable input,
+2 = an unexplained steady-state regression.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+
+TOLERANCE_PCT = 10.0        # shortfall below this is never flagged
+HIGH_VARIANCE_PCT = 10.0    # spread at/above this explains any dip
+
+_N_RE = re.compile(r"(\d{3,})")
+
+
+def load_round(path: str) -> dict | None:
+    """One BENCH_r*.json -> its bench row {"metric", "value", "extra"}
+    or None when the round carries no parseable row (recorded rc != 0
+    runs keep their file but have nothing to compare)."""
+    with open(path) as f:
+        doc = json.load(f)
+    row = doc.get("parsed")
+    if isinstance(row, dict) and "metric" in row and "value" in row:
+        return row
+    # Fallback: the last JSON line of the captured tail (the bench
+    # prints exactly one).
+    for line in reversed(doc.get("tail", "").splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(row, dict) and "metric" in row:
+                return row
+    return None
+
+
+def comparable_keys(row: dict) -> dict[str, float]:
+    """The steady-state rate keys of one round: the headline ``value``
+    (under its metric name) plus every numeric ``*_gflops`` extra.
+    First-call keys never appear here by construction, and neither do
+    the ``*_xla_gflops`` accounting rows: their numerator is the
+    COMPILER's flop count, so a jaxlib upgrade that fuses better
+    recounts the same execution — a compiler-accounting change must
+    not page as an execution regression (the same separation principle
+    that keeps first-call times out)."""
+    out = {}
+    if isinstance(row.get("value"), (int, float)):
+        out[str(row.get("metric", "value"))] = float(row["value"])
+    for k, v in (row.get("extra") or {}).items():
+        if (k.endswith("_gflops") and not k.endswith("_xla_gflops")
+                and isinstance(v, (int, float))):
+            out[k] = float(v)
+    return out
+
+
+def _base_tokens(key: str) -> set[str]:
+    """Digit-stripped ``_``-tokens: ``grouped2`` and ``grouped`` count
+    as the SAME configuration token, so a grouped row's fuzzy lookup
+    can never bind to the plain row's stats just because their numeric
+    suffixes differ."""
+    out = set()
+    for tok in key.split("_"):
+        base = tok.rstrip("0123456789")
+        if base:
+            out.add(base)
+    return out
+
+
+def _variance_context(key: str, row: dict) -> tuple[float | None, bool]:
+    """(spread_pct, variance_flag) for one rate key, best effort:
+
+      1. exact stem (``<key-minus-_gflops>_spread_pct``);
+      2. the historical SUFFIX style (``spread_pct_<n>`` /
+         ``variance_flag_<n>`` — how the 16384 scale row used to
+         record its stats);
+      3. the closest sibling among ``*_spread_pct`` keys carrying the
+         same problem size, scored by shared digit-stripped tokens
+         first (``grouped2`` matches ``grouped``, never the plain
+         sibling), then longest common prefix.
+
+    None = the round recorded no robust-capture stats for this row
+    (pre-ISSUE-4 rounds) — unknown, not quiet."""
+    extra = row.get("extra") or {}
+    if key.endswith("_gflops"):
+        stem = key[:-len("_gflops")]
+        if f"{stem}_spread_pct" in extra:
+            return (float(extra[f"{stem}_spread_pct"]),
+                    bool(extra.get(f"{stem}_variance_flag")))
+    m = _N_RE.search(key)
+    n_tok = m.group(1) if m else None
+    if n_tok is not None and f"spread_pct_{n_tok}" in extra:
+        return (float(extra[f"spread_pct_{n_tok}"]),
+                bool(extra.get(f"variance_flag_{n_tok}")))
+    key_toks = _base_tokens(key)
+    best = None
+    for k2 in extra:
+        if not k2.endswith("_spread_pct"):
+            continue
+        if n_tok is not None and n_tok not in k2:
+            continue
+        lcp = 0
+        for a, b in zip(key, k2):
+            if a != b:
+                break
+            lcp += 1
+        toks = len(key_toks
+                   & (_base_tokens(k2) - {"spread", "pct"}))
+        score = (toks, lcp, -len(k2))
+        if best is None or score > best[0]:
+            best = (score, k2)
+    if best is None:
+        return None, False
+    stem = best[1][:-len("_spread_pct")]
+    return (float(extra[best[1]]),
+            bool(extra.get(f"{stem}_variance_flag")))
+
+
+def check_trajectory(rounds: list[tuple[str, dict]],
+                     tolerance_pct: float = TOLERANCE_PCT,
+                     high_variance_pct: float = HIGH_VARIANCE_PCT
+                     ) -> tuple[list[str], list[str], list[str]]:
+    """Compare the LAST round against the best prior value per key.
+    Returns ``(regressions, warnings, notes)`` — regressions are the
+    exit-2 class."""
+    regressions, warnings, notes = [], [], []
+    if len(rounds) < 2:
+        notes.append(f"{len(rounds)} usable round(s) — nothing to "
+                     f"compare yet")
+        return regressions, warnings, notes
+    latest_name, latest = rounds[-1]
+    latest_keys = comparable_keys(latest)
+    for key, val in sorted(latest_keys.items()):
+        prior = [(name, comparable_keys(row)[key], row)
+                 for name, row in rounds[:-1]
+                 if key in comparable_keys(row)]
+        if not prior:
+            notes.append(f"{key}: new row in {latest_name} (no prior "
+                         f"round to compare)")
+            continue
+        ref_name, ref, ref_row = max(prior, key=lambda p: p[1])
+        if ref <= 0:
+            continue
+        shortfall = 100.0 * (1.0 - val / ref)
+        ctx = (f"{key}: {val:.1f} vs best {ref:.1f} ({ref_name}), "
+               f"{shortfall:+.1f}% shortfall")
+        if shortfall <= tolerance_pct:
+            continue
+        spread, vflag = _variance_context(key, latest)
+        ref_spread, ref_vflag = _variance_context(key, ref_row)
+        if spread is None:
+            warnings.append(
+                f"{ctx} — UNKNOWN: the {latest_name} row carries no "
+                f"spread stats (single-sample capture?), cannot "
+                f"distinguish noise from regression")
+        elif vflag or spread >= high_variance_pct:
+            warnings.append(
+                f"{ctx} — explained by the session's own variance "
+                f"(spread {spread:.1f}%"
+                f"{', variance_flag' if vflag else ''})")
+        elif ref_spread is not None and (ref_vflag
+                                         or ref_spread >= high_variance_pct):
+            warnings.append(
+                f"{ctx} — the {ref_name} high-water mark itself was "
+                f"noisy (spread {ref_spread:.1f}%"
+                f"{', variance_flag' if ref_vflag else ''})")
+        else:
+            regressions.append(
+                f"{ctx} — spread {spread:.1f}% cannot explain it: "
+                f"unexplained steady-state regression")
+    env = (latest.get("extra") or {}).get("env")
+    if isinstance(env, dict):
+        notes.append(f"{latest_name} env: jax {env.get('jax')} / "
+                     f"jaxlib {env.get('jaxlib')}, "
+                     f"{env.get('device_kind')} x"
+                     f"{env.get('device_count')}, "
+                     f"{env.get('host_cpu_count')} host cores")
+    else:
+        notes.append(f"{latest_name} env: unknown (pre-ISSUE-10 row)")
+    return regressions, warnings, notes
+
+
+def main(argv) -> int:
+    args = [a for a in argv if not a.startswith("--")]
+    tol, hivar = TOLERANCE_PCT, HIGH_VARIANCE_PCT
+    for a in argv:
+        if a.startswith("--tolerance-pct="):
+            tol = float(a.split("=", 1)[1])
+        elif a.startswith("--high-variance-pct="):
+            hivar = float(a.split("=", 1)[1])
+        elif a.startswith("--"):
+            print(f"unknown flag {a}", file=sys.stderr)
+            return 1
+    if not args:
+        print("usage: check_bench.py BENCH_r01.json BENCH_r02.json ...",
+              file=sys.stderr)
+        return 1
+    rounds = []
+    for path in args:
+        try:
+            row = load_round(path)
+        except (OSError, ValueError) as e:
+            print(f"FAIL {path}: unreadable round ({e})", file=sys.stderr)
+            return 1
+        if row is None:
+            print(f"note: {path} carries no bench row (failed run?) — "
+                  f"skipped", file=sys.stderr)
+            continue
+        rounds.append((path, row))
+    if not rounds:
+        print("FAIL: no usable rounds", file=sys.stderr)
+        return 1
+    if args and rounds and rounds[-1][0] != args[-1]:
+        print(f"FAIL: the latest round {args[-1]} is unjudgeable",
+              file=sys.stderr)
+        return 1
+    regressions, warnings, notes = check_trajectory(rounds, tol, hivar)
+    for msg in notes:
+        print(f"note: {msg}")
+    for msg in warnings:
+        print(f"warn: {msg}")
+    for msg in regressions:
+        print(f"REGRESSION: {msg}", file=sys.stderr)
+    if regressions:
+        return 2
+    n_keys = len(comparable_keys(rounds[-1][1]))
+    print(f"OK: {len(rounds)} rounds, {n_keys} steady-state rows in "
+          f"{rounds[-1][0]}, {len(warnings)} variance-explained/unknown "
+          f"dips, 0 unexplained regressions")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,160 @@
+#!/usr/bin/env python
+"""Validate a ``--numerics-demo`` report (ISSUE 10 satellite).
+
+Usage: ``python tools/check_numerics.py report.json [...]`` (or ``-``
+for stdin).  No jax import — this is the ``make numerics-demo`` gate
+and runs anywhere.
+
+What a valid numerics report must prove (docs/OBSERVABILITY.md):
+
+  * the trace is real — ``numerics.mode == "trace"`` with one record
+    per superstep (pivot ids in range, finite criterion values on a
+    nonsingular solve), and the MODELED field list names exactly the
+    fields that come from an error model (``residual_est``) — nothing
+    measured-labeled is modeled;
+  * the ladder actually fired — at least one recovery rung ran and the
+    last one passed (the demo's ill-conditioned fixture is chosen to
+    walk refine → fp32 re-solve);
+  * **causality** — every ``recovery_rung`` and every
+    ``residual_gate_failure`` event in the embedded black-box slice is
+    preceded (by ``seq``) by a ``numerics_spike`` event: the rung is
+    explained by the numerics evidence recorded before it.  A rung
+    with no preceding spike is the exit-2 class — an unexplained
+    ladder, exactly the blind spot ISSUE 10 exists to close;
+  * the report's own ledger agrees — ``rung_count`` matches the rung
+    events, ``spike_count`` the spike events, ``silent_rung`` is
+    false, and the ring slice is gap-free (``dropped == 0``).
+
+Exit taxonomy (the check_fleet/check_slo convention): 0 = valid,
+1 = unreadable/structurally invalid, 2 = an unexplained rung.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+
+
+def check(report: dict) -> tuple[list[str], list[str]]:
+    """Returns ``(errs, unexplained)``: structural violations and the
+    exit-2 causality violations, both empty for a valid report."""
+    errs: list[str] = []
+    if report.get("metric") != "numerics_demo":
+        return ([f"not a numerics_demo report "
+                 f"(metric={report.get('metric')!r})"], [])
+
+    num = report.get("numerics")
+    if not isinstance(num, dict):
+        errs.append("no numerics record in the report")
+        num = {}
+    if num.get("mode") != "trace":
+        errs.append(f"numerics mode is {num.get('mode')!r}, not 'trace'")
+    n = report.get("n", 0)
+    bs = num.get("block_size") or report.get("block_size", 1)
+    nr = -(-n // max(1, min(bs, n))) if n else 0
+    pivots = num.get("pivot_block") or []
+    if len(pivots) != nr:
+        errs.append(f"{len(pivots)} superstep records for Nr={nr}")
+    for t, p in enumerate(pivots):
+        if not (t <= p < nr):
+            errs.append(f"step {t}: pivot block {p} outside the live "
+                        f"window [{t}, {nr})")
+    for fname in ("pivot_inv_norm", "cand_norm_max", "growth",
+                  "residual_est"):
+        vals = num.get(fname) or []
+        if len(vals) != nr:
+            errs.append(f"{fname}: {len(vals)} values for Nr={nr}")
+        if fname != "residual_est":
+            bad = [v for v in vals
+                   if not isinstance(v, (int, float))
+                   or not math.isfinite(v)]
+            if bad:
+                errs.append(f"{fname}: non-finite values {bad[:3]} on a "
+                            f"nonsingular solve")
+    modeled = set(num.get("modeled_fields") or [])
+    if modeled != {"residual_est"}:
+        errs.append(f"modeled_fields {sorted(modeled)} != "
+                    f"['residual_est'] — a modeled number may be "
+                    f"masquerading as measured (or vice versa)")
+
+    recovery = report.get("recovery") or []
+    if not recovery:
+        errs.append("no recovery rungs — the demo's ladder never fired "
+                    "(the run was vacuous)")
+    elif not recovery[-1].get("passed"):
+        errs.append("the ladder exhausted without passing — the demo "
+                    "fixture should recover through the fp32 re-solve")
+
+    # ---- the causal chain (the exit-2 class) ------------------------
+    bb = report.get("blackbox") or {}
+    events = bb.get("events") or []
+    if bb.get("dropped", 1) != 0:
+        errs.append(f"black-box slice dropped {bb.get('dropped')} "
+                    f"events — the causal chain may have gaps")
+    spike_seqs = [e["seq"] for e in events
+                  if e.get("kind") == "numerics_spike"]
+    rung_events = [e for e in events
+                   if e.get("kind") in ("recovery_rung",
+                                        "residual_gate_failure")]
+    unexplained = [
+        f"{e['kind']} at seq {e['seq']} has no preceding "
+        f"numerics_spike — an unexplained ladder"
+        for e in rung_events
+        if not any(s < e["seq"] for s in spike_seqs)]
+    if report.get("silent_rung", True) and not unexplained:
+        errs.append("silent_rung flagged by the demo itself but every "
+                    "rung event has a preceding spike — the report "
+                    "disagrees with its own black box")
+    if not spike_seqs:
+        errs.append("no numerics_spike events — an ill-conditioned "
+                    "traced solve that spiked nothing")
+    nrungs = sum(1 for e in events if e.get("kind") == "recovery_rung")
+    if nrungs != len(recovery):
+        errs.append(f"{nrungs} recovery_rung events vs "
+                    f"{len(recovery)} recovery records")
+    if report.get("spike_count") != len(spike_seqs):
+        errs.append(f"spike_count {report.get('spike_count')} != "
+                    f"{len(spike_seqs)} spike events")
+    return errs, unexplained
+
+
+def main(argv) -> int:
+    if not argv:
+        print("usage: check_numerics.py report.json [...]",
+              file=sys.stderr)
+        return 1
+    rc = 0
+    for path in argv:
+        try:
+            if path == "-":
+                report = json.load(sys.stdin)
+            else:
+                with open(path) as f:
+                    report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"FAIL {path}: unreadable report ({e})", file=sys.stderr)
+            rc = max(rc, 1)
+            continue
+        errs, unexplained = check(report)
+        for e in unexplained:
+            print(f"UNEXPLAINED-RUNG {path}: {e}", file=sys.stderr)
+        for e in errs:
+            print(f"FAIL {path}: {e}", file=sys.stderr)
+        if unexplained:
+            rc = 2
+        elif errs:
+            rc = max(rc, 1)
+        else:
+            num = report["numerics"]
+            print(f"OK {path}: {len(num['pivot_block'])} supersteps "
+                  f"traced (growth {num['growth_factor']:.1f}x, max "
+                  f"pivot criterion {num['max_pivot_inv_norm']:.3g}), "
+                  f"{report['spike_count']} spikes -> "
+                  f"{report['rung_count']} rungs, every rung "
+                  f"causally explained")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -10,7 +10,9 @@ exposition format.  Checks (all must pass; exit 1 with a message
 otherwise):
 
   * Prometheus: every sample line parses as ``name[{labels}] value``,
-    every metric family has a ``# TYPE`` line with a known type, every
+    every metric family has a ``# TYPE`` line with a known type AND a
+    non-empty ``# HELP`` line (both ways — a HELP for a family that
+    exports no TYPE is a stale/typoed name; ISSUE 10 satellite), every
     family name lives in the ``tpu_jordan_`` namespace
     (``obs.metrics.NAME_RE``), and at least one sample exists.
   * Chrome trace: the document loads as JSON with a ``traceEvents``
@@ -53,6 +55,7 @@ _PALLAS_ENGINE_PREFIX = "grouped_pallas"
 def check_prometheus(text: str, path: str) -> int:
     """Returns the sample count; raises AssertionError on any violation."""
     typed: set[str] = set()
+    helped: set[str] = set()
     samples = 0
     for i, line in enumerate(text.splitlines(), 1):
         if not line.strip():
@@ -62,6 +65,12 @@ def check_prometheus(text: str, path: str) -> int:
             assert len(parts) == 4 and parts[3] in _TYPES, \
                 f"{path}:{i}: malformed TYPE line: {line!r}"
             typed.add(parts[2])
+            continue
+        if line.startswith("# HELP "):
+            parts = line.split(None, 3)
+            assert len(parts) >= 4 and parts[3].strip(), \
+                f"{path}:{i}: HELP line without text: {line!r}"
+            helped.add(parts[2])
             continue
         if line.startswith("#"):
             continue
@@ -81,6 +90,17 @@ def check_prometheus(text: str, path: str) -> int:
         float(m.group(3).replace("Inf", "inf").replace("NaN", "nan"))
         samples += 1
     assert samples > 0, f"{path}: no samples — empty scrape"
+    # HELP next to TYPE, both ways (ISSUE 10 satellite): a family that
+    # is typed but undocumented fails, as does a HELP line for a family
+    # that exports no TYPE (a stale or typoed family name).
+    unhelped = typed - helped
+    assert not unhelped, (
+        f"{path}: metric families with # TYPE but no # HELP line: "
+        f"{sorted(unhelped)}")
+    orphaned = helped - typed
+    assert not orphaned, (
+        f"{path}: # HELP lines for families with no # TYPE: "
+        f"{sorted(orphaned)}")
     return samples
 
 

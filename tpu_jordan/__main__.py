@@ -167,6 +167,34 @@ def _main(argv, state) -> int:
                          "capability; generator input only, single "
                          "device; B distinct matrices via per-element "
                          "index offsets)")
+    ap.add_argument("--numerics", default="off",
+                    choices=["off", "summary", "trace"],
+                    help="per-solve numerical health record (ISSUE 10, "
+                         "docs/OBSERVABILITY.md): 'summary' reports "
+                         "rel_residual/kappa from what the solve "
+                         "already returns; 'trace' adds the full "
+                         "per-superstep record (chosen pivot block, "
+                         "its inverse inf-norm — the paper's selection "
+                         "criterion — candidate spread, element-growth "
+                         "watermark) from the instrumented unrolled "
+                         "engines (single-device).  Both mirror into "
+                         "the tpu_jordan_pivot_condition/"
+                         "growth_factor/residual histograms and spike "
+                         "the flight recorder before any recovery "
+                         "rung; 'off' (default) costs nothing")
+    ap.add_argument("--numerics-demo", action="store_true",
+                    help="run the numerics-observatory acceptance demo "
+                         "(obs/numerics.numerics_demo): one seeded "
+                         "ill-conditioned bf16 solve, traced — the "
+                         "residual gate fails, refine diverges, the "
+                         "fp32 re-solve recovers — and print ONE JSON "
+                         "line proving every degradation rung was "
+                         "causally preceded by a numerics_spike event "
+                         "in the flight recorder (exit 2 on an "
+                         "unexplained rung; tools/check_numerics.py "
+                         "validates the report).  n is the fixture "
+                         "size, m the block size; --chaos-seed seeds "
+                         "the fixture")
     ap.add_argument("--serve-demo", action="store_true",
                     help="run the dynamic-batching inversion service "
                          "demo (tpu_jordan.serve.JordanService): mixed "
@@ -342,10 +370,16 @@ def _main(argv, state) -> int:
             # taxonomy — exit 2 IS the silent-loss alarm (a response
             # that neither bit-matched the fault-free replay nor
             # carried a typed error, or a request the ledger lost).
-            if args.serve_demo or args.chaos_demo:
-                raise UsageError("--fleet-demo, --chaos-demo and "
-                                 "--serve-demo are distinct modes; "
-                                 "pick one")
+            if args.serve_demo or args.chaos_demo or args.numerics_demo:
+                raise UsageError("--fleet-demo, --chaos-demo, "
+                                 "--serve-demo and --numerics-demo are "
+                                 "distinct modes; pick one")
+            if args.numerics != "off":
+                raise UsageError("--fleet-demo's replay-compare "
+                                 "semantics are pinned; --numerics "
+                                 "does not apply (use --serve-demo "
+                                 "--numerics summary, or solve with "
+                                 "--numerics)")
             if args.file is not None or args.workers != 1 or not args.gather:
                 raise UsageError(
                     "--fleet-demo runs on a single device (gathered "
@@ -385,6 +419,35 @@ def _main(argv, state) -> int:
             raise UsageError("--slo-report is a --fleet-demo leg "
                              "(the burn-rate monitor evaluates the "
                              "fleet's request-outcome series)")
+        if args.numerics_demo:
+            # Numerics demo (ISSUE 10): the same 0/1/2 taxonomy as the
+            # chaos/fleet demos — exit 2 IS the unexplained-rung alarm
+            # (a recovery rung with no causally preceding
+            # numerics_spike event in the flight recorder).
+            if args.serve_demo or args.chaos_demo:
+                raise UsageError("--numerics-demo, --chaos-demo and "
+                                 "--serve-demo are distinct modes; "
+                                 "pick one")
+            if args.file is not None or args.workers != 1 or not args.gather:
+                raise UsageError(
+                    "--numerics-demo runs on a single device (gathered "
+                    "output, seeded built-in ill-conditioned fixture)")
+            if args.batch > 1 or args.tune or args.group != 0:
+                raise UsageError("--numerics-demo takes no "
+                                 "--batch/--tune/--group")
+            import json as _json
+
+            from .obs.numerics import numerics_demo
+
+            report = numerics_demo(n=args.n, block_size=args.m,
+                                   seed=args.chaos_seed)
+            print(_json.dumps(report))
+            if report["silent_rung"]:
+                print(f"unexplained degradation rung(s): "
+                      f"{report['unexplained_rungs']} — no causally "
+                      f"preceding numerics_spike", file=sys.stderr)
+                return 2
+            return 0
         if args.chaos_demo:
             # Chaos demo: same restrictions as --serve-demo (single
             # device, generator-free deterministic fixtures, gathered),
@@ -401,6 +464,12 @@ def _main(argv, state) -> int:
                     "output, deterministic built-in fixtures)")
             if args.batch > 1 or args.tune:
                 raise UsageError("--chaos-demo takes no --batch/--tune")
+            if args.numerics != "off":
+                raise UsageError("--chaos-demo's replay-compare "
+                                 "semantics are pinned; --numerics "
+                                 "does not apply (use --serve-demo "
+                                 "--numerics summary, or solve with "
+                                 "--numerics)")
             if args.group != 0 or args.engine == "swapfree":
                 raise UsageError("--chaos-demo engines are single-device "
                                  "(auto resolution); --group does not "
@@ -453,7 +522,7 @@ def _main(argv, state) -> int:
                 max_wait_ms=args.max_wait_ms, engine=args.engine,
                 plan_cache=args.plan_cache,
                 dtype=jnp.dtype(args.dtype), generator=args.generator,
-                telemetry=telemetry)
+                telemetry=telemetry, numerics=args.numerics)
             if args.quiet:
                 report.pop("stats", None)
             print(_json.dumps(report))
@@ -481,6 +550,11 @@ def _main(argv, state) -> int:
             if args.tune or args.plan_cache:
                 raise UsageError("--batch uses the batched engine; "
                                  "--tune/--plan-cache do not apply")
+            if args.numerics != "off":
+                raise UsageError("--numerics applies to single solves "
+                                 "(the batched engine is one fused "
+                                 "vmapped executable — no per-superstep "
+                                 "host visibility)")
             result = solve_batch(
                 n=args.n,
                 block_size=args.m,
@@ -509,6 +583,7 @@ def _main(argv, state) -> int:
                 tune=args.tune,
                 plan_cache=args.plan_cache,
                 telemetry=telemetry,
+                numerics=args.numerics,
             )
     except FileNotFoundError:
         print(f"cannot open {args.file}")

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from .config import default_block_size
 from .io import read_matrix_file
+from .obs import hwcost as _hwcost
 from .obs import metrics as _obs_metrics
 from .obs.spans import NULL as _NULL_TEL
 from .obs.spans import attribute_phases, timed_blocking
@@ -78,6 +79,12 @@ class SolveResult:
     #   pass verdict — empty on the fault-free gate-passing path.  When
     #   non-empty, `inverse` (and residual/kappa) are the RECOVERED
     #   numbers, possibly at a higher precision than requested.
+    numerics: object | None = None  # obs.numerics.NumericsReport when
+    #   the caller passed numerics="summary"/"trace" (ISSUE 10): the
+    #   per-superstep health record — pivot criterion values, growth
+    #   watermark, verified residual — mirrored into the metrics
+    #   registry and spiking into the flight recorder BEFORE any
+    #   recovery rung.  None at the "off" default (zero cost).
 
     @property
     def rel_residual(self) -> float | None:
@@ -162,6 +169,47 @@ def _solve_metrics(n: int, elapsed: float, exec_span,
                              ).inc(component="solve")
 
 
+def _trace_engine_for(engine: str) -> str:
+    """Which instrumented twin a ``numerics="trace"`` solve runs: the
+    fp32 fused-kernel engine traces through its BIT-MATCHING XLA
+    grouped twin (the ISSUE 6 pin — identical pivot choices, identical
+    result bits, so the trace is the truth about the Pallas solve
+    too); every other engine traces itself."""
+    return "grouped" if engine == "grouped_pallas" else engine
+
+
+def _numerics_report(numerics: str, *, n, block_size, engine, residual,
+                     norm_a, kappa, dtype, policy, nstats=None):
+    """Build + observe + spike one solve's numerics record (ISSUE 10).
+
+    MUST run before the recovery ladder: the spike events this records
+    are the causal explanation a later ``recovery_rung`` flight-
+    recorder event points back to (tools/check_numerics.py validates
+    the seq ordering).  When a policy is attached, the residual spike
+    threshold IS the policy's own gate threshold — a gate failure can
+    never outrun its spike."""
+    from .obs import numerics as _numerics
+
+    rel = residual / norm_a if norm_a else residual
+    kw = dict(n=n, block_size=block_size, engine=engine,
+              rel_residual=rel, kappa=kappa, norm_a=norm_a, dtype=dtype)
+    if numerics == "trace":
+        report = _numerics.trace_report(
+            nstats, trace_engine=_trace_engine_for(engine), **kw)
+    else:
+        report = _numerics.summary_report(**kw)
+    _numerics.observe(report)
+    thresholds = None
+    if policy is not None:
+        from .resilience.degrade import gate_threshold
+
+        gd = policy.gate_dtype if policy.gate_dtype is not None else dtype
+        thresholds = _numerics.SpikeThresholds(
+            residual=gate_threshold(policy, n, kappa, gd))
+    _numerics.record_spikes(report, thresholds)
+    return report
+
+
 def resolve_engine(engine: str, group: int):
     """Shared engine/group flag contract (solve, JordanSolver, CLI).
 
@@ -239,6 +287,7 @@ def solve(
     plan_cache: str | None = None,
     telemetry=None,
     policy=None,
+    numerics: str = "off",
 ) -> SolveResult:
     """Invert an n x n matrix from a file or a generator and verify it.
 
@@ -305,6 +354,19 @@ def solve(
     of returning a known-bad inverse.  Without a policy, behavior (and
     the warm-path cost) is unchanged.
 
+    ``numerics`` (ISSUE 10, docs/OBSERVABILITY.md): ``"off"`` (the
+    default — zero cost), ``"summary"`` (a ``NumericsReport`` on
+    ``SolveResult.numerics`` built only from numbers the solve already
+    returns), or ``"trace"`` (the full per-superstep health trace —
+    chosen pivot block, its inverse ∞-norm [the paper's selection
+    criterion], candidate-norm spread, element-growth watermark — from
+    the instrumented unrolled engines; single-device, host-visible
+    engines only).  Both non-off modes mirror into the
+    ``tpu_jordan_pivot_condition``/``_growth_factor``/``_residual``
+    histograms and record ``numerics_spike`` flight-recorder events on
+    threshold exceedances BEFORE the recovery ladder runs, so a rung
+    is always causally explained.
+
     Raises SingularMatrixError like the reference's -2 path
     (main.cpp:435-437); file errors propagate from read_matrix_file.
     """
@@ -313,7 +375,8 @@ def solve(
                   generator=(None if file else generator)) as root:
         res = _solve_impl(n, block_size, file, generator, dtype, refine,
                           workers, device, verbose, gather, precision,
-                          engine, group, tune, plan_cache, tel, policy)
+                          engine, group, tune, plan_cache, tel, policy,
+                          numerics)
     if telemetry is not None:
         res.trace = root
     return res
@@ -321,11 +384,16 @@ def solve(
 
 def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
                 device, verbose, gather, precision, engine, group, tune,
-                plan_cache, tel, policy=None) -> SolveResult:
+                plan_cache, tel, policy=None,
+                numerics: str = "off") -> SolveResult:
     if block_size is None:
         block_size = default_block_size(n)
     prec = _PRECISIONS[precision]
     engine, group = resolve_engine(engine, group)
+    if numerics != "off":
+        from .obs.numerics import resolve_mode
+
+        numerics = resolve_mode(numerics)
     distributed = isinstance(workers, tuple) or workers > 1
     if (tune or plan_cache is not None) and engine != "auto":
         raise UsageError("tune/plan_cache apply to engine='auto' only "
@@ -340,6 +408,11 @@ def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
         # autotuner so an invalid combination never pays for selection
         # (let alone a measured tuning run).
         check_gather_flags(gather, refine, precision, engine)
+    if numerics == "trace" and distributed:
+        raise UsageError(
+            "numerics='trace' instruments the single-device unrolled "
+            "engines (the per-superstep stats are host-visible there); "
+            "distributed solves support numerics='summary'")
     plan = None
     if engine == "auto":
         from .tuning.tuner import auto_select
@@ -381,6 +454,14 @@ def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
             policy=policy,
         )
         res.engine, res.group, res.plan = engine, group, plan
+        if numerics != "off":
+            # Distributed solves get the summary record (built only
+            # from what the core already verified — the honest mode for
+            # engines the host can't see inside).
+            res.numerics = _numerics_report(
+                "summary", n=n, block_size=res.block_size, engine=engine,
+                residual=res.residual, norm_a=res._norm_a,
+                kappa=res.kappa, dtype=dtype, policy=policy)
         return res
 
     if engine == "swapfree":
@@ -401,11 +482,13 @@ def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
     # reload semantics), and donation lets XLA alias A's HBM into the
     # working matrix — the difference between fitting and OOM at
     # n >= 16384 (4 GB per n=32768 fp32 buffer on a 16 GB chip).
+    collect = numerics == "trace"
     with tel.span("compile", engine=engine, n=n) as csp:
         def _compile():
             _faults.fire("compile")
             return jax.jit(
-                single_device_invert(n, block_size, engine, group),
+                single_device_invert(n, block_size, engine, group,
+                                     collect_stats=collect),
                 static_argnames=("block_size", "refine", "precision"),
                 donate_argnums=(0,),
             ).lower(
@@ -414,6 +497,10 @@ def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
         compiled = (policy.retry.call(_compile, component="solve.compile")
                     if policy is not None else _compile())
     _record_compile(csp, "solve")
+    # XLA's own accounting, read ONCE per compile (ISSUE 10 hwcost):
+    # flops/bytes/HBM footprint off the executable — zero per-execute
+    # cost, attached to the execute span below.
+    exe_cost = _hwcost.executable_cost(compiled)
 
     def _execute():
         _faults.fire("execute")
@@ -426,13 +513,19 @@ def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
         nonlocal a
         a = load()
 
-    (inv, singular), esp = (
+    out, esp = (
         policy.retry.call(_execute, component="solve.execute",
                           on_retry=_reload_donated)
         if policy is not None else _execute())
+    if collect:
+        inv, singular, nstats = out
+    else:
+        (inv, singular), nstats = out, None
     elapsed = esp.duration
     _attribute_solve_phases(tel, esp, engine, n, block_size, group)
     _solve_metrics(n, elapsed, esp, singular=bool(singular))
+    _hwcost.attach_execute_cost(esp, exe_cost,
+                                analytical_flops=2.0 * float(n) ** 3)
     if _faults.corrupt("result_corrupt_nan"):
         # Silent-corruption simulation: poison the computed inverse so
         # the residual (verified against a FRESH A below) goes NaN and
@@ -455,6 +548,17 @@ def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
         residual = float(residual_inf_norm(a_fresh, inv))
         norm_a = float(inf_norm(a_fresh))
         kappa = norm_a * float(inf_norm(inv))  # condition_inf, one pass each
+
+    # The numerics record is built, observed, and SPIKED before the
+    # recovery ladder below runs: a recovery_rung flight-recorder event
+    # must be causally preceded by the numerics evidence explaining it
+    # (ISSUE 10 acceptance; tools/check_numerics.py).
+    nreport = None
+    if numerics != "off":
+        nreport = _numerics_report(
+            numerics, n=n, block_size=block_size, engine=engine,
+            residual=residual, norm_a=norm_a, kappa=kappa, dtype=dtype,
+            policy=policy, nstats=nstats)
 
     recovery = ()
     if policy is not None:
@@ -507,6 +611,7 @@ def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
         group=group,
         plan=plan,
         recovery=recovery,
+        numerics=nreport,
     )
 
 
@@ -605,11 +710,14 @@ def solve_batch(
                 donate_argnums=(0,),
             ).lower(a).compile()
         _record_compile(csp, "solve")
+        exe_cost = _hwcost.executable_cost(compiled)
         (inv, singular), esp = timed_blocking(compiled, a, telemetry=tel,
                                               name="execute", batch=batch)
         elapsed = esp.duration
         nsing = int(jnp.sum(singular))
         _solve_metrics(n, elapsed, esp, singular=bool(nsing), batch=batch)
+        _hwcost.attach_execute_cost(
+            esp, exe_cost, analytical_flops=2.0 * float(n) ** 3 * batch)
         if nsing:
             raise SingularMatrixError(
                 f"singular matrix ({nsing}/{batch} elements flagged)")
@@ -691,7 +799,7 @@ def check_gather_flags(gather: bool, refine: int, precision: str = "highest",
 
 
 def single_device_invert(n: int, block_size: int, engine: str = "auto",
-                         group: int = 0):
+                         group: int = 0, collect_stats: bool = False):
     """The single-device inversion entry point for a given problem size
     and (resolved) engine choice.
 
@@ -701,7 +809,15 @@ def single_device_invert(n: int, block_size: int, engine: str = "auto",
     independent of Nr).  "grouped": the delayed-group-update engine
     (same dispatch by Nr; the measured large-n winner — see
     resolve_engine's docstring for the dispatch policy).  "augmented":
-    the ~4N³ reference-parity implementation (global_scale mode)."""
+    the ~4N³ reference-parity implementation (global_scale mode).
+
+    ``collect_stats=True`` (``numerics="trace"``, ISSUE 10) compiles
+    the INSTRUMENTED unrolled twin returning ``(x, singular, stats)``
+    with the per-superstep health arrays.  Host-visible engines only:
+    the augmented path, the fori engines (Nr > MAX_UNROLL_NR), and the
+    bf16 fused kernel (whose rounded dots the XLA twin cannot
+    reproduce) are typed ``UsageError``s — a trace must describe the
+    solve that actually ran, never a silently different one."""
 
     from .ops import block_jordan_invert_inplace
     from .ops.jordan_inplace import (
@@ -714,6 +830,47 @@ def single_device_invert(n: int, block_size: int, engine: str = "auto",
 
     Nr = -(-n // min(block_size, n))
     unroll = Nr <= MAX_UNROLL_NR
+    if collect_stats:
+        if engine == "augmented":
+            raise UsageError(
+                "numerics='trace' has no instrumented twin for the "
+                "augmented reference-parity engine; use "
+                "engine='inplace'/'grouped' or numerics='summary'")
+        if engine == "grouped_pallas_bf16":
+            raise UsageError(
+                "numerics='trace' cannot instrument the bf16 fused "
+                "kernel (its rounded dots have no bit-matching "
+                "host-visible twin); use numerics='summary', or trace "
+                "the fp32 sibling engine='grouped_pallas'")
+        if not unroll:
+            raise UsageError(
+                f"numerics='trace' instruments the unrolled engines "
+                f"only and Nr={Nr} exceeds MAX_UNROLL_NR="
+                f"{MAX_UNROLL_NR}; use a larger block_size or "
+                f"numerics='summary'")
+        if engine in PALLAS_ENGINES or group > 1 or engine == "grouped":
+            kg = group if group > 1 else 2
+
+            def fn_tr_g(a, block_size=None, refine=0,
+                        precision=_lax.Precision.HIGHEST):
+                # The fp32 fused-kernel engine traces through its
+                # bit-matching XLA grouped twin (ISSUE 6 pin): same
+                # pivot choices, same result bits.
+                return block_jordan_invert_inplace_grouped(
+                    a, block_size=block_size, refine=refine,
+                    precision=precision, group=kg, collect_stats=True)
+
+            return jax.jit(fn_tr_g, static_argnames=(
+                "block_size", "refine", "precision"))
+
+        def fn_tr(a, block_size=None, refine=0,
+                  precision=_lax.Precision.HIGHEST):
+            return block_jordan_invert_inplace(
+                a, block_size=block_size, refine=refine,
+                precision=precision, collect_stats=True)
+
+        return jax.jit(fn_tr, static_argnames=(
+            "block_size", "refine", "precision"))
     if engine in PALLAS_ENGINES:
         if not unroll:
             raise UsageError(
@@ -1039,6 +1196,10 @@ def _solve_distributed_core(
         run = (policy.retry.call(_compile, component="solve.compile")
                if policy is not None else _compile())
     _record_compile(csp, "solve")
+    # XLA accounting where the backend exposes it (ISSUE 10 hwcost);
+    # a backend compile wrapper without cost_analysis reports
+    # unavailable — never a modeled substitute.
+    exe_cost = _hwcost.executable_cost(run)
     # The execute fault point fires here too, but distributed execute is
     # NOT retried (the sharded working state may be donated into the
     # engine): a mid-flight failure propagates typed, never silently.
@@ -1047,6 +1208,8 @@ def _solve_distributed_core(
                                           name="execute", engine=engine)
     elapsed = esp.duration
     attribute_phases(esp, n, be.lay.m, distributed=True)
+    _hwcost.attach_execute_cost(esp, exe_cost,
+                                analytical_flops=2.0 * float(n) ** 3)
     singular_flag = bool(singular.any())
     _solve_metrics(n, elapsed, esp, singular=singular_flag)
     if singular_flag:

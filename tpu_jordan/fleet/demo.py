@@ -286,6 +286,24 @@ def fleet_demo(n: int = 96, replicas: int = 3, requests: int = 60,
         v = percentiles(xs)["p99"]
         return 0.0 if v is None else float(v)
 
+    # ---- hwcost block (ISSUE 10): the shared store's per-executable
+    # XLA accounting — what the fleet's compiled programs actually cost
+    # per launch — plus the runtime environment fingerprint and the
+    # device live-bytes watermark where the backend reports one.
+    from ..obs import hwcost as _hwcost
+
+    executables = {}
+    for key, ex in store.entries():
+        cost = getattr(ex, "cost", None)
+        if cost is not None and cost.available:
+            executables[f"{key.bucket_n}x{key.batch_cap}"
+                        f"@{key.engine}"] = cost.to_json()
+    hwcost_block = {
+        "env": _hwcost.runtime_env(),
+        "executables": executables,
+        "device_memory": _hwcost.device_memory_stats(),
+    }
+
     fleet_p99_ms = p99(lat2)
     if p99_bound_ms is None:
         # Generous runaway guard, not a perf SLO: the closed-loop p99
@@ -339,6 +357,7 @@ def fleet_demo(n: int = 96, replicas: int = 3, requests: int = 60,
                         for s in chaos_stats["slots"]},
             "elapsed_s": round(el3, 3),
         },
+        "hwcost": hwcost_block,
         "ledger": ledger,
         # The journey-derived ledger of the SAME chaos pass (ISSUE 8:
         # the one shared outcome_ledger helper over the embedded

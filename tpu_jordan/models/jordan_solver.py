@@ -86,6 +86,7 @@ class JordanSolver:
     telemetry: Any = None
     policy: Any = None
     plan: Any = field(default=None, repr=False)
+    cost: Any = field(default=None, repr=False)  # hwcost.ExecutableCost
     _run: Any = field(default=None, repr=False)
     _be: Any = field(default=None, repr=False)
 
@@ -180,6 +181,13 @@ class JordanSolver:
                                                 component="solver.compile")
                          if self.policy is not None else compile_once())
         _record_compile(csp, "solver")
+        # XLA's own accounting (ISSUE 10 hwcost), read once per
+        # compile: ``self.cost`` (an ``obs.hwcost.ExecutableCost``)
+        # carries flops/bytes/HBM of the cached executable; execute
+        # spans get achieved-vs-analytical attrs off it.
+        from ..obs import hwcost as _hwcost
+
+        self.cost = _hwcost.executable_cost(self._run)
 
     def _execute(self, arg):
         """One executable launch: with telemetry, an honest blocking
@@ -192,11 +200,16 @@ class JordanSolver:
             _faults.fire("execute")
             if self.telemetry is None:
                 return self._run(arg)
+            from ..obs import hwcost as _hwcost
             from ..obs.spans import timed_blocking
 
-            out, _ = timed_blocking(self._run, arg,
-                                    telemetry=self.telemetry,
-                                    name="execute", engine=self.engine)
+            out, esp = timed_blocking(self._run, arg,
+                                      telemetry=self.telemetry,
+                                      name="execute", engine=self.engine)
+            _hwcost.attach_execute_cost(
+                esp, self.cost if self.cost is not None
+                else _hwcost.UNAVAILABLE,
+                analytical_flops=2.0 * float(self.n) ** 3)
             return out
 
         return (self.policy.retry.call(run_once, component="solver.execute")

@@ -15,6 +15,19 @@ counter, ``serve/stats``' per-instance dicts):
   * ``export`` — one-line JSON, Prometheus text, Chrome trace-event
     JSON (Perfetto), plus the jax.profiler kernel tier.
 
+ISSUE 10 adds the numerics-and-hardware observatory:
+
+  * ``numerics`` — per-superstep numerical health (the paper's pivot
+    criterion, candidate spread, element-growth watermark, verified
+    residual) behind the ``numerics=`` knob (off/summary/trace), with
+    ``numerics_spike`` flight-recorder events causally preceding any
+    recovery rung (``tools/check_numerics.py``).
+  * ``hwcost`` — XLA ``cost_analysis``/``memory_analysis`` per
+    compiled executable, achieved-vs-analytical TFLOP/s and
+    arithmetic-intensity attrs on execute spans, per-bucket
+    ``tpu_jordan_executable_*`` gauges, device live-bytes watermarks,
+    and the ``runtime_env`` fingerprint BENCH rows record.
+
 ISSUE 8 adds the request-scoped triad:
 
   * ``journey`` — per-request journey tracing: a deterministic
@@ -30,11 +43,15 @@ ISSUE 8 adds the request-scoped triad:
 Operator guide: ``docs/OBSERVABILITY.md``.
 """
 
-from . import export, journey, metrics, recorder, slo, spans
+from . import export, hwcost, journey, metrics, numerics, recorder, slo, spans
 from .export import (profiler_trace, to_chrome_trace, to_json_line,
                      to_prometheus, write_chrome_trace, write_metrics)
+from .hwcost import (ExecutableCost, attach_execute_cost,
+                     executable_cost, runtime_env)
 from .journey import (JourneyLog, RequestContext, async_trace_events,
                       journeys_from_events, outcome_ledger)
+from .numerics import (NumericsReport, SpikeThresholds, numerics_demo,
+                       record_spikes)
 from .metrics import REGISTRY, MetricsRegistry, Reservoir
 from .recorder import RECORDER, FlightRecorder
 from .slo import SLOMonitor, SLOSpec, bucket_specs
@@ -43,9 +60,14 @@ from .spans import (NULL, NullTelemetry, Span, Telemetry,
                     timed_blocking)
 
 __all__ = [
-    "export", "journey", "metrics", "recorder", "slo", "spans",
+    "export", "hwcost", "journey", "metrics", "numerics", "recorder",
+    "slo", "spans",
     "profiler_trace", "to_chrome_trace", "to_json_line", "to_prometheus",
     "write_chrome_trace", "write_metrics",
+    "ExecutableCost", "attach_execute_cost", "executable_cost",
+    "runtime_env",
+    "NumericsReport", "SpikeThresholds", "numerics_demo",
+    "record_spikes",
     "JourneyLog", "RequestContext", "async_trace_events",
     "journeys_from_events", "outcome_ledger",
     "REGISTRY", "MetricsRegistry", "Reservoir",

@@ -51,12 +51,19 @@ def _fmt_value(v: float) -> str:
 def to_prometheus(registry: "_metrics.MetricsRegistry | None" = None
                   ) -> str:
     """The registry as Prometheus text exposition format (one trailing
-    newline; empty registries export as an empty string)."""
+    newline; empty registries export as an empty string).
+
+    Every family gets a ``# HELP`` line next to its ``# TYPE`` —
+    scrapers and humans both read them, and ``tools/check_telemetry.py``
+    fails a scrape without them (ISSUE 10 satellite).  A family whose
+    registration carried no help text exports an explicit
+    ``(no help registered)`` marker rather than silently omitting the
+    line: the missing documentation is visible, never invisible."""
     reg = registry if registry is not None else _metrics.REGISTRY
     lines: list[str] = []
     for m in reg.collect():
-        if m.help:
-            lines.append(f"# HELP {m.name} {m.help}")
+        help_text = " ".join((m.help or "(no help registered)").split())
+        lines.append(f"# HELP {m.name} {help_text}")
         lines.append(f"# TYPE {m.name} {_PROM_TYPE[m.kind]}")
         series = m.series() or {(): (0.0 if m.kind != "histogram"
                                      else _metrics.Reservoir())}

@@ -1,0 +1,293 @@
+"""XLA cost/memory accounting per compiled executable (ISSUE 10
+tentpole part 2).
+
+Until this layer, achieved TFLOP/s everywhere came from the
+hand-counted 2n³ convention (``utils/profiling.invert_flops``,
+BASELINE.md) — fine for cross-round comparability, but blind to what
+the COMPILER actually scheduled: the probe's batched block inverses,
+the eager side-updates, refinement.  "Large Scale Distributed Linear
+Algebra With TPUs" (arXiv:2112.09017) attributes achieved-vs-peak from
+the executable's own accounting; this module does the same through
+``compiled.cost_analysis()`` (FLOPs, bytes accessed) and
+``compiled.memory_analysis()`` (argument/output/temp HBM footprint).
+
+Honesty contract (the PR 4 discipline): every number here is read from
+the compiler or the runtime — nothing is modeled.  When a backend does
+not expose the analysis, the fields are ``None`` and
+``available=False``; a missing number is reported missing, never
+silently replaced by a hand count.  The 2n³ BASELINE convention stays
+available as :func:`baseline_invert_flops` (what ``gflops`` headline
+rows keep for cross-round comparability) and the paper-accounting
+analytic is :func:`gauss_jordan_flops` = (8/3)n³ — pinned against the
+real ``cost_analysis`` count by tests/test_hwcost.py.
+
+Surfaces:
+
+  * :func:`executable_cost` — one :class:`ExecutableCost` per compiled
+    executable (driver solve/solve_batch, JordanSolver, every serve
+    ``BucketExecutor``), read once at compile time: zero per-execute
+    cost.
+  * :func:`attach_execute_cost` — achieved-vs-analytical TFLOP/s and
+    arithmetic-intensity attrs on ``execute`` spans.
+  * :func:`observe_cost` / ``ServeStats`` — ``tpu_jordan_executable_*``
+    gauges keyed by serve bucket, plus the live-bytes device watermark
+    gauges (``tpu_jordan_device_bytes_in_use`` /
+    ``_peak_bytes_in_use``) where the runtime reports them (TPU yes,
+    CPU no — absent, not zeroed).
+  * :func:`runtime_env` — jax/jaxlib versions, device kind, host core
+    count: the BENCH-row interpretability block (ISSUE 10 satellite).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from . import metrics as _metrics
+
+_M_FLOPS = _metrics.gauge(
+    "tpu_jordan_executable_flops",
+    "XLA cost_analysis FLOPs of a compiled executable (per serve "
+    "bucket / component)")
+_M_BYTES = _metrics.gauge(
+    "tpu_jordan_executable_bytes_accessed",
+    "XLA cost_analysis bytes accessed of a compiled executable")
+_M_HBM = _metrics.gauge(
+    "tpu_jordan_executable_hbm_bytes",
+    "XLA memory_analysis HBM footprint (arguments + outputs + temps) "
+    "of a compiled executable")
+_M_DEV_USED = _metrics.gauge(
+    "tpu_jordan_device_bytes_in_use",
+    "live bytes on the device per the runtime allocator (absent on "
+    "backends that do not report memory stats)")
+_M_DEV_PEAK = _metrics.gauge(
+    "tpu_jordan_device_peak_bytes_in_use",
+    "peak live-bytes watermark on the device per the runtime "
+    "allocator (absent on backends that do not report memory stats)")
+
+
+def baseline_invert_flops(n: int) -> float:
+    """The 2n³ Gauss–Jordan convention used by BASELINE.md and every
+    BENCH_r* headline — kept for cross-round comparability (changing
+    the unit would orphan the r01+ trajectory)."""
+    return 2.0 * float(n) ** 3
+
+
+def gauss_jordan_flops(n: int) -> float:
+    """The (8/3)n³ analytical count of the blocked in-place
+    Gauss–Jordan inversion INCLUDING the pivot probe's batched block
+    inverses and the normalize side-products — what
+    ``cost_analysis()`` reports for the real executable (pinned within
+    tolerance by tests/test_hwcost.py at a fixed shape)."""
+    return (8.0 / 3.0) * float(n) ** 3
+
+
+@dataclass(frozen=True)
+class ExecutableCost:
+    """Compiler-reported cost/memory of ONE compiled executable.
+    ``available=False`` means the backend exposed no analysis — every
+    field None, nothing modeled in its place."""
+
+    available: bool
+    flops: float | None = None
+    bytes_accessed: float | None = None
+    argument_bytes: int | None = None
+    output_bytes: int | None = None
+    temp_bytes: int | None = None
+    generated_code_bytes: int | None = None
+    source: str = "xla_cost_analysis"
+
+    @property
+    def hbm_bytes(self) -> int | None:
+        """Peak HBM footprint: arguments + outputs + temps (the
+        executable's resident working set; aliased/donated buffers
+        count once on the argument side)."""
+        parts = [self.argument_bytes, self.output_bytes, self.temp_bytes]
+        if all(p is None for p in parts):
+            return None
+        return sum(int(p) for p in parts if p is not None)
+
+    @property
+    def arithmetic_intensity(self) -> float | None:
+        """FLOPs per byte accessed — the roofline x-coordinate."""
+        if not self.flops or not self.bytes_accessed:
+            return None
+        return self.flops / self.bytes_accessed
+
+    def to_json(self) -> dict:
+        return {
+            "available": self.available,
+            "source": self.source,
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "argument_bytes": self.argument_bytes,
+            "output_bytes": self.output_bytes,
+            "temp_bytes": self.temp_bytes,
+            "hbm_bytes": self.hbm_bytes,
+            "arithmetic_intensity": (
+                None if self.arithmetic_intensity is None
+                else round(self.arithmetic_intensity, 2)),
+        }
+
+
+UNAVAILABLE = ExecutableCost(available=False)
+
+
+def executable_cost(compiled) -> ExecutableCost:
+    """Read cost/memory analysis off a compiled executable (a
+    ``jax.stages.Compiled`` or anything quacking like one).  Defensive
+    on purpose: backends differ in what they expose (list-of-dicts vs
+    dict cost analysis, missing memory analysis) and a telemetry read
+    must never fail a solve."""
+    flops = bytes_accessed = None
+    arg_b = out_b = tmp_b = code_b = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            f = ca.get("flops")
+            b = ca.get("bytes accessed")
+            flops = float(f) if f is not None else None
+            bytes_accessed = float(b) if b is not None else None
+    except Exception:                            # noqa: BLE001
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        arg_b = int(getattr(ma, "argument_size_in_bytes"))
+        out_b = int(getattr(ma, "output_size_in_bytes"))
+        tmp_b = int(getattr(ma, "temp_size_in_bytes"))
+        code_b = int(getattr(ma, "generated_code_size_in_bytes"))
+    except Exception:                            # noqa: BLE001
+        pass
+    if flops is None and bytes_accessed is None and arg_b is None:
+        return UNAVAILABLE
+    return ExecutableCost(available=True, flops=flops,
+                          bytes_accessed=bytes_accessed,
+                          argument_bytes=arg_b, output_bytes=out_b,
+                          temp_bytes=tmp_b, generated_code_bytes=code_b)
+
+
+def attach_execute_cost(span, cost: ExecutableCost,
+                        analytical_flops: float | None = None) -> None:
+    """Achieved-vs-analytical attrs on an ``execute`` span:
+
+      * ``xla_flops`` / ``xla_bytes`` — the compiler's own counts;
+      * ``achieved_tflops_xla`` — xla_flops / measured wall;
+      * ``achieved_tflops_analytical`` — the hand-convention rate
+        (``analytical_flops`` / wall, typically 2n³ — the BASELINE
+        headline unit) next to it, so the two accountings are always
+        side by side;
+      * ``xla_vs_analytical`` — their ratio (how much work the
+        compiled program really does per hand-counted flop);
+      * ``arithmetic_intensity`` — flops/byte (roofline position).
+
+    No-op when the analysis is unavailable or the span has no
+    duration — a missing number stays missing."""
+    if not cost.available:
+        return
+
+    def sig(v: float) -> float:
+        # 4 significant digits, never rounded to zero: a 64² solve's
+        # achieved rate is micro-TFLOP/s and must survive rounding.
+        return float(f"{v:.4g}")
+
+    el = span.duration
+    if cost.flops:
+        span.attrs["xla_flops"] = cost.flops
+        if el > 0:
+            span.attrs["achieved_tflops_xla"] = sig(
+                cost.flops / el / 1e12)
+    if cost.bytes_accessed:
+        span.attrs["xla_bytes"] = cost.bytes_accessed
+    ai = cost.arithmetic_intensity
+    if ai is not None:
+        span.attrs["arithmetic_intensity"] = sig(ai)
+    if analytical_flops and el > 0:
+        span.attrs["achieved_tflops_analytical"] = sig(
+            analytical_flops / el / 1e12)
+        if cost.flops:
+            span.attrs["xla_vs_analytical"] = sig(
+                cost.flops / analytical_flops)
+
+
+def observe_cost(cost: ExecutableCost, **labels) -> None:
+    """Mirror an executable's cost into the registry gauges (labeled
+    by serve bucket / component).  Unavailable analysis sets nothing —
+    absent is honest, zero would be a lie."""
+    if not cost.available:
+        return
+    if cost.flops is not None:
+        _M_FLOPS.set(cost.flops, **labels)
+    if cost.bytes_accessed is not None:
+        _M_BYTES.set(cost.bytes_accessed, **labels)
+    hbm = cost.hbm_bytes
+    if hbm is not None:
+        _M_HBM.set(hbm, **labels)
+
+
+def device_memory_stats(device=None) -> dict | None:
+    """The runtime allocator's live/peak byte counters for one device,
+    or None where the backend reports none (CPU).  Keys normalized to
+    ``bytes_in_use`` / ``peak_bytes_in_use`` when present."""
+    try:
+        import jax
+
+        dev = device if device is not None else jax.local_devices()[0]
+        stats = dev.memory_stats()
+    except Exception:                            # noqa: BLE001
+        return None
+    if not stats:
+        return None
+    return dict(stats)
+
+
+def observe_device_memory(device=None, **labels) -> dict | None:
+    """Sample the device allocator into the watermark gauges; returns
+    the raw stats dict (None = backend reports none, gauges
+    untouched)."""
+    stats = device_memory_stats(device)
+    if stats is None:
+        return None
+    used = stats.get("bytes_in_use")
+    peak = stats.get("peak_bytes_in_use")
+    if used is not None:
+        _M_DEV_USED.set(float(used), **labels)
+    if peak is not None:
+        _M_DEV_PEAK.set(float(peak), **labels)
+    return stats
+
+
+def runtime_env() -> dict:
+    """The environment fingerprint BENCH rows (and the fleet demo)
+    record so cross-round comparisons are interpretable: jax/jaxlib
+    versions, backend + device kind + count, host core count.  The
+    bench sentinel treats these as context, never as a gate — missing
+    fields in old rows are unknown, not regressed (ISSUE 10
+    satellite)."""
+    import os
+
+    env = {"host_cpu_count": os.cpu_count()}
+    try:
+        import jax
+
+        env["jax"] = jax.__version__
+    except Exception:                            # noqa: BLE001
+        env["jax"] = None
+    try:
+        import jaxlib
+
+        env["jaxlib"] = jaxlib.__version__
+    except Exception:                            # noqa: BLE001
+        env["jaxlib"] = None
+    try:
+        import jax
+
+        devs = jax.devices()
+        env["backend"] = jax.default_backend()
+        env["device_kind"] = devs[0].device_kind if devs else None
+        env["device_count"] = len(devs)
+    except Exception:                            # noqa: BLE001
+        env.setdefault("backend", None)
+        env.setdefault("device_kind", None)
+        env.setdefault("device_count", None)
+    return env

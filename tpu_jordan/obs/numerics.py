@@ -1,0 +1,396 @@
+"""Per-superstep numerical health (ISSUE 10 tentpole part 1).
+
+The paper's two distinctive signals — the condition-based pivot
+criterion (the ∞-norm of each candidate block inverse,
+main.cpp:1026-1074) and the final residual ‖A·A⁻¹ − I‖∞
+(main.cpp:490-513) — are computed on every solve and then discarded
+after a single comparison.  Our reproduction did the same: the PR 5
+degradation ladder fires on a gate failure with no record of WHY the
+numerics went bad.  This module is the record.
+
+Three modes (the ``numerics=`` knob on ``driver.solve``):
+
+  * ``"off"`` (the default, and the serve-path default) — nothing
+    collected, nothing observed, zero cost.  The warm-path pins
+    (zero compiles, zero measurements) run with this.
+  * ``"summary"`` — a :class:`NumericsReport` built ONLY from numbers
+    the solve already returns (rel_residual, κ∞, ‖A‖∞): no extra
+    device work, honest on every engine including the fused Pallas
+    executables the host cannot see inside.
+  * ``"trace"`` — the full per-superstep health trace from the
+    INSTRUMENTED unrolled engines (``ops/jordan_inplace.py``
+    ``collect_stats=True``): per step, the chosen pivot block id, its
+    inverse ∞-norm (the paper's selection criterion — the step's
+    ``key[rel]``), the worst finite candidate norm (the spread's other
+    end), the singular-candidate count, and the running
+    element-growth watermark ``max|V|``.  The stats ride the same
+    compiled executable as the solve (stacked (Nr,) outputs) and the
+    inverse bit-matches the uninstrumented engine — pinned by
+    tests/test_numerics.py.  Host-visible engines only: a fused
+    executable cannot be bracketed per step, so ``trace`` on the
+    augmented / fori-only / distributed / bf16-fused paths is a typed
+    ``UsageError``, never a silently different trace (the PR 4
+    honesty discipline).
+
+Every non-off report mirrors into the metrics registry
+(``tpu_jordan_pivot_condition`` / ``tpu_jordan_growth_factor`` /
+``tpu_jordan_residual`` histograms) and threshold exceedances are
+recorded as ``numerics_spike`` flight-recorder events BEFORE the PR 5
+ladder runs — so a ``recovery_rung`` event is causally preceded (by
+``seq``) by the numerics evidence that explains it.
+``tools/check_numerics.py`` validates that chain both ways.
+
+Honesty contract: every MEASURED field comes off the executed solve
+(the stats outputs, the verified residual).  The per-step
+``residual_est`` ladder is the one MODELED field (eps·n·growth/‖A‖∞ —
+the classic element-growth error model) and is named in
+``NumericsReport.modeled_fields`` so it can never masquerade as a
+measurement.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from . import metrics as _metrics
+from . import recorder as _recorder
+
+MODES = ("off", "summary", "trace")
+
+#: The modeled per-step residual-estimate ladder's error model:
+#: rel_residual ≈ eps · n · growth — the standard backward-error bound
+#: with the measured element-growth watermark standing in for the
+#: unknowable true growth factor (Higham, Accuracy and Stability,
+#: ch. 14; the same eps·n·κ family the PR 5 gate uses).
+_EST_NOTE = "eps*n*growth/norm_a (modeled; Higham-style growth bound)"
+
+
+def resolve_mode(mode) -> str:
+    """Validate the ``numerics=`` knob (shared by solve / JordanService
+    / CLI so the vocabulary can't drift)."""
+    if mode is None:
+        return "off"
+    if mode not in MODES:
+        from ..driver import UsageError
+
+        raise UsageError(f"unknown numerics mode {mode!r}; choose from "
+                         f"{'/'.join(MODES)}")
+    return mode
+
+
+# ---------------------------------------------------------------------
+# The report
+# ---------------------------------------------------------------------
+
+_M_PIVOT = _metrics.histogram(
+    "tpu_jordan_pivot_condition",
+    "per-superstep ∞-norm of the CHOSEN pivot block inverse — the "
+    "paper's selection criterion (main.cpp:1026-1074); trace mode only")
+_M_GROWTH = _metrics.histogram(
+    "tpu_jordan_growth_factor",
+    "element-growth watermark max|V|/‖A‖∞ of the working matrix over "
+    "the elimination; trace mode only")
+_M_RESIDUAL = _metrics.histogram(
+    "tpu_jordan_residual",
+    "verified relative residual ‖A·X−I‖∞/‖A‖∞ per solve (summary and "
+    "trace modes)")
+_M_SPIKES = _metrics.counter(
+    "tpu_jordan_numerics_spikes_total",
+    "numerics threshold exceedances recorded as flight-recorder "
+    "events, labeled by signal")
+
+
+@dataclass
+class NumericsReport:
+    """One solve's numerical health record (``SolveResult.numerics``).
+
+    Summary fields are present in both non-off modes; the per-step
+    lists (``pivot_block`` .. ``residual_est``) only in ``trace``.
+    ``modeled_fields`` names the fields that come from an error MODEL
+    rather than a measurement — everything else is read off the
+    executed solve."""
+
+    mode: str
+    n: int
+    block_size: int
+    engine: str
+    rel_residual: float
+    kappa: float
+    norm_a: float
+    eps: float
+    # trace-only (None in summary mode) -------------------------------
+    trace_engine: str | None = None   # the instrumented twin that ran
+    pivot_block: list | None = None         # chosen pivot block per step
+    pivot_inv_norm: list | None = None      # ‖H‖∞ = the criterion value
+    cand_norm_max: list | None = None       # worst FINITE candidate norm
+    singular_candidates: list | None = None  # probe-flagged per step
+    growth: list | None = None              # running max|V| watermark
+    residual_est: list | None = None        # MODELED eps·n·growth ladder
+    residual_est_model: str = _EST_NOTE
+    modeled_fields: tuple = ("residual_est",)
+    spikes: list = field(default_factory=list)  # record_spikes fills this
+
+    @property
+    def growth_factor(self) -> float | None:
+        """Final element-growth watermark relative to ‖A‖∞."""
+        if not self.growth or not self.norm_a:
+            return None
+        return float(self.growth[-1]) / self.norm_a
+
+    @property
+    def max_pivot_inv_norm(self) -> float | None:
+        vals = [v for v in (self.pivot_inv_norm or ())
+                if math.isfinite(v)]
+        return max(vals) if vals else None
+
+    @property
+    def pivot_spread_max(self) -> float | None:
+        """Worst per-step candidate-norm spread (max finite candidate
+        over the chosen minimum) — how decisive the pivot choice was."""
+        if not self.pivot_inv_norm:
+            return None
+        spreads = [mx / mn for mn, mx in zip(self.pivot_inv_norm,
+                                             self.cand_norm_max)
+                   if math.isfinite(mn) and math.isfinite(mx) and mn > 0]
+        return max(spreads) if spreads else None
+
+    def to_json(self) -> dict:
+        doc = {
+            "mode": self.mode, "n": self.n,
+            "block_size": self.block_size, "engine": self.engine,
+            "rel_residual": self.rel_residual, "kappa": self.kappa,
+            "norm_a": self.norm_a, "eps": self.eps,
+            "spikes": list(self.spikes),
+        }
+        if self.mode == "trace":
+            doc.update({
+                "trace_engine": self.trace_engine,
+                "pivot_block": self.pivot_block,
+                "pivot_inv_norm": self.pivot_inv_norm,
+                "cand_norm_max": self.cand_norm_max,
+                "singular_candidates": self.singular_candidates,
+                "growth": self.growth,
+                "growth_factor": self.growth_factor,
+                "max_pivot_inv_norm": self.max_pivot_inv_norm,
+                "pivot_spread_max": self.pivot_spread_max,
+                "residual_est": self.residual_est,
+                "residual_est_model": self.residual_est_model,
+                "modeled_fields": list(self.modeled_fields),
+            })
+        return doc
+
+
+def _floats(arr) -> list:
+    import numpy as np
+
+    return [float(v) for v in np.asarray(arr, dtype=np.float64)]
+
+
+def summary_report(*, n: int, block_size: int, engine: str,
+                   rel_residual: float, kappa: float, norm_a: float,
+                   dtype) -> NumericsReport:
+    """``"summary"`` mode: built ONLY from what the solve already
+    returned — no extra device work, honest on fused executables."""
+    import jax.numpy as jnp
+
+    return NumericsReport(
+        mode="summary", n=n, block_size=block_size, engine=engine,
+        rel_residual=float(rel_residual), kappa=float(kappa),
+        norm_a=float(norm_a),
+        eps=float(jnp.finfo(jnp.dtype(dtype)).eps))
+
+
+def trace_report(stats: dict, *, n: int, block_size: int, engine: str,
+                 trace_engine: str, rel_residual: float, kappa: float,
+                 norm_a: float, dtype) -> NumericsReport:
+    """``"trace"`` mode: the per-superstep stats stacked by the
+    instrumented engine (``collect_stats=True``) plus the verified
+    end-state numbers.  The modeled ``residual_est`` ladder is derived
+    host-side — the device pays nothing for it."""
+    import numpy as np
+
+    rep = summary_report(n=n, block_size=block_size, engine=engine,
+                         rel_residual=rel_residual, kappa=kappa,
+                         norm_a=norm_a, dtype=dtype)
+    rep.mode = "trace"
+    rep.trace_engine = trace_engine
+    rep.pivot_block = [int(v) for v in np.asarray(stats["pivot_block"])]
+    rep.pivot_inv_norm = _floats(stats["pivot_inv_norm"])
+    rep.cand_norm_max = _floats(stats["cand_norm_max"])
+    rep.singular_candidates = [
+        int(v) for v in np.asarray(stats["singular_candidates"])]
+    rep.growth = _floats(stats["growth"])
+    na = rep.norm_a if rep.norm_a else 1.0
+    rep.residual_est = [rep.eps * n * g / na for g in rep.growth]
+    return rep
+
+
+# ---------------------------------------------------------------------
+# Registry mirroring + spike events
+# ---------------------------------------------------------------------
+
+def observe(report: NumericsReport) -> None:
+    """Mirror a report into the process-wide registry (engine-labeled
+    series).  Trace-only signals are observed only when measured —
+    summary mode never fabricates a pivot/growth sample."""
+    if math.isfinite(report.rel_residual):
+        _M_RESIDUAL.observe(report.rel_residual, engine=report.engine)
+    if report.mode != "trace":
+        return
+    for v in report.pivot_inv_norm or ():
+        if math.isfinite(v):
+            _M_PIVOT.observe(v, engine=report.engine)
+    gf = report.growth_factor
+    if gf is not None and math.isfinite(gf):
+        _M_GROWTH.observe(gf, engine=report.engine)
+
+
+@dataclass(frozen=True)
+class SpikeThresholds:
+    """When a health signal becomes a flight-recorder event.
+
+    ``residual`` defaults to the PR 5 expected-error model eps·n·κ∞
+    (capped at 0.5, the same non-vacuousness ceiling as the gate) —
+    the driver passes the policy's OWN gate threshold when a policy is
+    attached, so a gate failure can never outrun its spike.
+    ``pivot_condition`` fires on ‖H‖∞·‖A‖∞ (a scale-free condition
+    proxy for the chosen pivot block) above ``1/sqrt(eps)``;
+    ``growth`` on the element-growth factor."""
+
+    residual: float | None = None       # None = eps·n·max(1,κ) cap 0.5
+    pivot_condition: float | None = None  # None = 1/sqrt(eps)
+    growth: float = 1e3
+
+    def residual_threshold(self, rep: NumericsReport) -> float:
+        if self.residual is not None:
+            return self.residual
+        kap = rep.kappa if math.isfinite(rep.kappa) else float("inf")
+        return min(rep.eps * max(1, rep.n) * max(1.0, kap), 0.5)
+
+    def pivot_threshold(self, rep: NumericsReport) -> float:
+        if self.pivot_condition is not None:
+            return self.pivot_condition
+        return 1.0 / math.sqrt(rep.eps)
+
+
+def record_spikes(report: NumericsReport,
+                  thresholds: SpikeThresholds | None = None,
+                  recorder=None) -> list[dict]:
+    """Compare the report against the thresholds and record one
+    ``numerics_spike`` flight-recorder event per exceedance — the
+    causal breadcrumb a later ``recovery_rung`` event points back to.
+    Returns the spike dicts (also appended to ``report.spikes``).
+
+    Must be called BEFORE the degradation ladder runs (the driver
+    does): the checker validates rung events by preceding-seq spike."""
+    thr = thresholds if thresholds is not None else SpikeThresholds()
+    rec = recorder if recorder is not None else _recorder.record
+    spikes = []
+
+    def spike(signal: str, value: float, threshold: float, **extra):
+        ev = {"signal": signal, "value": float(value),
+              "threshold": float(threshold), **extra}
+        spikes.append(ev)
+        _M_SPIKES.inc(signal=signal)
+        rec("numerics_spike", n=report.n, engine=report.engine,
+            mode=report.mode, **ev)
+
+    rthr = thr.residual_threshold(report)
+    rel = report.rel_residual
+    if not math.isfinite(rel) or rel > rthr:
+        spike("residual", rel, rthr)
+    if report.mode == "trace":
+        pthr = thr.pivot_threshold(report)
+        for t, v in enumerate(report.pivot_inv_norm or ()):
+            cond = v * report.norm_a
+            if not math.isfinite(cond) or cond > pthr:
+                spike("pivot_condition", cond, pthr, step=t,
+                      pivot_block=report.pivot_block[t])
+        gf = report.growth_factor
+        if gf is not None and (not math.isfinite(gf) or gf > thr.growth):
+            spike("growth", gf, thr.growth)
+    report.spikes.extend(spikes)
+    return spikes
+
+
+# ---------------------------------------------------------------------
+# The acceptance demo (`make numerics-demo`, CLI --numerics-demo)
+# ---------------------------------------------------------------------
+
+def ill_conditioned(n: int, kappa_decades: float = 4.5,
+                    seed: int = 7):
+    """A deliberately ill-conditioned (κ∞ ~ 10^decades) but well-scaled
+    dense matrix: rotated graded diagonal (the PR 5 ladder-acceptance
+    fixture, promoted here so the demo and the tests share one
+    recipe)."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    q1, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    q2, _ = np.linalg.qr(rng.standard_normal((n, n)))
+    return (q1 * np.logspace(0, -kappa_decades, n)) @ q2
+
+
+def numerics_demo(n: int = 16, block_size: int = 8, seed: int = 7,
+                  kappa_decades: float = 4.5) -> dict:
+    """The ISSUE 10 acceptance run: a seeded ill-conditioned solve at
+    bf16 storage under the default-shaped ladder policy, traced.
+
+    The bf16-grade residual fails the fp32-SLO gate, refine diverges
+    (initial residual > 1 kills Newton-Schulz), and the fp32 re-solve
+    passes — and because ``numerics="trace"`` observed the solve, the
+    flight recorder holds the numerics_spike events BEFORE the
+    residual_gate_failure / recovery_rung events they explain.  Prints
+    nothing; returns the one-line-JSON report ``tools/
+    check_numerics.py`` validates (exit 2 = a rung with no causally
+    preceding spike — an unexplained ladder)."""
+    import os
+    import tempfile
+
+    import jax.numpy as jnp
+
+    from ..driver import solve
+    from ..io import write_matrix_file
+    from ..resilience import ResiliencePolicy
+    from .spans import Telemetry
+
+    fd, path = tempfile.mkstemp(prefix="tpu_jordan_numerics_",
+                                suffix=".mat")
+    os.close(fd)
+    try:
+        write_matrix_file(path, ill_conditioned(n, kappa_decades, seed))
+        mark = _recorder.RECORDER.total
+        tel = Telemetry()
+        policy = ResiliencePolicy(gate_dtype="float32")
+        res = solve(n, block_size, file=path, dtype=jnp.bfloat16,
+                    policy=policy, telemetry=tel, numerics="trace")
+    finally:
+        os.unlink(path)
+
+    blackbox = _recorder.RECORDER.dump(
+        events=_recorder.RECORDER.since(mark))
+    events = blackbox["events"]
+    spike_seqs = [e["seq"] for e in events
+                  if e["kind"] == "numerics_spike"]
+    unexplained = [
+        e for e in events
+        if e["kind"] in ("recovery_rung", "residual_gate_failure")
+        and not any(s < e["seq"] for s in spike_seqs)]
+    rep = res.numerics
+    return {
+        "metric": "numerics_demo",
+        "n": n, "block_size": block_size, "seed": seed,
+        "kappa_decades": kappa_decades,
+        "engine": res.engine,
+        "numerics": rep.to_json() if rep is not None else None,
+        "recovery": [dict(r) for r in res.recovery],
+        "rel_residual": res.rel_residual,
+        "spike_count": len(spike_seqs),
+        "rung_count": sum(1 for e in events
+                          if e["kind"] == "recovery_rung"),
+        "unexplained_rungs": [
+            {"kind": e["kind"], "seq": e["seq"]} for e in unexplained],
+        "silent_rung": bool(unexplained),
+        "blackbox": blackbox,
+    }

@@ -43,6 +43,63 @@ from .padding import pad_with_identity, unpad
 from .refine import newton_schulz, resolve_precision
 
 
+class _StepStats:
+    """Per-superstep health accumulator for the INSTRUMENTED unrolled
+    engines (``collect_stats=True``; ISSUE 10 numerics trace).
+
+    Records, per elimination step, the paper's own selection evidence
+    (main.cpp:1026-1074): the chosen pivot block id, the ∞-norm of its
+    block inverse (the criterion value — the step's ``key`` minimum),
+    the worst FINITE candidate norm (the spread's other end), the
+    probe's singular-candidate count, and the running element-growth
+    watermark ``max|V|``.  Everything is a reduction over values the
+    engine already computed — the stats ride the same executable as
+    stacked (Nr,) outputs and the inverse bits are untouched (pinned
+    by tests/test_numerics.py)."""
+
+    def __init__(self):
+        self.pivot_block, self.pivot_inv_norm = [], []
+        self.cand_norm_max, self.singular_candidates = [], []
+        self.growth = []
+        self._watermark = None
+
+    def probe(self, piv, key, sing):
+        finite = jnp.isfinite(key)
+        self.pivot_block.append(jnp.asarray(piv, jnp.int32))
+        self.pivot_inv_norm.append(jnp.min(key))
+        self.cand_norm_max.append(
+            jnp.max(jnp.where(finite, key,
+                              jnp.asarray(-jnp.inf, key.dtype))))
+        self.singular_candidates.append(
+            jnp.sum(sing).astype(jnp.int32))
+
+    def sample_growth(self, *arrays):
+        """One per-step watermark sample over the live working state
+        (the grouped engine passes V and the pending panel U — the
+        eliminated columns live there until the group closes)."""
+        w = jnp.max(jnp.stack([jnp.max(jnp.abs(x)) for x in arrays]))
+        self._watermark = (w if self._watermark is None
+                           else jnp.maximum(self._watermark, w))
+        self.growth.append(self._watermark)
+
+    def refresh(self, *arrays):
+        """Fold a post-group-end state into the LAST recorded step's
+        watermark (the trailing V − U·P update lands after its group's
+        steps were already sampled)."""
+        w = jnp.max(jnp.stack([jnp.max(jnp.abs(x)) for x in arrays]))
+        self._watermark = jnp.maximum(self._watermark, w)
+        self.growth[-1] = self._watermark
+
+    def stacked(self) -> dict:
+        return {
+            "pivot_block": jnp.stack(self.pivot_block),
+            "pivot_inv_norm": jnp.stack(self.pivot_inv_norm),
+            "cand_norm_max": jnp.stack(self.cand_norm_max),
+            "singular_candidates": jnp.stack(self.singular_candidates),
+            "growth": jnp.stack(self.growth),
+        }
+
+
 def compose_swap_perm(swaps, Nr: int):
     """Fold the row-swap history into ONE block-column permutation.
 
@@ -87,7 +144,8 @@ def apply_col_perm(V, cols, m: int):
 
 
 @partial(jax.jit, static_argnames=(
-    "block_size", "eps", "precision", "refine", "use_pallas"))
+    "block_size", "eps", "precision", "refine", "use_pallas",
+    "collect_stats"))
 def block_jordan_invert_inplace(
     a: jnp.ndarray,
     block_size: int | None = None,
@@ -95,6 +153,7 @@ def block_jordan_invert_inplace(
     precision=lax.Precision.HIGHEST,
     refine: int = 0,
     use_pallas: bool | None = None,
+    collect_stats: bool = False,
 ):
     """Invert ``a`` by in-place blocked Gauss–Jordan with condition-based
     pivoting.  Drop-in for ``block_jordan_invert`` (same pivot rule, same
@@ -104,6 +163,11 @@ def block_jordan_invert_inplace(
 
     ``precision="mixed"`` runs the sweeps at HIGH + ≥2 HIGHEST
     Newton–Schulz steps (see ops/refine.py::resolve_precision).
+
+    ``collect_stats=True`` (the ISSUE 10 numerics trace) returns
+    ``(x, singular, stats)`` with per-superstep health arrays
+    (:class:`_StepStats`) stacked into the same executable; the
+    inverse is bit-identical to the uninstrumented call.
     """
     precision, refine = resolve_precision(precision, refine)
     n = a.shape[-1]
@@ -111,10 +175,14 @@ def block_jordan_invert_inplace(
     if jnp.dtype(in_dtype).itemsize < 4:
         # Same sub-fp32 policy as block_jordan_invert: fp32 compute, one
         # final rounding back to the storage dtype.
-        x, singular = block_jordan_invert_inplace(
+        out = block_jordan_invert_inplace(
             a.astype(jnp.float32), block_size, eps, precision, refine,
-            use_pallas,
+            use_pallas, collect_stats,
         )
+        if collect_stats:
+            x, singular, stats = out
+            return x.astype(in_dtype), singular, stats
+        x, singular = out
         return x.astype(in_dtype), singular
     dtype = a.dtype
     if block_size is None:
@@ -130,6 +198,7 @@ def block_jordan_invert_inplace(
     probe_dtype = dtype
 
     singular = jnp.asarray(False)
+    stats = _StepStats() if collect_stats else None
     rswaps = []
     for t in range(Nr):
         nc = Nr - t
@@ -148,6 +217,8 @@ def block_jordan_invert_inplace(
         singular = singular | jnp.all(sing)
         H = jnp.take(invs, rel, axis=0).astype(dtype)
         piv = t + rel
+        if stats is not None:
+            stats.probe(piv, key, sing)
 
         # --- SWAP block rows t <-> piv (swap-by-copy, main.cpp:1093-1131).
         rows_t = lax.slice(V, (t * m, 0), ((t + 1) * m, N))
@@ -167,6 +238,8 @@ def block_jordan_invert_inplace(
         V = V - jnp.matmul(E, prow, precision=precision)
         V = V.at[t * m:(t + 1) * m, :].set(prow)
         rswaps.append(piv)
+        if stats is not None:
+            stats.sample_growth(V)
 
     # --- Unscramble: the composed swap permutation, one blocked gather.
     V = apply_col_perm(V, compose_swap_perm(jnp.stack(rswaps), Nr), m)
@@ -175,11 +248,14 @@ def block_jordan_invert_inplace(
     # Refinement always runs at HIGHEST: its whole job is recovering the
     # accuracy a cheaper sweep precision gave up.
     x = newton_schulz(a, x, refine, lax.Precision.HIGHEST)
+    if stats is not None:
+        return x, singular, stats.stacked()
     return x, singular
 
 
 @partial(jax.jit, static_argnames=(
-    "block_size", "eps", "precision", "refine", "use_pallas", "group"))
+    "block_size", "eps", "precision", "refine", "use_pallas", "group",
+    "collect_stats"))
 def block_jordan_invert_inplace_grouped(
     a: jnp.ndarray,
     block_size: int | None = None,
@@ -188,6 +264,7 @@ def block_jordan_invert_inplace_grouped(
     refine: int = 0,
     use_pallas: bool | None = None,
     group: int = 4,
+    collect_stats: bool = False,
 ):
     """In-place blocked Gauss–Jordan with DELAYED GROUP UPDATES: the
     single-chip headline engine for large n.
@@ -225,10 +302,14 @@ def block_jordan_invert_inplace_grouped(
     n = a.shape[-1]
     in_dtype = a.dtype
     if jnp.dtype(in_dtype).itemsize < 4:
-        x, singular = block_jordan_invert_inplace_grouped(
+        out = block_jordan_invert_inplace_grouped(
             a.astype(jnp.float32), block_size, eps, precision, refine,
-            use_pallas, group,
+            use_pallas, group, collect_stats,
         )
+        if collect_stats:
+            x, singular, stats = out
+            return x.astype(in_dtype), singular, stats
+        x, singular = out
         return x.astype(in_dtype), singular
     dtype = a.dtype
     if block_size is None:
@@ -245,6 +326,7 @@ def block_jordan_invert_inplace_grouped(
     from .block_inverse import probe_blocks
 
     singular = jnp.asarray(False)
+    stats = _StepStats() if collect_stats else None
     rswaps = []
     for t0 in range(0, Nr, k):
         kg = min(k, Nr - t0)                   # this group's width
@@ -268,6 +350,8 @@ def block_jordan_invert_inplace_grouped(
             singular = singular | jnp.all(sing)
             H = jnp.take(invs, rel, axis=0).astype(dtype)
             piv = t + rel
+            if stats is not None:
+                stats.probe(piv, key, sing)
 
             # --- SWAP rows t <-> piv in V and U (swap-by-copy; pending
             # panel contributions follow the physical row).
@@ -303,15 +387,21 @@ def block_jordan_invert_inplace_grouped(
             U = U.at[:, j * m:(j + 1) * m].set(col)
             P = P.at[j * m:(j + 1) * m, :].set(prow)
             rswaps.append(piv)
+            if stats is not None:
+                stats.sample_growth(V, U)
 
         # --- GROUP-END TRAILING UPDATE: one fat MXU matmul.
         V = V - jnp.matmul(U, P, precision=precision)
+        if stats is not None:
+            stats.refresh(V)
 
     # --- Unscramble: the composed swap permutation, one blocked gather.
     V = apply_col_perm(V, compose_swap_perm(jnp.stack(rswaps), Nr), m)
 
     x = unpad(V, n)
     x = newton_schulz(a, x, refine, lax.Precision.HIGHEST)
+    if stats is not None:
+        return x, singular, stats.stacked()
     return x, singular
 
 

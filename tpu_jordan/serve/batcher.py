@@ -114,7 +114,7 @@ class MicroBatcher:
     def __init__(self, executors, stats, batch_cap: int = 8,
                  max_wait_ms: float = 2.0, max_queue: int = 256,
                  block_size: int | None = None, autostart: bool = True,
-                 telemetry=None, policy=None):
+                 telemetry=None, policy=None, numerics: str = "off"):
         from ..obs.spans import NULL
 
         if batch_cap < 1:
@@ -123,6 +123,14 @@ class MicroBatcher:
             raise ValueError("max_queue must be >= 1")
         self.executors = executors
         self.stats = stats
+        # Numerics knob (ISSUE 10): "off" (the serve-path default —
+        # zero added work on the dispatch path) or "summary" (each real
+        # rider's already-computed rel_residual/κ∞ observed into the
+        # tpu_jordan_residual histogram, spiking the flight recorder on
+        # expected-error exceedances).  "trace" is a solve-path mode:
+        # the batched executables are fused and host-opaque, so the
+        # service validates it away (JordanService).
+        self.numerics = numerics
         # Resilience policy (ISSUE 5): retry/integrity-gate on the batch
         # execution, deadline enforcement, breaker feedback.  None keeps
         # the pre-resilience behavior exactly.
@@ -373,6 +381,25 @@ class MicroBatcher:
                 live.append(req)
         return live
 
+    def _observe_numerics(self, batch, ex, sing, kappa, rel) -> None:
+        """Serve-path ``numerics="summary"`` (ISSUE 10): observe each
+        real, non-singular rider's in-launch rel_residual/κ∞ — numbers
+        the compiled batch program already returned, the honest summary
+        discipline for fused executables — into the numerics
+        histograms, spiking the flight recorder on expected-error
+        (eps·n·κ) exceedances.  Never runs at the "off" default."""
+        from ..obs import numerics as _numerics
+
+        for i, req in enumerate(batch):
+            if bool(sing[i]):
+                continue
+            rep = _numerics.summary_report(
+                n=req.n, block_size=ex.block_size,
+                engine=ex.key.engine, rel_residual=float(rel[i]),
+                kappa=float(kappa[i]), norm_a=0.0, dtype=ex.key.dtype)
+            _numerics.observe(rep)
+            _numerics.record_spikes(rep)
+
     def _execute(self, bucket: int, batch: list, t_dispatch: float) -> None:
         import jax.numpy as jnp
 
@@ -404,6 +431,14 @@ class MicroBatcher:
                     ex.run, jnp.asarray(stacked), jnp.asarray(n_real),
                     telemetry=self._tel, name="execute", bucket=bucket,
                     occupancy=len(batch))
+                # Achieved-vs-analytical attrs off the executable's own
+                # accounting (ISSUE 10 hwcost; read once at compile,
+                # attached per span — dict writes, no device work).
+                from ..obs import hwcost as _hwcost
+
+                _hwcost.attach_execute_cost(
+                    esp, ex.cost,
+                    analytical_flops=2.0 * float(bucket) ** 3 * cap)
                 inv, sing, kappa, rel = out
                 sing = np.asarray(sing)
                 kappa = np.asarray(kappa)
@@ -480,6 +515,8 @@ class MicroBatcher:
         self.stats.batch(bucket, occupancy=len(batch),
                          exec_seconds=exec_s, queue_seconds=queue_waits,
                          singular=int(sing[:len(batch)].sum()))
+        if self.numerics == "summary":
+            self._observe_numerics(batch, ex, sing, kappa, rel)
         # Deadline, phase 2 (execute): a batch that finished past a
         # rider's deadline fails THAT rider typed; batch-mates are
         # unaffected.

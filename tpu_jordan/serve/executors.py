@@ -84,6 +84,12 @@ class BucketExecutor:
         self.block_size = key.block_size
         self.plan = plan          # tuning.Plan (None for explicit engines)
         self._compiled = self._build()
+        # XLA's own per-executable accounting (ISSUE 10 hwcost), read
+        # ONCE at compile time — flops/bytes/HBM footprint for the
+        # whole batched launch; zero per-dispatch cost.
+        from ..obs import hwcost as _hwcost
+
+        self.cost = _hwcost.executable_cost(self._compiled)
 
     def _build(self):
         _faults.fire("compile")
@@ -183,6 +189,12 @@ class ExecutorStore:
     def keys(self):
         with self._lock:
             return list(self._executors)
+
+    def entries(self):
+        """[(key, executor)] snapshot — the fleet demo's hwcost block
+        reads each compiled executable's XLA accounting off this."""
+        with self._lock:
+            return list(self._executors.items())
 
     def __len__(self) -> int:
         with self._lock:
@@ -353,6 +365,11 @@ class ExecutorCache:
                 self.stats.compile(bucket_n)
             else:
                 self.stats.cache_hit(bucket_n)
+            # Either way this replica now serves the bucket through
+            # this executable — its XLA accounting belongs in the
+            # replica's stats (and the per-bucket gauges) whether this
+            # cache compiled it or adopted it from the shared store.
+            self.stats.executable_cost(bucket_n, ex.cost)
         return ex, ("compiled" if built else "shared_store")
 
     def keys(self):

@@ -101,6 +101,12 @@ class JordanService:
         passes ``{"replica": <slot>}`` so one Prometheus scrape
         aggregates the pool with per-replica breakdown
         (docs/FLEET.md).
+      numerics: ``"off"`` (the default — the warm-path pins run with
+        it; zero added dispatch work) or ``"summary"`` — each real
+        rider's in-launch rel_residual/κ∞ observed into the
+        ``tpu_jordan_residual`` histogram with expected-error spikes
+        into the flight recorder (ISSUE 10, docs/OBSERVABILITY.md).
+        ``"trace"`` is a solve-path mode and a typed refusal here.
     """
 
     def __init__(self, engine: str = "auto", plan_cache: str | None = None,
@@ -111,12 +117,32 @@ class JordanService:
                  default_deadline_ms: float | None = None,
                  shared_executors=None,
                  plan_cache_read_only: bool = False,
-                 metric_labels: dict | None = None):
+                 metric_labels: dict | None = None,
+                 numerics: str = "off"):
         self.dtype = jnp.dtype(dtype)
         self.batch_cap = int(batch_cap)
         self.telemetry = telemetry
         self.policy = DEFAULT_POLICY if policy == "default" else policy
         self.default_deadline_ms = default_deadline_ms
+        # Numerics knob (ISSUE 10, docs/OBSERVABILITY.md): "off" is THE
+        # serve-path default — the warm-path pins run with it and the
+        # observatory costs the hot path nothing.  "summary" observes
+        # each rider's in-launch rel_residual/κ∞ (numbers the batch
+        # executable already returns) into the numerics histograms.
+        # "trace" needs the instrumented unrolled solve path — the
+        # batched serve executables are fused and host-opaque, so it
+        # is a typed refusal here, never a silently different record.
+        from ..obs.numerics import resolve_mode
+
+        self.numerics = resolve_mode(numerics)
+        if self.numerics == "trace":
+            from ..driver import UsageError
+
+            raise UsageError(
+                "numerics='trace' is a solve-path mode (the serve "
+                "executables are fused; the host cannot see their "
+                "supersteps) — use numerics='summary' on the service, "
+                "or driver.solve(numerics='trace') for the full trace")
         self._stats = ServeStats(labels=metric_labels)
         self.executors = ExecutorCache(
             engine=engine, plan_cache=plan_cache,
@@ -128,7 +154,8 @@ class JordanService:
             self.executors, self._stats, batch_cap=batch_cap,
             max_wait_ms=max_wait_ms, max_queue=max_queue,
             block_size=block_size, autostart=autostart,
-            telemetry=telemetry, policy=self.policy)
+            telemetry=telemetry, policy=self.policy,
+            numerics=self.numerics)
         # Request-journey log (ISSUE 8, always on): deterministic
         # ``request_id``s in submit order; every hop mirrors into the
         # process-wide flight recorder.  A fleet replica does NOT mint
@@ -282,7 +309,7 @@ def serve_demo(n: int, block_size: int | None = None, requests: int = 64,
                batch_cap: int = 8, max_wait_ms: float = 2.0,
                engine: str = "auto", plan_cache: str | None = None,
                dtype=jnp.float32, generator: str = "rand",
-               telemetry=None) -> dict:
+               telemetry=None, numerics: str = "off") -> dict:
     """The ``--serve-demo`` CLI mode's engine: a self-contained
     sustained-throughput demonstration on whatever backend is live.
 
@@ -305,7 +332,8 @@ def serve_demo(n: int, block_size: int | None = None, requests: int = 64,
     with JordanService(engine=engine, plan_cache=plan_cache, dtype=dtype,
                        batch_cap=batch_cap, max_wait_ms=max_wait_ms,
                        max_queue=max(requests, 1),
-                       block_size=block_size, telemetry=telemetry) as svc:
+                       block_size=block_size, telemetry=telemetry,
+                       numerics=numerics) as svc:
         svc.warmup(shapes=sizes)
         compiles_after_warmup = svc.stats()["totals"]["compiles"]
         futures = []

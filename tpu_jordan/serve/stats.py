@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import threading
 
+from ..obs import hwcost as _hwcost
 from ..obs import metrics as _metrics
 from ..obs.metrics import Reservoir
 
@@ -85,10 +86,11 @@ class _BucketStats:
         self.singular = 0
         self.queue_s = Reservoir(MAX_LATENCY_SAMPLES)
         self.exec_s = Reservoir(MAX_LATENCY_SAMPLES)
+        self.executable = None     # hwcost.ExecutableCost json (ISSUE 10)
 
     def to_json(self) -> dict:
         occ = (self.elements / self.batches) if self.batches else 0.0
-        return {
+        doc = {
             "requests": self.requests,
             "rejected": self.rejected,
             "batches": self.batches,
@@ -99,6 +101,9 @@ class _BucketStats:
             "queue_ms": _percentiles(self.queue_s.samples),
             "execute_ms": _percentiles(self.exec_s.samples),
         }
+        if self.executable is not None:
+            doc["executable"] = self.executable
+        return doc
 
 
 class ServeStats:
@@ -132,6 +137,11 @@ class ServeStats:
                 f"reserved metric label(s) {sorted(clash)} — these are "
                 f"stamped by ServeStats itself; pick different names")
         self._buckets: dict[int, _BucketStats] = {}
+        # Live-bytes device watermark gauges (ISSUE 10 hwcost): probed
+        # once on the first batch — a backend that reports no memory
+        # stats (CPU) disables the sampling forever, so the warm path
+        # pays nothing for a gauge that cannot exist.
+        self._device_mem_enabled: bool | None = None
 
     def _b(self, bucket: int) -> _BucketStats:
         return self._buckets.setdefault(bucket, _BucketStats())
@@ -156,6 +166,18 @@ class ServeStats:
             self._b(bucket).cache_hits += 1
         _M_CACHE_HITS.inc(bucket=bucket, **self._labels)
 
+    def executable_cost(self, bucket: int, cost) -> None:
+        """Record a bucket executable's XLA accounting (ISSUE 10
+        hwcost): the snapshot's per-bucket ``executable`` block and
+        the ``tpu_jordan_executable_*`` gauges — read once at compile
+        time, zero per-request cost.  Unavailable analysis records
+        nothing (absent, never zeroed)."""
+        if cost is None or not cost.available:
+            return
+        with self._lock:
+            self._b(bucket).executable = cost.to_json()
+        _hwcost.observe_cost(cost, bucket=bucket, **self._labels)
+
     def batch(self, bucket: int, occupancy: int, exec_seconds: float,
               queue_seconds, singular: int = 0) -> None:
         """One dispatched batch: ``occupancy`` occupied slots,
@@ -177,6 +199,9 @@ class ServeStats:
         if singular:
             _M_SINGULAR.inc(singular, component="serve", bucket=bucket,
                             **self._labels)
+        if self._device_mem_enabled is not False:
+            sampled = _hwcost.observe_device_memory(**self._labels)
+            self._device_mem_enabled = sampled is not None
 
     def snapshot(self) -> dict:
         with self._lock:

@@ -73,5 +73,18 @@ def timed(label: str, flops: float | None = None, sync=None,
 
 
 def invert_flops(n: int) -> float:
-    """The 2n^3 Gauss–Jordan inversion convention used by BASELINE.md."""
-    return 2.0 * float(n) ** 3
+    """The 2n^3 Gauss–Jordan inversion convention used by BASELINE.md.
+
+    .. deprecated:: ISSUE 10
+       Hand FLOP counting is retired onto ``tpu_jordan/obs/hwcost.py``:
+       ``baseline_invert_flops`` (this 2n³ convention, kept for
+       BASELINE/BENCH cross-round comparability), ``gauss_jordan_flops``
+       ((8/3)n³ — the analytical count of the real blocked algorithm
+       including the pivot probe, pinned against
+       ``compiled.cost_analysis()`` by tests/test_hwcost.py), and
+       ``executable_cost`` (the compiled executable's OWN accounting —
+       what bench rows and execute spans now report).  This shim
+       delegates; new code should use ``tpu_jordan.obs.hwcost``."""
+    from ..obs.hwcost import baseline_invert_flops
+
+    return baseline_invert_flops(n)
