@@ -13,7 +13,7 @@ WORKERS  ?= 1
 REQUESTS  ?= 64
 BATCH_CAP ?= 8
 
-.PHONY: all native tpu test smoke serve-demo chaos-demo fleet-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
+.PHONY: all native tpu test smoke serve-demo solve-demo chaos-demo fleet-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
 
 REPLICAS ?= 3
 
@@ -45,6 +45,21 @@ smoke:
 serve-demo:
 	python -m tpu_jordan $(N) $(M) --serve-demo \
 	  --serve-requests $(REQUESTS) --batch-cap $(BATCH_CAP)
+
+# The solve workloads (ISSUE 11, docs/WORKLOADS.md): X = A^-1 B by
+# Gauss-Jordan on [A | B] (no inverse ever formed), the pivot-free
+# --assume spd fast path on the KMS SPD fixture, complex64, and lstsq
+# via the normal equations — all through the workload-scoped
+# engine-auto ladder, with the CLI's 0/1/2 exit taxonomy.
+solve-demo:
+	python -m tpu_jordan 256 64 --workload solve --rhs 4 \
+	  --generator rand --quiet
+	python -m tpu_jordan 192 64 --workload solve --rhs 2 --assume spd \
+	  --generator kms --quiet
+	python -m tpu_jordan 128 32 --workload solve --rhs 2 \
+	  --dtype complex64 --generator crand --quiet
+	python -m tpu_jordan 128 32 --workload lstsq --rhs 2 \
+	  --generator rand --quiet
 
 # Chaos demo + validation (docs/RESILIENCE.md): the same deterministic
 # request stream served fault-free and under a seeded FaultPlan
@@ -120,6 +135,9 @@ numerics-demo:
 	python -m tpu_jordan 16 8 --numerics-demo --quiet \
 	  > /tmp/tpu_jordan_numerics.json
 	python tools/check_numerics.py /tmp/tpu_jordan_numerics.json
+	python -m tpu_jordan 16 8 --numerics-demo --workload solve --quiet \
+	  > /tmp/tpu_jordan_numerics_solve.json
+	python tools/check_numerics.py /tmp/tpu_jordan_numerics_solve.json
 
 bench: native
 	python bench.py

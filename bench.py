@@ -522,6 +522,87 @@ def _pallas_rows(extra, baseline_gflops, dip_only=False):
     return out
 
 
+def _workload_rows(extra):
+    """The solve-workload capture rows (ISSUE 11 satellite):
+    ``solve_4096`` (pivoting Gauss–Jordan on [A | B], k=8 RHS),
+    ``spd_4096`` (the pivot-free assume="spd" path on the KMS SPD
+    fixture), and ``complex64_2048`` — each with the standard robust
+    capture (median-of-3, spread %, variance flag), the executable's
+    own ``cost_analysis`` accounting, and a backward-error residual
+    gate.  GFLOP/s uses the workload-aware n³(1+k/n) convention
+    (``obs/hwcost.baseline_workload_flops``) — NOT 2n³, which would
+    silently inflate a solve headline ~2x against the wrong
+    denominator.  Best-effort: a failing row records an error key and
+    never loses the invert rows."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_jordan.linalg.engine import block_jordan_solve
+    from tpu_jordan.obs import hwcost as _hwcost
+    from tpu_jordan.ops import generate
+    from tpu_jordan.resilience.degrade import solve_gate_threshold
+    from tpu_jordan.resilience.policy import ResiliencePolicy
+    from tpu_jordan.tuning.measure import measure_direct
+
+    rows = (
+        ("solve_4096", 4096, 128, 8, "rand", False, jnp.float32),
+        ("spd_4096", 4096, 128, 8, "kms", True, jnp.float32),
+        ("complex64_2048", 2048, 128, 8, "crand", False, jnp.complex64),
+    )
+    gate_policy = ResiliencePolicy()
+    for label, n, m, k, gen, spd, dtype in rows:
+        try:
+            a = generate(gen, (n, n), dtype)
+            b = generate("crand" if jnp.dtype(dtype).kind == "c"
+                         else "rand", (n, k), dtype, row_offset=n)
+            compiled = jax.jit(
+                lambda aa, bb, _m=m, _spd=spd: block_jordan_solve(
+                    aa, bb, block_size=_m, spd=_spd)
+            ).lower(a, b).compile()
+            cost = _hwcost.executable_cost(compiled)
+            x, sing = compiled(a, b)
+            jax.block_until_ready(x)
+            if bool(sing):
+                raise _Singular(f"{label}: fixture flagged singular")
+            # Backward-error gate (the solve workloads' residual
+            # semantics — resilience/degrade.solve_gate_threshold).
+            r = np.asarray(jnp.matmul(a, x) - b)
+            na = float(jnp.max(jnp.sum(jnp.abs(a), axis=-1)))
+            nx = float(jnp.max(jnp.sum(jnp.abs(x), axis=-1)))
+            nb = float(jnp.max(jnp.sum(jnp.abs(b), axis=-1)))
+            rel = float(np.abs(r).sum(axis=-1).max()) / (na * nx + nb)
+            thr = solve_gate_threshold(gate_policy, n, dtype)
+            assert rel <= thr, (
+                f"{label}: backward error {rel:.2e} > gate {thr:.2e}")
+
+            def call(_c=compiled, _a=a, _b=b):
+                jax.block_until_ready(_c(_a, _b)[0])
+
+            meas = _retry_transient(
+                lambda: measure_direct(call, samples=3, warmup=1))
+            flops = _hwcost.baseline_workload_flops(n, "solve", k=k)
+            gfs = sorted(flops / s / 1e9 for s in meas.accepted)
+            extra[f"{label}_k{k}_gflops"] = round(flops / meas.seconds
+                                                  / 1e9, 1)
+            extra[f"{label}_k{k}_gflops_minmax"] = [round(gfs[0], 1),
+                                                    round(gfs[-1], 1)]
+            extra[f"{label}_k{k}_spread_pct"] = meas.spread_pct
+            if meas.variance_flag:
+                extra[f"{label}_k{k}_variance_flag"] = meas.variance_flag
+            extra[f"{label}_rel_backward_error"] = rel
+            extra[f"{label}_flops_convention"] = "n^3*(1+k/n)"
+            if cost.available and cost.flops:
+                extra[f"{label}_xla_flops"] = cost.flops
+                if meas.seconds > 0:
+                    extra[f"{label}_xla_gflops"] = round(
+                        cost.flops / meas.seconds / 1e9, 1)
+                extra[f"{label}_xla_vs_analytic"] = round(
+                    cost.flops / flops, 3)
+        except Exception as ge:                      # noqa: BLE001
+            extra[f"{label}_error"] = str(ge)[:200]
+
+
 def _dip_guard(extra, candidates):
     """The r04→r05 4096² regression guard (ISSUE 6 satellite; `make
     bench-dip` reproduces just this row).  The best 4096² capture of
@@ -666,6 +747,11 @@ def main(argv=None):
     # gates — the batch north star finally carried by the driver
     # capture.  Best-effort like the sharded row below.
     _batched_rows(extra, baseline_gflops)
+
+    # Solve-workload tiers (ISSUE 11 satellite): solve_4096 (pivoting
+    # [A | B]), spd_4096 (pivot-free fast path on the KMS SPD fixture),
+    # complex64_2048 — best-effort like every non-contract row.
+    _workload_rows(extra)
 
     # Sharded-output tier: swapfree × gather=False (bucketed ppermute),
     # best-effort — a failure records an error key, never loses the

@@ -347,7 +347,7 @@ class TestBackpressureAndShutdown:
             def breaker(self, bucket):
                 return None
 
-            def get_info(self, bucket, batch_cap, block_size):
+            def get_info(self, bucket, batch_cap, block_size, **kw):
                 gate.wait(30)          # the hung device call
                 raise RuntimeError("released")
 

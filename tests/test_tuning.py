@@ -19,20 +19,62 @@ from tpu_jordan.tuning import (CACHE_VERSION, CONFIGS, ENGINES, REGISTRY,
 
 class TestRegistry:
     def test_every_solve_engine_registered_exactly_once(self):
-        """The registry IS a lint: every engine reachable from
-        driver.solve appears exactly once, and the driver/CLI vocabulary
-        derives from it (no string list can drift)."""
+        """The registry IS a lint: every (engine, workload) pair
+        reachable from driver.solve or linalg.solve_system appears
+        exactly once, and the driver/CLI/linalg vocabularies derive
+        from it (no string list can drift).  ISSUE 11 extended the
+        historical per-engine lint to the workload axis: the old lint
+        only covered the invert workload."""
         from tpu_jordan.driver import ENGINES as DRIVER_ENGINES
+        from tpu_jordan.tuning.registry import SOLVE_ENGINES, WORKLOADS
 
-        engines = [c.engine for c in CONFIGS]
-        assert sorted(engines) == sorted(set(engines)), \
-            "an engine is registered twice"
-        assert set(engines) == set(DRIVER_ENGINES) - {"auto"}
+        pairs = [(c.engine, c.workload) for c in CONFIGS]
+        assert sorted(pairs) == sorted(set(pairs)), \
+            "an (engine, workload) pair is registered twice"
+        assert all(c.workload in WORKLOADS for c in CONFIGS)
+        invert = [c.engine for c in CONFIGS if c.workload == "invert"]
+        assert sorted(invert) == sorted(set(invert)), \
+            "an invert engine is registered twice"
+        assert set(invert) == set(DRIVER_ENGINES) - {"auto"}
         assert DRIVER_ENGINES is ENGINES      # same derived object
         assert ENGINES[0] == "auto"
+        # The solve vocabulary derives the same way and never leaks
+        # into the driver/CLI invert vocabulary.
+        solve = {c.engine for c in CONFIGS if c.workload != "invert"}
+        assert set(SOLVE_ENGINES) - {"auto"} == solve
+        assert not (solve & set(DRIVER_ENGINES))
         names = [c.name for c in CONFIGS]
         assert sorted(names) == sorted(set(names))
         assert set(REGISTRY) == set(names)
+
+    def test_solve_workload_candidates_and_ranking(self):
+        """ISSUE 11: solve points rank the solve zoo only; SPD points
+        cost-prefer the pivot-free engine with the pivoting engine as
+        the registered fallback; invert candidacy is untouched."""
+        slv = TunePoint.create(256, 64, jnp.float32, 1, True,
+                               workload="solve")
+        assert {c.name for c in candidates(slv)} == {"solve_aug"}
+        assert select_by_cost(slv).engine == "solve_aug"
+        spd = TunePoint.create(256, 64, jnp.float32, 1, True,
+                               workload="solve_spd")
+        assert {c.name for c in candidates(spd)} == {
+            "solve_spd", "solve_aug_spd"}
+        assert select_by_cost(spd).engine == "solve_spd"
+        # Every solve engine prices strictly below every invert engine
+        # at the same point (the never-materializes-A⁻¹ cost story).
+        inv = TunePoint.create(256, 64, jnp.float32, 1, True)
+        inv_best = min(c.cost(inv) for c in candidates(inv))
+        assert all(c.cost(slv) < inv_best for c in candidates(slv))
+
+    def test_complex_points_route_to_augmented_family(self):
+        """Complex dtypes (ISSUE 11): the invert zoo's only complex
+        candidate is the augmented engine; the solve engines accept
+        complex outright."""
+        cx = TunePoint.create(256, 64, "complex64", 1, True)
+        assert {c.name for c in candidates(cx)} == {"augmented"}
+        cxs = TunePoint.create(256, 64, "complex64", 1, True,
+                               workload="solve")
+        assert {c.name for c in candidates(cxs)} == {"solve_aug"}
 
     def test_legality(self):
         single = TunePoint.create(64, 8, jnp.float32, 1, True)
@@ -208,6 +250,27 @@ class TestPlanKey:
         assert plan_key(base) == "cpu|single|n512|float32|gathered"
         assert plan_key(batched) == "cpu|single|n512|float32|gathered|b32"
         assert base.batch == 1 and batched.batch == 32
+
+    def test_workload_segment(self):
+        """ISSUE 11: solve-workload points key with a trailing
+        ``w<workload>`` segment; invert keys (the default) are
+        byte-identical to the pre-ISSUE-11 format — batched or not —
+        so every pre-existing cache stays valid."""
+        base = TunePoint.create(512, 128, jnp.float32, 1, True,
+                                backend="cpu")
+        assert base.workload == "invert"
+        assert plan_key(base) == "cpu|single|n512|float32|gathered"
+        slv = TunePoint.create(512, 128, jnp.float32, 1, True,
+                               backend="cpu", workload="solve")
+        assert plan_key(slv) == "cpu|single|n512|float32|gathered|wsolve"
+        spd_b = TunePoint.create(512, 128, jnp.float32, 1, True,
+                                 backend="cpu", batch=8,
+                                 workload="solve_spd")
+        assert plan_key(spd_b) == \
+            "cpu|single|n512|float32|gathered|b8|wsolve_spd"
+        with pytest.raises(ValueError, match="workload"):
+            TunePoint.create(512, 128, jnp.float32, 1, True,
+                             workload="nope")
 
 
 class TestPlanCache:

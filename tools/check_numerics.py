@@ -38,45 +38,69 @@ import sys
 
 def check(report: dict) -> tuple[list[str], list[str]]:
     """Returns ``(errs, unexplained)``: structural violations and the
-    exit-2 causality violations, both empty for a valid report."""
+    exit-2 causality violations, both empty for a valid report.
+
+    Two report flavors (ISSUE 11): the historical invert demo carries a
+    full per-superstep TRACE; a ``workload: "solve"`` demo is
+    summary-mode (the [A | B] engine has no per-superstep
+    instrumentation yet — ROADMAP remainder), judged on the κ-free
+    ‖A·X − B‖ backward error, so the superstep checks are skipped and
+    the mode contract flips to "summary".  The causal spike→rung chain
+    is validated identically for both."""
     errs: list[str] = []
     if report.get("metric") != "numerics_demo":
         return ([f"not a numerics_demo report "
                  f"(metric={report.get('metric')!r})"], [])
 
+    workload = report.get("workload", "invert")
     num = report.get("numerics")
     if not isinstance(num, dict):
         errs.append("no numerics record in the report")
         num = {}
-    if num.get("mode") != "trace":
-        errs.append(f"numerics mode is {num.get('mode')!r}, not 'trace'")
-    n = report.get("n", 0)
-    bs = num.get("block_size") or report.get("block_size", 1)
-    nr = -(-n // max(1, min(bs, n))) if n else 0
-    pivots = num.get("pivot_block") or []
-    if len(pivots) != nr:
-        errs.append(f"{len(pivots)} superstep records for Nr={nr}")
-    for t, p in enumerate(pivots):
-        if not (t <= p < nr):
-            errs.append(f"step {t}: pivot block {p} outside the live "
-                        f"window [{t}, {nr})")
-    for fname in ("pivot_inv_norm", "cand_norm_max", "growth",
-                  "residual_est"):
-        vals = num.get(fname) or []
-        if len(vals) != nr:
-            errs.append(f"{fname}: {len(vals)} values for Nr={nr}")
-        if fname != "residual_est":
-            bad = [v for v in vals
-                   if not isinstance(v, (int, float))
-                   or not math.isfinite(v)]
-            if bad:
-                errs.append(f"{fname}: non-finite values {bad[:3]} on a "
-                            f"nonsingular solve")
-    modeled = set(num.get("modeled_fields") or [])
-    if modeled != {"residual_est"}:
-        errs.append(f"modeled_fields {sorted(modeled)} != "
-                    f"['residual_est'] — a modeled number may be "
-                    f"masquerading as measured (or vice versa)")
+    if workload != "invert":
+        if num.get("mode") != "summary":
+            errs.append(f"solve-workload numerics mode is "
+                        f"{num.get('mode')!r}, not 'summary' (the solve "
+                        f"engine has no instrumented trace twin)")
+        if num.get("workload") != workload:
+            errs.append(f"numerics record workload "
+                        f"{num.get('workload')!r} != report workload "
+                        f"{workload!r}")
+        rel = num.get("rel_residual")
+        if not isinstance(rel, (int, float)) or not math.isfinite(rel):
+            errs.append(f"solve rel_residual {rel!r} is not a finite "
+                        f"number (the ‖A·X − B‖ backward error)")
+    else:
+        if num.get("mode") != "trace":
+            errs.append(f"numerics mode is {num.get('mode')!r}, "
+                        f"not 'trace'")
+        n = report.get("n", 0)
+        bs = num.get("block_size") or report.get("block_size", 1)
+        nr = -(-n // max(1, min(bs, n))) if n else 0
+        pivots = num.get("pivot_block") or []
+        if len(pivots) != nr:
+            errs.append(f"{len(pivots)} superstep records for Nr={nr}")
+        for t, p in enumerate(pivots):
+            if not (t <= p < nr):
+                errs.append(f"step {t}: pivot block {p} outside the "
+                            f"live window [{t}, {nr})")
+        for fname in ("pivot_inv_norm", "cand_norm_max", "growth",
+                      "residual_est"):
+            vals = num.get(fname) or []
+            if len(vals) != nr:
+                errs.append(f"{fname}: {len(vals)} values for Nr={nr}")
+            if fname != "residual_est":
+                bad = [v for v in vals
+                       if not isinstance(v, (int, float))
+                       or not math.isfinite(v)]
+                if bad:
+                    errs.append(f"{fname}: non-finite values {bad[:3]} "
+                                f"on a nonsingular solve")
+        modeled = set(num.get("modeled_fields") or [])
+        if modeled != {"residual_est"}:
+            errs.append(f"modeled_fields {sorted(modeled)} != "
+                        f"['residual_est'] — a modeled number may be "
+                        f"masquerading as measured (or vice versa)")
 
     recovery = report.get("recovery") or []
     if not recovery:
@@ -147,12 +171,20 @@ def main(argv) -> int:
             rc = max(rc, 1)
         else:
             num = report["numerics"]
-            print(f"OK {path}: {len(num['pivot_block'])} supersteps "
-                  f"traced (growth {num['growth_factor']:.1f}x, max "
-                  f"pivot criterion {num['max_pivot_inv_norm']:.3g}), "
-                  f"{report['spike_count']} spikes -> "
-                  f"{report['rung_count']} rungs, every rung "
-                  f"causally explained")
+            if report.get("workload", "invert") != "invert":
+                print(f"OK {path}: {report['workload']} workload "
+                      f"(engine {num['engine']}, backward error "
+                      f"{num['rel_residual']:.3g}), "
+                      f"{report['spike_count']} spikes -> "
+                      f"{report['rung_count']} rungs, every rung "
+                      f"causally explained")
+            else:
+                print(f"OK {path}: {len(num['pivot_block'])} supersteps "
+                      f"traced (growth {num['growth_factor']:.1f}x, max "
+                      f"pivot criterion {num['max_pivot_inv_norm']:.3g}"
+                      f"), {report['spike_count']} spikes -> "
+                      f"{report['rung_count']} rungs, every rung "
+                      f"causally explained")
     return rc
 
 

@@ -7,8 +7,9 @@ sharding, ring GEMM, residual verification, matrix generators/file I/O, and
 a CLI — designed for the MXU/ICI, not translated from MPI.
 """
 
-from . import (config, io, models, obs, ops, parallel, resilience, serve,
-               tuning, utils)
+from . import (config, io, linalg, models, obs, ops, parallel,
+               resilience, serve, tuning, utils)
 from .driver import SingularMatrixError, SolveResult, solve
+from .linalg import LstsqResult, SolveSystemResult, lstsq, solve_system
 
 __version__ = "0.1.0"

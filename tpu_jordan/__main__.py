@@ -92,7 +92,11 @@ def _main(argv, state) -> int:
     ap.add_argument("m", type=int, help="pivot block size")
     ap.add_argument("file", nargs="?", default=None, help="matrix file")
     ap.add_argument("--dtype", default="float32",
-                    choices=["float32", "float64", "bfloat16", "float16"])
+                    choices=["float32", "float64", "bfloat16", "float16",
+                             "complex64"],
+                    help="storage dtype (complex64, ISSUE 11: "
+                         "first-class on --workload solve/lstsq and on "
+                         "the augmented invert engine)")
     ap.add_argument("--precision", default="highest",
                     choices=["highest", "high", "default", "mixed"],
                     help="matmul precision for the elimination sweeps; "
@@ -100,11 +104,39 @@ def _main(argv, state) -> int:
                          "Newton-Schulz refinement steps "
                          "(benchmarks/PHASES.md)")
     ap.add_argument("--generator", default="absdiff",
-                    choices=["absdiff", "hilbert", "rand"],
+                    choices=["absdiff", "hilbert", "rand", "kms",
+                             "crand"],
                     help="matrix generator when no file is given "
                          "(hilbert = the reference's -DHILBERT build; "
                          "rand = deterministic uniform [-1,1), the "
-                         "well-conditioned scale fixture)")
+                         "well-conditioned scale fixture; kms = the "
+                         "0.25^|i-j| SPD fixture for --assume spd; "
+                         "crand = deterministic complex uniform, "
+                         "complex dtypes only)")
+    ap.add_argument("--workload", default="invert",
+                    choices=["invert", "solve", "lstsq"],
+                    help="what to compute (ISSUE 11, docs/WORKLOADS.md)"
+                         ": 'invert' = the historical A^-1 path; "
+                         "'solve' = X = A^-1 B by Gauss-Jordan on "
+                         "[A | B] with no inverse ever formed (~half "
+                         "the FLOPs, gated on the k-free ||AX - B|| "
+                         "backward error); 'lstsq' = argmin ||Ax - b|| "
+                         "via the normal equations through the SPD "
+                         "fast path (A is n x n//2, overdetermined "
+                         "2:1).  solve/lstsq run single-device with "
+                         "engine auto (the workload-scoped tuner "
+                         "ladder)")
+    ap.add_argument("--rhs", type=int, default=1, metavar="K",
+                    help="--workload solve/lstsq: number of "
+                         "right-hand-side columns (default 1)")
+    ap.add_argument("--assume", default="general",
+                    choices=["general", "spd"],
+                    help="--workload solve: 'spd' promises A is "
+                         "symmetric/Hermitian positive definite and "
+                         "takes the pivot-free fast path (skips the "
+                         "condition-based pivot probe — pair with "
+                         "--generator kms); unsound on general "
+                         "matrices")
     ap.add_argument("--refine", type=int, default=0,
                     help="Newton-Schulz refinement steps")
     ap.add_argument("--engine", default="auto",
@@ -307,6 +339,8 @@ def _main(argv, state) -> int:
             raise ValueError("--sleep must be non-negative")
         if args.serve_requests < 1 or args.batch_cap < 1:
             raise ValueError("--serve-requests/--batch-cap must be >= 1")
+        if args.rhs < 1:
+            raise ValueError("--rhs must be >= 1")
         if args.max_wait_ms < 0:
             raise ValueError("--max-wait-ms must be non-negative")
     except SystemExit as e:
@@ -364,6 +398,20 @@ def _main(argv, state) -> int:
 
         telemetry = Telemetry()
     try:
+        # Misapplied-flag discipline (the CLI's own contract): workload
+        # flags on the default invert workload are typed usage errors,
+        # never silently dropped — a user asking for the SPD fast path
+        # or a multi-RHS solve must not get invert numbers.
+        if args.workload == "invert" and args.assume != "general":
+            raise UsageError("--assume applies to --workload solve "
+                             "(the pivot-free SPD fast path)")
+        if args.workload == "invert" and args.rhs != 1:
+            raise UsageError("--rhs applies to --workload solve/lstsq")
+        if (args.generator == "crand"
+                and jnp.dtype(args.dtype).kind != "c"):
+            raise UsageError("--generator crand is complex-valued; a "
+                             "real --dtype would silently discard the "
+                             "imaginary part (use --dtype complex64)")
         if args.fleet_demo:
             # Fleet demo: the --chaos-demo restrictions (single device,
             # deterministic fixtures, gathered) and the same 0/1/2
@@ -380,6 +428,9 @@ def _main(argv, state) -> int:
                                  "does not apply (use --serve-demo "
                                  "--numerics summary, or solve with "
                                  "--numerics)")
+            if args.workload != "invert":
+                raise UsageError("--fleet-demo streams invert "
+                                 "requests; --workload does not apply")
             if args.file is not None or args.workers != 1 or not args.gather:
                 raise UsageError(
                     "--fleet-demo runs on a single device (gathered "
@@ -440,7 +491,8 @@ def _main(argv, state) -> int:
             from .obs.numerics import numerics_demo
 
             report = numerics_demo(n=args.n, block_size=args.m,
-                                   seed=args.chaos_seed)
+                                   seed=args.chaos_seed,
+                                   workload=args.workload)
             print(_json.dumps(report))
             if report["silent_rung"]:
                 print(f"unexplained degradation rung(s): "
@@ -474,6 +526,9 @@ def _main(argv, state) -> int:
                 raise UsageError("--chaos-demo engines are single-device "
                                  "(auto resolution); --group does not "
                                  "apply")
+            if args.workload != "invert":
+                raise UsageError("--chaos-demo streams invert "
+                                 "requests; --workload does not apply")
             import json as _json
 
             from .serve import chaos_demo
@@ -512,6 +567,10 @@ def _main(argv, state) -> int:
                 raise UsageError("--serve-demo engines are single-device "
                                  "(auto/inplace/grouped/augmented); "
                                  "--group does not apply")
+            if args.workload != "invert":
+                raise UsageError("--serve-demo streams invert "
+                                 "requests; submit(a, b) is the solve "
+                                 "serve surface (docs/WORKLOADS.md)")
             import json as _json
 
             from .serve import serve_demo
@@ -535,7 +594,73 @@ def _main(argv, state) -> int:
                       f"flagged)", file=sys.stderr)
                 return 2
             return 0
-        if args.batch > 1:
+        if args.workload != "invert":
+            # The solve workloads (ISSUE 11, docs/WORKLOADS.md): the
+            # --batch-style restriction shape — single device, gathered,
+            # engine resolved through the workload-scoped auto ladder
+            # (the --engine invert vocabulary does not apply).
+            if args.serve_demo or args.batch > 1:
+                raise UsageError("--workload solve/lstsq and "
+                                 "--serve-demo/--batch are distinct "
+                                 "modes; pick one (the service accepts "
+                                 "solve requests via submit(a, b))")
+            if args.workers != 1 or not args.gather:
+                raise UsageError("--workload solve/lstsq run on a "
+                                 "single device (gathered output)")
+            if args.engine != "auto" or args.group != 0:
+                raise UsageError("--workload solve/lstsq resolve their "
+                                 "engine through the workload-scoped "
+                                 "auto ladder (optionally --tune/"
+                                 "--plan-cache); --engine/--group name "
+                                 "invert engines and do not apply")
+            if args.refine:
+                raise UsageError("--refine is Newton-Schulz on an "
+                                 "INVERSE; the solve workloads gate on "
+                                 "||AX - B|| and recover via their own "
+                                 "ladder (attach a policy)")
+            from .io import read_matrix_file
+            from .linalg import lstsq as _lstsq
+            from .linalg import solve_system as _solve_system
+            from .ops import generate
+
+            dtype = jnp.dtype(args.dtype)
+            rgen = "crand" if dtype.kind == "c" else "rand"
+            bmat = generate(rgen, (args.n, args.rhs), dtype,
+                            row_offset=args.n)
+            if args.workload == "solve":
+                if args.file is not None:
+                    amat = read_matrix_file(args.file, args.n, dtype)
+                else:
+                    amat = generate(args.generator, (args.n, args.n),
+                                    dtype)
+                result = _solve_system(
+                    amat, bmat, block_size=args.m, dtype=dtype,
+                    assume=args.assume, engine="auto", tune=args.tune,
+                    plan_cache=args.plan_cache, telemetry=telemetry,
+                    numerics=args.numerics, verbose=not args.quiet)
+            else:
+                if args.file is not None:
+                    raise UsageError("--workload lstsq is "
+                                     "generator-input only (the matrix "
+                                     "file format is square)")
+                if args.assume != "general":
+                    raise UsageError("--assume applies to --workload "
+                                     "solve (lstsq's normal equations "
+                                     "are SPD by construction)")
+                cols = max(1, args.n // 2)
+                amat = generate(args.generator, (args.n, cols), dtype)
+                res = _lstsq(amat, bmat, block_size=args.m, dtype=dtype,
+                             engine="auto", tune=args.tune,
+                             plan_cache=args.plan_cache,
+                             telemetry=telemetry, numerics=args.numerics,
+                             verbose=not args.quiet)
+                if res.rank_deficient:
+                    print("rank deficient (singular normal equations)",
+                          file=sys.stderr)
+                    return 2
+                result = res.inner
+                result.plan = res.plan
+        elif args.batch > 1:
             if args.file is not None or args.workers != 1 or not args.gather:
                 raise UsageError(
                     "--batch requires generator input on a single device "

@@ -19,6 +19,11 @@ _EPS_BY_DTYPE = {
     np.dtype(np.float32): 5e-7,
     np.dtype(jnp.bfloat16): 4e-2,
     np.dtype(np.float16): 4e-3,
+    # Complex dtypes (ISSUE 11): pivot magnitudes are |z| (real), so the
+    # threshold is the component dtype's — complex64 arithmetic carries
+    # float32 rounding, complex128 float64.
+    np.dtype(np.complex64): 5e-7,
+    np.dtype(np.complex128): 1e-15,
 }
 
 # Matches MAX_P in the reference (main.cpp:6): pretty-printers show at most

@@ -395,6 +395,25 @@ def _solve_impl(n, block_size, file, generator, dtype, refine, workers,
 
         numerics = resolve_mode(numerics)
     distributed = isinstance(workers, tuple) or workers > 1
+    if jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating):
+        # Complex dtypes (ISSUE 11): single-device, augmented-family
+        # engines only — the [A | I] elimination and the |z|-based
+        # residual machinery are dtype-generic, while the in-place/
+        # grouped/fused engines' layout tricks and the distributed
+        # scatter/collective paths are validated for real dtypes.
+        # engine="auto" lands here too: registry legality routes
+        # complex points to the augmented config.
+        if distributed:
+            raise UsageError(
+                "complex dtypes run single-device (the distributed "
+                "scatter/collective paths are real-dtype); "
+                "workers must be 1")
+        if engine not in ("auto", "augmented"):
+            raise UsageError(
+                f"complex dtype requires engine='augmented' (or "
+                f"'auto'); engine={engine!r} is a real-dtype engine — "
+                f"for X = A⁻¹B use linalg.solve_system, which is "
+                f"complex-native")
     if (tune or plan_cache is not None) and engine != "auto":
         raise UsageError("tune/plan_cache apply to engine='auto' only "
                          "(an explicit engine leaves nothing to tune)")

@@ -1,0 +1,18 @@
+"""tpu_jordan.linalg — the solve workloads as first-class products
+(ISSUE 11): ``solve_system`` (X = A⁻¹B by Gauss–Jordan on [A | B], no
+inverse ever formed), ``lstsq`` (normal equations through the SPD fast
+path), the pivot-free ``assume="spd"`` route, and complex dtypes —
+wired through the tuning registry (workload-scoped engine="auto"), the
+plan cache (``|wsolve`` key segments; invert keys byte-identical), the
+serve buckets (``JordanService.submit(a, b)``), the ‖A·X − B‖ residual
+gate, and the numerics observatory.  docs/WORKLOADS.md is the guide.
+"""
+
+from .api import (LstsqResult, SolveSystemResult, lstsq,
+                  resolve_solve_engine, solve_system)
+from .engine import block_jordan_solve, solve_batch_metrics
+
+__all__ = [
+    "LstsqResult", "SolveSystemResult", "block_jordan_solve", "lstsq",
+    "resolve_solve_engine", "solve_batch_metrics", "solve_system",
+]

@@ -1,0 +1,548 @@
+"""``solve_system`` / ``lstsq`` — the solve workloads as products
+(ISSUE 11 tentpole).
+
+The driver discipline, re-applied to the new workloads end to end:
+AOT compile with the compile/execute split (warm telemetry shows zero
+compile spans), ``timed_blocking`` wall brackets, XLA ``cost_analysis``
+accounting on every executable, engine="auto" through the PR 2 tuner
+ladder at a WORKLOAD-scoped tuning point (plan-cache keys grow a
+``|wsolve`` segment; invert keys stay byte-identical), the κ-free
+‖A·X − B‖ residual gate with a recovery ladder when a policy is
+attached, and numerics="summary" observability — typed results
+(:class:`SolveSystemResult` / :class:`LstsqResult`), never bare arrays.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..config import default_block_size
+from ..obs import hwcost as _hwcost
+from ..obs import metrics as _obs_metrics
+from ..obs import recorder as _recorder
+from ..obs.spans import NULL as _NULL_TEL
+from ..obs.spans import timed_blocking
+from ..ops.norms import inf_norm
+from ..resilience import faults as _faults
+from .engine import block_jordan_solve
+
+ASSUME = ("general", "spd")
+
+_M_WORKLOAD = None
+
+
+def _count_workload(workload: str) -> None:
+    """Per-workload traffic accounting for the direct (non-serve) API
+    (ISSUE 11): one counter, labeled by workload — the serve path's
+    per-lane stats are the other half of the story."""
+    global _M_WORKLOAD
+    if _M_WORKLOAD is None:
+        _M_WORKLOAD = _obs_metrics.counter(
+            "tpu_jordan_workload_requests_total",
+            "direct-API workload executions (solve_system / lstsq), "
+            "labeled by workload")
+    _M_WORKLOAD.inc(workload=workload)
+
+
+@dataclass
+class SolveSystemResult:
+    """One ``solve_system`` outcome — the solve twin of
+    ``driver.SolveResult``.  ``residual`` is ‖A·X − B‖∞ (the right
+    verification for a solve: cheaper and tighter than inverting
+    first); ``rel_residual`` the κ-free normwise backward error it
+    gates on; ``kappa_est`` a lower-bound κ∞(A) estimate
+    (‖A‖∞‖X‖∞/‖B‖∞ — conditioning context with no A⁻¹ formed)."""
+
+    x: jax.Array | None
+    elapsed: float
+    residual: float               # ‖A·X − B‖∞
+    n: int
+    k: int
+    block_size: int
+    gflops: float                 # n³(1+k/n) convention (hwcost)
+    engine: str | None = None
+    workload: str = "solve"
+    singular: bool = False
+    plan: object | None = None    # tuning.Plan when engine="auto"
+    kappa_est: float | None = None
+    recovery: tuple = ()          # ladder rungs (policy= solves only)
+    numerics: object | None = None
+    trace: object | None = None
+    _norm_a: float | None = None
+    _norm_x: float | None = None
+    _norm_b: float | None = None
+
+    @property
+    def rel_residual(self) -> float | None:
+        """‖A·X−B‖∞ / (‖A‖∞‖X‖∞ + ‖B‖∞) — the normwise backward
+        error (Higham ch. 7); ``solve_gate_threshold`` is its gate."""
+        if self._norm_a is None:
+            return None
+        denom = self._norm_a * (self._norm_x or 0.0) + (self._norm_b
+                                                        or 0.0)
+        return self.residual / denom if denom else self.residual
+
+
+@dataclass
+class LstsqResult:
+    """One ``lstsq`` outcome.  ``x`` minimizes ‖A·x − b‖ via the
+    normal equations (AᴴA)x = Aᴴb routed through ``solve_system`` —
+    the Gram matrix is Hermitian PD for a full-column-rank A, so the
+    route IS the SPD fast path.  ``rank_deficient`` surfaces a
+    singular Gram system (the rank-deficiency signal) instead of
+    returning garbage; ``kappa_est`` is the Gram system's conditioning
+    estimate (≈ κ(A)², the known normal-equations squaring)."""
+
+    x: jax.Array | None
+    residual: float               # ‖A·x − b‖∞, the LS objective's norm
+    normal_residual: float        # ‖(AᴴA)x − Aᴴb‖∞ off the inner solve
+    rows: int
+    n: int
+    k: int
+    rank_deficient: bool
+    kappa_est: float | None
+    elapsed: float
+    engine: str | None = None
+    workload: str = "lstsq"
+    plan: object | None = None
+    inner: SolveSystemResult | None = None
+
+
+def resolve_solve_engine(engine: str, assume: str):
+    """Shared engine/assume flag contract for the solve workloads.
+
+    Returns ``(engine, workload)``: "auto" stays "auto" and is resolved
+    through the tuner ladder at the workload-scoped point ("solve", or
+    "solve_spd" under the assume="spd" promise — where cost ranking
+    picks the pivot-free engine, with the pivoting engine registered as
+    the legal fallback).  An explicit engine must belong to the SOLVE
+    vocabulary — the invert zoo is not addressable from here."""
+    from ..driver import UsageError
+    from ..tuning.registry import SOLVE_ENGINES
+
+    if assume not in ASSUME:
+        raise UsageError(f"unknown assume {assume!r}; choose from "
+                         f"{'/'.join(ASSUME)}")
+    workload = "solve_spd" if assume == "spd" else "solve"
+    if engine not in SOLVE_ENGINES:
+        raise UsageError(
+            f"unknown solve engine {engine!r}; choose from "
+            f"{'/'.join(SOLVE_ENGINES)} (the invert engines are not "
+            f"solve engines — use driver.solve for inverses)")
+    if engine == "solve_spd" and assume != "spd":
+        raise UsageError(
+            "engine='solve_spd' is the pivot-free path and requires "
+            "the assume='spd' promise (skipping pivoting on a general "
+            "matrix is unsound)")
+    return engine, workload
+
+
+def _as_2d_rhs(b, dtype, n: int, what: str):
+    from ..driver import UsageError
+
+    b = jnp.asarray(b, dtype)
+    squeezed = b.ndim == 1
+    if squeezed:
+        b = b[:, None]
+    if b.ndim != 2 or b.shape[0] != n or b.shape[1] < 1:
+        raise UsageError(
+            f"{what} must be (n,) or (n, k>=1) with n={n} rows, got "
+            f"shape {tuple(b.shape)}")
+    return b, squeezed
+
+
+def solve_system(
+    a,
+    b,
+    block_size: int | None = None,
+    dtype=None,
+    assume: str = "general",
+    engine: str = "auto",
+    tune: bool = False,
+    plan_cache: str | None = None,
+    telemetry=None,
+    policy=None,
+    numerics: str = "off",
+    check: bool = True,
+    verbose: bool = False,
+) -> SolveSystemResult:
+    """Solve A·X = B — Gauss–Jordan on [A | B], no inverse ever formed.
+
+    The solve twin of ``driver.solve`` (docs/WORKLOADS.md is the
+    product guide): ``engine="auto"`` resolves through the tuner ladder
+    at a ``workload="solve"`` (or ``"solve_spd"`` under
+    ``assume="spd"``) tuning point — plan-cache hit (zero
+    measurements), registry cost ranking, or ``tune=True`` measured
+    tuning; the resolved choice is on ``result.engine``/``plan``.
+    ``assume="spd"`` is the pivot-free fast path (the caller's
+    symmetric/Hermitian-positive-definite promise skips the
+    condition-based pivot probe).  Complex dtypes are first-class:
+    complex64/complex128 A and B flow through the engine, the residual
+    machinery (all norms are |z|-based), and the gate.
+
+    ``policy`` attaches the resilience layer: the κ-free backward-error
+    gate ``rel_residual <= gate_tol·eps·n``
+    (resilience/degrade.solve_gate_threshold) guards the result; a
+    failing gate walks the solve recovery ladder — one iterative-
+    refinement pass through the SAME compiled executable (X += A⁻¹R at
+    working precision), then, under assume="spd", a re-solve on the
+    pivoting engine, then (sub-fp32 storage) an fp32 re-solve — and an
+    exhausted ladder raises ``ResidualGateError``, never a silently
+    wrong X.  ``numerics="summary"`` records the NumericsReport
+    (workload-tagged) with spikes BEFORE any recovery rung; "trace" is
+    an invert-path mode and a typed refusal here.
+
+    ``check=False`` reports a singular system on
+    ``result.singular``/``x=None`` instead of raising — the lstsq
+    route uses it to surface rank deficiency as data."""
+    from ..driver import UsageError
+
+    tel = telemetry if telemetry is not None else _NULL_TEL
+    a = jnp.asarray(a) if dtype is None else jnp.asarray(a, dtype)
+    dtype = a.dtype
+    if a.ndim != 2 or a.shape[0] != a.shape[1]:
+        raise UsageError(f"expected a square (n, n) matrix, got shape "
+                         f"{tuple(a.shape)}")
+    n = int(a.shape[0])
+    b2, squeezed = _as_2d_rhs(b, dtype, n, "b")
+    k = int(b2.shape[1])
+    if block_size is None:
+        block_size = default_block_size(n)
+    m = min(block_size, n)
+
+    from ..obs.numerics import resolve_mode
+    numerics = resolve_mode(numerics)
+    if numerics == "trace":
+        raise UsageError(
+            "numerics='trace' instruments the unrolled INVERT engines; "
+            "the solve workloads support numerics='summary' (the "
+            "per-superstep instrumentation is ROADMAP remainder work)")
+
+    engine, workload = resolve_solve_engine(engine, assume)
+    if (tune or plan_cache is not None) and engine != "auto":
+        raise UsageError("tune/plan_cache apply to engine='auto' only "
+                         "(an explicit engine leaves nothing to tune)")
+    plan = None
+    if engine == "auto":
+        from ..tuning.tuner import auto_select
+
+        engine, _, plan = auto_select(n, m, dtype, 1, True, tune=tune,
+                                      plan_cache=plan_cache,
+                                      telemetry=tel, workload=workload)
+    spd = engine == "solve_spd"
+    _count_workload(workload)
+
+    with tel.span("solve_system", n=n, k=k, workload=workload) as root:
+        result = _solve_system_impl(
+            a, b2, n, k, m, dtype, engine, spd, workload, plan, tel,
+            policy, numerics, check, verbose)
+    if telemetry is not None:
+        result.trace = root
+    if squeezed and result.x is not None:
+        result.x = result.x[:, 0]
+    return result
+
+
+def _residual_stats(a, x, b):
+    """(residual, norm_a, norm_x, norm_b) — eager |z|-based norms, the
+    verification pass (‖A·X − B‖∞ against the CALLER's A and B — the
+    solve analog of the reference's reload semantics: never algorithm
+    state)."""
+    from jax import lax as _lax
+
+    r = jnp.matmul(a, x, precision=_lax.Precision.HIGHEST) - b
+    residual = float(jnp.max(jnp.sum(jnp.abs(r), axis=-1)))
+    return (residual, float(inf_norm(a)), float(inf_norm(x)),
+            float(inf_norm(b)))
+
+
+def _rel(residual: float, norm_a: float, norm_x: float,
+         norm_b: float) -> float:
+    denom = norm_a * norm_x + norm_b
+    return residual / denom if denom else residual
+
+
+def _solve_system_impl(a, b2, n, k, m, dtype, engine, spd, workload,
+                       plan, tel, policy, numerics, check, verbose):
+    from ..driver import SingularMatrixError, _record_compile
+
+    with tel.span("compile", engine=engine, n=n, k=k) as csp:
+        def _compile():
+            _faults.fire("compile")
+            return jax.jit(
+                lambda aa, bb: block_jordan_solve(aa, bb, block_size=m,
+                                                  spd=spd)
+            ).lower(a, b2).compile()
+        compiled = (policy.retry.call(_compile,
+                                      component="solve_system.compile")
+                    if policy is not None else _compile())
+    _record_compile(csp, "solve_system")
+    exe_cost = _hwcost.executable_cost(compiled)
+
+    def _execute():
+        _faults.fire("execute")
+        return timed_blocking(compiled, a, b2, telemetry=tel,
+                              name="execute", engine=engine,
+                              workload=workload)
+
+    (x, singular), esp = (
+        policy.retry.call(_execute, component="solve_system.execute")
+        if policy is not None else _execute())
+    elapsed = esp.duration
+    flops = _hwcost.baseline_workload_flops(n, workload, k=k)
+    if elapsed > 0:
+        esp.attrs["gflops"] = round(flops / elapsed / 1e9, 3)
+    _hwcost.attach_execute_cost(esp, exe_cost, analytical_flops=flops)
+    _obs_metrics.histogram(
+        "tpu_jordan_solve_seconds",
+        "timed elimination wall seconds (the glob_time analog)",
+    ).observe(elapsed, workload=workload)
+    if _faults.corrupt("result_corrupt_nan"):
+        x = x.at[0, 0].set(float("nan"))
+
+    singular = bool(singular)
+    if singular:
+        _obs_metrics.counter("tpu_jordan_singular_total",
+                             "solves/requests flagged singular"
+                             ).inc(component="solve_system")
+        if check:
+            raise SingularMatrixError("singular matrix")
+        return SolveSystemResult(
+            x=None, elapsed=elapsed, residual=float("inf"), n=n, k=k,
+            block_size=m, gflops=0.0, engine=engine, workload=workload,
+            singular=True, plan=plan)
+
+    with tel.span("residual"):
+        residual, norm_a, norm_x, norm_b = _residual_stats(a, x, b2)
+    rel = _rel(residual, norm_a, norm_x, norm_b)
+    kappa_est = (norm_a * norm_x / norm_b) if norm_b else None
+
+    nreport = None
+    if numerics == "summary":
+        # Recorded (and spiked) BEFORE the recovery ladder — a rung
+        # event must be causally preceded by its numerics evidence
+        # (the ISSUE 10 discipline, extended to the solve workloads).
+        nreport = _solve_numerics(n, m, engine, workload, rel,
+                                  kappa_est, norm_a, dtype, policy)
+
+    recovery = ()
+    if policy is not None:
+        x, residual, norm_a, norm_x, norm_b, recovery = _solve_recover(
+            policy, tel, a=a, b=b2, x=x, compiled=compiled,
+            residual=residual, norm_a=norm_a, norm_x=norm_x,
+            norm_b=norm_b, n=n, k=k, m=m, dtype=dtype, spd=spd,
+            workload=workload)
+
+    if verbose:
+        print(f"glob_time: {elapsed:.2f}")
+        print(f"residual: {residual:e}")
+
+    return SolveSystemResult(
+        x=x, elapsed=elapsed, residual=residual, n=n, k=k,
+        block_size=m,
+        gflops=(flops / elapsed / 1e9) if elapsed > 0 else 0.0,
+        engine=engine, workload=workload, singular=False, plan=plan,
+        kappa_est=kappa_est, recovery=recovery, numerics=nreport,
+        _norm_a=norm_a, _norm_x=norm_x, _norm_b=norm_b)
+
+
+def _solve_numerics(n, m, engine, workload, rel, kappa_est, norm_a,
+                    dtype, policy):
+    from ..obs import numerics as _numerics
+
+    report = _numerics.summary_report(
+        n=n, block_size=m, engine=engine, rel_residual=rel,
+        kappa=(kappa_est if kappa_est is not None else 1.0),
+        norm_a=norm_a, dtype=dtype, workload=workload)
+    _numerics.observe(report)
+    thresholds = None
+    if policy is not None:
+        from ..resilience.degrade import solve_gate_threshold
+
+        gd = policy.gate_dtype if policy.gate_dtype is not None else dtype
+        thresholds = _numerics.SpikeThresholds(
+            residual=solve_gate_threshold(policy, n, gd))
+    _numerics.record_spikes(report, thresholds)
+    return report
+
+
+def _solve_recover(policy, tel, *, a, b, x, compiled, residual, norm_a,
+                   norm_x, norm_b, n, k, m, dtype, spd, workload):
+    """The solve recovery ladder (the degrade.py discipline on the
+    ‖A·X − B‖ gate): refine through the SAME compiled executable
+    (X += A⁻¹R — one extra launch, no recompile), then under the SPD
+    promise a re-solve on the pivoting engine (a broken promise is the
+    one failure class refinement cannot fix), then an fp32 re-solve for
+    sub-fp32 storage.  Exhausted = typed ResidualGateError."""
+    from ..resilience.degrade import (_M_GATE_FAIL, _M_RUNGS,
+                                      gate_passes, solve_gate_threshold)
+    from ..resilience.policy import ResidualGateError
+
+    in_dtype = jnp.dtype(dtype)
+    gate_dtype = policy.gate_dtype if policy.gate_dtype is not None \
+        else in_dtype
+    threshold = solve_gate_threshold(policy, n, gate_dtype)
+    rel = _rel(residual, norm_a, norm_x, norm_b)
+    if gate_passes(rel, threshold):
+        return x, residual, norm_a, norm_x, norm_b, ()
+
+    _M_GATE_FAIL.inc()
+    _recorder.record("residual_gate_failure", n=n, workload=workload,
+                     rel_residual=float(rel), threshold=float(threshold))
+    recovery = []
+
+    def _judge(x2, span, rung: str, **extra):
+        res2, na2, nx2, nb2 = _residual_stats(a, x2, b)
+        rel2 = _rel(res2, na2, nx2, nb2)
+        # A refined/re-solved X may be at a higher working precision
+        # than the request; the gate stays at the SLO dtype.
+        passed = gate_passes(rel2, solve_gate_threshold(policy, n,
+                                                        gate_dtype))
+        span.attrs.update(rel_residual=float(rel2), passed=passed)
+        recovery.append({
+            "rung": rung, "rel_residual_before": float(rel),
+            "rel_residual_after": float(rel2), "passed": passed, **extra,
+        })
+        _M_RUNGS.inc(rung=rung, outcome="passed" if passed else "failed")
+        _recorder.record("recovery_rung", rung=rung, workload=workload,
+                         outcome="passed" if passed else "failed",
+                         rel_residual=float(rel2))
+        return passed, (x2, res2, na2, nx2, nb2)
+
+    with tel.span("recover", n=n, workload=workload,
+                  rel_residual=float(rel),
+                  threshold=float(threshold)) as rsp:
+        # ---- rung 1: refinement through the same executable ---------
+        if policy.refine_steps > 0:
+            with tel.span("refine", steps=1) as sp:
+                work = jnp.promote_types(in_dtype, jnp.float32)
+                aw = jnp.asarray(a, work)
+                xw = jnp.asarray(x, work)
+                r = jnp.asarray(b, work) - jnp.matmul(
+                    aw, xw, precision=jax.lax.Precision.HIGHEST)
+                d, dsing = compiled(a, r.astype(dtype))
+                x2 = xw + jnp.asarray(d, work)
+                passed, out = _judge(x2, sp, "refine")
+            if passed and not bool(dsing):
+                rsp.attrs["recovered_by"] = "refine"
+                x2, res2, na2, nx2, nb2 = out
+                return x2, res2, na2, nx2, nb2, tuple(recovery)
+
+        # ---- rung 2: repivot (the SPD promise may be unsound) -------
+        if spd:
+            with tel.span("repivot") as sp:
+                x3, sing3 = jax.jit(
+                    lambda aa, bb: block_jordan_solve(
+                        aa, bb, block_size=m, spd=False)
+                )(a, b)
+                passed, out = _judge(x3, sp, "repivot")
+            if passed and not bool(sing3):
+                rsp.attrs["recovered_by"] = "repivot"
+                x3, res3, na3, nx3, nb3 = out
+                return x3, res3, na3, nx3, nb3, tuple(recovery)
+
+        # ---- rung 3: fp32 re-solve (sub-fp32 storage only) ----------
+        if policy.escalate and in_dtype.itemsize < 4:
+            with tel.span("resolve") as sp:
+                x4, sing4 = jax.jit(
+                    lambda aa, bb: block_jordan_solve(
+                        aa, bb, block_size=m, spd=spd)
+                )(a.astype(jnp.float32), b.astype(jnp.float32))
+                passed, out = _judge(x4, sp, "resolve",
+                                     dtype=str(x4.dtype))
+            if passed and not bool(sing4):
+                rsp.attrs["recovered_by"] = "resolve"
+                x4, res4, na4, nx4, nb4 = out
+                return x4, res4, na4, nx4, nb4, tuple(recovery)
+
+    raise ResidualGateError(
+        f"solve residual gate failed (rel {rel:.3e} > {threshold:.3e}) "
+        f"and the recovery ladder exhausted "
+        f"({' -> '.join(r['rung'] for r in recovery) or 'no rungs'})",
+        recovery=tuple(recovery))
+
+
+def lstsq(
+    a,
+    b,
+    block_size: int | None = None,
+    dtype=None,
+    assume: str = "spd",
+    engine: str = "auto",
+    tune: bool = False,
+    plan_cache: str | None = None,
+    telemetry=None,
+    policy=None,
+    numerics: str = "off",
+    verbose: bool = False,
+) -> LstsqResult:
+    """argmin‖A·x − b‖₂ for a full-column-rank (rows, n) A via the
+    normal equations (AᴴA)x = Aᴴb, routed through :func:`solve_system`.
+
+    The Gram matrix is Hermitian positive definite exactly when A has
+    full column rank, so ``assume="spd"`` (the default) makes lstsq the
+    archetypal consumer of the pivot-free fast path; pass
+    ``assume="general"`` to keep condition-based pivoting on the Gram
+    system.  Rank deficiency is surfaced as DATA, not garbage: a
+    singular Gram elimination sets ``rank_deficient=True`` with
+    ``x=None``, and ``kappa_est`` carries the Gram conditioning
+    (≈ κ(A)² — the normal-equations squaring; MPAX-style LP/QP loops
+    that need better should pre-scale).  Complex dtypes use the
+    conjugate transpose throughout.
+
+    The known trade-off is documented, not hidden: normal equations
+    square the conditioning vs an orthogonal factorization — the eps·n
+    backward-error gate runs on the GRAM system, and ``residual``
+    reports the original ‖A·x − b‖∞ next to it."""
+    from ..driver import UsageError
+
+    a = jnp.asarray(a) if dtype is None else jnp.asarray(a, dtype)
+    dtype = a.dtype
+    if a.ndim != 2:
+        raise UsageError(f"expected a (rows, n) matrix, got shape "
+                         f"{tuple(a.shape)}")
+    rows, n = int(a.shape[0]), int(a.shape[1])
+    if rows < n:
+        raise UsageError(
+            f"lstsq needs rows >= n (got {rows} x {n}); the "
+            f"underdetermined minimum-norm problem is not implemented")
+    b2, squeezed = _as_2d_rhs(b, dtype, rows, "b")
+    k = int(b2.shape[1])
+    _count_workload("lstsq")
+
+    from jax import lax as _lax
+
+    ah = a.conj().T if jnp.issubdtype(dtype, jnp.complexfloating) \
+        else a.T
+    gram = jnp.matmul(ah, a, precision=_lax.Precision.HIGHEST)
+    rhs = jnp.matmul(ah, b2, precision=_lax.Precision.HIGHEST)
+
+    inner = solve_system(
+        gram, rhs, block_size=block_size, assume=assume, engine=engine,
+        tune=tune, plan_cache=plan_cache, telemetry=telemetry,
+        policy=policy, numerics=numerics, check=False, verbose=False)
+
+    if inner.singular:
+        if verbose:
+            print("rank deficient (singular normal equations)")
+        return LstsqResult(
+            x=None, residual=float("inf"),
+            normal_residual=float("inf"), rows=rows, n=n, k=k,
+            rank_deficient=True, kappa_est=None, elapsed=inner.elapsed,
+            engine=inner.engine, plan=inner.plan, inner=inner)
+
+    x = inner.x
+    r = jnp.matmul(a, x, precision=_lax.Precision.HIGHEST) - b2
+    residual = float(jnp.max(jnp.sum(jnp.abs(r), axis=-1)))
+    if verbose:
+        print(f"lstsq residual: {residual:e}")
+    if squeezed:
+        x = x[:, 0]
+    return LstsqResult(
+        x=x, residual=residual, normal_residual=inner.residual,
+        rows=rows, n=n, k=k, rank_deficient=False,
+        kappa_est=inner.kappa_est, elapsed=inner.elapsed,
+        engine=inner.engine, plan=inner.plan, inner=inner)

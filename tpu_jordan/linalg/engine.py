@@ -1,0 +1,242 @@
+"""Augmented-system Gauss–Jordan: X = A⁻¹B with no inverse ever formed
+(ISSUE 11 tentpole core).
+
+Every pre-existing path in the repo materializes an explicit A⁻¹ — yet
+the paper's own verification pass (the residual ‖A·A⁻¹ − I‖∞,
+main.cpp:490-513) is the only consumer that actually *needs* the
+inverse.  Most real traffic wants X = A⁻¹B or argmin‖Ax − b‖, which the
+augmented working set [A | B] (main.cpp:366-370 with B = I specialized
+away) computes directly: run the same condition-pivoted block
+elimination until the A half is the identity, and the B half IS the
+solution — ~n³·(1 + k/n) FLOPs for k right-hand sides against the
+in-place inversion's 2n³, and a better-conditioned answer (the gate
+judges the κ-free normwise backward error ‖AX − B‖, never an eps·n·κ∞
+inverse bound — resilience/degrade.solve_gate_threshold).
+
+Design, relative to ``ops/jordan.py``:
+
+  * **Unrolled supersteps with a statically shrinking live window.**
+    The elimination update at superstep ``t`` only touches columns
+    >= t·m of the A half (the normalized pivot row is exactly zero in
+    every already-eliminated column), so a Python-level loop slices the
+    live columns statically — this is where the half-the-FLOPs saving
+    physically lives; a fori_loop with full-width updates would compute
+    (and throw away) the dead half.  Unrolled-only, capped at the same
+    ``MAX_UNROLL_NR`` as the other unrolled engines.
+  * **Pivot-free SPD fast path** (``spd=True``): the caller's
+    assume="spd" promise means every diagonal block of every Schur
+    complement is invertible (principal submatrices of an SPD matrix
+    are PD), so the condition-based probe over all Nr−t candidates —
+    the paper's most expensive non-GEMM phase (main.cpp:1026-1074) —
+    collapses to ONE diagonal-block inverse per superstep and the row
+    exchange disappears.  The probe arithmetic for that one block is
+    the same ``batched_block_inverse`` element the pivoting path runs,
+    so on inputs where the condition criterion would pick the diagonal
+    anyway (e.g. the diagonally dominant ``kms`` fixture) the two paths
+    are bit-identical — pinned by tests/test_linalg.py.
+  * **Complex dtypes are first-class**: every magnitude comparison
+    (probe thresholds, pivot keys) already runs in the real dtype of
+    ``|z|`` (ops/block_inverse.py), and the sweeps are dtype-generic —
+    complex64/complex128 flow through unchanged.  Sub-fp32 storage
+    computes at fp32 and rounds once at the end, the engines' shared
+    policy.
+
+Padding follows ops/padding.py: A embeds into [[A, 0], [0, I]] and B's
+rows pad with zeros, so X_pad = [[X], [0]] exactly and the returned
+``X[:n]`` is bit-independent of the padding.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..config import default_block_size, eps_for
+from ..ops.block_inverse import batched_block_inverse
+from ..ops.norms import block_inf_norms
+from ..ops.padding import pad_with_identity
+
+
+def _is_complex(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)
+
+
+@partial(jax.jit, static_argnames=("block_size", "eps", "precision",
+                                   "spd"))
+def block_jordan_solve(
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    block_size: int | None = None,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    spd: bool = False,
+):
+    """Solve A·X = B by blocked Gauss–Jordan on [A | B].
+
+    Args:
+      a: (n, n) matrix (real or complex; sub-fp32 storage upcasts).
+      b: (n, k) right-hand sides (promoted to ``a.dtype``).
+      block_size: pivot block size ``m`` (the reference's argv[2] knob).
+      eps: relative singularity threshold; defaults to the dtype's
+        (``config.eps_for`` — complex dtypes use their component
+        dtype's threshold on |z|).
+      precision: matmul precision for the sweeps (HIGHEST default, the
+        engines' measured requirement on badly scaled fixtures).
+      spd: the caller PROMISES A is symmetric/Hermitian positive
+        definite: the condition-based pivot probe and the row exchange
+        are skipped (diagonal pivots are always invertible).  On a
+        non-SPD matrix this promise is unsound — the per-block
+        singularity threshold still catches hard zeros, but a
+        badly-pivoted solve can pass it; the residual gate
+        (linalg/api.py + a policy) is the safety net.
+
+    Returns:
+      (x, singular): X = A⁻¹B (garbage if singular) and the bool flag —
+      the same contract as ``ops.jordan.block_jordan_invert``.
+    """
+    n = a.shape[-1]
+    k = b.shape[-1]
+    in_dtype = a.dtype
+    if jnp.dtype(in_dtype).itemsize < 4:
+        # Sub-fp32 storage: fp32 compute, ONE final rounding (carrying
+        # bf16 elimination state compounds a rounding injection per
+        # superstep — measured divergent on the invert engines; the
+        # same physics applies here).
+        x, singular = block_jordan_solve(
+            a.astype(jnp.float32), b.astype(jnp.float32), block_size,
+            eps, precision, spd)
+        return x.astype(in_dtype), singular
+    dtype = a.dtype
+    b = b.astype(dtype)
+    if block_size is None:
+        block_size = default_block_size(n)
+    m = min(block_size, n)
+    if eps is None:
+        eps = eps_for(dtype)
+
+    Nr = -(-n // m)
+    from ..parallel.sharded_inplace import MAX_UNROLL_NR
+    if Nr > MAX_UNROLL_NR:
+        raise ValueError(
+            f"block_jordan_solve is unrolled-only (the live-column "
+            f"window shrinks statically) and Nr={Nr} exceeds "
+            f"MAX_UNROLL_NR={MAX_UNROLL_NR}; use a larger block_size")
+    N = Nr * m
+    A = pad_with_identity(a, N)
+    X = jnp.zeros((N, k), dtype).at[:n].set(b)
+    singular = jnp.asarray(False)
+    row_blocks = jnp.arange(N) // m
+
+    for t in range(Nr):
+        lo = t * m
+        # --- PIVOT: probe the live candidates of column block t (all
+        # of them for the general path; exactly the diagonal one under
+        # the SPD promise — the same batched element either way, which
+        # is what makes the two paths bit-comparable when the
+        # condition criterion would pick the diagonal anyway).
+        cands = A[lo:, lo:lo + m].reshape(Nr - t, m, m)
+        if spd:
+            invs, sing = batched_block_inverse(cands[:1], None, eps)
+            singular = singular | sing[0]
+            H = invs[0]
+            rows_p_A = A[lo:lo + m, lo:]                  # (m, N - lo)
+            rows_p_X = X[lo:lo + m]
+        else:
+            invs, sing = batched_block_inverse(cands, None, eps)
+            inv_norms = block_inf_norms(invs)             # real dtype
+            valid = ~sing
+            key = jnp.where(valid, inv_norms,
+                            jnp.asarray(jnp.inf, inv_norms.dtype))
+            rel = jnp.argmin(key)                         # window-local
+            singular = singular | ~jnp.any(valid)
+            H = jnp.take(invs, rel, axis=0).astype(dtype)
+            piv_row = lo + rel * m                        # dynamic
+            # Swap-by-copy (main.cpp:1093-1131): lift slot t, write it
+            # into the pivot slot; slot t is rewritten from the
+            # normalized copy below.  Columns < lo of A are unit and
+            # identical across live rows' history — only live columns
+            # (and X) need the exchange.
+            rows_t_A = A[lo:lo + m, lo:]
+            rows_t_X = X[lo:lo + m]
+            rows_p_A = lax.dynamic_slice(A, (piv_row, lo), (m, N - lo))
+            rows_p_X = lax.dynamic_slice(X, (piv_row, 0), (m, k))
+            A = lax.dynamic_update_slice(A, rows_t_A, (piv_row, lo))
+            X = lax.dynamic_update_slice(X, rows_t_X, (piv_row, 0))
+
+        # --- NORMALIZE the pivot row: prow = H @ row, live columns +
+        # the RHS block only (main.cpp:1133-1159).
+        prow_A = jnp.matmul(H, rows_p_A, precision=precision)
+        prow_X = jnp.matmul(H, rows_p_X, precision=precision)
+
+        # --- ELIMINATE: one (N, m) x (m, live + k) MXU matmul pair
+        # (main.cpp:1165-1193) over the statically-live columns — the
+        # already-eliminated columns are provably untouched (prow is
+        # zero there), so they are simply not computed.
+        E = A[:, lo:lo + m]
+        E = jnp.where((row_blocks == t)[:, None],
+                      jnp.asarray(0, dtype), E)
+        A = A.at[:, lo:].add(-jnp.matmul(E, prow_A, precision=precision))
+        X = X - jnp.matmul(E, prow_X, precision=precision)
+        A = A.at[lo:lo + m, lo:].set(prow_A)
+        X = X.at[lo:lo + m].set(prow_X)
+
+    return X[:n], singular
+
+
+def solve_batch_metrics(a, x, b, n_real=None,
+                        precision=lax.Precision.HIGHEST):
+    """Per-element accuracy assembly for BATCHED solves — the solve
+    twin of ``driver.batch_metrics`` (ISSUE 11): one shared
+    implementation for the serve executors, the bench rows, and tests.
+
+    ``a`` (B, N, N), ``x``/``b`` (B, N, K) stacks; returns (B,) arrays:
+    ``residual`` = ‖A·X − B‖∞, the backing norms, the κ-free normwise
+    backward error ``rel_residual`` = residual / (‖A‖∞‖X‖∞ + ‖B‖∞)
+    (resilience/degrade.solve_gate_threshold is its gate), and
+    ``kappa_est`` = ‖A‖∞‖X‖∞/‖B‖∞ — a LOWER-BOUND estimate of κ∞(A)
+    (‖X‖ <= ‖A⁻¹‖‖B‖), the conditioning context without ever forming
+    A⁻¹.
+
+    ``n_real`` masks to each element's real rows under identity
+    padding; pad rows of A·X − B are exactly zero (X and B pad rows are
+    zero and A's pad block is [[0],[I]]), so the residual needs no mask
+    — the norms do (pad rows of A abs-sum to 1)."""
+    r = jnp.matmul(a, x, precision=precision) - b
+    r_sums = jnp.sum(jnp.abs(r), axis=-1)
+    a_sums = jnp.sum(jnp.abs(a), axis=-1)
+    x_sums = jnp.sum(jnp.abs(x), axis=-1)
+    b_sums = jnp.sum(jnp.abs(b), axis=-1)
+    if n_real is not None:
+        N = a.shape[-1]
+        mask = (jnp.arange(N)[None, :]
+                < jnp.asarray(n_real, jnp.int32)[:, None])
+        zero = jnp.asarray(0, r_sums.dtype)
+        r_sums = jnp.where(mask, r_sums, zero)
+        a_sums = jnp.where(mask, a_sums, zero)
+        x_sums = jnp.where(mask, x_sums, zero)
+        b_sums = jnp.where(mask, b_sums, zero)
+    residual = jnp.max(r_sums, axis=-1)
+    norm_a = jnp.max(a_sums, axis=-1)
+    norm_x = jnp.max(x_sums, axis=-1)
+    norm_b = jnp.max(b_sums, axis=-1)
+    denom = norm_a * norm_x + norm_b
+    one = jnp.asarray(1, denom.dtype)
+    return {
+        "residual": residual,
+        "norm_a": norm_a,
+        "norm_x": norm_x,
+        "norm_b": norm_b,
+        # Guarded divisions: an all-masked filler element (n_real=0)
+        # must report 0, never NaN.
+        "rel_residual": jnp.where(denom > 0,
+                                  residual / jnp.where(denom > 0, denom,
+                                                       one),
+                                  residual),
+        "kappa_est": jnp.where(norm_b > 0,
+                               norm_a * norm_x
+                               / jnp.where(norm_b > 0, norm_b, one),
+                               norm_a * norm_x),
+    }

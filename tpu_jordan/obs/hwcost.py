@@ -81,6 +81,40 @@ def gauss_jordan_flops(n: int) -> float:
     return (8.0 / 3.0) * float(n) ** 3
 
 
+def baseline_workload_flops(n: int, workload: str = "invert",
+                            k: int = 1, rows: int | None = None) -> float:
+    """Workload-aware analytic FLOP conventions (ISSUE 11 satellite).
+
+    The invert headline keeps the 2n³ BASELINE convention; the solve
+    workloads get their own honest denominators so achieved-TFLOP/s
+    headlines for the new bench rows are never judged against the wrong
+    count (a solve row divided by 2n³ would read ~2x too fast):
+
+      * ``solve`` / ``solve_spd`` — Gauss–Jordan on [A | B] with the
+        STATICALLY shrinking live-column window: ~n³·(1 + k/n) for k
+        right-hand sides (the ISSUE 11 convention; the SPD path skips
+        probe work, not sweep work, so the convention is shared).
+      * ``lstsq`` — the normal-equations route: one AᴴA Gram product
+        (2·rows·n² for a (rows, n) A), the Aᴴb projection (2·rows·n·k),
+        then the n-sized SPD solve.
+
+    A complex FLOP is counted as one flop like everywhere else in the
+    BASELINE convention (the ~4x real-op cost of complex arithmetic is
+    the hardware's business; ``cost_analysis`` reports the real count
+    next to these on every row)."""
+    n = float(n)
+    k = float(max(1, k))
+    if workload == "invert":
+        return baseline_invert_flops(int(n))
+    if workload in ("solve", "solve_spd"):
+        return n ** 3 * (1.0 + k / n)
+    if workload == "lstsq":
+        r = n if rows is None else float(rows)
+        return (2.0 * r * n * n + 2.0 * r * n * k
+                + n ** 3 * (1.0 + k / n))
+    raise ValueError(f"unknown workload {workload!r}")
+
+
 @dataclass(frozen=True)
 class ExecutableCost:
     """Compiler-reported cost/memory of ONE compiled executable.

@@ -87,19 +87,25 @@ class RequestContext:
     batcher, and executors; closed exactly once with the terminal
     outcome."""
 
-    __slots__ = ("request_id", "n", "bucket", "t_created", "_log",
-                 "_lock", "_events", "_closed")
+    __slots__ = ("request_id", "n", "bucket", "workload", "t_created",
+                 "_log", "_lock", "_events", "_closed")
 
-    def __init__(self, request_id: str, n: int, bucket: int, log):
+    def __init__(self, request_id: str, n: int, bucket: int, log,
+                 workload: str = "invert"):
         self.request_id = request_id
         self.n = int(n)
         self.bucket = int(bucket)
+        #: the request's workload (ISSUE 11): "invert" or "solve" —
+        #: stamped on the submit hop so journey-level traffic splits
+        #: per workload without re-deriving it from lane labels.
+        self.workload = str(workload)
         self._log = log
         self._lock = threading.Lock()
         self._events: list[dict] = []
         self._closed = False
         self.t_created = log.clock()
-        self.event("submit", n=self.n, bucket=self.bucket)
+        self.event("submit", n=self.n, bucket=self.bucket,
+                   workload=self.workload)
 
     def event(self, name: str, **attrs) -> None:
         """One journey hop: appended to this context AND mirrored into
@@ -210,11 +216,12 @@ class JourneyLog:
         self._active: dict[str, RequestContext] = {}
         self._completed: deque = deque(maxlen=int(max_completed))
 
-    def new(self, n: int, bucket: int) -> RequestContext:
+    def new(self, n: int, bucket: int,
+            workload: str = "invert") -> RequestContext:
         with self._lock:
             self._seq += 1
             rid = f"{self.prefix}-{self._seq:05d}"
-        ctx = RequestContext(rid, n, bucket, self)
+        ctx = RequestContext(rid, n, bucket, self, workload=workload)
         with self._lock:
             self._active[rid] = ctx
         return ctx

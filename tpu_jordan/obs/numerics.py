@@ -119,6 +119,12 @@ class NumericsReport:
     kappa: float
     norm_a: float
     eps: float
+    #: which workload produced the record (ISSUE 11): "invert" (the
+    #: historical default — eps·n·κ residual semantics) or a solve
+    #: workload, whose rel_residual is the κ-FREE ‖A·X − B‖ normwise
+    #: backward error and whose ``kappa`` is the ‖A‖‖X‖/‖B‖
+    #: lower-bound estimate (linalg/engine.solve_batch_metrics).
+    workload: str = "invert"
     # trace-only (None in summary mode) -------------------------------
     trace_engine: str | None = None   # the instrumented twin that ran
     pivot_block: list | None = None         # chosen pivot block per step
@@ -159,6 +165,7 @@ class NumericsReport:
         doc = {
             "mode": self.mode, "n": self.n,
             "block_size": self.block_size, "engine": self.engine,
+            "workload": self.workload,
             "rel_residual": self.rel_residual, "kappa": self.kappa,
             "norm_a": self.norm_a, "eps": self.eps,
             "spikes": list(self.spikes),
@@ -189,16 +196,20 @@ def _floats(arr) -> list:
 
 def summary_report(*, n: int, block_size: int, engine: str,
                    rel_residual: float, kappa: float, norm_a: float,
-                   dtype) -> NumericsReport:
+                   dtype, workload: str = "invert") -> NumericsReport:
     """``"summary"`` mode: built ONLY from what the solve already
-    returned — no extra device work, honest on fused executables."""
+    returned — no extra device work, honest on fused executables.
+    ``workload`` tags the record (ISSUE 11) so solve-workload residual
+    semantics (κ-free backward error) are never mistaken for invert's
+    eps·n·κ model."""
     import jax.numpy as jnp
 
     return NumericsReport(
         mode="summary", n=n, block_size=block_size, engine=engine,
         rel_residual=float(rel_residual), kappa=float(kappa),
         norm_a=float(norm_a),
-        eps=float(jnp.finfo(jnp.dtype(dtype)).eps))
+        eps=float(jnp.finfo(jnp.dtype(dtype)).eps),
+        workload=workload)
 
 
 def trace_report(stats: dict, *, n: int, block_size: int, engine: str,
@@ -235,7 +246,10 @@ def observe(report: NumericsReport) -> None:
     series).  Trace-only signals are observed only when measured —
     summary mode never fabricates a pivot/growth sample."""
     if math.isfinite(report.rel_residual):
-        _M_RESIDUAL.observe(report.rel_residual, engine=report.engine)
+        labels = {"engine": report.engine}
+        if report.workload != "invert":
+            labels["workload"] = report.workload
+        _M_RESIDUAL.observe(report.rel_residual, **labels)
     if report.mode != "trace":
         return
     for v in report.pivot_inv_norm or ():
@@ -333,14 +347,23 @@ def ill_conditioned(n: int, kappa_decades: float = 4.5,
 
 
 def numerics_demo(n: int = 16, block_size: int = 8, seed: int = 7,
-                  kappa_decades: float = 4.5) -> dict:
+                  kappa_decades: float = 4.5,
+                  workload: str = "invert") -> dict:
     """The ISSUE 10 acceptance run: a seeded ill-conditioned solve at
     bf16 storage under the default-shaped ladder policy, traced.
 
-    The bf16-grade residual fails the fp32-SLO gate, refine diverges
-    (initial residual > 1 kills Newton-Schulz), and the fp32 re-solve
-    passes — and because ``numerics="trace"`` observed the solve, the
-    flight recorder holds the numerics_spike events BEFORE the
+    ``workload="invert"`` (the historical demo): the bf16-grade
+    residual fails the fp32-SLO gate, refine diverges (initial
+    residual > 1 kills Newton-Schulz), and the fp32 re-solve passes.
+    ``workload="solve"`` (ISSUE 11): the same ill-conditioned fixture
+    through ``linalg.solve_system`` at bf16 storage — the rounded-X
+    backward error fails the fp32-SLO solve gate and ONE refinement
+    pass through the same compiled executable recovers (the solve
+    path is summary-mode: its engine has no per-superstep
+    instrumentation yet, ROADMAP remainder).
+
+    Either way, because numerics observed the solve, the flight
+    recorder holds the numerics_spike events BEFORE the
     residual_gate_failure / recovery_rung events they explain.  Prints
     nothing; returns the one-line-JSON report ``tools/
     check_numerics.py`` validates (exit 2 = a rung with no causally
@@ -348,25 +371,42 @@ def numerics_demo(n: int = 16, block_size: int = 8, seed: int = 7,
     import os
     import tempfile
 
+    import numpy as np
     import jax.numpy as jnp
 
-    from ..driver import solve
-    from ..io import write_matrix_file
     from ..resilience import ResiliencePolicy
     from .spans import Telemetry
 
-    fd, path = tempfile.mkstemp(prefix="tpu_jordan_numerics_",
-                                suffix=".mat")
-    os.close(fd)
-    try:
-        write_matrix_file(path, ill_conditioned(n, kappa_decades, seed))
-        mark = _recorder.RECORDER.total
-        tel = Telemetry()
-        policy = ResiliencePolicy(gate_dtype="float32")
-        res = solve(n, block_size, file=path, dtype=jnp.bfloat16,
-                    policy=policy, telemetry=tel, numerics="trace")
-    finally:
-        os.unlink(path)
+    if workload not in ("invert", "solve"):
+        from ..driver import UsageError
+
+        raise UsageError(f"--numerics-demo supports workload "
+                         f"invert/solve, not {workload!r}")
+    mark = _recorder.RECORDER.total
+    tel = Telemetry()
+    policy = ResiliencePolicy(gate_dtype="float32")
+    if workload == "solve":
+        from ..linalg import solve_system
+
+        a = ill_conditioned(n, kappa_decades, seed)
+        b = np.random.default_rng(seed + 1).standard_normal((n, 2))
+        res = solve_system(a, b, block_size=block_size,
+                           dtype=jnp.bfloat16, policy=policy,
+                           telemetry=tel, numerics="summary")
+    else:
+        from ..driver import solve
+        from ..io import write_matrix_file
+
+        fd, path = tempfile.mkstemp(prefix="tpu_jordan_numerics_",
+                                    suffix=".mat")
+        os.close(fd)
+        try:
+            write_matrix_file(path,
+                              ill_conditioned(n, kappa_decades, seed))
+            res = solve(n, block_size, file=path, dtype=jnp.bfloat16,
+                        policy=policy, telemetry=tel, numerics="trace")
+        finally:
+            os.unlink(path)
 
     blackbox = _recorder.RECORDER.dump(
         events=_recorder.RECORDER.since(mark))
@@ -380,6 +420,7 @@ def numerics_demo(n: int = 16, block_size: int = 8, seed: int = 7,
     rep = res.numerics
     return {
         "metric": "numerics_demo",
+        "workload": workload,
         "n": n, "block_size": block_size, "seed": seed,
         "kappa_decades": kappa_decades,
         "engine": res.engine,

@@ -53,8 +53,14 @@ def gauss_jordan_inverse(
         eps = eps_for(dtype)
     if scale_norm is None:
         scale_norm = inf_norm(a)
-    scale_norm = jnp.asarray(scale_norm, dtype)
-    thresh = jnp.asarray(eps, dtype) * scale_norm
+    # Magnitude comparisons run in the REAL dtype (ISSUE 11 complex
+    # support: |z| of a complex64 block is float32, and mixing it with a
+    # complex threshold would promote the argmax key to complex).  For
+    # real dtypes ‖a‖∞ is already non-negative, so abs() is the identity
+    # and every comparison below is value-identical to the pre-complex
+    # code.
+    scale_abs = jnp.abs(jnp.asarray(scale_norm, dtype))
+    thresh = jnp.asarray(eps, scale_abs.dtype) * scale_abs
 
     idx = jnp.arange(m)
     w = jnp.concatenate([a, jnp.eye(m, dtype=dtype)], axis=1)  # (m, 2m)
@@ -63,7 +69,8 @@ def gauss_jordan_inverse(
         w, singular = carry
         col = lax.dynamic_slice_in_dim(w, k, 1, axis=1)[:, 0]       # (m,)
         # column partial pivot: argmax |w[i,k]| over i >= k (main.cpp:756-763)
-        cand = jnp.where(idx >= k, jnp.abs(col), jnp.asarray(-1.0, dtype))
+        mags = jnp.abs(col)                                    # real dtype
+        cand = jnp.where(idx >= k, mags, jnp.asarray(-1.0, mags.dtype))
         r = jnp.argmax(cand)
         # swap rows k and r (masked select; main.cpp:765-781)
         row_k = jnp.take(w, k, axis=0)
@@ -77,7 +84,7 @@ def gauss_jordan_inverse(
         singular = (
             singular
             | (jnp.abs(piv) < thresh)
-            | (jnp.abs(scale_norm) < jnp.asarray(eps, dtype))
+            | (scale_abs < jnp.asarray(eps, scale_abs.dtype))
         )
         safe_piv = jnp.where(piv == 0, jnp.asarray(1, dtype), piv)
         prow = jnp.take(w, k, axis=0) / safe_piv                    # (2m,)

@@ -59,11 +59,38 @@ def rand_uniform(i, j):
     return x.astype(jnp.float32) * jnp.float32(2.0 / 4294967296.0) - 1.0
 
 
+def kms(i, j):
+    """Kac–Murdock–Szegő matrix ``rho^|i-j|`` with rho = 0.25.
+
+    Symmetric positive definite for |rho| < 1 and strongly diagonally
+    dominant at rho = 0.25 (off-diagonal row mass < 2/3 of the unit
+    diagonal) — the seeded SPD fixture for the pivot-free solve fast
+    path (ISSUE 11): the condition-based probe provably prefers the
+    diagonal block, so the pivoting engine and the ``assume="spd"``
+    path follow identical arithmetic and bit-match.
+    """
+    return jnp.power(jnp.float32(0.25),
+                     jnp.abs(i - j).astype(jnp.float32))
+
+
+def crand(i, j):
+    """Deterministic complex uniform: ``rand_uniform`` hashes for the
+    real part, an index-shifted hash stream for the imaginary part
+    (complex-dtype workloads, ISSUE 11).  Use with complex dtypes only —
+    casting the result to a real dtype discards the imaginary part.
+    """
+    re = rand_uniform(i, j)
+    im = rand_uniform(i + jnp.int32(0x5BF0), j + jnp.int32(0x2C1B))
+    return lax.complex(re, im)
+
+
 GENERATORS: dict[str, GeneratorFn] = {
     "absdiff": abs_diff,
     "hilbert": hilbert,
     "identity": identity,
     "rand": rand_uniform,
+    "kms": kms,
+    "crand": crand,
 }
 
 
@@ -86,4 +113,14 @@ def generate(
     h, w = shape
     ii = row_offset + lax.broadcasted_iota(jnp.int32, (h, w), 0)
     jj = col_offset + lax.broadcasted_iota(jnp.int32, (h, w), 1)
-    return fn(ii, jj).astype(dtype)
+    vals = fn(ii, jj)
+    if (jnp.issubdtype(vals.dtype, jnp.complexfloating)
+            and not jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)):
+        # astype(complex -> real) silently discards the imaginary part
+        # (no warning under jit) — a complex generator cast to a real
+        # dtype is a caller bug, never a half-real fixture (ISSUE 11).
+        raise ValueError(
+            f"complex-valued generator cast to real dtype "
+            f"{jnp.dtype(dtype).name} would discard the imaginary "
+            f"part; request a complex dtype")
+    return vals.astype(dtype)

@@ -95,7 +95,9 @@ def _jordan_step(t, carry, *, Nr: int, m: int, eps: float, precision,
     # candidates in rows >= t — the composite-key argmin that replaces the
     # custom MPI reduction (pivot_op, main.cpp:729-744, 1074).
     valid = (jnp.arange(Nr) >= t) & ~sing
-    key = jnp.where(valid, inv_norms, jnp.asarray(jnp.inf, probe_dtype))
+    # inf in the NORMS' dtype (real even for complex W, ISSUE 11): the
+    # argmin key must never promote to a complex dtype.
+    key = jnp.where(valid, inv_norms, jnp.asarray(jnp.inf, inv_norms.dtype))
     piv = jnp.argmin(key)
     singular = singular | ~jnp.any(valid)                       # main.cpp:1075-1083
     H = jnp.take(invs, piv, axis=0).astype(dtype)               # pivot block inverse

@@ -89,6 +89,24 @@ def gate_passes(rel_residual: float, threshold: float) -> bool:
     return bool(rel_residual <= threshold) and math.isfinite(rel_residual)
 
 
+def solve_gate_threshold(policy: ResiliencePolicy, n: int, dtype) -> float:
+    """The residual gate for the SOLVE workloads (ISSUE 11): judged on
+    the normwise backward error
+
+        ‖A·X − B‖∞ / (‖A‖∞·‖X‖∞ + ‖B‖∞)  <=  gate_tol · eps · n
+
+    which is κ-FREE — a backward-stable solve has a small backward
+    error whatever the conditioning, so the gate is both cheaper (no
+    A⁻¹ to norm) and tighter than the invert gate's eps·n·κ∞ model:
+    exactly why serving X = A⁻¹B beats inverting first even on the
+    verification bill.  Same 0.5 non-vacuousness ceiling as
+    :func:`gate_threshold` (a rel residual ≥ 0.5 is no solution at
+    all), same ``gate_dtype`` SLO override."""
+    eps = gate_eps(policy.gate_dtype if policy.gate_dtype is not None
+                   else dtype)
+    return min(policy.gate_tol * eps * max(1, n), 0.5)
+
+
 def maybe_recover(policy: ResiliencePolicy, tel, *, a_fresh, inv,
                   residual: float, norm_a: float, kappa: float, n: int,
                   dtype, resolve):

@@ -73,9 +73,16 @@ class ServiceClosedError(RuntimeError):
 
 @dataclass
 class InvertResult:
-    """What a request's future resolves to: the unpadded inverse plus
+    """What a request's future resolves to: the unpadded result plus
     the per-element accuracy/diagnostics the compiled batch program
-    assembled (``driver.batch_metrics``)."""
+    assembled (``driver.batch_metrics`` /
+    ``linalg.solve_batch_metrics``).
+
+    ISSUE 11: solve requests (``submit(a, b)``) resolve to the same
+    type with ``workload="solve"``, ``solution`` = the (n, k) X and
+    ``inverse=None`` — no inverse is ever formed for them;
+    ``rel_residual`` is then the κ-free ‖A·X − B‖ backward error and
+    ``kappa`` the ‖A‖‖X‖/‖B‖ conditioning estimate."""
 
     inverse: object           # (n, n) device array, padding sliced off
     n: int
@@ -86,6 +93,8 @@ class InvertResult:
     queue_seconds: float      # submit -> dispatch
     execute_seconds: float    # the batch execution this request rode
     batch_occupancy: int      # real requests in that batch
+    workload: str = "invert"  # "invert" | "solve" (ISSUE 11)
+    solution: object = None   # (n, k) X for solve requests
 
 
 @dataclass
@@ -97,12 +106,37 @@ class _Request:
     future: Future
     t_deadline: float | None = None   # absolute perf_counter deadline
     ctx: object = None        # obs.journey.RequestContext (ISSUE 8)
+    workload: str = "invert"  # lane workload (ISSUE 11)
+    padded_b: np.ndarray = None       # (bucket_n, rhs) zero-padded RHS
+    rhs: int = 0              # RHS-width bucket of the lane
+    k: int = 0                # this request's REAL RHS width
 
     def hop(self, event: str, **attrs) -> None:
         """One journey event for this rider (no-op without a context —
         the batcher never requires journeys to function)."""
         if self.ctx is not None:
             self.ctx.event(event, **attrs)
+
+
+def _lane(workload: str, bucket_n: int, rhs: int = 0):
+    """The queue/breaker key for a request class: invert lanes keep the
+    historical bare int (every pre-ISSUE-11 key, stat label, and
+    breaker name is byte-identical); solve lanes are
+    ("solve", bucket_n, rhs) tuples."""
+    return bucket_n if workload == "invert" else (workload, bucket_n,
+                                                  int(rhs))
+
+
+def _lane_label(lane):
+    """The stats/metrics label of a lane: the bare bucket int for
+    invert, ``"solve:<bucket>:k<rhs>"`` for solve lanes."""
+    if isinstance(lane, int):
+        return lane
+    return f"{lane[0]}:{lane[1]}:k{lane[2]}"
+
+
+def _lane_workload(lane) -> str:
+    return "invert" if isinstance(lane, int) else lane[0]
 
 
 class MicroBatcher:
@@ -168,30 +202,35 @@ class MicroBatcher:
     # ---- caller side -------------------------------------------------
 
     def submit(self, padded: np.ndarray, n: int, bucket_n: int,
-               deadline_s: float | None = None, ctx=None) -> Future:
-        br = self.executors.breaker(bucket_n) \
+               deadline_s: float | None = None, ctx=None,
+               workload: str = "invert", padded_b: np.ndarray = None,
+               rhs: int = 0, k: int = 0) -> Future:
+        lane = _lane(workload, bucket_n, rhs)
+        label = _lane_label(lane)
+        br = self.executors.breaker(label) \
             if self.policy is not None else None
         if br is not None and not br.allow():
             # Typed fast-fail instead of queueing doomed work: the
             # bucket's executor has failed K consecutive times; a
             # half-open probe is admitted once the cooldown elapses.
-            self.stats.rejected(bucket_n)
+            self.stats.rejected(label, workload=workload)
             if ctx is not None:
                 ctx.event("breaker_fast_fail", bucket=bucket_n)
             raise CircuitOpenError(
-                f"bucket {bucket_n} circuit open after repeated executor "
+                f"bucket {label} circuit open after repeated executor "
                 f"failures — retry after the cooldown")
         now = time.perf_counter()
         req = _Request(padded, n, bucket_n, now, Future(),
                        t_deadline=(None if deadline_s is None
                                    else now + float(deadline_s)),
-                       ctx=ctx)
+                       ctx=ctx, workload=workload, padded_b=padded_b,
+                       rhs=int(rhs), k=int(k))
         with self._cv:
             if self._closing:
                 req.hop("reject", reason="closed")
                 raise ServiceClosedError("service is closed")
             if self._queued >= self.max_queue:
-                self.stats.rejected(bucket_n)
+                self.stats.rejected(label, workload=workload)
                 req.hop("reject", reason="overload", queued=self._queued)
                 raise ServiceOverloadedError(
                     f"request queue full ({self.max_queue} pending) — "
@@ -201,9 +240,9 @@ class MicroBatcher:
             # race ahead of "enqueue" in the journey.  Lock order is
             # _cv -> ctx -> recorder, never reversed.
             req.hop("enqueue", bucket=bucket_n, queued=self._queued + 1)
-            self._queues.setdefault(bucket_n, deque()).append(req)
+            self._queues.setdefault(lane, deque()).append(req)
             self._queued += 1
-            self.stats.request(bucket_n)
+            self.stats.request(label, workload=workload)
             self._cv.notify()
         return req.future
 
@@ -387,28 +426,55 @@ class MicroBatcher:
         the compiled batch program already returned, the honest summary
         discipline for fused executables — into the numerics
         histograms, spiking the flight recorder on expected-error
-        (eps·n·κ) exceedances.  Never runs at the "off" default."""
+        (eps·n·κ) exceedances.  Never runs at the "off" default.
+        Solve-lane riders (ISSUE 11) report workload-tagged: their rel
+        is the κ-free ‖A·X − B‖ backward error, so the spike threshold
+        is the solve gate's eps·n form, not eps·n·κ."""
         from ..obs import numerics as _numerics
 
+        wl = ex.key.workload
         for i, req in enumerate(batch):
             if bool(sing[i]):
                 continue
+            thresholds = None
+            if wl != "invert":
+                # Solve riders spike on the SAME κ-free backward-error
+                # gate the policy would judge them by (the service's
+                # attached policy — DEFAULT_POLICY's shape when
+                # resilience is off), at the rider's REAL n: a gate
+                # failure can never outrun its spike, and the serve
+                # path agrees with the direct API on identical inputs.
+                from ..resilience.degrade import solve_gate_threshold
+                from ..resilience.policy import DEFAULT_POLICY
+
+                pol = self.policy if self.policy is not None \
+                    else DEFAULT_POLICY
+                thresholds = _numerics.SpikeThresholds(
+                    residual=solve_gate_threshold(pol, req.n,
+                                                  ex.key.dtype))
             rep = _numerics.summary_report(
                 n=req.n, block_size=ex.block_size,
                 engine=ex.key.engine, rel_residual=float(rel[i]),
-                kappa=float(kappa[i]), norm_a=0.0, dtype=ex.key.dtype)
+                kappa=float(kappa[i]), norm_a=0.0, dtype=ex.key.dtype,
+                workload=wl)
             _numerics.observe(rep)
-            _numerics.record_spikes(rep)
+            _numerics.record_spikes(rep, thresholds)
 
-    def _execute(self, bucket: int, batch: list, t_dispatch: float) -> None:
+    def _execute(self, lane, batch: list, t_dispatch: float) -> None:
         import jax.numpy as jnp
 
-        br = self.executors.breaker(bucket) \
+        bucket = lane if isinstance(lane, int) else lane[1]
+        workload = _lane_workload(lane)
+        label = _lane_label(lane)
+        br = self.executors.breaker(label) \
             if self.policy is not None else None
         try:
             _faults.fire("dispatch")
+            rhs = 0 if isinstance(lane, int) else lane[2]
             ex, source = self.executors.get_info(bucket, self.batch_cap,
-                                                 self.block_size)
+                                                 self.block_size,
+                                                 workload=workload,
+                                                 rhs=rhs)
             for req in batch:
                 # Compile-vs-cache-hit is a per-request journey fact
                 # (ISSUE 8): "my request paid a compile" is exactly the
@@ -423,14 +489,27 @@ class MicroBatcher:
             for i, req in enumerate(batch):
                 stacked[i] = req.padded
                 n_real[i] = req.n
+            if workload == "invert":
+                args = (jnp.asarray(stacked), jnp.asarray(n_real))
+            else:
+                # Solve lane (ISSUE 11): the zero-padded RHS stack rides
+                # next to the identity-padded A stack; filler slots keep
+                # an all-zero B, whose solution against the identity
+                # filler A is exactly zero — inert like the invert
+                # lanes' identity filler.
+                stacked_b = np.zeros((cap, bucket, rhs), dtype)
+                for i, req in enumerate(batch):
+                    stacked_b[i] = req.padded_b
+                args = (jnp.asarray(stacked), jnp.asarray(stacked_b),
+                        jnp.asarray(n_real))
             from ..obs.spans import timed_blocking
 
             def run_once():
                 _faults.fire("execute")
                 out, esp = timed_blocking(
-                    ex.run, jnp.asarray(stacked), jnp.asarray(n_real),
+                    ex.run, *args,
                     telemetry=self._tel, name="execute", bucket=bucket,
-                    occupancy=len(batch))
+                    occupancy=len(batch), workload=workload)
                 # Achieved-vs-analytical attrs off the executable's own
                 # accounting (ISSUE 10 hwcost; read once at compile,
                 # attached per span — dict writes, no device work).
@@ -438,7 +517,8 @@ class MicroBatcher:
 
                 _hwcost.attach_execute_cost(
                     esp, ex.cost,
-                    analytical_flops=2.0 * float(bucket) ** 3 * cap)
+                    analytical_flops=_hwcost.baseline_workload_flops(
+                        bucket, workload, k=rhs) * cap)
                 inv, sing, kappa, rel = out
                 sing = np.asarray(sing)
                 kappa = np.asarray(kappa)
@@ -500,7 +580,7 @@ class MicroBatcher:
                 "tpu_jordan_serve_batch_failures_total",
                 "dispatched batches that terminally failed (after any "
                 "retries) and fanned a typed error to their riders",
-            ).inc(bucket=bucket)
+            ).inc(bucket=label)
             if br is not None:
                 br.record_failure()
             for req in batch:
@@ -512,9 +592,10 @@ class MicroBatcher:
             br.record_success()
 
         queue_waits = [t_dispatch - req.t_enqueue for req in batch]
-        self.stats.batch(bucket, occupancy=len(batch),
+        self.stats.batch(label, occupancy=len(batch),
                          exec_seconds=exec_s, queue_seconds=queue_waits,
-                         singular=int(sing[:len(batch)].sum()))
+                         singular=int(sing[:len(batch)].sum()),
+                         workload=workload)
         if self.numerics == "summary":
             self._observe_numerics(batch, ex, sing, kappa, rel)
         # Deadline, phase 2 (execute): a batch that finished past a
@@ -527,7 +608,8 @@ class MicroBatcher:
             req.hop("served", singular=bool(sing[i]),
                     seconds=round(exec_s, 6))
             req.future.set_result(InvertResult(
-                inverse=inv[i, :req.n, :req.n],
+                inverse=(inv[i, :req.n, :req.n]
+                         if workload == "invert" else None),
                 n=req.n,
                 bucket_n=bucket,
                 singular=bool(sing[i]),
@@ -536,4 +618,7 @@ class MicroBatcher:
                 queue_seconds=queue_waits[i],
                 execute_seconds=exec_s,
                 batch_occupancy=len(batch),
+                workload=workload,
+                solution=(inv[i, :req.n, :req.k]
+                          if workload != "invert" else None),
             ))

@@ -55,19 +55,37 @@ def bucket_for(n: int, floor: int = MIN_BUCKET_N) -> int:
     return max(floor, n_bucket(n))
 
 
+def rhs_bucket_for(k: int) -> int:
+    """Round a solve request's RHS width up to its lane bucket: next
+    power of two, floor 1 (ISSUE 11) — the ONE rounding both
+    ``JordanService.submit`` and ``warmup(solve_shapes=)`` use, so a
+    warmed lane is always the lane a request lands on.  Exact by zero
+    padding: pad columns solve to exactly zero and are sliced off."""
+    if k <= 0:
+        raise ValueError(f"rhs width must be positive, got {k}")
+    return 1 << max(0, int(k - 1).bit_length())
+
+
 @dataclass(frozen=True)
 class ExecutorKey:
     """The executable cache key — the coordinates a compiled serving
     program depends on (ISSUE 3 tentpole): shape bucket, batch capacity,
     dtype, the RESOLVED engine (never "auto"), and the pivot block size
     (part of the key so a direct cache user requesting a different m
-    can never be handed a stale-m executable from a cache hit)."""
+    can never be handed a stale-m executable from a cache hit).
+
+    ``workload``/``rhs`` (ISSUE 11): solve lanes compile their own
+    executables per (workload, bucket_n, dtype, rhs-bucket) — an invert
+    key keeps the historical defaults, so every pre-existing key (and
+    the fleet's shared-store sharing semantics) is unchanged."""
 
     bucket_n: int
     batch_cap: int
     dtype: str
     engine: str
     block_size: int
+    workload: str = "invert"
+    rhs: int = 0                  # RHS-width bucket (solve lanes only)
 
 
 class BucketExecutor:
@@ -102,6 +120,16 @@ class BucketExecutor:
 
         key = self.key
         m = key.block_size
+        if key.workload != "invert":
+            return self._build_solve()
+        if jnp.dtype(key.dtype).kind == "c":
+            from ..driver import UsageError
+
+            raise UsageError(
+                "complex dtypes are served on the solve lanes "
+                "(submit(a, b) — linalg.block_jordan_solve is "
+                "complex-native); the batched invert engines are "
+                "real-dtype")
         if key.engine in ("inplace", "auto"):
             # The batched dispatch (ops/batched.py): the dedicated
             # batch-first small-n engine in its validated regime
@@ -138,8 +166,44 @@ class BucketExecutor:
             jax.ShapeDtypeStruct((key.batch_cap,), jnp.int32),
         ).compile()
 
-    def run(self, stacked, n_real):
-        return self._compiled(stacked, n_real)
+    def _build_solve(self):
+        """The solve-lane executable (ISSUE 11): one vmapped
+        ``linalg.block_jordan_solve`` over the identity-padded A stack
+        and the zero-padded B stack, with the per-element ‖A·X − B‖
+        accuracy assembly (``linalg.solve_batch_metrics``) in the same
+        launch — the exact shape of the invert build, solve semantics."""
+        from ..linalg.engine import block_jordan_solve, solve_batch_metrics
+
+        key = self.key
+        m = key.block_size
+        spd = key.engine == "solve_spd"
+        if key.engine not in ("solve_aug", "solve_spd"):
+            from ..driver import UsageError
+
+            raise UsageError(
+                f"engine {key.engine!r} is not a solve-lane engine "
+                f"(solve_aug/solve_spd)")
+
+        def fn(a, b, n_real):
+            x, sing = jax.vmap(
+                lambda aa, bb: block_jordan_solve(aa, bb, block_size=m,
+                                                  spd=spd))(a, b)
+            met = solve_batch_metrics(a, x, b, n_real)
+            return x, sing, met["kappa_est"], met["rel_residual"]
+
+        dtype = jnp.dtype(key.dtype)
+        cap, N, K = key.batch_cap, key.bucket_n, key.rhs
+        return jax.jit(fn).lower(
+            jax.ShapeDtypeStruct((cap, N, N), dtype),
+            jax.ShapeDtypeStruct((cap, N, K), dtype),
+            jax.ShapeDtypeStruct((cap,), jnp.int32),
+        ).compile()
+
+    def run(self, *args):
+        """Invert lanes: ``run(stacked, n_real)``; solve lanes:
+        ``run(stacked_a, stacked_b, n_real)`` — either way the lane's
+        compiled signature, returning (result, singular, kappa, rel)."""
+        return self._compiled(*args)
 
 
 class ExecutorStore:
@@ -291,45 +355,61 @@ class ExecutorCache:
         """Plan-cache measurement counter (the warm-server pin)."""
         return self.tuner.measurements
 
-    def _resolve(self, bucket_n: int, batch_cap: int, block_size: int):
+    def _resolve(self, bucket_n: int, batch_cap: int, block_size: int,
+                 workload: str = "invert"):
         """(engine, plan) for one bucket: the tuner ladder for "auto"
-        (batched plan-cache key — zero measurements on the cost-only
-        ladder, counter-pinned), the explicit engine otherwise."""
-        if self.engine != "auto":
+        (batched, workload-scoped plan-cache key — zero measurements on
+        the cost-only ladder, counter-pinned), the explicit engine
+        otherwise.  A service built with an explicit INVERT engine
+        still resolves its solve lanes through the ladder — the invert
+        zoo is not a solve vocabulary (tuning/registry.py)."""
+        if self.engine != "auto" and workload == "invert":
             return self.engine, None
         point = TunePoint.create(bucket_n, block_size, self.dtype,
-                                 workers=1, gather=True, batch=batch_cap)
+                                 workers=1, gather=True, batch=batch_cap,
+                                 workload=workload)
         plan = self.tuner.select(point)
         return plan.engine, plan
 
     def get(self, bucket_n: int, batch_cap: int,
-            block_size: int | None = None) -> BucketExecutor:
+            block_size: int | None = None, workload: str = "invert",
+            rhs: int = 0) -> BucketExecutor:
         """The executor for a bucket — compiled on first use, a cache
         hit forever after (ISSUE 3: a warm server performs zero
         recompiles; the per-bucket ``compiles`` counter is the pin)."""
-        return self.get_info(bucket_n, batch_cap, block_size)[0]
+        return self.get_info(bucket_n, batch_cap, block_size,
+                             workload=workload, rhs=rhs)[0]
 
     def get_info(self, bucket_n: int, batch_cap: int,
-                 block_size: int | None = None
+                 block_size: int | None = None,
+                 workload: str = "invert", rhs: int = 0
                  ) -> tuple[BucketExecutor, str]:
         """``get`` plus HOW the executor was obtained — ``"cached"``
         (this cache's own view), ``"shared_store"`` (another replica
         compiled it), or ``"compiled"`` (this call built it).  The
         dispatcher stamps the source on each rider's journey (ISSUE 8:
         compile-vs-cache-hit is a per-request fact, not just a
-        counter)."""
+        counter).  ``workload``/``rhs`` select a solve lane (ISSUE 11)."""
         m = min(block_size if block_size is not None
                 else default_block_size(bucket_n), bucket_n)
         with self._lock:
-            rkey = (bucket_n, batch_cap, m)
+            rkey = (bucket_n, batch_cap, m, workload)
             if rkey not in self._resolved:
-                self._resolved[rkey] = self._resolve(bucket_n, batch_cap, m)
+                self._resolved[rkey] = self._resolve(bucket_n, batch_cap,
+                                                     m, workload)
             engine, plan = self._resolved[rkey]
-            key = ExecutorKey(bucket_n, batch_cap, self.dtype, engine, m)
+            key = ExecutorKey(bucket_n, batch_cap, self.dtype, engine, m,
+                              workload, rhs)
             ex = self._executors.get(key)
+        # Stats are keyed by the LANE label (ISSUE 11): invert lanes
+        # keep the historical bare bucket int; solve lanes get their
+        # own "solve:<bucket>:k<rhs>" row so a solve compile can never
+        # masquerade as an invert bucket's.
+        label = (bucket_n if workload == "invert"
+                 else f"{workload}:{bucket_n}:k{rhs}")
         if ex is not None:
             if self.stats is not None:
-                self.stats.cache_hit(bucket_n)
+                self.stats.cache_hit(label, workload=workload)
             return ex, "cached"
 
         def build():
@@ -362,14 +442,14 @@ class ExecutorCache:
             self._executors[key] = ex
         if self.stats is not None:
             if built:
-                self.stats.compile(bucket_n)
+                self.stats.compile(label, workload=workload)
             else:
-                self.stats.cache_hit(bucket_n)
+                self.stats.cache_hit(label, workload=workload)
             # Either way this replica now serves the bucket through
             # this executable — its XLA accounting belongs in the
             # replica's stats (and the per-bucket gauges) whether this
             # cache compiled it or adopted it from the shared store.
-            self.stats.executable_cost(bucket_n, ex.cost)
+            self.stats.executable_cost(label, ex.cost)
         return ex, ("compiled" if built else "shared_store")
 
     def keys(self):

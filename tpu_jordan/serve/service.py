@@ -41,7 +41,7 @@ from ..obs.journey import JourneyLog
 from ..resilience.policy import DEFAULT_POLICY
 from .batcher import (InvertResult, MicroBatcher, ServiceClosedError,
                       ServiceOverloadedError)
-from .executors import ExecutorCache, bucket_for
+from .executors import ExecutorCache, bucket_for, rhs_bucket_for
 from .stats import ServeStats
 
 
@@ -166,11 +166,22 @@ class JordanService:
 
     # ---- request path ------------------------------------------------
 
-    def submit(self, a, deadline_ms: float | None = None,
+    def submit(self, a, b=None, deadline_ms: float | None = None,
                _ctx=None) -> Future:
-        """Queue one (n, n) matrix; returns a future resolving to
-        :class:`InvertResult`.  Raises :class:`ServiceOverloadedError`
-        when the bounded queue is full (backpressure — retry later),
+        """Queue one request; returns a future resolving to
+        :class:`InvertResult`.
+
+        ``submit(a)`` is the historical invert request.  ``submit(a, b)``
+        (ISSUE 11) is a SOLVE request: X = A⁻¹B with no inverse ever
+        formed — ``b`` is (n,) or (n, k), the request lands on its own
+        (workload, bucket_n, rhs-bucket) lane with its own AOT
+        executable (``linalg.block_jordan_solve`` vmapped, resolved
+        through the workload-scoped tuner ladder), and the result
+        carries ``solution``/``workload="solve"`` with the κ-free
+        ‖A·X − B‖ backward error as ``rel_residual``.
+
+        Raises :class:`ServiceOverloadedError` when the bounded queue
+        is full (backpressure — retry later),
         :class:`~..resilience.policy.CircuitOpenError` while the
         bucket's breaker is open (fast-fail — doomed work is not
         queued), and :class:`ServiceClosedError` after ``close()``.
@@ -193,16 +204,31 @@ class JordanService:
         bucket = bucket_for(n)
         padded = np.asarray(np.eye(bucket, dtype=self.dtype))
         padded[:n, :n] = a
+        workload, padded_b, rhs, k = "invert", None, 0, 0
+        if b is not None:
+            workload = "solve"
+            b = np.asarray(b, self.dtype)
+            if b.ndim == 1:
+                b = b[:, None]
+            if b.ndim != 2 or b.shape[0] != n or b.shape[1] < 1:
+                raise ValueError(f"b must be (n,) or (n, k>=1) with "
+                                 f"n={n} rows, got shape {b.shape}")
+            k = b.shape[1]
+            rhs = rhs_bucket_for(k)
+            padded_b = np.zeros((bucket, rhs), self.dtype)
+            padded_b[:n, :k] = b
         if deadline_ms is None:
             deadline_ms = self.default_deadline_ms
         own_ctx = _ctx is None
-        ctx = self.journey.new(n, bucket) if own_ctx else _ctx
+        ctx = (self.journey.new(n, bucket, workload=workload)
+               if own_ctx else _ctx)
         try:
             fut = self._batcher.submit(
                 padded, n, bucket,
                 deadline_s=(None if deadline_ms is None
                             else float(deadline_ms) / 1e3),
-                ctx=ctx)
+                ctx=ctx, workload=workload, padded_b=padded_b,
+                rhs=rhs, k=k)
         except Exception as e:
             if own_ctx:
                 ctx.close("error", error=type(e).__name__)
@@ -234,20 +260,43 @@ class JordanService:
             raise SingularMatrixError("singular matrix")
         return res
 
+    def solve_system(self, a, b, timeout: float | None = None,
+                     deadline_ms: float | None = None) -> InvertResult:
+        """Synchronous ``submit(a, b)`` + wait (ISSUE 11): X = A⁻¹B
+        through the solve lane; raises ``SingularMatrixError`` when
+        THIS request's element was flagged (batch-mates unaffected)."""
+        res = self.submit(a, b, deadline_ms=deadline_ms).result(timeout)
+        if res.singular:
+            from ..driver import SingularMatrixError
+
+            raise SingularMatrixError("singular matrix")
+        return res
+
     # ---- lifecycle ---------------------------------------------------
 
-    def warmup(self, shapes) -> dict:
+    def warmup(self, shapes=(), solve_shapes=()) -> dict:
         """Pre-compile the executables for every bucket the given
-        request sizes land in; returns {bucket_n: resolved engine}.
+        request sizes land in; returns {lane: resolved engine}.
         After a warmup covering the live shape mix, the serve path
         performs zero compiles and zero plan-cache measurements (both
-        counter-pinned by the acceptance test)."""
+        counter-pinned by the acceptance test).
+
+        ``solve_shapes`` (ISSUE 11): an iterable of (n, k) pairs to
+        pre-compile the solve lanes those requests land in — the
+        zero-compile warm-path contract covers both workloads."""
         out = {}
         for n in shapes:
             b = bucket_for(int(n))
             ex = self.executors.get(b, self.batch_cap,
                                     self._batcher.block_size)
             out[b] = ex.key.engine
+        for n, k in solve_shapes:
+            b = bucket_for(int(n))
+            rhs = rhs_bucket_for(int(k))
+            ex = self.executors.get(b, self.batch_cap,
+                                    self._batcher.block_size,
+                                    workload="solve", rhs=rhs)
+            out[f"solve:{b}:k{rhs}"] = ex.key.engine
         return out
 
     def start(self) -> None:
@@ -291,10 +340,13 @@ class JordanService:
         warm-server pin)."""
         snap = self._stats.snapshot()
         snap["engines"] = {
-            f"{k.bucket_n}": {"engine": k.engine,
-                              "batch_cap": k.batch_cap,
-                              "plan_source": (ex.plan.source
-                                              if ex.plan else None)}
+            (f"{k.bucket_n}" if k.workload == "invert"
+             else f"{k.workload}:{k.bucket_n}:k{k.rhs}"):
+            {"engine": k.engine,
+             "batch_cap": k.batch_cap,
+             "workload": k.workload,
+             "plan_source": (ex.plan.source
+                             if ex.plan else None)}
             for k, ex in self.executors.entries()
         }
         snap["measurements"] = self.executors.measurements

@@ -76,7 +76,8 @@ class _BucketStats:
     """Counters for one shape bucket (all mutation under the owner's
     lock — this class itself is not thread-safe on purpose)."""
 
-    def __init__(self):
+    def __init__(self, workload: str = "invert"):
+        self.workload = workload
         self.requests = 0
         self.rejected = 0
         self.batches = 0
@@ -91,6 +92,7 @@ class _BucketStats:
     def to_json(self) -> dict:
         occ = (self.elements / self.batches) if self.batches else 0.0
         doc = {
+            "workload": self.workload,
             "requests": self.requests,
             "rejected": self.rejected,
             "batches": self.batches,
@@ -143,30 +145,39 @@ class ServeStats:
         # pays nothing for a gauge that cannot exist.
         self._device_mem_enabled: bool | None = None
 
-    def _b(self, bucket: int) -> _BucketStats:
-        return self._buckets.setdefault(bucket, _BucketStats())
+    def _b(self, bucket, workload: str = "invert") -> _BucketStats:
+        return self._buckets.setdefault(bucket, _BucketStats(workload))
 
-    def request(self, bucket: int) -> None:
-        with self._lock:
-            self._b(bucket).requests += 1
-        _M_REQUESTS.inc(bucket=bucket, **self._labels)
+    def _wl(self, workload: str) -> dict:
+        """Mirror labels for a mutation: invert lanes keep their
+        historical label set byte-identical; solve lanes (ISSUE 11)
+        add a ``workload`` label so one Prometheus scrape splits
+        traffic per workload."""
+        if workload == "invert":
+            return self._labels
+        return dict(self._labels, workload=workload)
 
-    def rejected(self, bucket: int) -> None:
+    def request(self, bucket, workload: str = "invert") -> None:
         with self._lock:
-            self._b(bucket).rejected += 1
-        _M_REJECTED.inc(bucket=bucket, **self._labels)
+            self._b(bucket, workload).requests += 1
+        _M_REQUESTS.inc(bucket=bucket, **self._wl(workload))
 
-    def compile(self, bucket: int) -> None:
+    def rejected(self, bucket, workload: str = "invert") -> None:
         with self._lock:
-            self._b(bucket).compiles += 1
+            self._b(bucket, workload).rejected += 1
+        _M_REJECTED.inc(bucket=bucket, **self._wl(workload))
+
+    def compile(self, bucket, workload: str = "invert") -> None:
+        with self._lock:
+            self._b(bucket, workload).compiles += 1
         _M_COMPILES.inc(component="serve", bucket=bucket, **self._labels)
 
-    def cache_hit(self, bucket: int) -> None:
+    def cache_hit(self, bucket, workload: str = "invert") -> None:
         with self._lock:
-            self._b(bucket).cache_hits += 1
+            self._b(bucket, workload).cache_hits += 1
         _M_CACHE_HITS.inc(bucket=bucket, **self._labels)
 
-    def executable_cost(self, bucket: int, cost) -> None:
+    def executable_cost(self, bucket, cost) -> None:
         """Record a bucket executable's XLA accounting (ISSUE 10
         hwcost): the snapshot's per-bucket ``executable`` block and
         the ``tpu_jordan_executable_*`` gauges — read once at compile
@@ -178,19 +189,21 @@ class ServeStats:
             self._b(bucket).executable = cost.to_json()
         _hwcost.observe_cost(cost, bucket=bucket, **self._labels)
 
-    def batch(self, bucket: int, occupancy: int, exec_seconds: float,
-              queue_seconds, singular: int = 0) -> None:
+    def batch(self, bucket, occupancy: int, exec_seconds: float,
+              queue_seconds, singular: int = 0,
+              workload: str = "invert") -> None:
         """One dispatched batch: ``occupancy`` occupied slots,
         ``queue_seconds`` an iterable of per-request queue waits."""
         queue_seconds = [float(q) for q in queue_seconds]
         with self._lock:
-            b = self._b(bucket)
+            b = self._b(bucket, workload)
             b.batches += 1
             b.elements += occupancy
             b.singular += singular
             b.exec_s.add(float(exec_seconds))
             b.queue_s.extend(queue_seconds)
-        _M_BATCHES.inc(bucket=bucket, **self._labels)
+        wl = self._wl(workload)
+        _M_BATCHES.inc(bucket=bucket, **wl)
         _M_OCCUPANCY.observe(occupancy, bucket=bucket, **self._labels)
         _M_EXEC_S.observe(float(exec_seconds), bucket=bucket,
                           **self._labels)
@@ -205,8 +218,12 @@ class ServeStats:
 
     def snapshot(self) -> dict:
         with self._lock:
+            # Lane keys may mix ints (invert buckets, the historical
+            # shape) and "solve:<n>:k<k>" strings (ISSUE 11) — sort by
+            # the string form so the snapshot stays deterministic.
             buckets = {str(k): v.to_json()
-                       for k, v in sorted(self._buckets.items())}
+                       for k, v in sorted(self._buckets.items(),
+                                          key=lambda kv: str(kv[0]))}
         totals = {
             "requests": sum(b["requests"] for b in buckets.values()),
             "rejected": sum(b["rejected"] for b in buckets.values()),
@@ -214,4 +231,15 @@ class ServeStats:
             "compiles": sum(b["compiles"] for b in buckets.values()),
             "singular": sum(b["singular"] for b in buckets.values()),
         }
-        return {"buckets": buckets, "totals": totals}
+        # Per-workload traffic rollup (ISSUE 11): the serve half of the
+        # workload accounting story (the direct API's is
+        # tpu_jordan_workload_requests_total).
+        workloads: dict = {}
+        for b in buckets.values():
+            w = workloads.setdefault(b["workload"], {
+                "requests": 0, "batches": 0, "singular": 0})
+            w["requests"] += b["requests"]
+            w["batches"] += b["batches"]
+            w["singular"] += b["singular"]
+        return {"buckets": buckets, "totals": totals,
+                "workloads": workloads}

@@ -24,8 +24,8 @@ from .measure import (Measurement, is_transient, measure_direct,
                       measure_slope, retry_transient, robust_stats)
 from .plan_cache import CACHE_VERSION, Plan, PlanCache, n_bucket, plan_key
 from .registry import (CONFIGS, ENGINES, PALLAS_ENGINES, REGISTRY,
-                       EngineConfig, TunePoint, candidates,
-                       select_by_cost)
+                       SOLVE_ENGINES, WORKLOADS, EngineConfig,
+                       TunePoint, candidates, select_by_cost)
 from .tuner import Tuner, auto_select, measure_config
 
 __all__ = [
@@ -33,6 +33,7 @@ __all__ = [
     "retry_transient", "robust_stats",
     "CACHE_VERSION", "Plan", "PlanCache", "n_bucket", "plan_key",
     "CONFIGS", "ENGINES", "PALLAS_ENGINES", "REGISTRY", "EngineConfig",
-    "TunePoint", "candidates", "select_by_cost",
+    "SOLVE_ENGINES", "TunePoint", "WORKLOADS", "candidates",
+    "select_by_cost",
     "Tuner", "auto_select", "measure_config",
 ]

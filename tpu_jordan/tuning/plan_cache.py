@@ -68,7 +68,13 @@ def plan_key(point: TunePoint) -> str:
     the serving executors key plans per (bucket, batch_cap) because
     per-launch overheads amortize differently across a batch — so every
     pre-existing unbatched key is byte-identical and old caches stay
-    valid without a version bump."""
+    valid without a version bump.
+
+    The workload segment (ISSUE 11) follows the same discipline: it
+    appears only when ``point.workload != "invert"`` (e.g.
+    ``tpu-v5e|single|n4096|float32|gathered|wsolve``), so every
+    pre-existing invert key — batched or not — is byte-identical and
+    existing caches stay valid."""
     backend = (f"{point.backend}-{point.chip}" if point.chip
                else point.backend)
     mem = "gathered" if point.gather else "sharded"
@@ -76,6 +82,8 @@ def plan_key(point: TunePoint) -> str:
            f"{point.dtype}|{mem}")
     if getattr(point, "batch", 1) > 1:
         key += f"|b{point.batch}"
+    if getattr(point, "workload", "invert") != "invert":
+        key += f"|w{point.workload}"
     return key
 
 

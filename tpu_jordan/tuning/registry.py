@@ -37,6 +37,17 @@ from typing import Any, Callable
 # never makes the survivor cut, which IS the prior doing its job).
 GROUPED_MIN_SINGLE_CHIP_N = 8192
 
+# The workload vocabulary (ISSUE 11): every tuning point carries the
+# WORKLOAD it selects an engine for.  "invert" is the historical default
+# (every pre-ISSUE-11 point; its plan-cache keys are byte-identical),
+# "solve" the augmented-[A | B] X = A⁻¹B path (no inverse ever formed),
+# "solve_spd" its pivot-free fast path (the caller's assume="spd"
+# promise skips the condition-based probe — the paper's most expensive
+# non-GEMM phase, main.cpp:1026-1074).  lstsq is not a registry
+# workload: it routes through solve_system on the normal equations
+# (tpu_jordan/linalg/api.py), so its engine choice IS a solve choice.
+WORKLOADS: tuple[str, ...] = ("invert", "solve", "solve_spd")
+
 # The comm model's calibration floor: its compute terms are calibrated
 # on the measured 8192-class phase model and its smallest validated
 # contract point is 2048 (tests/test_scale_demo.py).  Below this, the
@@ -74,12 +85,18 @@ class TunePoint:
     #: solve; plan keys only grow a ``bN`` segment when batch > 1, so
     #: every pre-existing cache key is unchanged.
     batch: int = 1
+    #: the workload this point selects an engine for (ISSUE 11): plan
+    #: keys only grow a ``|w<workload>`` segment when != "invert", so
+    #: every pre-existing invert key is byte-identical and old caches
+    #: stay valid without a version bump.
+    workload: str = "invert"
 
     @classmethod
     def create(cls, n: int, block_size: int | None = None, dtype="float32",
                workers: Any = 1, gather: bool = True,
                backend: str | None = None,
-               chip: str | None = None, batch: int = 1) -> "TunePoint":
+               chip: str | None = None, batch: int = 1,
+               workload: str = "invert") -> "TunePoint":
         import jax
         import jax.numpy as jnp
 
@@ -95,10 +112,13 @@ class TunePoint:
             backend = jax.default_backend()
         if chip is None and backend == "tpu":
             chip = _sniff_chip()
+        if workload not in WORKLOADS:
+            raise ValueError(f"unknown workload {workload!r}; choose "
+                             f"from {'/'.join(WORKLOADS)}")
         return cls(n=int(n), block_size=int(min(block_size, n)),
                    dtype=jnp.dtype(dtype).name, workers=workers,
                    gather=bool(gather), backend=backend, chip=chip,
-                   batch=int(batch))
+                   batch=int(batch), workload=str(workload))
 
     @property
     def distributed(self) -> bool:
@@ -135,6 +155,11 @@ class EngineConfig:
     legal: Callable[[TunePoint], bool]
     cost: Callable[[TunePoint], float]
     note: str
+    #: which workload this configuration serves (ISSUE 11): candidacy is
+    #: an exact match against the point's workload, so the invert and
+    #: solve engine zoos can never leak into each other's rankings.  The
+    #: (engine, workload) pair is linted unique by tests/test_tuning.py.
+    workload: str = "invert"
 
 
 _COMM_MODEL = None
@@ -278,22 +303,61 @@ def _always(pt: TunePoint) -> bool:
     return True
 
 
+def _real_dtype(pt: TunePoint) -> bool:
+    # Complex dtypes (ISSUE 11) run on the augmented-family engines only
+    # (the [A | B] elimination is dtype-generic; the in-place/grouped/
+    # fused engines' layout tricks are validated for real dtypes only) —
+    # an auto point at complex64 must never be routed to an engine that
+    # would crash or silently mis-handle it.
+    return not pt.dtype.startswith("complex")
+
+
 def _distributed_only(pt: TunePoint) -> bool:
-    return pt.distributed
+    return pt.distributed and _real_dtype(pt)
+
+
+def _legal_solve(pt: TunePoint) -> bool:
+    # The augmented-[A | B] solve engine (tpu_jordan/linalg/engine.py):
+    # single-device, unrolled-only (the live-column window shrinks
+    # STATICALLY per superstep — that is where the ~half-the-invert-FLOPs
+    # saving lives), any storage dtype including complex (sub-fp32
+    # computes at fp32 and rounds once, the invert engines' policy).
+    from ..parallel.sharded_inplace import MAX_UNROLL_NR
+
+    m = min(pt.block_size, pt.n)
+    Nr = -(-pt.n // m)
+    return not pt.distributed and Nr <= MAX_UNROLL_NR
+
+
+def _cost_solve(pt: TunePoint) -> float:
+    # Gauss–Jordan on [A | B] never forms A⁻¹: ~n³(1 + k/n) FLOPs vs the
+    # in-place inversion's 2n³ (obs/hwcost.baseline_workload_flops).
+    # 0.55x the in-place projection is the honest first-order ranking —
+    # strictly below every invert engine at the same point, with margin
+    # for the k-column RHS the point does not carry.
+    return 0.55 * projected_seconds(pt)
+
+
+def _cost_solve_spd(pt: TunePoint) -> float:
+    # assume="spd" skips the condition-based pivot probe — the paper's
+    # most expensive non-GEMM phase (main.cpp:1026-1074): one diagonal
+    # block inverse per superstep instead of Nr-t candidates.
+    return 0.45 * projected_seconds(pt)
 
 
 CONFIGS: tuple[EngineConfig, ...] = (
     EngineConfig(
-        "inplace", "inplace", 0, _always, _cost_inplace,
+        "inplace", "inplace", 0, _real_dtype, _cost_inplace,
         "in-place 2N^3 elimination — the conservative default; unrolled "
         "trace vs fori picked by Nr inside the engine"),
     EngineConfig(
-        "grouped2", "grouped", 2, _always, _cost_grouped,
+        "grouped2", "grouped", 2, _real_dtype, _cost_grouped,
         "delayed group updates, k=2 (the measured single-chip winner at "
         "n >= 8192 well-conditioned; fused stacked psums distributed)"),
     EngineConfig(
         "augmented", "augmented", 0, _always, _cost_augmented,
-        "~4N^3 reference-parity path (global-scale singularity rule)"),
+        "~4N^3 reference-parity path (global-scale singularity rule); "
+        "the one complex-capable invert engine (dtype-generic sweeps)"),
     EngineConfig(
         "swapfree", "swapfree", 0, _distributed_only, _cost_swapfree,
         "implicit-permutation engine: no row-swap broadcast, bucketed "
@@ -312,6 +376,26 @@ CONFIGS: tuple[EngineConfig, ...] = (
         "the fused kernel with bf16-compute/fp32-accumulate dots "
         "(arXiv:2112.09017); auto-candidate only at sub-fp32 storage "
         "points, always guarded by the residual-gate ladder"),
+    # ---- solve workloads (ISSUE 11, tpu_jordan/linalg/) --------------
+    EngineConfig(
+        "solve_aug", "solve_aug", 0, _legal_solve, _cost_solve,
+        "Gauss–Jordan on [A | B] with the condition-based pivot probe: "
+        "X = A⁻¹B at ~n³(1+k/n) FLOPs, no inverse ever formed "
+        "(linalg/engine.py); any dtype incl. complex",
+        workload="solve"),
+    EngineConfig(
+        "solve_spd", "solve_spd", 0, _legal_solve, _cost_solve_spd,
+        "pivot-free SPD fast path: the caller's assume='spd' promise "
+        "makes every diagonal block invertible (PD principal "
+        "submatrices), so the probe — the most expensive non-GEMM "
+        "phase — is skipped outright",
+        workload="solve_spd"),
+    EngineConfig(
+        "solve_aug_spd", "solve_aug", 0, _legal_solve, _cost_solve,
+        "the pivoting solve engine at SPD points: the cross-check and "
+        "recovery fallback (never cost-preferred over the pivot-free "
+        "path, but a legal candidate the measuring tuner can promote)",
+        workload="solve_spd"),
 )
 
 REGISTRY: dict[str, EngineConfig] = {c.name: c for c in CONFIGS}
@@ -320,8 +404,16 @@ assert len(REGISTRY) == len(CONFIGS), "duplicate registry names"
 # The product's engine vocabulary, derived from the registry (driver and
 # CLI import this instead of keeping their own string lists).  dict.fromkeys
 # dedups while preserving registration order; "auto" is the tuner.
+# ENGINES stays the INVERT vocabulary (what driver.solve / the CLI
+# --engine flag accept — byte-identical to pre-ISSUE-11); the solve
+# workloads get their own derived tuple.
 ENGINES: tuple[str, ...] = ("auto",) + tuple(
-    dict.fromkeys(c.engine for c in CONFIGS))
+    dict.fromkeys(c.engine for c in CONFIGS if c.workload == "invert"))
+
+#: The solve-workload engine vocabulary (linalg.solve_system's engine=
+#: flag): derived the same way, "auto" = the tuner ladder per workload.
+SOLVE_ENGINES: tuple[str, ...] = ("auto",) + tuple(
+    dict.fromkeys(c.engine for c in CONFIGS if c.workload != "invert"))
 
 #: The single-device fused-kernel engines (ops/pallas_update.py): the
 #: driver gates them off distributed meshes, dispatches their grouped
@@ -337,8 +429,12 @@ def get(name: str) -> EngineConfig:
 
 def candidates(point: TunePoint) -> list[EngineConfig]:
     """Legal engine configurations at ``point``, cheapest projected
-    first (name tie-break keeps the order deterministic)."""
-    legal = [c for c in CONFIGS if c.legal(point)]
+    first (name tie-break keeps the order deterministic).  Candidacy
+    matches the point's WORKLOAD exactly (ISSUE 11): an invert point
+    ranks the invert zoo, a solve point the solve engines — neither can
+    leak into the other's cost ranking."""
+    wl = getattr(point, "workload", "invert")
+    legal = [c for c in CONFIGS if c.workload == wl and c.legal(point)]
     return sorted(legal, key=lambda c: (c.cost(point), c.name))
 
 

@@ -75,6 +75,27 @@ def measure_config(point: TunePoint, cfg: EngineConfig,
 
     dtype = jnp.dtype(point.dtype)
     n, m = point.n, point.block_size
+    if getattr(point, "workload", "invert") != "invert":
+        # Solve-workload measurement (ISSUE 11): the [A | B] engine at a
+        # representative single-RHS point — engine ranking is measured
+        # to depend on n/dtype, not on the RHS width, which the point
+        # deliberately does not carry (docs/WORKLOADS.md).
+        from ..linalg.engine import block_jordan_solve
+
+        a = generate("kms" if cfg.workload == "solve_spd" else "rand",
+                     (n, n), dtype)
+        b = generate("crand" if point.dtype.startswith("complex")
+                     else "rand", (n, 1), dtype)
+        spd = cfg.engine == "solve_spd"
+        compiled = jax.jit(
+            lambda aa, bb: block_jordan_solve(aa, bb, block_size=m,
+                                              spd=spd)
+        ).lower(a, b).compile()
+
+        def call():
+            jax.block_until_ready(compiled(a, b)[0])
+
+        return measure_direct(call, samples=samples)
     if point.distributed:
         be = make_distributed_backend(point.workers, n, m, cfg.engine,
                                       cfg.group)
@@ -149,6 +170,7 @@ class Tuner:
         return (cfg is not None
                 and cfg.engine == plan.engine
                 and cfg.group == plan.group
+                and cfg.workload == getattr(point, "workload", "invert")
                 and cfg.legal(point))
 
     def _rank(self, point: TunePoint) -> Plan:
@@ -201,19 +223,23 @@ class Tuner:
 def auto_select(n: int, block_size: int | None, dtype, workers,
                 gather: bool, tune: bool = False,
                 plan_cache: str | None = None,
-                telemetry=None) -> tuple[str, int, Plan]:
+                telemetry=None,
+                workload: str = "invert") -> tuple[str, int, Plan]:
     """The driver's ``engine="auto"`` hook: build the tuning point from
     the solve arguments, run the selection ladder, return the resolved
     ``(engine, group, plan)``.  ``plan_cache`` is a JSON path (consulted
     always, updated whenever selection ran); ``tune=True`` turns on real
     measurement of the cost-pruned survivors.  ``telemetry`` records
     the ladder walk as a ``select`` span (attrs: resolved engine +
-    ladder rung — obs/spans.py)."""
+    ladder rung — obs/spans.py).  ``workload`` (ISSUE 11) scopes the
+    ladder to that workload's engine zoo and plan-cache key segment
+    ("invert" keys stay byte-identical)."""
     from ..obs.spans import NULL
 
     tel = telemetry if telemetry is not None else NULL
-    with tel.span("select", n=n, tune=tune) as sp:
-        point = TunePoint.create(n, block_size, dtype, workers, gather)
+    with tel.span("select", n=n, tune=tune, workload=workload) as sp:
+        point = TunePoint.create(n, block_size, dtype, workers, gather,
+                                 workload=workload)
         cache = PlanCache.load(plan_cache) if plan_cache else None
         tuner = Tuner(cache=cache, measure=tune)
         plan = tuner.select(point)

@@ -88,3 +88,18 @@ def invert_flops(n: int) -> float:
     from ..obs.hwcost import baseline_invert_flops
 
     return baseline_invert_flops(n)
+
+
+def workload_flops(n: int, workload: str = "invert", k: int = 1,
+                   rows: int | None = None) -> float:
+    """Workload-aware analytic FLOP count (ISSUE 11 satellite).
+
+    ``invert_flops``'s 2n³ convention is an INVERSION convention; a
+    solve row divided by it would headline ~2x too fast (Gauss–Jordan
+    on [A | B] is ~n³·(1 + k/n) for k right-hand sides, and lstsq adds
+    the Gram/projection products).  Deprecated shim like the rest of
+    this module: delegates to
+    ``tpu_jordan.obs.hwcost.baseline_workload_flops``."""
+    from ..obs.hwcost import baseline_workload_flops
+
+    return baseline_workload_flops(n, workload, k=k, rows=rows)
