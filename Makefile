@@ -13,7 +13,7 @@ WORKERS  ?= 1
 REQUESTS  ?= 64
 BATCH_CAP ?= 8
 
-.PHONY: all native tpu test smoke serve-demo solve-demo chaos-demo fleet-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
+.PHONY: all native tpu test smoke serve-demo solve-demo chaos-demo fleet-demo update-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
 
 REPLICAS ?= 3
 
@@ -87,6 +87,23 @@ fleet-demo:
 	  --serve-requests 60 --batch-cap 4 --quiet $(FLEET_ARGS) \
 	  > /tmp/tpu_jordan_fleet.json
 	python tools/check_fleet.py /tmp/tpu_jordan_fleet.json
+
+# Resident-update demo + validation (ISSUE 12, docs/WORKLOADS.md):
+# a resident handle streams rank-32 Sherman-Morrison-Woodbury updates
+# through the O(n^2 k) update lane at the acceptance scale (2048^2,
+# k=32 <= n/8) — the ledger accounts every update as
+# refreshed|re_inverted|gated, warm update latency must beat warm
+# re-invert, the update executable's cost_analysis FLOPs must sit
+# below the fresh-invert executable's, and a seeded replica_kill
+# mid-update-stream must leave a bit-matched, gate-verified resident
+# inverse (exit 2 = a silently stale inverse).  This row is the
+# demo gate for the update workload, like chaos-demo/fleet-demo for
+# theirs.
+update-demo:
+	python -m tpu_jordan 2048 128 --update-demo --rank 32 --updates 6 \
+	  --replicas $(REPLICAS) --kills 1 --quiet \
+	  > /tmp/tpu_jordan_update.json
+	python tools/check_update.py /tmp/tpu_jordan_update.json
 
 # SLO demo + validation (docs/OBSERVABILITY.md): the fleet demo with
 # the --slo-report leg — declarative per-bucket availability SLOs

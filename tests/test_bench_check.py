@@ -193,6 +193,53 @@ class TestRegressionRules:
         assert "invert_4096_f32_gflops" in keys
         assert "invert_4096_xla_gflops" not in keys
 
+    def test_update_rows_trap_quiet_regression(self, tmp_path):
+        """ISSUE 12 satellite: the new resident-update keys
+        (update_4096_k32_gflops / update_resident_amortized_gflops)
+        participate in the sentinel — a quiet 30% shortfall on either
+        pages (exit 2), exactly like the invert rows."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "update_4096_k32_gflops": 500.0,
+                "update_4096_k32_spread_pct": 2.0,
+                "update_resident_amortized_gflops": 300.0,
+                "update_resident_amortized_spread_pct": 2.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "update_4096_k32_gflops": 340.0,
+                "update_4096_k32_spread_pct": 2.0,
+                "update_resident_amortized_gflops": 300.0,
+                "update_resident_amortized_spread_pct": 2.0})),
+        ]
+        assert check_bench.main(files) == 2
+        files[1] = _write(tmp_path, "r2b.json", _round(10000.0, {
+            "update_4096_k32_gflops": 500.0,
+            "update_4096_k32_spread_pct": 2.0,
+            "update_resident_amortized_gflops": 190.0,
+            "update_resident_amortized_spread_pct": 2.0}))
+        assert check_bench.main(files) == 2
+
+    def test_update_rows_variance_and_unknown_rules_hold(self, tmp_path):
+        """The variance discipline covers the update keys too: a noisy
+        session explains its own dip; a round without spread stats is
+        unknown, never paged — and the exact-stem spread lookup binds
+        the update row's own stats, not a sibling's."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "update_4096_k32_gflops": 500.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "update_4096_k32_gflops": 300.0,
+                "update_4096_k32_spread_pct": 28.0})),
+        ]
+        assert check_bench.main(files) == 0
+        files[1] = _write(tmp_path, "r2b.json", _round(10000.0, {
+            "update_4096_k32_gflops": 300.0}))
+        assert check_bench.main(files) == 0
+        row = {"extra": {"update_4096_k32_spread_pct": 3.0,
+                         "invert_4096_spread_pct": 44.0}}
+        spread, _ = check_bench._variance_context(
+            "update_4096_k32_gflops", row)
+        assert spread == 3.0
+
     def test_renamed_config_is_a_new_row(self, tmp_path):
         """A config migration renames its key (m256 vs m384): the
         sentinel never diffs different configurations."""

@@ -215,8 +215,12 @@ class TestSolveSystemAPI:
             solve_system(a, b, engine="solve_spd")   # no spd promise
         with pytest.raises(UsageError, match="auto"):
             solve_system(a, b, engine="solve_aug", tune=True)
-        with pytest.raises(UsageError, match="trace"):
-            solve_system(a, b, numerics="trace")
+        with pytest.raises(UsageError, match="probe"):
+            # trace is a PIVOTING-path mode since ISSUE 12 (the 1b
+            # remainder); the pivot-free fast path stays a typed
+            # refusal — no probe to trace.
+            solve_system(a @ a.T + 16 * np.eye(16, dtype=np.float32),
+                         b, assume="spd", numerics="trace")
         with pytest.raises(UsageError, match="square"):
             solve_system(_rand((8, 4), seed=26), b)
         # a zero-column RHS is a caller bug, never a vacuous success
@@ -302,6 +306,82 @@ class TestSolveSystemAPI:
         assert res.recovery and res.recovery[-1]["passed"]
         assert res.recovery[-1]["rung"] == "repivot"
         assert res.rel_residual < 1e-5
+
+
+class TestSolveTrace:
+    """ISSUE 12 satellite (ROADMAP 1b remainder): the instrumented
+    per-superstep trace twin for the solve engine — stats ride the
+    SAME executable, X bits untouched, pivot sequence pinned equal to
+    the invert engine's on a shared fixture."""
+
+    def test_trace_bits_untouched_and_report_shape(self):
+        a = _rand((48, 48), seed=71)
+        b = _rand((48, 3), seed=72)
+        traced = solve_system(a, b, block_size=8, numerics="trace")
+        plain = solve_system(a, b, block_size=8)
+        assert (np.asarray(traced.x) == np.asarray(plain.x)).all()
+        rep = traced.numerics
+        assert rep.mode == "trace" and rep.workload == "solve"
+        assert rep.trace_engine == traced.engine == "solve_aug"
+        Nr = 48 // 8
+        assert len(rep.pivot_block) == Nr
+        assert len(rep.pivot_inv_norm) == Nr
+        assert len(rep.cand_norm_max) == Nr
+        assert len(rep.singular_candidates) == Nr
+        assert len(rep.growth) == Nr
+        assert all(s == 0 for s in rep.singular_candidates)
+        doc = rep.to_json()
+        assert doc["modeled_fields"] == ["residual_est"]
+        assert doc["workload"] == "solve"
+
+    def test_pivot_sequence_matches_invert_engine(self):
+        """The parity pin: the [A | B] elimination probes the same
+        candidate blocks with the same criterion as the in-place
+        invert engine — identical pivot choices on a shared fixture."""
+        import os
+        import tempfile
+
+        from tpu_jordan.driver import solve
+
+        n, m = 48, 8
+        a = _rand((n, n), seed=73)
+        b = _rand((n, 2), seed=74)
+        traced = solve_system(a, b, block_size=m, numerics="trace")
+        fd, path = tempfile.mkstemp(suffix=".mat")
+        os.close(fd)
+        try:
+            from tpu_jordan.io import write_matrix_file
+
+            write_matrix_file(path, a)
+            inv_res = solve(n, m, file=path, numerics="trace")
+        finally:
+            os.unlink(path)
+        assert traced.numerics.pivot_block == \
+            inv_res.numerics.pivot_block
+
+    def test_trace_spikes_precede_recovery(self):
+        """The ISSUE 10 causality discipline holds on the traced solve
+        path: an ill-conditioned bf16 solve records its numerics_spike
+        BEFORE the gate/ladder events."""
+        from tpu_jordan.obs.numerics import ill_conditioned
+        from tpu_jordan.obs.recorder import RECORDER
+        from tpu_jordan.resilience import ResiliencePolicy
+
+        a = ill_conditioned(16, 4.5, seed=7)
+        b = _rand((16, 2), seed=75)
+        mark = RECORDER.total
+        res = solve_system(a, b, block_size=8, dtype=jnp.bfloat16,
+                           policy=ResiliencePolicy(gate_dtype="float32"),
+                           numerics="trace")
+        assert res.numerics.mode == "trace"
+        assert res.recovery          # the gate fired and recovered
+        events = RECORDER.since(mark)
+        spikes = [e["seq"] for e in events
+                  if e["kind"] == "numerics_spike"]
+        rungs = [e["seq"] for e in events
+                 if e["kind"] == "recovery_rung"]
+        assert spikes and rungs
+        assert min(spikes) < min(rungs)
 
 
 class TestLstsq:

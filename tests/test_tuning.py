@@ -39,10 +39,16 @@ class TestRegistry:
         assert DRIVER_ENGINES is ENGINES      # same derived object
         assert ENGINES[0] == "auto"
         # The solve vocabulary derives the same way and never leaks
-        # into the driver/CLI invert vocabulary.
-        solve = {c.engine for c in CONFIGS if c.workload != "invert"}
+        # into the driver/CLI invert vocabulary; the update workload
+        # (ISSUE 12) is its own axis — smw_update is neither a solve
+        # nor an invert engine.
+        solve = {c.engine for c in CONFIGS
+                 if c.workload in ("solve", "solve_spd")}
         assert set(SOLVE_ENGINES) - {"auto"} == solve
         assert not (solve & set(DRIVER_ENGINES))
+        update = {c.engine for c in CONFIGS if c.workload == "update"}
+        assert update == {"smw_update"}
+        assert not (update & (set(DRIVER_ENGINES) | set(SOLVE_ENGINES)))
         names = [c.name for c in CONFIGS]
         assert sorted(names) == sorted(set(names))
         assert set(REGISTRY) == set(names)

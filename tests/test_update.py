@@ -1,0 +1,692 @@
+"""ISSUE 12 — resident-inverse handles and Sherman–Morrison–Woodbury
+rank-k updates: the SMW identity vs a from-scratch inverse, exact
+zero-pad bucketing, typed singularity (det(A+UVᵀ) = det(A)·det(S) —
+the rank-deficient Gram edge included), the drift-budget accumulation
+ladder with its exact crossing point, the serve update lane's warm
+zero-compile/zero-measurement pins (plain run AND across a fleet
+rolling restart), the compiled-executable FLOP pin (update strictly
+below fresh invert at k ≤ n/8), replica-kill durability of the shared
+handle store, and the ``check_update.py`` both-ways gate."""
+
+import importlib.util
+import pathlib
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_jordan.linalg.update import (DRIFT_BUDGET_FACTOR, drift_budget,
+                                      drift_exceeded, smw_update,
+                                      smw_update_with_metrics,
+                                      solve_update)
+
+
+def _factors(rng, n, k, dtype=np.float32, scale=None):
+    s = (1.0 / np.sqrt(float(n) * k)) if scale is None else scale
+    return (rng.standard_normal((n, k)).astype(dtype) * s,
+            rng.standard_normal((n, k)).astype(dtype) * s)
+
+
+class TestSMWIdentity:
+    def test_matches_fresh_inverse(self, rng):
+        n, k = 40, 3
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        inv = np.linalg.inv(a).astype(np.float32)
+        u, v = _factors(rng, n, k)
+        got, sing = smw_update(jnp.asarray(inv), jnp.asarray(u),
+                               jnp.asarray(v))
+        assert not bool(sing)
+        want = np.linalg.inv(a + u @ v.T)
+        assert np.abs(np.asarray(got) - want).max() < 1e-4
+
+    def test_zero_pad_columns_exact(self, rng):
+        """The k-bucket contract: zero-padded U/V columns change NO
+        bits — pad columns contribute nothing to U·Vᵀ and the
+        capacitance pad block is the identity."""
+        n, k, kb = 24, 3, 8
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        inv = np.linalg.inv(a).astype(np.float32)
+        u, v = _factors(rng, n, k)
+        up = np.zeros((n, kb), np.float32)
+        vp = np.zeros((n, kb), np.float32)
+        up[:, :k], vp[:, :k] = u, v
+        bare, s1 = smw_update(jnp.asarray(inv), jnp.asarray(u),
+                              jnp.asarray(v))
+        padded, s2 = smw_update(jnp.asarray(inv), jnp.asarray(up),
+                                jnp.asarray(vp))
+        assert not bool(s1) and not bool(s2)
+        assert (np.asarray(bare) == np.asarray(padded)).all()
+
+    def test_with_metrics_verifies_against_mutated_matrix(self, rng):
+        n, k = 32, 2
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        inv = np.linalg.inv(a).astype(np.float32)
+        u, v = _factors(rng, n, k)
+        a_new, inv_new, sing, kappa, rel = smw_update_with_metrics(
+            jnp.asarray(a), jnp.asarray(inv), jnp.asarray(u),
+            jnp.asarray(v))
+        assert not bool(sing)
+        assert np.allclose(np.asarray(a_new), a + u @ v.T, atol=1e-6)
+        # rel is ‖A_new·X_new − I‖∞ / ‖A_new‖∞ — the invert convention.
+        r = np.abs(np.asarray(a_new) @ np.asarray(inv_new)
+                   - np.eye(n)).sum(axis=-1).max()
+        na = np.abs(np.asarray(a_new)).sum(axis=-1).max()
+        assert float(rel) == pytest.approx(r / na, rel=1e-3)
+        assert float(kappa) > 0
+
+    def test_sub_fp32_storage_rounds_once(self, rng):
+        n, k = 16, 2
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        inv = np.linalg.inv(a)
+        u, v = _factors(rng, n, k)
+        got, sing = smw_update(jnp.asarray(inv, jnp.bfloat16),
+                               jnp.asarray(u, jnp.bfloat16),
+                               jnp.asarray(v, jnp.bfloat16))
+        assert got.dtype == jnp.bfloat16
+        assert not bool(sing)
+
+
+class TestTypedSingularity:
+    def test_rank_destroying_update_flags_capacitance(self, rng):
+        """u = −A·e₀, v = e₀ zeroes column 0: det(S) = det(A+uvᵀ)/det(A)
+        = 0 exactly — the capacitance solve must flag it, never emit
+        garbage.  Exact arithmetic here: inv is the EXACT float64
+        inverse so 1 + e₀ᵀA⁻¹u cancels to ~0 below the eps threshold."""
+        n = 12
+        a = rng.standard_normal((n, n)).astype(np.float64)
+        inv = np.linalg.inv(a)
+        u = -a[:, :1]
+        v = np.zeros((n, 1))
+        v[0, 0] = 1.0
+        _, sing = smw_update(jnp.asarray(inv), jnp.asarray(u),
+                             jnp.asarray(v))
+        # Typed somewhere on the ladder: either the capacitance flags
+        # it here, or (fp rounding slipping past eps) the serve gate +
+        # re_invert rung types it — TestServeLane covers that end.
+        from tpu_jordan.driver import SingularMatrixError
+
+        if not bool(sing):
+            with pytest.raises(SingularMatrixError):
+                solve_update(a, inv, u, v,
+                             policy=None, check=True)
+        else:
+            with pytest.raises(SingularMatrixError):
+                solve_update(a, inv, u, v, check=True)
+
+    def test_lstsq_rank_deficient_gram_edge_typed(self, rng):
+        """The ISSUE 12 satellite edge: a resident GRAM inverse (the
+        lstsq normal-equations shape) updated by a mutation that
+        destroys A's column rank — (A'ᵀA') is singular and the update
+        path must type it, never return a garbage pseudo-inverse."""
+        rows, n = 20, 6
+        a = rng.standard_normal((rows, n)).astype(np.float64)
+        gram = a.T @ a
+        gram_inv = np.linalg.inv(gram)
+        # Make column 1 a copy of column 0: rank deficiency.  The Gram
+        # mutation G' = A'ᵀA' − AᵀA is rank-2 symmetric: G' = U·Vᵀ with
+        # U = [d, s], V = [s, d]/shared — build it exactly.
+        a2 = a.copy()
+        a2[:, 1] = a2[:, 0]
+        gram2 = a2.T @ a2
+        # Factor the symmetric difference exactly via its eigendecomp.
+        diff = gram2 - gram
+        w, q = np.linalg.eigh(diff)
+        keep = np.abs(w) > 1e-12
+        u = q[:, keep] * w[keep]
+        v = q[:, keep]
+        assert u.shape[1] <= 4
+        res = solve_update(gram, gram_inv, u, v, check=False)
+        if not res.singular:
+            # The capacitance rounded past eps: the GATE must still
+            # refuse the garbage inverse (rel residual of a singular
+            # system cannot pass eps·n·κ with finite κ).
+            assert not np.isfinite(res.rel_residual) or \
+                res.rel_residual > 1e-3
+        else:
+            assert res.inverse is None
+
+
+class TestDriftBudget:
+    def test_documented_budget_factor(self):
+        assert drift_budget(0.25) == DRIFT_BUDGET_FACTOR * 0.25
+
+    def test_exact_crossing_point(self):
+        """m small updates whose SUMMED drift crosses the budget
+        exactly at the documented threshold: m·d <= F·thr passes,
+        the first update past it fires."""
+        thr = 0.125                  # binary-exact: the crossing is
+        budget = drift_budget(thr)   # judged at the boundary, so the
+        d = budget / 8.0             # fixture must sum without rounding
+        drift = 0.0
+        fired_at = None
+        for i in range(1, 12):
+            drift += d
+            if drift_exceeded(drift, budget):
+                fired_at = i
+                break
+        # 8·d == budget exactly (<= passes); the 9th crosses.
+        assert fired_at == 9
+
+    def test_nan_hostile(self):
+        assert drift_exceeded(float("nan"), 1.0)
+        assert drift_exceeded(1.0, float("nan"))
+        assert not drift_exceeded(0.0, 0.0)
+
+    def test_factor_override(self):
+        assert drift_budget(1.0, factor=0.0) == 0.0
+        assert drift_exceeded(1e-12, drift_budget(1.0, factor=0.0))
+
+
+class TestSolveUpdateAPI:
+    def test_result_surface_and_drift_threading(self, rng):
+        n, k = 24, 2
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        inv = np.linalg.inv(a).astype(np.float32)
+        u, v = _factors(rng, n, k)
+        r1 = solve_update(a, inv, u, v)
+        assert r1.workload == "update" and r1.engine == "smw_update"
+        assert r1.n == n and r1.k == k
+        assert r1.drift >= r1.rel_residual >= 0
+        assert r1.gflops >= 0
+        # Drift accumulates across chained updates.
+        u2, v2 = _factors(rng, n, k)
+        r2 = solve_update(np.asarray(r1.a_new), np.asarray(r1.inverse),
+                          u2, v2, drift=r1.drift)
+        assert r2.drift > r1.drift
+
+    def test_policy_gate_and_re_invert_rung(self, rng):
+        """A policy-attached update whose accumulated drift is doctored
+        past the budget fires the re_invert rung (a fresh elimination
+        of the mutated matrix), passes, and resets drift to 0."""
+        from tpu_jordan.resilience import ResiliencePolicy
+
+        n, k = 24, 2
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        inv = np.linalg.inv(a).astype(np.float32)
+        u, v = _factors(rng, n, k)
+        res = solve_update(a, inv, u, v, policy=ResiliencePolicy(),
+                           drift=1e9)
+        assert res.recovery and res.recovery[0]["rung"] == "re_invert"
+        assert res.recovery[0]["cause"] == "drift_budget"
+        assert res.recovery[0]["passed"]
+        assert res.drift == 0.0
+
+    def test_shape_validation_typed(self, rng):
+        from tpu_jordan.driver import UsageError
+
+        n = 8
+        a = np.eye(n, dtype=np.float32)
+        with pytest.raises(UsageError, match="matching"):
+            solve_update(a, a, np.zeros((n, 2), np.float32),
+                         np.zeros((n, 3), np.float32))
+        with pytest.raises(UsageError, match="trace"):
+            solve_update(a, a, np.zeros((n, 1), np.float32),
+                         np.zeros((n, 1), np.float32), numerics="trace")
+
+
+class TestHandleStore:
+    def test_unknown_handle_typed(self):
+        from tpu_jordan.serve import HandleStore, UnknownHandleError
+
+        store = HandleStore()
+        with pytest.raises(UnknownHandleError):
+            store.get("nope")
+        assert not store.evict("nope")
+
+    def test_commit_and_eviction(self):
+        from tpu_jordan.serve.handles import HandleState, HandleStore
+
+        store = HandleStore()
+        st = HandleState(handle_id="x", n=4, bucket_n=64,
+                         dtype="float32", a=np.eye(4), inverse=np.eye(4))
+        ref = store.create(st)
+        assert ref.handle_id == "x" and len(store) == 1
+        with store.txn("x") as live:
+            store.commit(live, a=2 * np.eye(4), inverse=0.5 * np.eye(4),
+                         kappa=1.0, rel_residual=1e-6, drift=1e-6)
+        got = store.get("x")
+        assert got.version == 1 and got.updates_applied == 1
+        assert store.snapshot()["x"]["version"] == 1
+        assert store.evict("x") and len(store) == 0
+
+
+class TestHandleStoreRaces:
+    def test_evict_waits_out_in_flight_txn(self):
+        """Review hardening: an evict racing an in-flight update must
+        WAIT (the handle's own lock), so a committed update is never
+        orphaned into a state the store no longer serves."""
+        import threading
+        import time
+
+        from tpu_jordan.serve.handles import (HandleState, HandleStore,
+                                              UnknownHandleError)
+
+        store = HandleStore()
+        store.create(HandleState(handle_id="x", n=4, bucket_n=64,
+                                 dtype="float32", a=np.eye(4),
+                                 inverse=np.eye(4)))
+        entered = threading.Event()
+        release = threading.Event()
+        versions = []
+
+        def updater():
+            with store.txn("x") as live:
+                entered.set()
+                release.wait(10)
+                store.commit(live, a=np.eye(4), inverse=np.eye(4),
+                             kappa=1.0, rel_residual=0.0, drift=0.0)
+                versions.append(live.version)
+
+        t = threading.Thread(target=updater)
+        t.start()
+        assert entered.wait(10)
+        evictor = threading.Thread(target=lambda: store.evict("x"))
+        evictor.start()
+        time.sleep(0.05)
+        assert evictor.is_alive()     # blocked on the txn, not racing it
+        release.set()
+        t.join(10)
+        evictor.join(10)
+        assert versions == [1]        # the commit landed first ...
+        with pytest.raises(UnknownHandleError):
+            store.get("x")            # ... THEN the evict removed it
+
+    def test_txn_on_replaced_handle_lands_on_successor(self):
+        """create() over an existing id REPLACES the state; a txn that
+        raced the swap retries onto the successor — never the orphan."""
+        from tpu_jordan.serve.handles import HandleState, HandleStore
+
+        store = HandleStore()
+        store.create(HandleState(handle_id="x", n=4, bucket_n=64,
+                                 dtype="float32", a=np.eye(4),
+                                 inverse=np.eye(4)))
+        fresh = HandleState(handle_id="x", n=4, bucket_n=64,
+                            dtype="float32", a=2 * np.eye(4),
+                            inverse=0.5 * np.eye(4))
+        store.create(fresh)           # the re-create
+        with store.txn("x") as live:
+            assert live is fresh
+            assert live.version == 0  # version restarted with the swap
+
+
+class TestServeLane:
+    @pytest.mark.smoke       # the resident-handle round trip (smoke)
+    def test_resident_round_trip_submit_update_verified(self, rng):
+        """submit → update → verified result: the smoke-tier pin for
+        the whole resident path (create, O(n²k) refresh, in-launch
+        verification against the mutated matrix, write-through)."""
+        from tpu_jordan.serve import JordanService
+
+        n, k = 48, 3
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        u, v = _factors(rng, n, k)
+        with JordanService(batch_cap=2, max_wait_ms=0.5) as svc:
+            svc.warmup(update_shapes=[(n, k)])
+            warm = svc.stats()["totals"]["compiles"]
+            ref = svc.invert(a, resident=True, timeout=120)
+            assert ref.bucket_n == 64 and ref.result.rel_residual < 1e-4
+            res = svc.update(ref, u, v, timeout=120)
+            stats = svc.stats()
+        assert res.workload == "update"
+        assert res.update_outcome == "refreshed"
+        assert res.handle_version == 1
+        assert res.rel_residual < 1e-3
+        want = np.linalg.inv(a + u @ v.T)
+        assert np.abs(np.asarray(res.inverse) - want).max() < 1e-3
+        # Warm pins: zero compiles on the whole request path, zero
+        # plan-cache measurements, and the update traffic accounted.
+        assert stats["totals"]["compiles"] == warm
+        assert stats["measurements"] == 0
+        assert stats["workloads"]["update"]["requests"] == 1
+        assert stats["handles"][ref.handle_id]["version"] == 1
+
+    def test_flops_pin_update_below_fresh_invert(self):
+        """The acceptance FLOP pin: the update executable's own XLA
+        cost_analysis FLOPs sit STRICTLY below the same-n fresh-invert
+        executable's at k ≤ n/8 — even though the update deliberately
+        carries the full O(n³) verification matmul."""
+        from tpu_jordan.serve import JordanService, k_bucket_for
+
+        n, k = 128, 16          # k = n/8, the documented boundary
+        with JordanService(batch_cap=1, max_wait_ms=0.5) as svc:
+            svc.warmup(update_shapes=[(n, k)])
+            ex_upd = svc.executors.get(n, 1, svc._batcher.block_size,
+                                       workload="update",
+                                       rhs=k_bucket_for(k))
+            ex_inv = svc.executors.get(n, 1, svc._batcher.block_size)
+        if not (ex_upd.cost.available and ex_upd.cost.flops
+                and ex_inv.cost.available and ex_inv.cost.flops):
+            pytest.skip("backend exposes no cost_analysis")
+        assert ex_upd.cost.flops < ex_inv.cost.flops, (
+            f"update executable {ex_upd.cost.flops:.3g} FLOPs not "
+            f"below fresh invert {ex_inv.cost.flops:.3g}")
+
+    def test_singular_update_gated_handle_untouched(self, rng):
+        from tpu_jordan.serve import JordanService
+
+        n, k = 32, 2
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        with JordanService(batch_cap=1, max_wait_ms=0.5) as svc:
+            ref = svc.invert(a, resident=True, timeout=120)
+            st = svc.handles.get(ref.handle_id)
+            u = np.zeros((n, k), np.float32)
+            v = np.zeros((n, k), np.float32)
+            u[:, 0] = -np.asarray(st.a[:n, 0])
+            v[0, 0] = 1.0
+            res = svc.submit_update(ref, u, v).result(120)
+            assert res.singular and res.update_outcome == "gated"
+            assert svc.handles.get(ref.handle_id).version == 0
+            # The sync surface raises typed; state still untouched.
+            from tpu_jordan.driver import SingularMatrixError
+
+            with pytest.raises(SingularMatrixError):
+                svc.update(ref, u, v, timeout=120)
+            # A later healthy update still lands.
+            u2, v2 = _factors(rng, n, k)
+            ok = svc.update(ref, u2, v2, timeout=120)
+            assert ok.update_outcome == "refreshed"
+            assert ok.handle_version == 1
+
+    def test_forced_drift_budget_fires_re_invert_rung(self, rng):
+        from tpu_jordan.obs.metrics import REGISTRY
+        from tpu_jordan.serve import JordanService
+
+        n, k = 32, 2
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        u, v = _factors(rng, n, k)
+        rungs = REGISTRY.counter("tpu_jordan_recovery_rungs_total")
+        before = rungs.total()
+        with JordanService(batch_cap=1, max_wait_ms=0.5,
+                           update_drift_budget_factor=0.0) as svc:
+            svc.warmup(update_shapes=[(n, k)])
+            warm = svc.stats()["totals"]["compiles"]
+            ref = svc.invert(a, resident=True, timeout=120)
+            res = svc.update(ref, u, v, timeout=120)
+            compiles = svc.stats()["totals"]["compiles"]
+        assert res.update_outcome == "re_inverted"
+        assert res.drift == 0.0
+        assert rungs.total() == before + 1
+        # The rung rode the WARM invert lane: still zero compiles.
+        assert compiles == warm
+        want = np.linalg.inv(a + u @ v.T)
+        assert np.abs(np.asarray(res.inverse) - want).max() < 1e-3
+
+    def test_summary_spike_causally_precedes_update_rung(self, rng):
+        """Review hardening (the ISSUE 10 causality discipline on the
+        update lane): a drift-forced re_invert rung under
+        numerics='summary' is preceded — by seq — by a numerics_spike
+        (signal='drift'): the budget exceedance records its own
+        breadcrumb, since every individual residual passed the gate."""
+        from tpu_jordan.obs.recorder import RECORDER
+        from tpu_jordan.serve import JordanService
+
+        n, k = 32, 2
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        u, v = _factors(rng, n, k)
+        mark = RECORDER.total
+        with JordanService(batch_cap=1, max_wait_ms=0.5,
+                           numerics="summary",
+                           update_drift_budget_factor=0.0) as svc:
+            svc.warmup(update_shapes=[(n, k)])
+            ref = svc.invert(a, resident=True, timeout=120)
+            res = svc.update(ref, u, v, timeout=120)
+        assert res.update_outcome == "re_inverted"
+        events = RECORDER.since(mark)
+        spikes = [e["seq"] for e in events
+                  if e["kind"] == "numerics_spike"]
+        rungs = [e["seq"] for e in events
+                 if e["kind"] == "recovery_rung"]
+        assert spikes and rungs and min(spikes) < min(rungs)
+        assert any(e.get("signal") == "drift" for e in events
+                   if e["kind"] == "numerics_spike")
+
+    def test_deadline_exceeded_leaves_handle_untouched(self, rng):
+        """A typed update failure NEVER leaves a half-trusted
+        mutation: a deadline-expired update fails typed with the
+        committed state (and version) untouched."""
+        from tpu_jordan.resilience.policy import DeadlineExceededError
+        from tpu_jordan.serve import JordanService
+
+        n, k = 24, 2
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        u, v = _factors(rng, n, k)
+        with JordanService(batch_cap=1, max_wait_ms=0.5) as svc:
+            ref = svc.invert(a, resident=True, timeout=120)
+            fut = svc.submit_update(ref, u, v, deadline_ms=0.0)
+            with pytest.raises(DeadlineExceededError):
+                fut.result(60)
+            assert svc.handles.get(ref.handle_id).version == 0
+
+    def test_update_against_unknown_handle_typed(self):
+        from tpu_jordan.serve import (HandleRef, JordanService,
+                                      UnknownHandleError)
+
+        with JordanService(batch_cap=1, max_wait_ms=0.5) as svc:
+            ghost = HandleRef("ghost", 16, 64, "float32")
+            fut = svc.submit_update(ghost, np.zeros((16, 1), np.float32),
+                                    np.zeros((16, 1), np.float32))
+            with pytest.raises(UnknownHandleError):
+                fut.result(60)
+
+    def test_typed_failures_never_trip_the_lane_breaker(self, rng):
+        """Review hardening: typed caller/numerics outcomes (an
+        evicted/unknown handle) are THAT rider's answer, not
+        lane-health evidence — K of them in a row must NOT open the
+        update lane's breaker or shed healthy handles' traffic."""
+        from tpu_jordan.serve import (HandleRef, JordanService,
+                                      UnknownHandleError)
+
+        n, k = 32, 2
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        u, v = _factors(rng, n, k)
+        with JordanService(batch_cap=1, max_wait_ms=0.5) as svc:
+            svc.warmup(update_shapes=[(n, k)])
+            ref = svc.invert(a, resident=True, timeout=120)
+            ghost = HandleRef("ghost", n, ref.bucket_n, "float32")
+            for _ in range(5):        # > the breaker's K=3
+                with pytest.raises(UnknownHandleError):
+                    svc.submit_update(ghost, u, v).result(60)
+            # The lane still serves healthy handles — no CircuitOpen.
+            ok = svc.update(ref, u, v, timeout=120)
+            assert ok.update_outcome == "refreshed"
+            states = svc.stats()["breakers"]
+        assert all(s != "open" for s in states.values()), states
+
+
+class TestFleetDurability:
+    def test_kill_mid_update_stream_bitmatches_fault_free(self, rng):
+        """The ISSUE 12 chaos pin at test scale: a seeded replica_kill
+        mid-update-stream loses nothing — every per-update outcome AND
+        the final resident inverse bit-match the fault-free replay
+        (the shared HandleStore is the durability boundary), with zero
+        compiles after warmup across the kill + warm replacement."""
+        from tpu_jordan.fleet import JordanFleet
+        from tpu_jordan.obs.metrics import REGISTRY
+        from tpu_jordan.resilience import FaultPlan, activate
+
+        n, k = 48, 4
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        stream = [_factors(rng, n, k) for _ in range(5)]
+
+        def run(plan):
+            outs = []
+            with JordanFleet(replicas=2, batch_cap=2, max_wait_ms=0.5,
+                             stable_after_s=0.2,
+                             liveness_deadline_s=5.0) as flt:
+                flt.warmup([n], update_shapes=[(n, k)])
+                warm = REGISTRY.counter(
+                    "tpu_jordan_compiles_total").total()
+                if plan is not None:
+                    cm = activate(plan)
+                    cm.__enter__()
+                try:
+                    ref = flt.invert(a, resident=True, handle_id="t",
+                                     timeout=120)
+                    for u, v in stream:
+                        r = flt.update(ref, u, v, timeout=120)
+                        outs.append((r.update_outcome, r.handle_version,
+                                     np.asarray(r.inverse).tobytes()))
+                finally:
+                    if plan is not None:
+                        cm.__exit__(None, None, None)
+                final = np.asarray(
+                    flt.handles.get("t").inverse).tobytes()
+                compiles = REGISTRY.counter(
+                    "tpu_jordan_compiles_total").total() - warm
+            return outs, final, compiles
+
+        base, base_final, c0 = run(None)
+        plan = FaultPlan.seeded(0, points={"replica_kill": (1, 4)})
+        chaos, chaos_final, c1 = run(plan)
+        assert plan.injected_total >= 1
+        assert chaos == base
+        assert chaos_final == base_final
+        assert c0 == 0 and c1 == 0
+        assert [o[1] for o in base] == [1, 2, 3, 4, 5]
+
+    def test_rolling_restart_serves_updates_warm(self, rng):
+        """A supervisor-replaced replica serves the update lane with
+        ZERO compiles (shared executor store + shared handle store:
+        nothing replica-local to rebuild) — the warm-path pin across a
+        rolling restart."""
+        from tpu_jordan.fleet import JordanFleet
+        from tpu_jordan.obs.metrics import REGISTRY
+
+        n, k = 48, 4
+        a = rng.standard_normal((n, n)).astype(np.float32)
+        with JordanFleet(replicas=2, batch_cap=2, max_wait_ms=0.5,
+                         stable_after_s=0.2, liveness_deadline_s=5.0,
+                         autostart_supervisor=False) as flt:
+            flt.warmup([n], update_shapes=[(n, k)])
+            ref = flt.invert(a, resident=True, handle_id="r",
+                             timeout=120)
+            u, v = _factors(rng, n, k)
+            r1 = flt.update(ref, u, v, timeout=120)
+            warm = REGISTRY.counter("tpu_jordan_compiles_total").total()
+            # Kill EVERY slot, then let the supervisor install warm
+            # replacements (the worst rolling-restart instant).
+            for slot in flt.slot_table():
+                slot.replica.kill(reason="test")
+            flt.supervisor.check()
+            assert len(flt.live_replicas()) >= 1
+            u2, v2 = _factors(rng, n, k)
+            r2 = flt.update(ref, u2, v2, timeout=120)
+            compiles_after = REGISTRY.counter(
+                "tpu_jordan_compiles_total").total()
+        assert r1.handle_version == 1 and r2.handle_version == 2
+        assert compiles_after == warm
+        assert r2.update_outcome == "refreshed"
+
+
+class TestUpdateDemoAndChecker:
+    def test_demo_report_valid_and_doctored_stale_exits_2(self, tmp_path):
+        """Both-ways gate (the repo's checker discipline): a real
+        small-scale demo report validates clean, and doctored-stale
+        variants — a bit mismatch, a failed gate, an unaccounted
+        update — each exit 2."""
+        import copy
+        import json
+
+        from tpu_jordan.serve.update_demo import update_demo
+
+        _repo = pathlib.Path(__file__).resolve().parent.parent
+        spec = importlib.util.spec_from_file_location(
+            "check_update", _repo / "tools" / "check_update.py")
+        check_update = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(check_update)
+
+        report = update_demo(n=128, rank=8, updates=4, replicas=2,
+                             kills=1, seed=0)
+        errs, stale = check_update.check(report)
+        assert errs == [] and stale == [], (errs, stale)
+        assert report["latency"]["update_beats_reinvert"]
+        assert report["chaos"]["final_inverse_bitmatch_replay"]
+
+        def rc(rep, tmp_name):
+            p = tmp_path / tmp_name
+            p.write_text(json.dumps(rep))
+            return check_update.main([str(p)])
+
+        assert rc(report, "ok.json") == 0
+        d1 = copy.deepcopy(report)
+        d1["chaos"]["final_inverse_bitmatch_replay"] = False
+        d1["silent_stale"] = True
+        assert rc(d1, "bits.json") == 2
+        d2 = copy.deepcopy(report)
+        d2["verification"]["gate_passes"] = False
+        assert rc(d2, "gate.json") == 2
+        d3 = copy.deepcopy(report)
+        d3["chaos"]["ledger"]["refreshed"] -= 1
+        assert rc(d3, "ledger.json") == 2
+
+    def test_cli_usage_errors_exit_1(self):
+        from tpu_jordan.__main__ import main
+
+        assert main(["96", "32", "--update-demo", "--workers", "8",
+                     "--quiet"]) == 1
+        assert main(["96", "32", "--update-demo", "--batch", "4",
+                     "--quiet"]) == 1
+        assert main(["96", "32", "--update-demo", "--replicas", "1",
+                     "--quiet"]) == 1
+        assert main(["96", "32", "--update-demo", "--rank", "64",
+                     "--quiet"]) == 1          # rank > n/8
+        assert main(["96", "32", "--update-demo", "--updates", "2",
+                     "--quiet"]) == 1
+        assert main(["96", "32", "--update-demo", "--slo-report",
+                     "--quiet"]) == 1
+        assert main(["96", "32", "--update-demo", "--batch-cap", "4",
+                     "--quiet"]) == 1
+        assert main(["96", "32", "--update-demo", "--plan-cache",
+                     "/tmp/p.json", "--quiet"]) == 1
+        assert main(["96", "32", "--update-demo", "--scaling-floor",
+                     "2.5", "--quiet"]) == 1
+        # --rank/--updates outside --update-demo: typed usage errors.
+        assert main(["96", "32", "--rank", "8", "--quiet"]) == 1
+        assert main(["96", "32", "--updates", "9", "--quiet"]) == 1
+
+
+class TestRegistryAndKeys:
+    def test_update_workload_resolves_smw_engine(self):
+        from tpu_jordan.tuning.plan_cache import plan_key
+        from tpu_jordan.tuning.registry import TunePoint, candidates
+
+        pt = TunePoint.create(256, 64, "float32", workers=1,
+                              backend="cpu", workload="update")
+        cands = candidates(pt)
+        assert [c.name for c in cands] == ["smw_update"]
+        assert plan_key(pt).endswith("|wupdate")
+        # Invert keys stay byte-identical (no workload segment).
+        base = TunePoint.create(256, 64, "float32", workers=1,
+                                backend="cpu")
+        assert "|w" not in plan_key(base)
+
+    def test_update_flop_convention(self):
+        from tpu_jordan.obs.hwcost import baseline_workload_flops
+
+        n, k = 100, 10
+        assert baseline_workload_flops(n, "update", k=k) == \
+            4.0 * n * n * k + 2.0 * n * k * k
+
+    def test_tune_refuses_update_workload_typed(self):
+        """Review hardening: measuring the update workload is a typed
+        refusal (one engine, nothing to rank) — never a silently
+        mis-measured solve kernel landing under the |wupdate| key."""
+        from tpu_jordan.driver import UsageError
+        from tpu_jordan.tuning.registry import TunePoint, get
+        from tpu_jordan.tuning.tuner import measure_config
+
+        pt = TunePoint.create(64, 32, "float32", workers=1,
+                              backend="cpu", workload="update")
+        with pytest.raises(UsageError, match="nothing to measure"):
+            measure_config(pt, get("smw_update"), samples=1)
+
+    def test_k_bucket_rounding(self):
+        from tpu_jordan.serve import MIN_UPDATE_K, k_bucket_for
+
+        assert k_bucket_for(1) == MIN_UPDATE_K
+        assert k_bucket_for(8) == 8
+        assert k_bucket_for(9) == 16
+        assert k_bucket_for(32) == 32
+        with pytest.raises(ValueError):
+            k_bucket_for(0)

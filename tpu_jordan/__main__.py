@@ -209,7 +209,11 @@ def _main(argv, state) -> int:
                          "its inverse inf-norm — the paper's selection "
                          "criterion — candidate spread, element-growth "
                          "watermark) from the instrumented unrolled "
-                         "engines (single-device).  Both mirror into "
+                         "engines (single-device; --workload solve "
+                         "traces the [A | B] elimination the same "
+                         "way, pivot sequence pinned equal — the SPD "
+                         "fast path has no probe to trace and "
+                         "refuses typed).  Both mirror into "
                          "the tpu_jordan_pivot_condition/"
                          "growth_factor/residual histograms and spike "
                          "the flight recorder before any recovery "
@@ -271,9 +275,37 @@ def _main(argv, state) -> int:
                          "fault-free replay or carried a typed error "
                          "(exit 2 on any silent loss; "
                          "tools/check_fleet.py validates the report)")
+    ap.add_argument("--update-demo", action="store_true",
+                    help="run the resident-inverse update acceptance "
+                         "demo (tpu_jordan.serve.update_demo; ISSUE 12, "
+                         "docs/WORKLOADS.md): a warmed service creates "
+                         "a resident handle (invert(resident=True)) "
+                         "and streams --updates rank-K (--rank) "
+                         "Sherman-Morrison-Woodbury mutations through "
+                         "the O(n^2 k) update lane (one deliberately "
+                         "rank-destroying mutation mid-stream -> typed "
+                         "'gated'; a zero-drift-budget burst -> the "
+                         "'re_invert' rung), measures warm update vs "
+                         "warm re-invert latency + executable "
+                         "cost_analysis FLOPs, then replays the same "
+                         "stream through an N-replica fleet under a "
+                         "seeded replica_kill — the post-kill resident "
+                         "inverse must bit-match the fault-free replay "
+                         "and gate-verify against a from-scratch solve "
+                         "of the mutated matrix; prints ONE JSON line "
+                         "(exit 2 = a silently stale inverse; "
+                         "tools/check_update.py validates)")
+    ap.add_argument("--rank", type=int, default=32, metavar="K",
+                    help="--update-demo: rank of each mutation "
+                         "(default 32; the FLOP/latency wins need "
+                         "k <= n/8)")
+    ap.add_argument("--updates", type=int, default=8, metavar="M",
+                    help="--update-demo: mutations per stream "
+                         "(default 8; >= 3 so the ledger shows "
+                         "refreshed + gated outcomes)")
     ap.add_argument("--replicas", type=int, default=3, metavar="N",
-                    help="--fleet-demo: replica slots in the pool "
-                         "(default 3; >= 2)")
+                    help="--fleet-demo/--update-demo: replica slots in "
+                         "the pool (default 3; >= 2)")
     ap.add_argument("--kills", type=int, default=2, metavar="K",
                     help="--fleet-demo: seeded replica_kill injections "
                          "(default 2)")
@@ -339,6 +371,8 @@ def _main(argv, state) -> int:
             raise ValueError("--sleep must be non-negative")
         if args.serve_requests < 1 or args.batch_cap < 1:
             raise ValueError("--serve-requests/--batch-cap must be >= 1")
+        if args.rank < 1 or args.updates < 3:
+            raise ValueError("--rank must be >= 1 and --updates >= 3")
         if args.rhs < 1:
             raise ValueError("--rhs must be >= 1")
         if args.max_wait_ms < 0:
@@ -407,11 +441,92 @@ def _main(argv, state) -> int:
                              "(the pivot-free SPD fast path)")
         if args.workload == "invert" and args.rhs != 1:
             raise UsageError("--rhs applies to --workload solve/lstsq")
+        if not args.update_demo and (args.rank != 32 or args.updates != 8):
+            raise UsageError("--rank/--updates apply to --update-demo "
+                             "(the resident-inverse update acceptance "
+                             "run)")
         if (args.generator == "crand"
                 and jnp.dtype(args.dtype).kind != "c"):
             raise UsageError("--generator crand is complex-valued; a "
                              "real --dtype would silently discard the "
                              "imaginary part (use --dtype complex64)")
+        if args.update_demo:
+            # Update demo (ISSUE 12): the fleet-demo restriction shape
+            # (single device, deterministic seeded fixtures, gathered)
+            # and the same 0/1/2 taxonomy — exit 2 IS the
+            # silently-stale-inverse alarm (a resident inverse that
+            # diverged from the fault-free replay, failed the gate
+            # against a from-scratch solve of the mutated matrix
+            # without a typed outcome, or an unaccounted update).
+            if (args.serve_demo or args.chaos_demo or args.fleet_demo
+                    or args.numerics_demo):
+                raise UsageError("--update-demo, --fleet-demo, "
+                                 "--chaos-demo, --serve-demo and "
+                                 "--numerics-demo are distinct modes; "
+                                 "pick one")
+            if args.file is not None or args.workers != 1 or not args.gather:
+                raise UsageError(
+                    "--update-demo runs on a single device (gathered "
+                    "output, deterministic seeded fixtures)")
+            if args.batch > 1 or args.tune:
+                raise UsageError("--update-demo takes no --batch/--tune")
+            if args.group != 0 or args.engine == "swapfree":
+                raise UsageError("--update-demo engines are "
+                                 "single-device (auto resolution); "
+                                 "--group does not apply")
+            if args.workload != "invert":
+                raise UsageError("--update-demo streams resident-invert"
+                                 " + update requests; --workload does "
+                                 "not apply")
+            if args.numerics != "off":
+                raise UsageError("--update-demo's replay-compare "
+                                 "semantics are pinned; --numerics "
+                                 "does not apply")
+            if args.slo_report:
+                raise UsageError("--slo-report is a --fleet-demo leg "
+                                 "(the burn-rate monitor evaluates the "
+                                 "fleet's request-outcome series)")
+            if (args.serve_requests != 64 or args.batch_cap != 8
+                    or args.max_wait_ms != 2.0):
+                raise UsageError("--update-demo streams --updates "
+                                 "sequential mutations (cap-1 lanes); "
+                                 "--serve-requests/--batch-cap/"
+                                 "--max-wait-ms do not apply")
+            if args.plan_cache is not None or args.scaling_floor is not None:
+                raise UsageError("--update-demo resolves its lanes "
+                                 "through the cost-only ladder and "
+                                 "measures update-vs-reinvert latency "
+                                 "directly; --plan-cache/"
+                                 "--scaling-floor do not apply")
+            if args.replicas < 2:
+                raise UsageError("--update-demo needs --replicas >= 2")
+            if args.kills < 1:
+                raise UsageError("--update-demo needs --kills >= 1")
+            if args.rank > args.n // 8:
+                raise UsageError("--update-demo needs --rank <= n/8 "
+                                 "(the documented regime where the "
+                                 "update executable's FLOPs beat the "
+                                 "fresh invert's)")
+            import json as _json
+
+            from .serve import update_demo
+
+            report = update_demo(
+                n=args.n, block_size=args.m, rank=args.rank,
+                updates=args.updates, replicas=args.replicas,
+                kills=args.kills, seed=args.chaos_seed,
+                dtype=jnp.dtype(args.dtype), telemetry=telemetry)
+            if args.quiet:
+                report["chaos"]["faults"].pop("log", None)
+            print(_json.dumps(report))
+            if report["silent_stale"]:
+                print(f"silently stale resident inverse: "
+                      f"{len(report['mismatches'])} mismatches, "
+                      f"gate_passes="
+                      f"{report['verification']['gate_passes']}",
+                      file=sys.stderr)
+                return 2
+            return 0
         if args.fleet_demo:
             # Fleet demo: the --chaos-demo restrictions (single device,
             # deterministic fixtures, gathered) and the same 0/1/2

@@ -41,6 +41,7 @@ from ..obs import metrics as _obs_metrics
 from ..obs.journey import JourneyLog
 from ..resilience.policy import DEFAULT_POLICY, CircuitBreaker
 from ..serve.executors import ExecutorStore
+from ..serve.handles import HandleStore
 from ..serve.service import JordanService
 from ..tuning.plan_cache import PlanCache
 from .replica import READY, Replica
@@ -105,6 +106,8 @@ class JordanFleet:
                  default_deadline_ms: float | None = None,
                  telemetry=None,
                  executor_store: ExecutorStore | None = None,
+                 handle_store: HandleStore | None = None,
+                 update_drift_budget_factor: float | None = None,
                  heartbeat_interval_s: float = 0.05,
                  liveness_deadline_s: float = 1.0,
                  check_interval_s: float = 0.05,
@@ -120,6 +123,13 @@ class JordanFleet:
         self.clock = clock if clock is not None else time.monotonic
         self.store = (executor_store if executor_store is not None
                       else ExecutorStore())
+        # Resident-handle store (ISSUE 12): like the executor store,
+        # ONE instance shared by every replica — and every warm
+        # replacement — so a replica_kill never loses resident state
+        # and updates write through fleet-wide (docs/FLEET.md).
+        self.handles = (handle_store if handle_store is not None
+                        else HandleStore())
+        self._handle_seq = 0
         self.policy = DEFAULT_POLICY if policy == "default" else policy
         if plan_cache is not None and plan_cache_read_only:
             # Load the shared pre-tuned file ONCE: every replica — and
@@ -136,7 +146,8 @@ class JordanFleet:
             max_queue=max_queue, block_size=block_size,
             telemetry=telemetry, policy=self.policy,
             default_deadline_ms=default_deadline_ms,
-            shared_executors=self.store)
+            shared_executors=self.store, shared_handles=self.handles,
+            update_drift_budget_factor=update_drift_budget_factor)
         self._hb_interval = float(heartbeat_interval_s)
         self.restart_grace_s = float(restart_grace_s)
         # A Condition, not a bare Lock: router threads that find ZERO
@@ -144,6 +155,9 @@ class JordanFleet:
         # on it for the supervisor's replacement instead of typed-
         # failing work a warm worker could serve milliseconds later.
         self._lock = threading.Condition()
+        #: update-lane (n, k) pairs the fleet has warmed — replacement
+        #: replicas re-warm these too (a store lookup: zero compiles).
+        self._warm_updates: set[tuple[int, int]] = set()
         # Close teardown serializes here (the Condition above must stay
         # free for grace-waiting routers): a racing second close()
         # blocks until the first has drained every replica, exactly
@@ -263,6 +277,10 @@ class JordanFleet:
         with self._lock:
             return sorted(self._warm_shapes)
 
+    def warm_update_shapes(self):
+        with self._lock:
+            return sorted(self._warm_updates)
+
     def _record_bucket(self, bucket: int) -> None:
         # Buckets only in _warm_shapes: warmup() normalizes raw request
         # sizes through bucket_for too, so the set never conflates the
@@ -296,33 +314,93 @@ class JordanFleet:
                                   deadline_ms=deadline_ms)
 
     def invert(self, a, timeout: float | None = None,
-               deadline_ms: float | None = None):
+               deadline_ms: float | None = None, resident: bool = False,
+               handle_id: str | None = None):
+        """Synchronous fleet invert.  ``resident=True`` (ISSUE 12)
+        installs the result as a resident handle in the FLEET-SHARED
+        handle store and returns the :class:`~..serve.handles.HandleRef`
+        — any replica (including every future warm replacement) can
+        serve ``update(ref, u, v)`` against it."""
         res = self.submit(a, deadline_ms=deadline_ms).result(timeout)
         if res.singular:
             from ..driver import SingularMatrixError
 
             raise SingularMatrixError("singular matrix")
+        if not resident:
+            return res
+        from ..serve.handles import create_resident_handle
+
+        if handle_id is None:
+            with self._lock:
+                self._handle_seq += 1
+                handle_id = f"fh{self._handle_seq}"
+        import jax.numpy as jnp
+
+        return create_resident_handle(
+            self.handles, jnp.dtype(self._svc_kw["dtype"]), a, res,
+            handle_id)
+
+    def submit_update(self, handle, u, v,
+                      deadline_ms: float | None = None):
+        """Route one rank-k resident-inverse update through the fleet
+        (ISSUE 12): the router picks a READY replica (bucket affinity,
+        breaker-aware), the replica's update lane mutates the handle's
+        committed state in the shared store, and a mid-flight replica
+        death re-queues the request — the retry re-reads committed
+        state, so an update is applied exactly once."""
+        if deadline_ms is None:
+            deadline_ms = self._svc_kw["default_deadline_ms"]
+        return self.router.submit_update(handle, u, v,
+                                         self._svc_kw["dtype"],
+                                         deadline_ms=deadline_ms)
+
+    def update(self, handle, u, v, timeout: float | None = None,
+               deadline_ms: float | None = None):
+        """Synchronous ``submit_update`` + wait; raises
+        ``SingularMatrixError`` when the mutation destroyed rank
+        (typed — the committed resident state is untouched)."""
+        res = self.submit_update(handle, u, v,
+                                 deadline_ms=deadline_ms).result(timeout)
+        if res.singular:
+            from ..driver import SingularMatrixError
+
+            raise SingularMatrixError(
+                "singular matrix (rank-k update destroyed rank; "
+                "resident state unchanged)")
         return res
 
     # ---- lifecycle ---------------------------------------------------
 
-    def warmup(self, shapes) -> dict:
+    def warmup(self, shapes, update_shapes=()) -> dict:
         """Warm every replica against the shared store: the FIRST
         replica to reach each bucket compiles it (once, fleet-wide);
         every other replica — and every future replacement — finds it
-        built.  Returns {bucket: engine} from the last replica."""
+        built.  Returns {bucket: engine} from the last replica.
+
+        ``update_shapes`` (ISSUE 12): (n, k) pairs warming the
+        resident-update lanes (and each n's invert lane — handle
+        creation and the re_invert rung ride it); replacements re-warm
+        these too."""
         from ..serve.executors import bucket_for
 
+        from ..serve.executors import k_bucket_for
+
         shapes = [int(s) for s in shapes]
+        update_shapes = [(int(n), int(k)) for n, k in update_shapes]
         with self._lock:
             # Normalized to buckets — the same coordinates
             # _record_bucket stores — so stats()["warm_shapes"] reports
             # what the fleet actually serves and a replacement's warmup
-            # never re-resolves duplicate sizes of one bucket.
+            # never re-resolves duplicate sizes of one bucket.  The
+            # update set follows the same invariant with its lane
+            # coordinates: (bucket_n, k_bucket).
             self._warm_shapes.update(bucket_for(s) for s in shapes)
+            self._warm_updates.update(
+                (bucket_for(n), k_bucket_for(k))
+                for n, k in update_shapes)
         out = {}
         for replica in self.live_replicas():
-            out = replica.warmup(shapes)
+            out = replica.warmup(shapes, update_shapes=update_shapes)
         return out
 
     def start(self) -> None:
@@ -396,6 +474,9 @@ class JordanFleet:
             # drained = silent loss.
             "journey_ledger": self.journey.ledger(),
             "warm_shapes": self.warm_shapes(),
+            "warm_update_shapes": [list(p) for p
+                                   in self.warm_update_shapes()],
             "executors_compiled": len(self.store),
+            "handles": self.handles.snapshot(),
             "slots": per_slot,
         }

@@ -123,14 +123,11 @@ class Replica:
 
     # ---- request path ------------------------------------------------
 
-    def submit(self, a, deadline_ms: float | None = None, ctx=None):
-        """Route one request into this replica's service.  Raises
-        :class:`ReplicaKilledError` when the replica is not serving —
-        including the case where THIS call is the one the seeded
-        ``replica_kill`` schedule crashes (the request never entered a
-        queue; the router re-dispatches it elsewhere).  ``ctx`` is the
-        fleet-level journey context (ISSUE 8), threaded through so one
-        request keeps ONE journey across replicas."""
+    def _admit(self, ctx) -> None:
+        """The shared dispatch guard every request kind passes: refuse
+        when not serving, and fire the seeded ``replica_kill`` point —
+        THIS call may be the one the schedule crashes (the request
+        never entered a queue; the router re-dispatches it)."""
         if self.state != READY:
             raise ReplicaKilledError(
                 f"replica {self.name} is {self.state}, not serving")
@@ -147,10 +144,31 @@ class Replica:
             raise ReplicaKilledError(
                 f"replica {self.name} crashed at dispatch "
                 f"(injected replica_kill)") from e
+
+    def submit(self, a, deadline_ms: float | None = None, ctx=None):
+        """Route one request into this replica's service.  Raises
+        :class:`ReplicaKilledError` when the replica is not serving —
+        including the case where THIS call is the one the seeded
+        ``replica_kill`` schedule crashes.  ``ctx`` is the fleet-level
+        journey context (ISSUE 8), threaded through so one request
+        keeps ONE journey across replicas."""
+        self._admit(ctx)
         return self.service.submit(a, deadline_ms=deadline_ms, _ctx=ctx)
 
-    def warmup(self, shapes) -> dict:
-        return self.service.warmup(shapes)
+    def submit_update(self, handle, u, v,
+                      deadline_ms: float | None = None, ctx=None):
+        """Route one resident-inverse update into this replica's
+        service (ISSUE 12) — same admission guard, same kill
+        semantics: the handle's committed state lives in the
+        fleet-shared store, so a crash here loses nothing (the router
+        re-queues and the retry re-reads committed state)."""
+        self._admit(ctx)
+        return self.service.submit_update(handle, u, v,
+                                          deadline_ms=deadline_ms,
+                                          _ctx=ctx)
+
+    def warmup(self, shapes, update_shapes=()) -> dict:
+        return self.service.warmup(shapes, update_shapes=update_shapes)
 
     def breaker_allows(self, bucket_n: int) -> bool:
         """Router shedding hook: False while this replica's per-bucket

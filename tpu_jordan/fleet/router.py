@@ -69,7 +69,13 @@ class _FleetRequest:
     replica serves it), the caller's ABSOLUTE deadline, the reroute
     budget spent so far, the outer future the caller holds, and the
     fleet-level journey context (ISSUE 8 — ONE journey per request,
-    however many replicas it visits)."""
+    however many replicas it visits).
+
+    ``kind="update"`` (ISSUE 12) routes a resident-inverse update
+    instead: ``handle``/``u``/``v`` replace ``a``; the re-queue path is
+    identical — the handle's committed state lives in the fleet-shared
+    store, so a retried update re-reads it (exactly-once application
+    across any number of reroute hops)."""
 
     a: np.ndarray
     n: int
@@ -79,11 +85,27 @@ class _FleetRequest:
     attempts: int = 0
     t_submit: float = field(default=0.0)
     ctx: object = None                   # obs.journey.RequestContext
+    kind: str = "invert"                 # "invert" | "update"
+    handle: object = None                # HandleRef (update kind)
+    u: np.ndarray = None                 # (n, k) update factors
+    v: np.ndarray = None
 
     def remaining_ms(self, now: float) -> float | None:
         if self.t_deadline is None:
             return None
         return (self.t_deadline - now) * 1e3
+
+    @property
+    def breaker_key(self):
+        """The per-replica breaker this request's lane trips: invert
+        lanes keep the historical bare bucket int; update lanes use
+        their serve lane label, so the router sheds exactly what the
+        replica's admission would fast-fail."""
+        if self.kind == "update":
+            from ..serve.executors import k_bucket_for
+
+            return f"update:{self.bucket}:k{k_bucket_for(self.u.shape[1])}"
+        return self.bucket
 
     @property
     def rid(self) -> str | None:
@@ -135,6 +157,36 @@ class Router:
             raise
         return outer
 
+    def submit_update(self, handle, u, v, dtype,
+                      deadline_ms: float | None = None) -> Future:
+        """Route one rank-k resident-inverse update (ISSUE 12): the
+        same front door as ``submit`` — one fleet-level journey
+        (``workload="update"``), bucket-affinity candidate order off
+        the HANDLE's bucket, typed backpressure, death re-queue."""
+        from ..linalg.update import as_update_factors
+
+        n = int(handle.n)
+        u, v, _ = as_update_factors(u, v, n, dtype)
+        now = time.monotonic()
+        outer = Future()
+        outer.set_running_or_notify_cancel()
+        req = _FleetRequest(
+            a=None, n=n, bucket=int(handle.bucket_n), outer=outer,
+            t_deadline=(None if deadline_ms is None
+                        else now + float(deadline_ms) / 1e3),
+            t_submit=now,
+            ctx=self.pool.journey.new(n, int(handle.bucket_n),
+                                      workload="update"),
+            kind="update", handle=handle, u=u, v=v)
+        self.pool._account_submitted()
+        try:
+            self._dispatch(req)
+        except Exception as e:
+            self.pool._account_resolved(ok=False)
+            req.ctx.close("error", error=type(e).__name__)
+            raise
+        return outer
+
     # ---- dispatch / re-queue ----------------------------------------
 
     def _candidates(self, bucket: int):
@@ -174,7 +226,7 @@ class Router:
                 shed_dead += down
                 req.hop("shed", reason="dead", slots_down=down)
             for replica in candidates:
-                if not replica.breaker_allows(req.bucket):
+                if not replica.breaker_allows(req.breaker_key):
                     _M_SHED.inc(reason="breaker", exemplar=req.rid)
                     shed_breaker += 1
                     req.hop("shed", reason="breaker",
@@ -188,10 +240,18 @@ class Router:
                 req.hop("route", replica=replica.name,
                         slot=replica.slot, attempt=req.attempts)
                 try:
-                    inner = replica.submit(
-                        req.a,
-                        deadline_ms=req.remaining_ms(time.monotonic()),
-                        ctx=req.ctx)
+                    if req.kind == "update":
+                        inner = replica.submit_update(
+                            req.handle, req.u, req.v,
+                            deadline_ms=req.remaining_ms(
+                                time.monotonic()),
+                            ctx=req.ctx)
+                    else:
+                        inner = replica.submit(
+                            req.a,
+                            deadline_ms=req.remaining_ms(
+                                time.monotonic()),
+                            ctx=req.ctx)
                 except (ReplicaKilledError, ServiceClosedError):
                     # Died between the candidate scan and the submit
                     # (or THIS submit triggered the seeded kill): not

@@ -165,7 +165,8 @@ class Supervisor:
         if slot.breaker.allow():
             try:
                 replacement = pool._spawn_replica(slot.index)
-                replacement.warmup(pool.warm_shapes())
+                replacement.warmup(pool.warm_shapes(),
+                                   update_shapes=pool.warm_update_shapes())
             except Exception as e:      # noqa: BLE001 — counted, retried
                 _M_RESTART_FAILURES.inc(replica=str(slot.index))
                 _recorder.record("restart_failure", slot=slot.index,
@@ -210,7 +211,8 @@ class Supervisor:
         replica = None
         try:
             replica = pool._spawn_replica(slot.index)
-            replica.warmup(pool.warm_shapes())
+            replica.warmup(pool.warm_shapes(),
+                           update_shapes=pool.warm_update_shapes())
         except Exception as e:          # noqa: BLE001 — counted, retried
             _M_RESTART_FAILURES.inc(replica=str(slot.index))
             _recorder.record("restart_failure", slot=slot.index,

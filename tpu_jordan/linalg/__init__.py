@@ -11,8 +11,14 @@ gate, and the numerics observatory.  docs/WORKLOADS.md is the guide.
 from .api import (LstsqResult, SolveSystemResult, lstsq,
                   resolve_solve_engine, solve_system)
 from .engine import block_jordan_solve, solve_batch_metrics
+from .update import (DRIFT_BUDGET_FACTOR, UpdateResult, drift_budget,
+                     drift_exceeded, smw_update, smw_update_with_metrics,
+                     solve_update)
 
 __all__ = [
-    "LstsqResult", "SolveSystemResult", "block_jordan_solve", "lstsq",
-    "resolve_solve_engine", "solve_batch_metrics", "solve_system",
+    "DRIFT_BUDGET_FACTOR", "LstsqResult", "SolveSystemResult",
+    "UpdateResult", "block_jordan_solve", "drift_budget",
+    "drift_exceeded", "lstsq", "resolve_solve_engine", "smw_update",
+    "smw_update_with_metrics", "solve_batch_metrics", "solve_system",
+    "solve_update",
 ]

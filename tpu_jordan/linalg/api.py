@@ -192,8 +192,13 @@ def solve_system(
     pivoting engine, then (sub-fp32 storage) an fp32 re-solve — and an
     exhausted ladder raises ``ResidualGateError``, never a silently
     wrong X.  ``numerics="summary"`` records the NumericsReport
-    (workload-tagged) with spikes BEFORE any recovery rung; "trace" is
-    an invert-path mode and a typed refusal here.
+    (workload-tagged) with spikes BEFORE any recovery rung;
+    ``numerics="trace"`` (ISSUE 12 satellite — the ROADMAP 1b
+    remainder) additionally stacks the per-superstep pivot/growth
+    health arrays into the same executable (pivot sequence pinned ==
+    the invert engine's on shared fixtures); trace on the
+    ``assume="spd"`` fast path stays a typed refusal (no probe to
+    trace).
 
     ``check=False`` reports a singular system on
     ``result.singular``/``x=None`` instead of raising — the lstsq
@@ -215,11 +220,18 @@ def solve_system(
 
     from ..obs.numerics import resolve_mode
     numerics = resolve_mode(numerics)
-    if numerics == "trace":
+    if numerics == "trace" and assume == "spd":
+        # The trace instruments the condition-based pivot PROBE; the
+        # pivot-free fast path probes exactly one candidate per
+        # superstep — there is no selection to trace, and silently
+        # recording a one-candidate "spread" would be a different
+        # record than the mode promises (the PR 4 honesty discipline,
+        # same shape as the fused-engine refusals on the invert side).
         raise UsageError(
-            "numerics='trace' instruments the unrolled INVERT engines; "
-            "the solve workloads support numerics='summary' (the "
-            "per-superstep instrumentation is ROADMAP remainder work)")
+            "numerics='trace' traces the condition-based pivot probe; "
+            "the assume='spd' fast path has no probe (one diagonal "
+            "candidate per superstep) — use numerics='summary', or "
+            "assume='general'")
 
     engine, workload = resolve_solve_engine(engine, assume)
     if (tune or plan_cache is not None) and engine != "auto":
@@ -269,18 +281,27 @@ def _solve_system_impl(a, b2, n, k, m, dtype, engine, spd, workload,
                        plan, tel, policy, numerics, check, verbose):
     from ..driver import SingularMatrixError, _record_compile
 
+    # ISSUE 10 remainder (ROADMAP 1b): the instrumented per-superstep
+    # trace twin, stacked into the SAME compiled executable — X bits
+    # untouched, pivot sequence pinned equal to the invert engine's.
+    collect = numerics == "trace"
     with tel.span("compile", engine=engine, n=n, k=k) as csp:
         def _compile():
             _faults.fire("compile")
             return jax.jit(
-                lambda aa, bb: block_jordan_solve(aa, bb, block_size=m,
-                                                  spd=spd)
+                lambda aa, bb: block_jordan_solve(
+                    aa, bb, block_size=m, spd=spd,
+                    collect_stats=collect)
             ).lower(a, b2).compile()
         compiled = (policy.retry.call(_compile,
                                       component="solve_system.compile")
                     if policy is not None else _compile())
     _record_compile(csp, "solve_system")
     exe_cost = _hwcost.executable_cost(compiled)
+    # The recovery ladder refines through the same executable and
+    # expects the (x, singular) pair whichever mode compiled.
+    run_compiled = ((lambda aa, bb: compiled(aa, bb)[:2]) if collect
+                    else compiled)
 
     def _execute():
         _faults.fire("execute")
@@ -288,9 +309,13 @@ def _solve_system_impl(a, b2, n, k, m, dtype, engine, spd, workload,
                               name="execute", engine=engine,
                               workload=workload)
 
-    (x, singular), esp = (
+    out, esp = (
         policy.retry.call(_execute, component="solve_system.execute")
         if policy is not None else _execute())
+    if collect:
+        x, singular, nstats = out
+    else:
+        (x, singular), nstats = out, None
     elapsed = esp.duration
     flops = _hwcost.baseline_workload_flops(n, workload, k=k)
     if elapsed > 0:
@@ -321,17 +346,18 @@ def _solve_system_impl(a, b2, n, k, m, dtype, engine, spd, workload,
     kappa_est = (norm_a * norm_x / norm_b) if norm_b else None
 
     nreport = None
-    if numerics == "summary":
+    if numerics != "off":
         # Recorded (and spiked) BEFORE the recovery ladder — a rung
         # event must be causally preceded by its numerics evidence
         # (the ISSUE 10 discipline, extended to the solve workloads).
         nreport = _solve_numerics(n, m, engine, workload, rel,
-                                  kappa_est, norm_a, dtype, policy)
+                                  kappa_est, norm_a, dtype, policy,
+                                  stats=nstats)
 
     recovery = ()
     if policy is not None:
         x, residual, norm_a, norm_x, norm_b, recovery = _solve_recover(
-            policy, tel, a=a, b=b2, x=x, compiled=compiled,
+            policy, tel, a=a, b=b2, x=x, compiled=run_compiled,
             residual=residual, norm_a=norm_a, norm_x=norm_x,
             norm_b=norm_b, n=n, k=k, m=m, dtype=dtype, spd=spd,
             workload=workload)
@@ -350,13 +376,23 @@ def _solve_system_impl(a, b2, n, k, m, dtype, engine, spd, workload,
 
 
 def _solve_numerics(n, m, engine, workload, rel, kappa_est, norm_a,
-                    dtype, policy):
+                    dtype, policy, stats=None):
     from ..obs import numerics as _numerics
 
-    report = _numerics.summary_report(
-        n=n, block_size=m, engine=engine, rel_residual=rel,
-        kappa=(kappa_est if kappa_est is not None else 1.0),
-        norm_a=norm_a, dtype=dtype, workload=workload)
+    if stats is not None:
+        # The full per-superstep record (ISSUE 10 trace, solve twin):
+        # pivot selection evidence + element growth off the SAME
+        # executable, residual semantics the κ-free backward error.
+        report = _numerics.trace_report(
+            stats, n=n, block_size=m, engine=engine,
+            trace_engine=engine, rel_residual=rel,
+            kappa=(kappa_est if kappa_est is not None else 1.0),
+            norm_a=norm_a, dtype=dtype, workload=workload)
+    else:
+        report = _numerics.summary_report(
+            n=n, block_size=m, engine=engine, rel_residual=rel,
+            kappa=(kappa_est if kappa_est is not None else 1.0),
+            norm_a=norm_a, dtype=dtype, workload=workload)
     _numerics.observe(report)
     thresholds = None
     if policy is not None:

@@ -65,7 +65,7 @@ def _is_complex(dtype) -> bool:
 
 
 @partial(jax.jit, static_argnames=("block_size", "eps", "precision",
-                                   "spd"))
+                                   "spd", "collect_stats"))
 def block_jordan_solve(
     a: jnp.ndarray,
     b: jnp.ndarray,
@@ -73,6 +73,7 @@ def block_jordan_solve(
     eps: float | None = None,
     precision=lax.Precision.HIGHEST,
     spd: bool = False,
+    collect_stats: bool = False,
 ):
     """Solve A·X = B by blocked Gauss–Jordan on [A | B].
 
@@ -92,11 +93,27 @@ def block_jordan_solve(
         singularity threshold still catches hard zeros, but a
         badly-pivoted solve can pass it; the residual gate
         (linalg/api.py + a policy) is the safety net.
+      collect_stats: the ISSUE 10 instrumented trace, extended to the
+        solve engine (ROADMAP 1b remainder): returns
+        ``(x, singular, stats)`` with the per-superstep health arrays
+        (``ops.jordan_inplace._StepStats`` — chosen pivot block, its
+        inverse ∞-norm, candidate spread, singular-candidate count,
+        element-growth watermark over [A | X]) stacked into the SAME
+        executable; X is bit-identical to the uninstrumented call and
+        the pivot sequence equals the invert engine's on a shared
+        fixture (tests/test_linalg.py).  The pivoting path only: the
+        SPD fast path probes exactly one candidate — no selection to
+        trace — and is a typed refusal at the API layer.
 
     Returns:
       (x, singular): X = A⁻¹B (garbage if singular) and the bool flag —
       the same contract as ``ops.jordan.block_jordan_invert``.
     """
+    if collect_stats and spd:
+        raise ValueError(
+            "collect_stats traces the condition-based pivot probe; the "
+            "spd fast path has no probe to trace (linalg/api.py types "
+            "this refusal for callers)")
     n = a.shape[-1]
     k = b.shape[-1]
     in_dtype = a.dtype
@@ -105,9 +122,13 @@ def block_jordan_solve(
         # bf16 elimination state compounds a rounding injection per
         # superstep — measured divergent on the invert engines; the
         # same physics applies here).
-        x, singular = block_jordan_solve(
+        out = block_jordan_solve(
             a.astype(jnp.float32), b.astype(jnp.float32), block_size,
-            eps, precision, spd)
+            eps, precision, spd, collect_stats)
+        if collect_stats:
+            x, singular, stats = out
+            return x.astype(in_dtype), singular, stats
+        x, singular = out
         return x.astype(in_dtype), singular
     dtype = a.dtype
     b = b.astype(dtype)
@@ -129,6 +150,12 @@ def block_jordan_solve(
     X = jnp.zeros((N, k), dtype).at[:n].set(b)
     singular = jnp.asarray(False)
     row_blocks = jnp.arange(N) // m
+    if collect_stats:
+        from ..ops.jordan_inplace import _StepStats
+
+        stats = _StepStats()
+    else:
+        stats = None
 
     for t in range(Nr):
         lo = t * m
@@ -153,6 +180,14 @@ def block_jordan_solve(
             rel = jnp.argmin(key)                         # window-local
             singular = singular | ~jnp.any(valid)
             H = jnp.take(invs, rel, axis=0).astype(dtype)
+            if stats is not None:
+                # The same probe evidence the instrumented INVERT
+                # engine records (ops/jordan_inplace._StepStats):
+                # chosen block id (absolute), the criterion value, the
+                # candidate spread, the probe's singular count — the
+                # pivot sequence is pinned equal to the invert
+                # engine's on shared fixtures (tests/test_linalg.py).
+                stats.probe(t + rel, key, sing)
             piv_row = lo + rel * m                        # dynamic
             # Swap-by-copy (main.cpp:1093-1131): lift slot t, write it
             # into the pivot slot; slot t is rewritten from the
@@ -182,7 +217,15 @@ def block_jordan_solve(
         X = X - jnp.matmul(E, prow_X, precision=precision)
         A = A.at[lo:lo + m, lo:].set(prow_A)
         X = X.at[lo:lo + m].set(prow_X)
+        if stats is not None:
+            # Element growth over the LIVE working set [A_live | X] —
+            # the augmented analogue of the invert trace's max|V|
+            # watermark (eliminated A columns are dead by
+            # construction: they are simply not computed).
+            stats.sample_growth(A[:, lo:], X)
 
+    if stats is not None:
+        return X[:n], singular, stats.stacked()
     return X[:n], singular
 
 

@@ -97,6 +97,17 @@ def baseline_workload_flops(n: int, workload: str = "invert",
       * ``lstsq`` — the normal-equations route: one AᴴA Gram product
         (2·rows·n² for a (rows, n) A), the Aᴴb projection (2·rows·n·k),
         then the n-sized SPD solve.
+      * ``update`` — the Sherman–Morrison–Woodbury rank-k
+        resident-inverse update (ISSUE 12, linalg/update.py): 4n²k +
+        2nk², the CANONICAL two-sided SMW count (the A⁻¹U / VᵀA⁻¹
+        products plus the capacitance assembly/solve's nk² term, k³
+        dropped as low-order dust).  Deliberately lean, like the 2n³
+        invert headline vs its measured (8/3)n³: the executed kernel
+        additionally pays the correction-apply and U·Vᵀ-mutation GEMMs
+        (~8n²k of update arithmetic total) AND the deliberate O(n³)
+        re-verification matmul — all of which show up honestly in the
+        ``cost_analysis`` numbers (``*_xla_vs_analytic``) recorded
+        next to every headline, never silently inside its denominator.
 
     A complex FLOP is counted as one flop like everywhere else in the
     BASELINE convention (the ~4x real-op cost of complex arithmetic is
@@ -108,6 +119,8 @@ def baseline_workload_flops(n: int, workload: str = "invert",
         return baseline_invert_flops(int(n))
     if workload in ("solve", "solve_spd"):
         return n ** 3 * (1.0 + k / n)
+    if workload == "update":
+        return 4.0 * n * n * k + 2.0 * n * k * k
     if workload == "lstsq":
         r = n if rows is None else float(rows)
         return (2.0 * r * n * n + 2.0 * r * n * k

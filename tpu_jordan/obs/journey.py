@@ -141,8 +141,15 @@ class RequestContext:
         self._log.recorder.record("journey", t=t,
                                   request_id=self.request_id,
                                   event="result", **payload)
-        _M_OUTCOME.inc(outcome=str(outcome), bucket=self.bucket)
-        _M_LATENCY.observe(t - self.t_created, bucket=self.bucket)
+        # Non-invert journeys carry a workload label (ISSUE 12: the
+        # update-vs-solve-vs-invert traffic split, visible to one
+        # Prometheus scrape); invert keeps its historical label set
+        # byte-identical, and the SLO evaluator — which filters by
+        # bucket and sums the rest — sees every series either way.
+        wl = ({} if self.workload == "invert"
+              else {"workload": self.workload})
+        _M_OUTCOME.inc(outcome=str(outcome), bucket=self.bucket, **wl)
+        _M_LATENCY.observe(t - self.t_created, bucket=self.bucket, **wl)
         self._log._complete(self)
 
     def close_from_future(self, future) -> None:

@@ -214,16 +214,20 @@ def summary_report(*, n: int, block_size: int, engine: str,
 
 def trace_report(stats: dict, *, n: int, block_size: int, engine: str,
                  trace_engine: str, rel_residual: float, kappa: float,
-                 norm_a: float, dtype) -> NumericsReport:
+                 norm_a: float, dtype,
+                 workload: str = "invert") -> NumericsReport:
     """``"trace"`` mode: the per-superstep stats stacked by the
     instrumented engine (``collect_stats=True``) plus the verified
     end-state numbers.  The modeled ``residual_est`` ladder is derived
-    host-side — the device pays nothing for it."""
+    host-side — the device pays nothing for it.  ``workload`` tags the
+    record (ISSUE 12 satellite: the solve engine's trace twin) so the
+    κ-free backward-error semantics of a solve trace are never
+    mistaken for invert's eps·n·κ model."""
     import numpy as np
 
     rep = summary_report(n=n, block_size=block_size, engine=engine,
                          rel_residual=rel_residual, kappa=kappa,
-                         norm_a=norm_a, dtype=dtype)
+                         norm_a=norm_a, dtype=dtype, workload=workload)
     rep.mode = "trace"
     rep.trace_engine = trace_engine
     rep.pivot_block = [int(v) for v in np.asarray(stats["pivot_block"])]
@@ -328,6 +332,22 @@ def record_spikes(report: NumericsReport,
     return spikes
 
 
+def record_drift_spike(*, n: int, engine: str, value: float,
+                       threshold: float, recorder=None) -> dict:
+    """ISSUE 12: the resident-update ACCUMULATED-DRIFT budget
+    exceedance as a ``numerics_spike`` (signal="drift") — the causal
+    breadcrumb for a ``re_invert`` rung fired by composition when
+    every individual update passed the residual gate (a residual spike
+    alone cannot explain that rung)."""
+    rec = recorder if recorder is not None else _recorder.record
+    ev = {"signal": "drift", "value": float(value),
+          "threshold": float(threshold)}
+    _M_SPIKES.inc(signal="drift")
+    rec("numerics_spike", n=n, engine=engine, mode="summary",
+        workload="update", **ev)
+    return ev
+
+
 # ---------------------------------------------------------------------
 # The acceptance demo (`make numerics-demo`, CLI --numerics-demo)
 # ---------------------------------------------------------------------
@@ -358,9 +378,10 @@ def numerics_demo(n: int = 16, block_size: int = 8, seed: int = 7,
     ``workload="solve"`` (ISSUE 11): the same ill-conditioned fixture
     through ``linalg.solve_system`` at bf16 storage — the rounded-X
     backward error fails the fp32-SLO solve gate and ONE refinement
-    pass through the same compiled executable recovers (the solve
-    path is summary-mode: its engine has no per-superstep
-    instrumentation yet, ROADMAP remainder).
+    pass through the same compiled executable recovers.  The solve
+    engine has its own per-superstep trace since ISSUE 12
+    (``solve_system(numerics="trace")``); the demo keeps summary mode
+    so its report shape stays pinned.
 
     Either way, because numerics observed the solve, the flight
     recorder holds the numerics_spike events BEFORE the

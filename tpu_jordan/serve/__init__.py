@@ -31,23 +31,36 @@ open, half-open probe after the cooldown) — on by default via
 ``resilience.DEFAULT_POLICY``.  ``chaos_demo`` (CLI ``--chaos-demo``)
 proves the whole stack against a fault-free replay under seeded
 deterministic fault injection.
+
+Resident-inverse handles (ISSUE 12, ``handles`` + the ``update``
+lanes): ``invert(a, resident=True)`` returns a :class:`HandleRef`;
+``update(handle, u, v)`` applies rank-k Sherman–Morrison–Woodbury
+mutations in O(n²k) through per-(bucket, k-bucket) AOT executables,
+gated by the accumulated-drift budget (``linalg/update.py``) with a
+typed "re_invert" degradation rung — never a silently stale inverse.
+``update_demo`` (CLI ``--update-demo``) is the acceptance run.
 """
 
 from ..resilience.policy import (CircuitOpenError, DeadlineExceededError,
                                  ResultCorruptionError)
 from .batcher import (InvertResult, MicroBatcher, ServiceClosedError,
                       ServiceOverloadedError)
-from .executors import (MIN_BUCKET_N, BucketExecutor, ExecutorCache,
-                        ExecutorKey, bucket_for)
+from .executors import (MIN_BUCKET_N, MIN_UPDATE_K, BucketExecutor,
+                        ExecutorCache, ExecutorKey, bucket_for,
+                        k_bucket_for)
+from .handles import (HandleRef, HandleState, HandleStore,
+                      UnknownHandleError)
 from .service import JordanService, chaos_demo, serve_demo
 from .stats import ServeStats
+from .update_demo import update_demo
 
 __all__ = [
     "InvertResult", "MicroBatcher", "ServiceClosedError",
     "ServiceOverloadedError",
     "CircuitOpenError", "DeadlineExceededError", "ResultCorruptionError",
-    "MIN_BUCKET_N", "BucketExecutor", "ExecutorCache", "ExecutorKey",
-    "bucket_for",
-    "JordanService", "chaos_demo", "serve_demo",
+    "MIN_BUCKET_N", "MIN_UPDATE_K", "BucketExecutor", "ExecutorCache",
+    "ExecutorKey", "bucket_for", "k_bucket_for",
+    "HandleRef", "HandleState", "HandleStore", "UnknownHandleError",
+    "JordanService", "chaos_demo", "serve_demo", "update_demo",
     "ServeStats",
 ]

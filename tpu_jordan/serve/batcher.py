@@ -93,8 +93,13 @@ class InvertResult:
     queue_seconds: float      # submit -> dispatch
     execute_seconds: float    # the batch execution this request rode
     batch_occupancy: int      # real requests in that batch
-    workload: str = "invert"  # "invert" | "solve" (ISSUE 11)
+    workload: str = "invert"  # "invert" | "solve" | "update"
     solution: object = None   # (n, k) X for solve requests
+    # ---- resident-update fields (ISSUE 12; None off the update lane)
+    update_outcome: str = None    # "refreshed" | "re_inverted" | "gated"
+    handle: object = None         # the HandleRef the update mutated
+    handle_version: int = None    # committed version after this update
+    drift: float = None           # accumulated drift after this update
 
 
 @dataclass
@@ -106,10 +111,13 @@ class _Request:
     future: Future
     t_deadline: float | None = None   # absolute perf_counter deadline
     ctx: object = None        # obs.journey.RequestContext (ISSUE 8)
-    workload: str = "invert"  # lane workload (ISSUE 11)
+    workload: str = "invert"  # lane workload (ISSUE 11/12)
     padded_b: np.ndarray = None       # (bucket_n, rhs) zero-padded RHS
     rhs: int = 0              # RHS-width bucket of the lane
-    k: int = 0                # this request's REAL RHS width
+    k: int = 0                # this request's REAL RHS/rank width
+    handle: object = None     # update lane: the HandleRef to mutate
+    padded_u: np.ndarray = None       # (bucket_n, k_bucket) zero-padded
+    padded_v: np.ndarray = None       # (bucket_n, k_bucket) zero-padded
 
     def hop(self, event: str, **attrs) -> None:
         """One journey event for this rider (no-op without a context —
@@ -148,7 +156,8 @@ class MicroBatcher:
     def __init__(self, executors, stats, batch_cap: int = 8,
                  max_wait_ms: float = 2.0, max_queue: int = 256,
                  block_size: int | None = None, autostart: bool = True,
-                 telemetry=None, policy=None, numerics: str = "off"):
+                 telemetry=None, policy=None, numerics: str = "off",
+                 handles=None, update_drift_budget_factor=None):
         from ..obs.spans import NULL
 
         if batch_cap < 1:
@@ -157,6 +166,13 @@ class MicroBatcher:
             raise ValueError("max_queue must be >= 1")
         self.executors = executors
         self.stats = stats
+        # Resident-handle store (ISSUE 12): where the update lanes read
+        # committed (A, A⁻¹) state and write through — fleet-shared
+        # when the service was built with shared_handles.  The drift
+        # factor widens/narrows the accumulated-drift budget (None =
+        # linalg.update.DRIFT_BUDGET_FACTOR, the documented default).
+        self.handles = handles
+        self._drift_factor = update_drift_budget_factor
         # Numerics knob (ISSUE 10): "off" (the serve-path default —
         # zero added work on the dispatch path) or "summary" (each real
         # rider's already-computed rel_residual/κ∞ observed into the
@@ -204,7 +220,9 @@ class MicroBatcher:
     def submit(self, padded: np.ndarray, n: int, bucket_n: int,
                deadline_s: float | None = None, ctx=None,
                workload: str = "invert", padded_b: np.ndarray = None,
-               rhs: int = 0, k: int = 0) -> Future:
+               rhs: int = 0, k: int = 0, handle=None,
+               padded_u: np.ndarray = None,
+               padded_v: np.ndarray = None) -> Future:
         lane = _lane(workload, bucket_n, rhs)
         label = _lane_label(lane)
         br = self.executors.breaker(label) \
@@ -224,7 +242,8 @@ class MicroBatcher:
                        t_deadline=(None if deadline_s is None
                                    else now + float(deadline_s)),
                        ctx=ctx, workload=workload, padded_b=padded_b,
-                       rhs=int(rhs), k=int(k))
+                       rhs=int(rhs), k=int(k), handle=handle,
+                       padded_u=padded_u, padded_v=padded_v)
         with self._cv:
             if self._closing:
                 req.hop("reject", reason="closed")
@@ -399,6 +418,330 @@ class MicroBatcher:
                 req.hop("dispatch", cause=cause, occupancy=len(batch))
             self._execute(bucket, batch, now)
 
+    # ---- the resident-update lane (ISSUE 12) -------------------------
+
+    def _execute_updates(self, lane, batch: list,
+                         t_dispatch: float) -> None:
+        """Dispatch one picked update-lane batch: riders run
+        SEQUENTIALLY through the lane's unbatched SMW executable (each
+        mutates its own handle's resident state under the handle's
+        store lock — write-through, ISSUE 12).  A rider's terminal
+        failure is ITS typed error and ITS batch-failure count;
+        batch-mates are untouched — per-rider attempt chains, not one
+        shared fate, because each rider is its own launch."""
+        label = _lane_label(lane)
+        bucket, kb = lane[1], lane[2]
+        br = self.executors.breaker(label) \
+            if self.policy is not None else None
+        try:
+            _faults.fire("dispatch")
+            ex, source = self.executors.get_info(
+                bucket, 1, self.block_size, workload="update", rhs=kb)
+        except BaseException as e:                  # noqa: BLE001
+            _obs_metrics.counter(
+                "tpu_jordan_serve_batch_failures_total",
+                "dispatched batches that terminally failed (after any "
+                "retries) and fanned a typed error to their riders",
+            ).inc(bucket=label)
+            if br is not None:
+                br.record_failure()
+            for req in batch:
+                req.hop("batch_failure", error=type(e).__name__)
+                if not req.future.done():
+                    req.future.set_exception(e)
+            return
+        queue_waits = [t_dispatch - req.t_enqueue for req in batch]
+        singular_served = 0
+        exec_total = 0.0
+        ok = True
+        from ..resilience.policy import ResidualGateError
+        from .handles import UnknownHandleError
+
+        for i, req in enumerate(batch):
+            req.hop("executor", bucket=bucket, source=source,
+                    engine=ex.key.engine)
+            try:
+                res = self._run_one_update(req, ex, queue_waits[i],
+                                           len(batch))
+            except (UnknownHandleError, ResidualGateError) as e:
+                # Typed CALLER/NUMERICS outcomes — an evicted handle,
+                # or one handle's gate/drift failure the rung couldn't
+                # recover — are THIS rider's answer, not lane-health
+                # evidence: no breaker feedback, no batch-failure
+                # count (the invert lane never counts caller bugs or
+                # per-element numerics against its breaker either).
+                req.hop("typed_failure", error=type(e).__name__)
+                if not req.future.done():
+                    req.future.set_exception(e)
+                continue
+            except BaseException as e:              # noqa: BLE001
+                ok = False
+                _obs_metrics.counter(
+                    "tpu_jordan_serve_batch_failures_total",
+                    "dispatched batches that terminally failed (after "
+                    "any retries) and fanned a typed error to their "
+                    "riders",
+                ).inc(bucket=label)
+                if br is not None:
+                    br.record_failure()
+                req.hop("batch_failure", error=type(e).__name__)
+                if not req.future.done():
+                    req.future.set_exception(e)
+                continue
+            if res is None:
+                # Deadline expired during execute: the rider was
+                # failed typed BEFORE the commit (the handle is
+                # untouched — a typed update failure never leaves a
+                # half-trusted mutation behind).
+                continue
+            singular_served += int(res.singular)
+            exec_total += res.execute_seconds
+            req.hop("served", singular=bool(res.singular),
+                    outcome=res.update_outcome,
+                    version=res.handle_version,
+                    seconds=round(res.execute_seconds, 6))
+            req.future.set_result(res)
+        if ok and br is not None:
+            br.record_success()
+        self.stats.batch(label, occupancy=len(batch),
+                         exec_seconds=exec_total,
+                         queue_seconds=queue_waits,
+                         singular=singular_served, workload="update")
+
+    def _run_one_update(self, req, ex, queue_s: float,
+                        occupancy: int):
+        """One rider's SMW application end to end: read the committed
+        handle state under its lock, run the lane executable (retried
+        + integrity-gated per the policy), judge the residual gate and
+        the accumulated-drift budget against the MUTATED matrix, walk
+        the re_invert rung when they fire, and WRITE THROUGH the new
+        committed state.  Returns the rider's ``InvertResult``; raises
+        typed on terminal failure (handle state untouched — committed,
+        never half-updated)."""
+        import jax.numpy as jnp
+        import math
+
+        from ..linalg.update import drift_budget, drift_exceeded
+        from ..obs import hwcost as _hwcost
+        from ..obs.spans import timed_blocking
+        from ..resilience.degrade import gate_passes, gate_threshold
+
+        bucket = req.bucket_n
+        handle = req.handle
+        store = self.handles
+        with store.txn(handle.handle_id) as st:
+            args = (jnp.asarray(st.a), jnp.asarray(st.inverse),
+                    jnp.asarray(req.padded_u),
+                    jnp.asarray(req.padded_v),
+                    jnp.asarray([req.n], jnp.int32))
+
+            def run_once():
+                _faults.fire("execute")
+                out, esp = timed_blocking(
+                    ex.run, *args, telemetry=self._tel, name="execute",
+                    bucket=bucket, occupancy=1, workload="update")
+                _hwcost.attach_execute_cost(
+                    esp, ex.cost,
+                    analytical_flops=_hwcost.baseline_workload_flops(
+                        bucket, "update", k=ex.key.rhs))
+                a_new, inv_new, sing, kappa, rel = out
+                sing = bool(sing)
+                kappa = float(kappa)
+                rel = float(rel)
+                if not sing and _faults.corrupt("result_corrupt_nan"):
+                    rel = float("nan")
+                # Integrity gate (the invert-lane discipline): a
+                # non-singular update must report a finite in-launch
+                # rel_residual — corruption is typed and retryable.
+                if not sing and not math.isfinite(rel):
+                    raise ResultCorruptionError(
+                        f"non-finite rel_residual for update "
+                        f"(handle {handle.handle_id}, bucket {bucket}) "
+                        f"— corrupted result detected by the "
+                        f"integrity gate")
+                return a_new, inv_new, sing, kappa, rel, esp.duration
+
+            def on_retry(exc, attempt):
+                req.hop("retry", attempt=attempt,
+                        error=type(exc).__name__)
+
+            a_new, inv_new, sing, kappa, rel, exec_s = (
+                self.policy.retry.call(
+                    run_once, component="serve.update",
+                    on_retry=on_retry,
+                    exemplar=(req.ctx.request_id
+                              if req.ctx is not None else None))
+                if self.policy is not None else run_once())
+
+            # Deadline, judged BEFORE the commit: an update past its
+            # deadline fails typed with the handle untouched — "typed
+            # failure = no mutation" holds unconditionally (the invert
+            # lanes check after fan-out; an update has state to
+            # protect).
+            if not self._fail_expired([req], "execute"):
+                return None
+
+            if self.numerics == "summary" and not sing:
+                # Observed (and spiked) BEFORE the gate/rung run — the
+                # ISSUE 10 causality discipline: a recovery_rung event
+                # must be preceded by the numerics evidence (the
+                # PRE-recovery residual, judged by the policy's own
+                # gate threshold) that explains it.
+                self._observe_update_numerics(req, ex, kappa, rel)
+
+            outcome, recovery_rel = "refreshed", rel
+            if sing:
+                # Typed singularity, handle untouched: the mutation
+                # would have destroyed the matrix's rank — the rider
+                # learns it, the resident state stays consistent.
+                outcome = "gated"
+            elif self.policy is not None:
+                thr = gate_threshold(self.policy, req.n, kappa,
+                                     jnp.dtype(ex.key.dtype))
+                budget = drift_budget(thr, self._drift_factor)
+                new_drift = st.drift + max(rel, 0.0)
+                if (not gate_passes(rel, thr)
+                        or drift_exceeded(new_drift, budget)):
+                    if (self.numerics == "summary"
+                            and gate_passes(rel, thr)):
+                        # Drift-caused: the residual spike above
+                        # cannot explain this rung (rel passed), so
+                        # the budget exceedance records its own spike.
+                        from ..obs.numerics import record_drift_spike
+
+                        record_drift_spike(n=req.n,
+                                           engine=ex.key.engine,
+                                           value=new_drift,
+                                           threshold=budget)
+                    outcome, kappa, recovery_rel, inv_new = (
+                        self._reinvert_rung(req, a_new, rel,
+                                            new_drift, thr, budget))
+                    new_drift = 0.0
+                    if outcome == "gated":
+                        # The rung's FRESH elimination flagged the
+                        # mutated matrix singular — the capacitance
+                        # solve's rounded determinant slipped past the
+                        # eps threshold, but the from-scratch pivot
+                        # probe cannot be fooled: typed singularity,
+                        # handle untouched.
+                        sing = True
+                if not sing:
+                    store.commit(st, a=np.asarray(a_new),
+                                 inverse=np.asarray(inv_new),
+                                 kappa=kappa,
+                                 rel_residual=recovery_rel,
+                                 drift=new_drift,
+                                 reinverted=outcome == "re_inverted")
+            else:
+                # No policy = no gate (the PR 5 contract): drift still
+                # accumulates so an attached policy later sees history.
+                store.commit(st, a=np.asarray(a_new),
+                             inverse=np.asarray(inv_new), kappa=kappa,
+                             rel_residual=rel,
+                             drift=st.drift + max(rel, 0.0))
+            version, drift_after = st.version, st.drift
+            req.hop("update", outcome=outcome, version=version,
+                    drift=round(drift_after, 9))
+        return InvertResult(
+            inverse=(None if sing
+                     else np.asarray(inv_new)[:req.n, :req.n]),
+            n=req.n, bucket_n=bucket, singular=sing, kappa=kappa,
+            rel_residual=recovery_rel, queue_seconds=queue_s,
+            execute_seconds=exec_s, batch_occupancy=occupancy,
+            workload="update", update_outcome=outcome, handle=handle,
+            handle_version=version, drift=drift_after)
+
+    def _reinvert_rung(self, req, a_new, rel, new_drift, thr,
+                       budget):
+        """The "re_invert" degradation rung (ISSUE 12): the residual
+        gate or the accumulated-drift budget fired, so the mutated
+        matrix is re-eliminated FROM SCRATCH through a warm CAP-1
+        invert executable (zero new compiles — warmed next to the
+        update lane: one matrix, one elimination, never batch_cap
+        identity fillers paying batch_cap eliminations) and judged
+        again.  Passing resets the drift ledger; failing raises the
+        typed ``ResidualGateError`` (the rider's answer — never a
+        silently stale inverse)."""
+        import jax.numpy as jnp
+
+        from ..obs import recorder as _recorder
+        from ..resilience.degrade import (_M_GATE_FAIL, _M_RUNGS,
+                                          gate_passes, gate_threshold)
+        from ..resilience.policy import ResidualGateError
+
+        bucket = req.bucket_n
+        cause = ("drift_budget" if gate_passes(rel, thr)
+                 else "residual_gate")
+        _M_GATE_FAIL.inc()
+        _recorder.record("residual_gate_failure", n=req.n,
+                         workload="update", rel_residual=float(rel),
+                         threshold=float(thr), drift=float(new_drift),
+                         budget=float(budget), cause=cause)
+        inv_ex = self.executors.get(bucket, 1, self.block_size)
+        dtype = jnp.dtype(inv_ex.key.dtype)
+        stacked = np.asarray(a_new)[None]
+        n_real = np.asarray([req.n], np.int32)
+        inv2, sing2, kap2, rel2 = inv_ex.run(jnp.asarray(stacked),
+                                             jnp.asarray(n_real))
+        sing2 = bool(sing2[0])
+        kap2, rel2 = float(kap2[0]), float(rel2[0])
+        passed = (not sing2
+                  and gate_passes(rel2, gate_threshold(
+                      self.policy, req.n, kap2, dtype)))
+        _M_RUNGS.inc(rung="re_invert",
+                     outcome="passed" if passed else "failed")
+        _recorder.record("recovery_rung", rung="re_invert",
+                         workload="update",
+                         outcome="passed" if passed else "failed",
+                         singular=sing2, rel_residual=float(rel2))
+        req.hop("recovery_rung", rung="re_invert", cause=cause,
+                passed=passed)
+        if sing2:
+            # The from-scratch pivot probe flagged the MUTATED matrix
+            # singular: the mutation destroyed rank but the k×k
+            # capacitance determinant rounded just past the eps
+            # threshold.  This is the typed singularity answer, not a
+            # gate exhaustion — the rider gets the per-element
+            # singular flag (the invert lanes' contract) and the
+            # committed resident state stays untouched.
+            return "gated", kap2, rel2, np.asarray(inv2[0])
+        if not passed:
+            raise ResidualGateError(
+                f"update residual gate failed ({cause}: rel {rel:.3e},"
+                f" drift {new_drift:.3e} vs threshold {thr:.3e} / "
+                f"budget {budget:.3e}) and the re_invert rung did not "
+                f"recover (handle {req.handle.handle_id})",
+                recovery=({"rung": "re_invert", "cause": cause,
+                           "rel_residual_after": rel2,
+                           "passed": False},))
+        return "re_inverted", kap2, rel2, np.asarray(inv2[0])
+
+    def _observe_update_numerics(self, req, ex, kappa, rel) -> None:
+        """Serve-path ``numerics="summary"`` for ONE update rider: the
+        in-launch verified rel_residual/κ∞ against the MUTATED matrix
+        — the PRE-recovery numbers, observed workload-tagged and
+        spiked against the attached policy's OWN gate threshold (an
+        update's residual IS an inverse residual), so a gate failure
+        can never outrun its spike."""
+        import jax.numpy as jnp
+
+        from ..obs import numerics as _numerics
+
+        thresholds = None
+        if self.policy is not None:
+            from ..resilience.degrade import gate_threshold
+
+            thresholds = _numerics.SpikeThresholds(
+                residual=gate_threshold(self.policy, req.n,
+                                        float(kappa),
+                                        jnp.dtype(ex.key.dtype)))
+        rep = _numerics.summary_report(
+            n=req.n, block_size=ex.block_size, engine=ex.key.engine,
+            rel_residual=float(rel), kappa=float(kappa), norm_a=0.0,
+            dtype=ex.key.dtype, workload="update")
+        _numerics.observe(rep)
+        _numerics.record_spikes(rep, thresholds)
+
     def _fail_expired(self, batch: list, phase: str) -> list:
         """Split out requests past their deadline; fail them with the
         typed error (counted, labeled by phase) and return the rest."""
@@ -465,6 +808,8 @@ class MicroBatcher:
 
         bucket = lane if isinstance(lane, int) else lane[1]
         workload = _lane_workload(lane)
+        if workload == "update":
+            return self._execute_updates(lane, batch, t_dispatch)
         label = _lane_label(lane)
         br = self.executors.breaker(label) \
             if self.policy is not None else None

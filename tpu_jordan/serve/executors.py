@@ -66,6 +66,24 @@ def rhs_bucket_for(k: int) -> int:
     return 1 << max(0, int(k - 1).bit_length())
 
 
+#: The smallest update-lane rank bucket (ISSUE 12): sub-8 mutations
+#: still update correctly (zero-padded to 8); a finer ladder would
+#: multiply (bucket_n, k_bucket) executables for launch-bound work.
+MIN_UPDATE_K = 8
+
+
+def k_bucket_for(k: int, floor: int = MIN_UPDATE_K) -> int:
+    """Round an update's rank up to its lane bucket: the SAME
+    power-of-two rounding as the solve lanes (``rhs_bucket_for`` — one
+    rounding recipe, never two that can drift), floored at
+    ``MIN_UPDATE_K``.  Exact by zero padding (``linalg/update.py``:
+    zero U/V columns contribute nothing to U·Vᵀ and make the
+    capacitance pad block the identity)."""
+    if k <= 0:
+        raise ValueError(f"update rank must be positive, got {k}")
+    return max(floor, rhs_bucket_for(k))
+
+
 @dataclass(frozen=True)
 class ExecutorKey:
     """The executable cache key — the coordinates a compiled serving
@@ -120,6 +138,8 @@ class BucketExecutor:
 
         key = self.key
         m = key.block_size
+        if key.workload == "update":
+            return self._build_update()
         if key.workload != "invert":
             return self._build_solve()
         if jnp.dtype(key.dtype).kind == "c":
@@ -199,10 +219,44 @@ class BucketExecutor:
             jax.ShapeDtypeStruct((cap,), jnp.int32),
         ).compile()
 
+    def _build_update(self):
+        """The update-lane executable (ISSUE 12): ONE Sherman–Morrison–
+        Woodbury rank-k application per launch — mutate A, update the
+        resident inverse, and re-verify against the MUTATED matrix in
+        the same compiled program (``linalg.update.
+        smw_update_with_metrics``).  Unbatched on purpose: each launch
+        mutates one handle's resident state, and the executable is
+        keyed per (bucket_n, k_bucket, dtype) so its ``cost_analysis``
+        FLOPs are pinnable strictly below the same-n fresh-invert
+        executable's (tests/test_update.py)."""
+        from ..linalg.update import smw_update_with_metrics
+
+        key = self.key
+        if key.engine != "smw_update":
+            from ..driver import UsageError
+
+            raise UsageError(
+                f"engine {key.engine!r} is not an update-lane engine "
+                f"(smw_update is the one registered update engine)")
+
+        def fn(a, inv, u, v, n_real):
+            return smw_update_with_metrics(a, inv, u, v, n_real=n_real)
+
+        dtype = jnp.dtype(key.dtype)
+        N, K = key.bucket_n, key.rhs
+        return jax.jit(fn).lower(
+            jax.ShapeDtypeStruct((N, N), dtype),
+            jax.ShapeDtypeStruct((N, N), dtype),
+            jax.ShapeDtypeStruct((N, K), dtype),
+            jax.ShapeDtypeStruct((N, K), dtype),
+            jax.ShapeDtypeStruct((1,), jnp.int32),
+        ).compile()
+
     def run(self, *args):
         """Invert lanes: ``run(stacked, n_real)``; solve lanes:
-        ``run(stacked_a, stacked_b, n_real)`` — either way the lane's
-        compiled signature, returning (result, singular, kappa, rel)."""
+        ``run(stacked_a, stacked_b, n_real)``; update lanes:
+        ``run(a, inv, u, v, n_real)`` — the lane's compiled signature
+        either way."""
         return self._compiled(*args)
 
 

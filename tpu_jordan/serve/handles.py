@@ -1,0 +1,247 @@
+"""Resident-inverse handles — the fleet-shared database of live
+inverses (ISSUE 12 tentpole).
+
+A :class:`HandleState` is one resident (A, A⁻¹) pair: the identity-
+padded MUTATED matrix, its padded resident inverse, the committed
+version counter, and the accumulated-drift ledger the update gate
+judges (``linalg/update.py``).  States live in a :class:`HandleStore`
+— the handle analogue of the PR 7 ``ExecutorStore``: a fleet passes
+ONE store to every replica (``JordanService(shared_handles=...)``), so
+
+  * an ``update()`` on any replica reads the committed state and
+    WRITES THROUGH under the handle's own lock (per-handle locks, like
+    the executor store's per-key build locks: updates to one handle
+    serialize across the whole pool, updates to different handles
+    proceed concurrently);
+  * a ``replica_kill`` never loses resident state — the store is not
+    the replica's; queued updates fail typed, the router re-queues
+    them, and the retry re-reads the committed state (the in-process
+    kill boundary: an in-flight update commits and delivers, a queued
+    one never ran — an update is applied exactly once either way);
+  * a warm rolling restart "rebuilds" a replacement's handle view for
+    free: there is nothing replica-local to rebuild (docs/FLEET.md).
+
+Callers hold a :class:`HandleRef` — coordinates only, no arrays — and
+thread it through ``JordanService.update(handle, u, v)`` /
+``JordanFleet.update(...)``.  Mutation discipline: state arrays are
+replaced wholesale under ``txn()``, never edited in place, so a reader
+between transactions always sees one committed version.
+"""
+
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+
+class UnknownHandleError(KeyError):
+    """The handle id names no resident state — never created here, or
+    already evicted.  Typed: an update against a missing handle must
+    fail loudly, not invert garbage."""
+
+
+@dataclass(frozen=True)
+class HandleRef:
+    """What a caller holds for one resident inverse: the id plus the
+    coordinates every update request needs to land on the right lane.
+    ``result`` (when present) is the creating invert's
+    :class:`~.batcher.InvertResult` — sugar so ``invert(a,
+    resident=True)`` hands back both the answer and the handle."""
+
+    handle_id: str
+    n: int
+    bucket_n: int
+    dtype: str
+    result: object = None
+
+    def __repr__(self) -> str:       # results are big; keep refs terse
+        return (f"HandleRef({self.handle_id!r}, n={self.n}, "
+                f"bucket={self.bucket_n}, dtype={self.dtype})")
+
+
+@dataclass
+class HandleState:
+    """One committed resident state (all arrays PADDED to the bucket).
+    ``drift`` is the accumulated per-update rel_residual since the last
+    fresh elimination (``linalg.update.drift_budget`` is its ceiling);
+    ``version`` counts committed mutations (0 = as created)."""
+
+    handle_id: str
+    n: int
+    bucket_n: int
+    dtype: str
+    a: object                     # (bucket, bucket) np — mutated matrix
+    inverse: object               # (bucket, bucket) np — resident A⁻¹
+    version: int = 0
+    drift: float = 0.0
+    updates_applied: int = 0
+    reinverts: int = 0
+    kappa: float = 0.0
+    rel_residual: float = 0.0
+    lock: threading.Lock = field(default_factory=threading.Lock,
+                                 repr=False)
+
+    def snapshot(self) -> dict:
+        """The JSON-able per-handle slice of ``service.stats()`` /
+        the update-demo report (no arrays).  Taken under the handle's
+        own lock so a row can never be torn by a concurrent commit
+        (e.g. the new version paired with the previous update's
+        drift); never call from inside ``txn()`` of the same handle
+        (the lock is not reentrant)."""
+        with self.lock:
+            return {
+                "handle_id": self.handle_id, "n": self.n,
+                "bucket_n": self.bucket_n, "dtype": self.dtype,
+                "version": self.version, "drift": float(self.drift),
+                "updates_applied": self.updates_applied,
+                "reinverts": self.reinverts,
+                "rel_residual": float(self.rel_residual),
+            }
+
+
+class HandleStore:
+    """Thread-safe home for resident handles, shared fleet-wide.
+
+    The outer lock guards the id→state map; each state carries its own
+    mutation lock (``txn()``) so concurrent updates of different
+    handles never serialize on the store.
+
+    Lock order is STATE → STORE everywhere a state lock is held (txn's
+    identity re-check, evict's and create's replacement checks); the
+    bare map reads/writes take the store lock alone.  That ordering is
+    what lets evict/create wait out an in-flight update without
+    deadlock — and guarantees an update can never commit to an
+    orphaned state object (the silently-lost-update class)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._handles: dict[str, HandleState] = {}
+
+    def create(self, state: HandleState) -> HandleRef:
+        """Install a freshly-inverted resident state; re-creating an
+        existing id REPLACES it (the caller re-inverted from scratch —
+        the new state is the truth, version restarts at 0).  A
+        replacement waits out any in-flight ``txn`` on the OLD state
+        (its lock) before swapping, so an update never straddles the
+        swap: it lands on the old state and is then superseded, or it
+        retries onto the new one — never both, never lost."""
+        ref = HandleRef(state.handle_id, state.n, state.bucket_n,
+                        state.dtype)
+        while True:
+            with self._lock:
+                old = self._handles.get(state.handle_id)
+                if old is None:
+                    self._handles[state.handle_id] = state
+                    return ref
+            with old.lock:
+                with self._lock:
+                    if self._handles.get(state.handle_id) is old:
+                        self._handles[state.handle_id] = state
+                        return ref
+            # old was itself replaced/evicted between the reads: retry.
+
+    def get(self, handle_id: str) -> HandleState:
+        with self._lock:
+            st = self._handles.get(handle_id)
+        if st is None:
+            raise UnknownHandleError(
+                f"unknown resident handle {handle_id!r} — never "
+                f"created, or already evicted")
+        return st
+
+    @contextmanager
+    def txn(self, handle_id: str):
+        """One serialized mutation window for a handle: yields the
+        live state under ITS lock, with the store identity RE-CHECKED
+        under that lock — a state evicted or replaced between the
+        lookup and the lock acquisition is never yielded (an eviction
+        raises the typed :class:`UnknownHandleError`; a replacement
+        retries onto the new committed state).  Callers compute first
+        and assign state fields (via :meth:`commit`) last — an
+        exception inside the window leaves the committed state
+        untouched."""
+        while True:
+            st = self.get(handle_id)          # raises if evicted
+            with st.lock:
+                with self._lock:
+                    current = self._handles.get(handle_id)
+                if current is st:
+                    yield st
+                    return
+            # Replaced between lookup and lock: loop onto the
+            # successor (or raise typed if it was evicted meanwhile).
+
+    @staticmethod
+    def commit(state: HandleState, *, a, inverse, kappa: float,
+               rel_residual: float, drift: float,
+               reinverted: bool = False) -> int:
+        """Write-through of one applied update (caller inside
+        ``txn()``): arrays replaced wholesale, version bumped, the
+        drift ledger advanced (reset by a re_invert rung).  Returns
+        the new version."""
+        state.a = a
+        state.inverse = inverse
+        state.kappa = float(kappa)
+        state.rel_residual = float(rel_residual)
+        state.drift = float(drift)
+        state.version += 1
+        state.updates_applied += 1
+        if reinverted:
+            state.reinverts += 1
+        return state.version
+
+    def evict(self, handle_id: str) -> bool:
+        """Drop a resident handle (False when already gone).  Eviction
+        is the caller's lifecycle call — the store never ages state
+        out on its own (docs/SERVING.md).  An in-flight ``txn`` is
+        waited out (the state's lock) before removal, so a committed
+        update is never orphaned by a racing evict."""
+        while True:
+            with self._lock:
+                st = self._handles.get(handle_id)
+            if st is None:
+                return False
+            with st.lock:
+                with self._lock:
+                    if self._handles.get(handle_id) is st:
+                        del self._handles[handle_id]
+                        return True
+            # st was replaced between the reads: retry on the successor.
+
+    def ids(self) -> list[str]:
+        with self._lock:
+            return sorted(self._handles)
+
+    def snapshot(self) -> dict:
+        """{handle_id: state.snapshot()} — the stats()/report block."""
+        with self._lock:
+            states = list(self._handles.values())
+        return {st.handle_id: st.snapshot() for st in states}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._handles)
+
+
+def create_resident_handle(store: HandleStore, dtype, a, res,
+                           handle_id: str) -> HandleRef:
+    """Install one resident handle from a completed invert — the ONE
+    padding recipe the service and the fleet share: the bucketed
+    inverse IS [[A⁻¹, 0], [0, I]] (ops/padding.py), so re-padding the
+    returned n×n slice with identity reconstructs the padded resident
+    state exactly.  ``res`` is the creating invert's ``InvertResult``;
+    the returned ref carries it."""
+    import numpy as np
+
+    bucket, n = res.bucket_n, res.n
+    a_pad = np.asarray(np.eye(bucket, dtype=dtype))
+    a_pad[:n, :n] = np.asarray(a, dtype)
+    inv_pad = np.asarray(np.eye(bucket, dtype=dtype))
+    inv_pad[:n, :n] = np.asarray(res.inverse)
+    ref = store.create(HandleState(
+        handle_id=handle_id, n=n, bucket_n=bucket,
+        dtype=np.dtype(dtype).name, a=a_pad, inverse=inv_pad,
+        kappa=res.kappa, rel_residual=res.rel_residual))
+    return HandleRef(ref.handle_id, ref.n, ref.bucket_n, ref.dtype,
+                     result=res)

@@ -41,7 +41,9 @@ from ..obs.journey import JourneyLog
 from ..resilience.policy import DEFAULT_POLICY
 from .batcher import (InvertResult, MicroBatcher, ServiceClosedError,
                       ServiceOverloadedError)
-from .executors import ExecutorCache, bucket_for, rhs_bucket_for
+from .executors import (ExecutorCache, bucket_for, k_bucket_for,
+                        rhs_bucket_for)
+from .handles import HandleRef, HandleStore
 from .stats import ServeStats
 
 
@@ -118,7 +120,9 @@ class JordanService:
                  shared_executors=None,
                  plan_cache_read_only: bool = False,
                  metric_labels: dict | None = None,
-                 numerics: str = "off"):
+                 numerics: str = "off",
+                 shared_handles=None,
+                 update_drift_budget_factor: float | None = None):
         self.dtype = jnp.dtype(dtype)
         self.batch_cap = int(batch_cap)
         self.telemetry = telemetry
@@ -143,6 +147,15 @@ class JordanService:
                 "executables are fused; the host cannot see their "
                 "supersteps) — use numerics='summary' on the service, "
                 "or driver.solve(numerics='trace') for the full trace")
+        # Resident-inverse handles (ISSUE 12): the database of live
+        # (A, A⁻¹) pairs the update lanes mutate.  A fleet passes ONE
+        # shared store to every replica (the ExecutorStore discipline),
+        # so a replica kill never loses a handle and a warm replacement
+        # has nothing to rebuild; None keeps a private store — the
+        # single-service behavior.
+        self.handles = (shared_handles if shared_handles is not None
+                        else HandleStore())
+        self._handle_seq = 0
         self._stats = ServeStats(labels=metric_labels)
         self.executors = ExecutorCache(
             engine=engine, plan_cache=plan_cache,
@@ -155,7 +168,8 @@ class JordanService:
             max_wait_ms=max_wait_ms, max_queue=max_queue,
             block_size=block_size, autostart=autostart,
             telemetry=telemetry, policy=self.policy,
-            numerics=self.numerics)
+            numerics=self.numerics, handles=self.handles,
+            update_drift_budget_factor=update_drift_budget_factor)
         # Request-journey log (ISSUE 8, always on): deterministic
         # ``request_id``s in submit order; every hop mirrors into the
         # process-wide flight recorder.  A fleet replica does NOT mint
@@ -247,17 +261,108 @@ class JordanService:
         return future.result(timeout)
 
     def invert(self, a, timeout: float | None = None,
-               deadline_ms: float | None = None) -> InvertResult:
+               deadline_ms: float | None = None, resident: bool = False,
+               handle_id: str | None = None):
         """Synchronous submit + wait.  Raises
         :class:`~..driver.SingularMatrixError` when THIS request's
         element was flagged (batch-mates are unaffected either way —
         the async ``submit`` path reports the flag on the result
-        instead, for callers that want to inspect rather than raise)."""
+        instead, for callers that want to inspect rather than raise).
+
+        ``resident=True`` (ISSUE 12) additionally installs the
+        (A, A⁻¹) pair as a RESIDENT handle in the handle store and
+        returns a :class:`~.handles.HandleRef` (``ref.result`` carries
+        the ``InvertResult``): subsequent ``update(ref, u, v)`` calls
+        apply rank-k Sherman–Morrison–Woodbury mutations in O(n²k)
+        instead of paying a fresh O(n³) elimination
+        (docs/SERVING.md).  ``handle_id`` names the handle (demos pass
+        deterministic ids so chaos replays compare); default: a
+        service-minted ``h<N>``."""
         res = self.submit(a, deadline_ms=deadline_ms).result(timeout)
         if res.singular:
             from ..driver import SingularMatrixError
 
             raise SingularMatrixError("singular matrix")
+        if not resident:
+            return res
+        return self._create_handle(a, res, handle_id)
+
+    def _create_handle(self, a, res: InvertResult,
+                       handle_id: str | None) -> HandleRef:
+        """Install one resident handle from a completed invert (the
+        shared ``handles.create_resident_handle`` recipe)."""
+        from .handles import create_resident_handle
+
+        if handle_id is None:
+            with self._close_lock:
+                self._handle_seq += 1
+                handle_id = f"h{self._handle_seq}"
+        return create_resident_handle(self.handles, self.dtype, a, res,
+                                      handle_id)
+
+    def submit_update(self, handle: HandleRef, u, v,
+                      deadline_ms: float | None = None,
+                      _ctx=None) -> Future:
+        """Queue one rank-k resident-inverse update (ISSUE 12): apply
+        A ← A + U·Vᵀ to the handle's committed state and refresh its
+        inverse by the Sherman–Morrison–Woodbury identity in O(n²k) —
+        re-verified in the same launch against the MUTATED matrix,
+        with the accumulated-drift budget deciding when the
+        "re_invert" rung pays a fresh elimination instead
+        (docs/WORKLOADS.md).  The future resolves to an
+        :class:`~.batcher.InvertResult` with ``workload="update"``,
+        the committed ``handle_version``/``drift``, and
+        ``update_outcome`` ∈ {refreshed, re_inverted, gated}.  Typed
+        rejections/failures exactly like ``submit``."""
+        from ..linalg.update import as_update_factors
+        from .handles import HandleRef as _Ref
+
+        if not isinstance(handle, _Ref):
+            raise ValueError(f"update() takes the HandleRef returned "
+                             f"by invert(resident=True), got "
+                             f"{type(handle).__name__}")
+        n = handle.n
+        u, v, k = as_update_factors(u, v, n, self.dtype)
+        kb = k_bucket_for(k)
+        bucket = handle.bucket_n
+        padded_u = np.zeros((bucket, kb), self.dtype)
+        padded_u[:n, :k] = u
+        padded_v = np.zeros((bucket, kb), self.dtype)
+        padded_v[:n, :k] = v
+        if deadline_ms is None:
+            deadline_ms = self.default_deadline_ms
+        own_ctx = _ctx is None
+        ctx = (self.journey.new(n, bucket, workload="update")
+               if own_ctx else _ctx)
+        try:
+            fut = self._batcher.submit(
+                None, n, bucket,
+                deadline_s=(None if deadline_ms is None
+                            else float(deadline_ms) / 1e3),
+                ctx=ctx, workload="update", rhs=kb, k=k,
+                handle=handle, padded_u=padded_u, padded_v=padded_v)
+        except Exception as e:
+            if own_ctx:
+                ctx.close("error", error=type(e).__name__)
+            raise
+        if own_ctx:
+            fut.add_done_callback(ctx.close_from_future)
+        return fut
+
+    def update(self, handle: HandleRef, u, v,
+               timeout: float | None = None,
+               deadline_ms: float | None = None) -> InvertResult:
+        """Synchronous ``submit_update`` + wait; raises
+        ``SingularMatrixError`` when the mutation made the matrix
+        singular (typed — the handle's committed state is untouched)."""
+        res = self.submit_update(handle, u, v,
+                                 deadline_ms=deadline_ms).result(timeout)
+        if res.singular:
+            from ..driver import SingularMatrixError
+
+            raise SingularMatrixError(
+                "singular matrix (rank-k update destroyed rank; "
+                "resident state unchanged)")
         return res
 
     def solve_system(self, a, b, timeout: float | None = None,
@@ -274,7 +379,7 @@ class JordanService:
 
     # ---- lifecycle ---------------------------------------------------
 
-    def warmup(self, shapes=(), solve_shapes=()) -> dict:
+    def warmup(self, shapes=(), solve_shapes=(), update_shapes=()) -> dict:
         """Pre-compile the executables for every bucket the given
         request sizes land in; returns {lane: resolved engine}.
         After a warmup covering the live shape mix, the serve path
@@ -283,7 +388,15 @@ class JordanService:
 
         ``solve_shapes`` (ISSUE 11): an iterable of (n, k) pairs to
         pre-compile the solve lanes those requests land in — the
-        zero-compile warm-path contract covers both workloads."""
+        zero-compile warm-path contract covers both workloads.
+
+        ``update_shapes`` (ISSUE 12): an iterable of (n, k) pairs to
+        pre-compile the resident-update lanes for, PLUS each n's invert
+        lane (handle creation rides the normal batched lane) AND its
+        CAP-1 invert twin (the "re_invert" degradation rung eliminates
+        ONE mutated matrix — it must not pay batch_cap eliminations of
+        identity fillers), so a warm update path performs zero compiles
+        even when a rung fires."""
         out = {}
         for n in shapes:
             b = bucket_for(int(n))
@@ -297,6 +410,19 @@ class JordanService:
                                     self._batcher.block_size,
                                     workload="solve", rhs=rhs)
             out[f"solve:{b}:k{rhs}"] = ex.key.engine
+        for n, k in update_shapes:
+            b = bucket_for(int(n))
+            ex = self.executors.get(b, self.batch_cap,
+                                    self._batcher.block_size)
+            out[b] = ex.key.engine
+            if self.batch_cap != 1:
+                # The re_invert rung's cap-1 twin (one matrix per
+                # elimination); same executable when batch_cap == 1.
+                self.executors.get(b, 1, self._batcher.block_size)
+            kb = k_bucket_for(int(k))
+            ex = self.executors.get(b, 1, self._batcher.block_size,
+                                    workload="update", rhs=kb)
+            out[f"update:{b}:k{kb}"] = ex.key.engine
         return out
 
     def start(self) -> None:
@@ -352,6 +478,7 @@ class JordanService:
         snap["measurements"] = self.executors.measurements
         snap["batch_cap"] = self.batch_cap
         snap["queued"] = self._batcher.queued
+        snap["handles"] = self.handles.snapshot()
         snap["breakers"] = {str(b): s for b, s
                             in self.executors.breaker_states().items()}
         return snap

@@ -1,0 +1,363 @@
+"""``update_demo`` — the ``--update-demo`` CLI mode's engine (ISSUE 12
+acceptance).
+
+One self-contained run proves the resident-inverse contract end to end,
+in three legs sharing ONE fleet-shared executor store:
+
+  1. **serve ledger** — a warmed :class:`~.service.JordanService`
+     creates a resident handle (``invert(a, resident=True)``) and
+     streams ``updates`` rank-``rank`` mutations through the O(n²k)
+     update lane, with one deliberately rank-destroying mutation
+     sprinkled mid-stream (its typed "gated" outcome must ride the
+     ledger) and a zero-drift-budget burst at the end (every update
+     trips the "re_invert" degradation rung deterministically — the
+     ladder demonstration).  Pins: ZERO compiles and ZERO plan-cache
+     measurements on the warm update path, and every update accounted
+     ``refreshed | re_inverted | gated`` (``tools/check_update.py``
+     validates; exit 2 = a silently stale inverse).
+  2. **warm latency + FLOPs** — median warm update latency vs median
+     warm re-invert latency at the same bucket (the acceptance bound:
+     the update must win), next to both executables' own XLA
+     ``cost_analysis`` FLOPs (the update executable's must be strictly
+     below the fresh-invert executable's — k ≤ n/8 is the documented
+     regime) and the achieved-vs-analytical 4n²k+O(nk²) rate (hwcost).
+  3. **fleet chaos** — the same deterministic update stream twice
+     through an N-replica :class:`~..fleet.JordanFleet` sharing the
+     executor store: fault-free (the replay baseline), then under a
+     seeded ``replica_kill`` schedule crashing replicas mid-stream.
+     Handles live in the fleet-shared :class:`~.handles.HandleStore`,
+     so a kill loses nothing: the router re-queues, the retry re-reads
+     committed state, and every per-update outcome — AND the final
+     resident inverse — must bit-match the fault-free replay; the
+     final resident inverse is additionally verified against a
+     from-scratch solve of the mutated matrix (the fresh invert lane)
+     with the residual gate.  Zero compiles after warmup across kills
+     and warm replacements (the PR 7 pin, extended to update lanes).
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..obs.metrics import REGISTRY
+from ..resilience import FaultPlan, ResiliencePolicy
+from ..resilience import activate as _activate
+from ..resilience.policy import RetryPolicy
+from .executors import ExecutorStore, bucket_for, k_bucket_for
+from .service import JordanService
+
+
+def _fixture(n: int, rank: int, updates: int, seed: int, dtype):
+    """The deterministic demo fixture: one well-conditioned seeded A
+    plus an update stream scaled so each mutation perturbs without
+    destroying conditioning.  Update ``updates // 2`` is replaced at
+    stream time by the rank-destroying mutation (computed against the
+    then-committed A — see ``_run_update_stream``)."""
+    rng = np.random.default_rng(seed)
+    a = rng.standard_normal((n, n)).astype(dtype)
+    scale = 1.0 / np.sqrt(float(n) * rank)
+    stream = [(rng.standard_normal((n, rank)).astype(dtype) * scale,
+               rng.standard_normal((n, rank)).astype(dtype) * scale)
+              for _ in range(updates)]
+    return a, stream
+
+
+def _singular_factors(a_committed: np.ndarray, n: int, rank: int, dtype):
+    """Rank-destroying factors against the COMMITTED matrix: zero out
+    column 0 (u = −A·e₀ padded to rank-k with zero columns), so the
+    capacitance determinant — det(A+UVᵀ)/det(A) — is exactly the
+    singularity signal the typed "gated" outcome must carry."""
+    u = np.zeros((n, rank), dtype)
+    v = np.zeros((n, rank), dtype)
+    u[:, 0] = -np.asarray(a_committed[:n, 0])
+    v[0, 0] = 1.0
+    return u, v
+
+
+def _classify_update(target, ref, u, v, timeout: float = 600.0):
+    """One update outcome tuple for the replay comparison:
+    ("ok", outcome, version, inverse-bytes) or ("error", type-name).
+    ``target`` is a JordanService or JordanFleet (same surface)."""
+    try:
+        fut = target.submit_update(ref, u, v)
+        res = fut.result(timeout)
+        if res.singular:
+            return ("ok", "gated", res.handle_version, b"")
+        return ("ok", res.update_outcome, res.handle_version,
+                np.asarray(res.inverse).tobytes())
+    except Exception as e:                           # noqa: BLE001
+        return ("error", type(e).__name__)
+
+
+def _run_update_stream(target, ref, a0, stream, n, rank, dtype,
+                       singular_at: int | None):
+    """Apply the stream SEQUENTIALLY (per-handle ordering is the
+    determinism contract) and track the true mutated matrix host-side
+    — the from-scratch verification target.  Returns (outcomes,
+    a_track)."""
+    a_track = np.asarray(a0, dtype).copy()
+    outcomes = []
+    for i, (u, v) in enumerate(stream):
+        if singular_at is not None and i == singular_at:
+            u, v = _singular_factors(a_track, n, rank, dtype)
+        out = _classify_update(target, ref, u, v)
+        outcomes.append(out)
+        if out[0] == "ok" and out[1] in ("refreshed", "re_inverted"):
+            a_track = a_track + u @ v.T
+    return outcomes, a_track
+
+
+def _median_latency(samples):
+    s = sorted(samples)
+    return s[len(s) // 2] if s else None
+
+
+def update_demo(n: int = 2048, block_size: int | None = None,
+                rank: int = 32, updates: int = 8, replicas: int = 3,
+                kills: int = 1, seed: int = 0, dtype=jnp.float32,
+                telemetry=None) -> dict:
+    """Run the three-leg resident-update acceptance demo; returns the
+    one-line JSON report ``tools/check_update.py`` validates (exit 2 =
+    silent stale inverse)."""
+    t0 = time.perf_counter()
+    if updates < 3:
+        raise ValueError("update_demo needs updates >= 3 (the ledger "
+                         "must show refreshed + gated outcomes)")
+    dtype = jnp.dtype(dtype)
+    a0, stream = _fixture(n, rank, updates, seed, dtype)
+    singular_at = updates // 2
+    store = ExecutorStore()
+    policy = ResiliencePolicy(
+        retry=RetryPolicy(max_retries=max(4, kills + 2), backoff_s=0.0))
+    bucket = bucket_for(n)
+    kb = k_bucket_for(rank)
+
+    def counters():
+        c = REGISTRY.counter
+        return {
+            "compiles": c("tpu_jordan_compiles_total").total(),
+            "measurements":
+                c("tpu_jordan_tuner_measurements_total").total(),
+            "rungs": c("tpu_jordan_recovery_rungs_total").total(),
+            "deaths":
+                c("tpu_jordan_fleet_replica_deaths_total").total(),
+            "restarts": c("tpu_jordan_fleet_restarts_total").total(),
+            "reroutes": c("tpu_jordan_fleet_reroutes_total").total(),
+            "faults": c("tpu_jordan_faults_injected_total").total(),
+        }
+
+    # ---- leg 1: serve ledger + drift-rung demonstration -------------
+    with JordanService(engine="auto", dtype=dtype, batch_cap=1,
+                       max_wait_ms=0.5, block_size=block_size,
+                       policy=policy, shared_executors=store,
+                       telemetry=telemetry) as svc:
+        svc.warmup(update_shapes=[(n, rank)])
+        after_warm = counters()
+        ref = svc.invert(a0, resident=True, handle_id="svc",
+                         timeout=600)
+        ledger_outcomes, a_track = _run_update_stream(
+            svc, ref, a0, stream, n, rank, dtype, singular_at)
+
+        # ---- leg 2: warm latency + FLOPs, same warm service ---------
+        upd_lat, inv_lat = [], []
+        for i in range(3):
+            u, v = stream[i % len(stream)]
+            res = svc.update(ref, u, v, timeout=600)
+            upd_lat.append(res.execute_seconds)
+            a_track = a_track + u @ v.T
+            inv_res = svc.submit(a_track).result(600)
+            inv_lat.append(inv_res.execute_seconds)
+        ex_upd = svc.executors.get(bucket, 1, svc._batcher.block_size,
+                                   workload="update", rhs=kb)
+        ex_inv = svc.executors.get(bucket, 1, svc._batcher.block_size)
+        svc_stats = svc.stats()
+    serve_counters = counters()
+
+    # The deterministic re_invert demonstration: a zero drift budget
+    # trips the rung on EVERY update — the ladder is exercised without
+    # depending on fixture conditioning (linalg.update.drift_budget's
+    # factor override; the documented default governs everywhere else).
+    with JordanService(engine="auto", dtype=dtype, batch_cap=1,
+                       max_wait_ms=0.5, block_size=block_size,
+                       policy=policy, shared_executors=store,
+                       update_drift_budget_factor=0.0) as svc2:
+        svc2.warmup(update_shapes=[(n, rank)])
+        ref2 = svc2.invert(a0, resident=True, handle_id="svc-drift",
+                           timeout=600)
+        u, v = stream[0]
+        drift_res = svc2.update(ref2, u, v, timeout=600)
+    drift_counters = counters()
+
+    upd_ms = _median_latency(upd_lat) * 1e3
+    inv_ms = _median_latency(inv_lat) * 1e3
+    upd_flops = ex_upd.cost.flops if ex_upd.cost.available else None
+    inv_flops = ex_inv.cost.flops if ex_inv.cost.available else None
+    from ..obs import hwcost as _hwcost
+
+    analytical = _hwcost.baseline_workload_flops(bucket, "update", k=kb)
+
+    # ---- leg 3: fleet chaos vs fault-free replay --------------------
+    from ..fleet import JordanFleet
+
+    fleet_kw = dict(engine="auto", dtype=dtype, batch_cap=1,
+                    max_wait_ms=0.5, block_size=block_size,
+                    policy=policy, executor_store=store,
+                    stable_after_s=0.2, liveness_deadline_s=5.0,
+                    max_queue=max(4 * updates, 64))
+    before = counters()
+    with JordanFleet(replicas=replicas, **fleet_kw) as flt:
+        flt.warmup([n], update_shapes=[(n, rank)])
+        fref = flt.invert(a0, resident=True, handle_id="flt",
+                          timeout=600)
+        baseline, a_base = _run_update_stream(
+            flt, fref, a0, stream, n, rank, dtype, singular_at)
+        base_state = flt.handles.get("flt")
+        base_inv_bytes = np.asarray(base_state.inverse).tobytes()
+    after_free = counters()
+
+    horizon = max(3, updates)
+    plan = FaultPlan.seeded(seed,
+                            points={"replica_kill": (kills, horizon)})
+    with JordanFleet(replicas=replicas, **fleet_kw) as cflt:
+        cflt.warmup([n], update_shapes=[(n, rank)])
+        chaos_warm = counters()
+        with _activate(plan):
+            cref = cflt.invert(a0, resident=True, handle_id="flt",
+                               timeout=600)
+            chaos, a_chaos = _run_update_stream(
+                cflt, cref, a0, stream, n, rank, dtype, singular_at)
+        chaos_state = cflt.handles.get("flt")
+        chaos_inv = np.asarray(chaos_state.inverse).copy()
+        chaos_a = np.asarray(chaos_state.a).copy()
+        chaos_snapshot = chaos_state.snapshot()
+        # From-scratch solve of the MUTATED matrix through the warm
+        # fresh-invert lane: the independent verification target.
+        fresh = cflt.invert(chaos_a[:n, :n], timeout=600)
+        fleet_stats = cflt.stats()
+    after = counters()
+    delta = {k: after[k] - before[k] for k in before}
+
+    # ---- compare chaos vs the fault-free replay ---------------------
+    mismatches = []
+    matched = 0
+    typed_errors: dict[str, int] = {}
+    for i, (base, ch) in enumerate(zip(baseline, chaos)):
+        if ch[0] == "error":
+            typed_errors[ch[1]] = typed_errors.get(ch[1], 0) + 1
+            continue
+        if ch == base:
+            matched += 1
+        else:
+            mismatches.append({"update": i, "why": (
+                f"outcome diverged from the fault-free replay: "
+                f"{base[:3]} vs {ch[:3]}")})
+    final_bitmatch = (chaos_inv.tobytes() == base_inv_bytes)
+    if not final_bitmatch:
+        mismatches.append({"update": "final",
+                           "why": "post-kill resident inverse bits "
+                                  "diverged from the fault-free replay"})
+
+    # ---- from-scratch verification of the post-kill inverse ---------
+    from ..resilience.degrade import gate_threshold
+
+    fresh_inv = np.asarray(fresh.inverse)
+    denom = float(np.abs(fresh_inv).sum(axis=-1).max())
+    vs_fresh = (float(np.abs(chaos_inv[:n, :n] - fresh_inv)
+                      .sum(axis=-1).max()) / denom if denom else 0.0)
+    gate_thr = gate_threshold(policy, n, fresh.kappa, dtype)
+    resident_rel = float(chaos_snapshot["rel_residual"])
+    fresh_ok = bool(resident_rel <= gate_thr) and resident_rel == resident_rel
+
+    # ---- the per-update accounting ledger ---------------------------
+    def tally(outs):
+        t = {"refreshed": 0, "re_inverted": 0, "gated": 0, "error": 0}
+        for o in outs:
+            if o[0] == "error":
+                t["error"] += 1
+            else:
+                t[o[1]] += 1
+        return t
+
+    serve_tally = tally(ledger_outcomes)
+    chaos_tally = tally(chaos)
+    ledger_ok = (sum(serve_tally.values()) == updates
+                 and sum(chaos_tally.values()) == updates)
+
+    silent_stale = (bool(mismatches) or not fresh_ok or not ledger_ok
+                    or delta["compiles"] - (chaos_warm["compiles"]
+                                            - before["compiles"]) != 0)
+
+    report = {
+        "metric": "update_demo",
+        "n": n, "rank": rank, "k_bucket": kb, "bucket_n": bucket,
+        "updates": updates, "replicas": replicas, "seed": seed,
+        "dtype": dtype.name,
+        "serve": {
+            "ledger": serve_tally,
+            "outcomes": [list(o[:3]) for o in ledger_outcomes],
+            "compiles_on_update_path": (
+                serve_counters["compiles"] - after_warm["compiles"]),
+            "measurements": serve_counters["measurements"]
+                - after_warm["measurements"],
+            "drift_rung": {
+                "forced_budget_factor": 0.0,
+                "outcome": drift_res.update_outcome,
+                "drift_after": drift_res.drift,
+                "rungs_fired": (drift_counters["rungs"]
+                                - serve_counters["rungs"]),
+            },
+            "handles": svc_stats["handles"],
+        },
+        "latency": {
+            "warm_update_ms": round(upd_ms, 3),
+            "warm_reinvert_ms": round(inv_ms, 3),
+            "update_beats_reinvert": bool(upd_ms < inv_ms),
+            "speedup_x": round(inv_ms / upd_ms, 2) if upd_ms else None,
+        },
+        "hwcost": {
+            "update_executable_flops": upd_flops,
+            "invert_executable_flops": inv_flops,
+            "update_vs_invert_flops": (
+                round(upd_flops / inv_flops, 4)
+                if upd_flops and inv_flops else None),
+            "flops_below_invert": (
+                bool(upd_flops < inv_flops)
+                if upd_flops and inv_flops else None),
+            "analytical_update_flops": analytical,
+            "flops_convention": "4n^2k + 2nk^2",
+            "k_over_n": round(kb / bucket, 4),
+            "env": _hwcost.runtime_env(),
+        },
+        "chaos": {
+            "faults": plan.report(),
+            "kills_injected": int(delta["faults"]
+                                  - (after_free["faults"]
+                                     - before["faults"])),
+            "deaths": delta["deaths"],
+            "restarts": delta["restarts"],
+            "reroutes": delta["reroutes"],
+            "compiles_delta_after_warmup": (after["compiles"]
+                                            - chaos_warm["compiles"]),
+            "ledger": chaos_tally,
+            "outcomes": [list(o[:3]) for o in chaos],
+            "final_inverse_bitmatch_replay": final_bitmatch,
+            "handle": chaos_snapshot,
+        },
+        "verification": {
+            "resident_rel_residual": resident_rel,
+            "gate_threshold": float(gate_thr),
+            "gate_passes": fresh_ok,
+            "vs_fresh_solve_rel_diff": vs_fresh,
+            "fresh_solve_rel_residual": float(fresh.rel_residual),
+        },
+        "matched_bitwise": matched,
+        "typed_errors": typed_errors,
+        "mismatches": mismatches,
+        "fleet_ledger": fleet_stats["ledger"],
+        "silent_stale": bool(silent_stale),
+        "elapsed_s": round(time.perf_counter() - t0, 3),
+    }
+    return report

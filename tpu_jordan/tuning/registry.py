@@ -43,10 +43,12 @@ GROUPED_MIN_SINGLE_CHIP_N = 8192
 # "solve" the augmented-[A | B] X = A⁻¹B path (no inverse ever formed),
 # "solve_spd" its pivot-free fast path (the caller's assume="spd"
 # promise skips the condition-based probe — the paper's most expensive
-# non-GEMM phase, main.cpp:1026-1074).  lstsq is not a registry
-# workload: it routes through solve_system on the normal equations
+# non-GEMM phase, main.cpp:1026-1074), "update" the Sherman–Morrison–
+# Woodbury rank-k resident-inverse update (ISSUE 12,
+# tpu_jordan/linalg/update.py).  lstsq is not a registry workload: it
+# routes through solve_system on the normal equations
 # (tpu_jordan/linalg/api.py), so its engine choice IS a solve choice.
-WORKLOADS: tuple[str, ...] = ("invert", "solve", "solve_spd")
+WORKLOADS: tuple[str, ...] = ("invert", "solve", "solve_spd", "update")
 
 # The comm model's calibration floor: its compute terms are calibrated
 # on the measured 8192-class phase model and its smallest validated
@@ -345,6 +347,25 @@ def _cost_solve_spd(pt: TunePoint) -> float:
     return 0.45 * projected_seconds(pt)
 
 
+def _legal_update(pt: TunePoint) -> bool:
+    # The SMW update (linalg/update.py): three GEMMs, a k×k capacitance
+    # solve, and the in-launch verification matmul — single-device
+    # (the resident state it mutates lives on one chip; the tuning
+    # point's k rides the serve executor key, not the plan key).
+    return not pt.distributed
+
+
+def _cost_update(pt: TunePoint) -> float:
+    # O(n²k) correction + the one deliberate O(n³) verification matmul
+    # vs the fresh elimination's ~(8/3)n³ + its own verification: ~0.45x
+    # of the invert projection is the honest first-order ranking at
+    # serve-relevant k ≤ n/8 (the point does not carry k; the serve key
+    # does).  It is also the ONLY update-workload engine — the ranking
+    # exists so the ladder, plan keys, and drift recording work exactly
+    # like every other lane, not to arbitrate a zoo.
+    return 0.45 * projected_seconds(pt)
+
+
 CONFIGS: tuple[EngineConfig, ...] = (
     EngineConfig(
         "inplace", "inplace", 0, _real_dtype, _cost_inplace,
@@ -396,6 +417,14 @@ CONFIGS: tuple[EngineConfig, ...] = (
         "recovery fallback (never cost-preferred over the pivot-free "
         "path, but a legal candidate the measuring tuner can promote)",
         workload="solve_spd"),
+    # ---- resident-inverse updates (ISSUE 12, tpu_jordan/linalg) ------
+    EngineConfig(
+        "smw_update", "smw_update", 0, _legal_update, _cost_update,
+        "Sherman–Morrison–Woodbury rank-k resident-inverse update: "
+        "(A+UVᵀ)⁻¹ = A⁻¹ − A⁻¹U(I+VᵀA⁻¹U)⁻¹VᵀA⁻¹ at ~4n²k + O(nk²) "
+        "plus the in-launch re-verification against the mutated matrix "
+        "(linalg/update.py); the serve 'update' lanes' one engine",
+        workload="update"),
 )
 
 REGISTRY: dict[str, EngineConfig] = {c.name: c for c in CONFIGS}
@@ -412,8 +441,11 @@ ENGINES: tuple[str, ...] = ("auto",) + tuple(
 
 #: The solve-workload engine vocabulary (linalg.solve_system's engine=
 #: flag): derived the same way, "auto" = the tuner ladder per workload.
+#: The update workload is deliberately excluded — smw_update is not a
+#: solve engine (linalg.solve_update has no engine= knob to leak into).
 SOLVE_ENGINES: tuple[str, ...] = ("auto",) + tuple(
-    dict.fromkeys(c.engine for c in CONFIGS if c.workload != "invert"))
+    dict.fromkeys(c.engine for c in CONFIGS
+                  if c.workload in ("solve", "solve_spd")))
 
 #: The single-device fused-kernel engines (ops/pallas_update.py): the
 #: driver gates them off distributed meshes, dispatches their grouped

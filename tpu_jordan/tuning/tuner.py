@@ -75,6 +75,18 @@ def measure_config(point: TunePoint, cfg: EngineConfig,
 
     dtype = jnp.dtype(point.dtype)
     n, m = point.n, point.block_size
+    if getattr(point, "workload", "invert") == "update":
+        # The update workload is cost-only by construction (ISSUE 12):
+        # smw_update is its ONE registered engine, so there is no
+        # ranking to measure — and silently timing a different kernel
+        # under the '|wupdate' key would be exactly the bogus-plan
+        # class the typed-refusal discipline exists for.
+        from ..driver import UsageError
+
+        raise UsageError(
+            "tune=True has nothing to measure for the update workload "
+            "(smw_update is its one engine; the serve update lanes "
+            "resolve cost-only)")
     if getattr(point, "workload", "invert") != "invert":
         # Solve-workload measurement (ISSUE 11): the [A | B] engine at a
         # representative single-RHS point — engine ranking is measured
