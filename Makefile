@@ -13,7 +13,7 @@ WORKERS  ?= 1
 REQUESTS  ?= 64
 BATCH_CAP ?= 8
 
-.PHONY: all native tpu test smoke serve-demo solve-demo chaos-demo fleet-demo update-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
+.PHONY: all native tpu test smoke serve-demo solve-demo chaos-demo fleet-demo update-demo capacity-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
 
 REPLICAS ?= 3
 
@@ -104,6 +104,19 @@ update-demo:
 	  --replicas $(REPLICAS) --kills 1 --quiet \
 	  > /tmp/tpu_jordan_update.json
 	python tools/check_update.py /tmp/tpu_jordan_update.json
+
+# Capacity demo + validation (ISSUE 13, docs/OBSERVABILITY.md): a
+# warmed service under a resident-handle byte budget — lane bytes
+# projected before any compile, LRU budget eviction with journey-hop +
+# flight-recorder evidence, the typed CapacityExceededError at submit
+# when everything evictable is pinned, and the ledger reconciliation
+# bytes_created == bytes_live + bytes_evicted per class (exit 2 =
+# unmetered residency / a silent eviction).  This row is the capacity
+# observatory's demo gate, like update-demo/fleet-demo for theirs.
+capacity-demo:
+	python -m tpu_jordan 96 32 --capacity-demo --quiet \
+	  > /tmp/tpu_jordan_capacity.json
+	python tools/check_capacity.py /tmp/tpu_jordan_capacity.json
 
 # SLO demo + validation (docs/OBSERVABILITY.md): the fleet demo with
 # the --slo-report leg — declarative per-bucket availability SLOs

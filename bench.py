@@ -246,6 +246,12 @@ def _measure(n, m, r1, r2, generator="absdiff", max_rel=1e-2, refine=0,
         ai = cost.arithmetic_intensity
         if ai is not None:
             acc["arithmetic_intensity"] = round(ai, 1)
+    # Accounting-class capacity field (ISSUE 13 satellite): the
+    # executable's memory_analysis HBM footprint — excluded from the
+    # cross-round perf comparison by check_bench (a jaxlib layout
+    # change must not page as an execution regression).
+    if cost.available and cost.hbm_bytes is not None:
+        acc["peak_hbm_bytes"] = cost.hbm_bytes
     if refine:
         refined = newton_schulz(a, inv, refine)
         rel_ref = float(residual_inf_norm(a, refined)) / norm_a
@@ -312,9 +318,11 @@ def _record_spread(extra, prefix, acc):
         extra[f"{prefix}_iqr_rejected_samples"] = acc["iqr_rejected_samples"]
     if "variance_flag" in acc:
         extra[f"{prefix}_variance_flag"] = acc["variance_flag"]
-    # Compiler-counted accounting (ISSUE 10), when the backend gave it.
+    # Compiler-counted accounting (ISSUE 10/13), when the backend gave
+    # it; the *_bytes keys are accounting-class — never compared
+    # across rounds (tools/check_bench.py).
     for key in ("xla_flops", "xla_gflops", "xla_vs_2n3",
-                "arithmetic_intensity"):
+                "arithmetic_intensity", "peak_hbm_bytes"):
         if key in acc:
             extra[f"{prefix}_{key}"] = acc[key]
 
@@ -699,6 +707,16 @@ def _update_rows(extra, n=4096, m=128, k=32, amortized_updates=8):
                     cost.flops / meas_u.seconds / 1e9, 1)
             extra[f"{label}_xla_vs_analytic"] = round(cost.flops / flops,
                                                       3)
+        # Capacity accounting fields (ISSUE 13 satellite): the update
+        # executable's memory_analysis HBM footprint next to the
+        # 2n²·dtype a resident handle pins — both accounting-class,
+        # excluded from cross-round perf comparison by check_bench.
+        if cost.available and cost.hbm_bytes is not None:
+            extra[f"{label}_peak_hbm_bytes"] = cost.hbm_bytes
+        from tpu_jordan.serve.handles import resident_handle_bytes
+
+        extra[f"{label}_resident_handle_bytes"] = resident_handle_bytes(
+            n, jnp.float32)
 
         # ---- the amortized resident-handle row ----------------------
         M = amortized_updates

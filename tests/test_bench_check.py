@@ -193,6 +193,49 @@ class TestRegressionRules:
         assert "invert_4096_f32_gflops" in keys
         assert "invert_4096_xla_gflops" not in keys
 
+    def test_capacity_bytes_rows_accounting_class_never_compared(
+            self, tmp_path):
+        """ISSUE 13 satellite, trapped both ways: the new capacity
+        accounting fields (``*_peak_hbm_bytes`` from memory_analysis,
+        ``*_resident_handle_bytes``) are accounting-class — a 10x
+        'regression' in them (a jaxlib layout change, a dtype change)
+        must NEVER page — while the SAME shortfall under a rate key
+        still does."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "update_4096_k32_peak_hbm_bytes": 2.0e8,
+                "update_4096_k32_resident_handle_bytes": 1.3e8,
+                "invert_4096_spread_pct": 1.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "update_4096_k32_peak_hbm_bytes": 2.0e9,
+                "update_4096_k32_resident_handle_bytes": 1.3e9,
+                "invert_4096_spread_pct": 1.0})),
+        ]
+        assert check_bench.main(files) == 0
+        # The other way: the same 10x shortfall under a rate key pages.
+        files = [
+            _write(tmp_path, "r3.json", _round(10000.0, {
+                "update_4096_k32_gflops": 2000.0,
+                "update_4096_k32_spread_pct": 1.0})),
+            _write(tmp_path, "r4.json", _round(10000.0, {
+                "update_4096_k32_gflops": 200.0,
+                "update_4096_k32_spread_pct": 1.0})),
+        ]
+        assert check_bench.main(files) == 2
+        assert check_bench.is_accounting_key(
+            "update_4096_k32_peak_hbm_bytes")
+        assert check_bench.is_accounting_key(
+            "update_4096_k32_resident_handle_bytes")
+        assert check_bench.is_accounting_key("invert_4096_xla_gflops")
+        assert not check_bench.is_accounting_key(
+            "update_4096_k32_gflops")
+        keys = check_bench.comparable_keys(
+            {"metric": "m", "value": 1.0,
+             "extra": {"update_4096_k32_peak_hbm_bytes": 1.0,
+                       "update_4096_k32_gflops": 9000.0}})
+        assert "update_4096_k32_gflops" in keys
+        assert "update_4096_k32_peak_hbm_bytes" not in keys
+
     def test_update_rows_trap_quiet_regression(self, tmp_path):
         """ISSUE 12 satellite: the new resident-update keys
         (update_4096_k32_gflops / update_resident_amortized_gflops)

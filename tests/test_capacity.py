@@ -1,0 +1,729 @@
+"""ISSUE 13 — the capacity observatory: the process-wide byte ledger
+(created == live + evicted per metered class, high-water marks), the
+resident-handle CapacityBudget (LRU eviction over last-served with
+pinned exemption, typed CapacityExceededError at submit — never an OOM
+mid-launch), budget eviction racing an in-flight update txn (the PR 11
+STATE→STORE lock order extended to the budget evictor), lane byte
+projection before any compile, the sticky device live-bytes watermark
+(re-probed every snapshot on supporting backends, disabled forever on
+a first probe that reported nothing — both behaviors pinned), and the
+``check_capacity.py`` both-ways gate."""
+
+import importlib.util
+import pathlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_jordan.obs.capacity import (CapacityBudget, CapacityLedger,
+                                     capacity_demo, lru_policy)
+from tpu_jordan.resilience.policy import CapacityExceededError
+from tpu_jordan.serve.handles import (HandleState, HandleStore,
+                                      UnknownHandleError,
+                                      resident_handle_bytes)
+
+_repo = pathlib.Path(__file__).resolve().parent.parent
+_spec = importlib.util.spec_from_file_location(
+    "check_capacity", _repo / "tools" / "check_capacity.py")
+check_capacity = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_capacity)
+
+
+def _state(hid, bucket=64, n=4):
+    return HandleState(handle_id=hid, n=n, bucket_n=bucket,
+                       dtype="float32", a=np.eye(n), inverse=np.eye(n))
+
+
+class TestLedger:
+    def test_register_release_reconciles(self):
+        led = CapacityLedger()
+        led.register("handles", "a", 100, detail="n64")
+        led.register("handles", "b", 50, detail="n64")
+        assert led.live_bytes("handles") == 150
+        led.release("handles", "a")
+        snap = led.snapshot()["components"]["handles"]
+        assert snap["bytes_created"] == 150
+        assert snap["bytes_live"] == 50
+        assert snap["bytes_evicted"] == 100
+        assert snap["bytes_created"] == (snap["bytes_live"]
+                                         + snap["bytes_evicted"])
+        assert snap["high_water_bytes"] == 150
+        assert snap["breakdown"] == {"n64": 50}
+
+    def test_reregister_same_key_counts_old_as_evicted(self):
+        """Replace semantics: a re-created key's old bytes are evicted,
+        never silently lost — the reconciliation invariant survives
+        re-inverts and plan-cache re-saves."""
+        led = CapacityLedger()
+        led.register("plan_cache", "k", 100)
+        led.register("plan_cache", "k", 300)
+        snap = led.snapshot()["components"]["plan_cache"]
+        assert snap["bytes_live"] == 300
+        assert snap["bytes_created"] == 400
+        assert snap["bytes_evicted"] == 100
+        assert snap["entries"] == 1
+
+    def test_double_release_is_noop_never_negative(self):
+        led = CapacityLedger()
+        led.register("handles", "a", 10)
+        assert led.release("handles", "a") == 10
+        assert led.release("handles", "a") == 0
+        assert led.live_bytes("handles") == 0
+
+    def test_sampled_probe_available_and_absent(self):
+        """A probe returning None reports available=False — absent,
+        never zeroed; a probe raising is absent too (telemetry must
+        never fail a snapshot)."""
+        led = CapacityLedger()
+        led.register_probe("ring", lambda: {"bytes": 42, "extra": 1})
+        led.register_probe("dev", lambda: None)
+        led.register_probe("boom", lambda: 1 / 0)
+        comps = led.snapshot()["components"]
+        assert comps["ring"] == {"kind": "sampled", "available": True,
+                                 "bytes_live": 42, "extra": 1}
+        assert comps["dev"] == {"kind": "sampled", "available": False}
+        assert comps["boom"] == {"kind": "sampled", "available": False}
+
+    def test_process_ledger_gauges_mirrored(self):
+        from tpu_jordan.obs import capacity as cap
+        from tpu_jordan.obs.metrics import REGISTRY
+
+        key = ("test_capacity", "gauge-mirror")
+        cap.register("handles", key, 7, detail="test")
+        g = REGISTRY.gauge("tpu_jordan_capacity_bytes")
+        assert g.value(component="handles") >= 7
+        created = REGISTRY.counter(
+            "tpu_jordan_capacity_bytes_created_total")
+        assert created.value(component="handles") >= 7
+        cap.release("handles", key)
+
+
+class TestWatermark:
+    """ISSUE 13 satellite: the PR 9 one-shot device watermark,
+    re-based as a sticky first-probe decision."""
+
+    def test_unsupported_first_probe_sticky_forever(self):
+        from tpu_jordan.obs.hwcost import DeviceMemoryWatermark
+
+        calls = []
+
+        def sampler():
+            calls.append(1)
+            return None if len(calls) == 1 else {"bytes_in_use": 9}
+
+        wm = DeviceMemoryWatermark(sampler=sampler)
+        assert wm.sample() is None
+        assert wm.available is False
+        # The backend "starts reporting" later — irrelevant: the first
+        # probe's verdict is final, the sampler is never called again.
+        assert wm.sample() is None
+        assert wm.sample() is None
+        assert calls == [1]
+
+    def test_supported_backend_reprobed_every_sample(self):
+        from tpu_jordan.obs.hwcost import DeviceMemoryWatermark
+
+        vals = iter([100, 200, 300])
+        calls = []
+
+        def sampler():
+            v = next(vals)
+            calls.append(v)
+            return {"bytes_in_use": v, "peak_bytes_in_use": 300}
+
+        wm = DeviceMemoryWatermark(sampler=sampler)
+        assert wm.sample()["bytes_in_use"] == 100
+        assert wm.available is True
+        assert wm.sample()["bytes_in_use"] == 200
+        assert wm.sample()["bytes_in_use"] == 300
+        assert calls == [100, 200, 300]
+
+    def test_transient_none_on_supported_backend_never_zeroes(self):
+        """A supporting backend hiccuping one empty read must not
+        disable the watermark (the old per-instance tri-state did) —
+        and must not zero the gauges (absent is honest)."""
+        from tpu_jordan.obs.hwcost import DeviceMemoryWatermark
+        from tpu_jordan.obs.metrics import REGISTRY
+
+        seq = iter([{"bytes_in_use": 77}, None, {"bytes_in_use": 88}])
+        wm = DeviceMemoryWatermark(sampler=lambda: next(seq))
+        assert wm.sample(probe="t")["bytes_in_use"] == 77
+        g = REGISTRY.gauge("tpu_jordan_device_bytes_in_use")
+        assert g.value(probe="t") == 77
+        assert wm.sample(probe="t") is None       # transient miss
+        assert wm.available is True               # ... not a verdict
+        assert g.value(probe="t") == 77           # never zeroed
+        assert wm.sample(probe="t")["bytes_in_use"] == 88
+        assert g.value(probe="t") == 88
+
+    def test_capacity_snapshot_reprobes_supported_backend(self,
+                                                          monkeypatch):
+        """The capacity snapshot's device component goes through the
+        sticky probe — one sampler call per snapshot on a supporting
+        backend."""
+        from tpu_jordan.obs import capacity as cap
+        from tpu_jordan.obs import hwcost
+        from tpu_jordan.obs.hwcost import DeviceMemoryWatermark
+
+        calls = []
+
+        def sampler():
+            calls.append(1)
+            return {"bytes_in_use": 5, "peak_bytes_in_use": 6}
+
+        monkeypatch.setattr(hwcost, "WATERMARK",
+                            DeviceMemoryWatermark(sampler=sampler))
+        d1 = cap.snapshot()["components"]["device"]
+        d2 = cap.snapshot()["components"]["device"]
+        assert d1 == {"kind": "sampled", "available": True,
+                      "bytes_live": 5, "peak_bytes_in_use": 6}
+        assert d2 == d1
+        assert len(calls) == 2
+
+    def test_cpu_backend_stays_unavailable_in_snapshot(self):
+        """On this CPU host the real allocator reports nothing: the
+        device component is available=False — never zeroed, never
+        modeled (the pinned PR 9 behavior, now at every snapshot)."""
+        from tpu_jordan.obs import capacity as cap
+
+        dev = cap.snapshot()["components"]["device"]
+        assert dev == {"kind": "sampled", "available": False}
+
+
+class TestBudgetedHandleStore:
+    def test_resident_handle_bytes_unit(self):
+        assert resident_handle_bytes(64, jnp.float32) == 2 * 64 * 64 * 4
+        assert resident_handle_bytes(128, jnp.float64) == 2 * 128**2 * 8
+
+    @staticmethod
+    def _commit_noop(store, hid):
+        """One COMMITTED serve of a handle (the commit-gated LRU
+        stamp: only a txn that wrote through refreshes the handle's
+        eviction position)."""
+        with store.txn(hid) as st:
+            store.commit(st, a=st.a, inverse=st.inverse, kappa=1.0,
+                         rel_residual=0.0, drift=0.0)
+
+    def test_lru_eviction_order_and_pin_exemption(self):
+        """The budget evicts the least-recently-SERVED unpinned handle:
+        a COMMITTED txn refreshes the stamp, a pin exempts entirely."""
+        per = resident_handle_bytes(64, jnp.float32)
+        t = [0.0]
+
+        def clock():
+            t[0] += 1.0
+            return t[0]
+
+        store = HandleStore(budget=CapacityBudget(max_bytes=2 * per),
+                            clock=clock)
+        store.create(_state("h1"))
+        store.create(_state("h2"))
+        self._commit_noop(store, "h1")    # serve h1: h2 becomes LRU
+        store.create(_state("h3"))        # must evict h2
+        assert store.ids() == ["h1", "h3"]
+        snap = store.budget_snapshot()
+        assert snap["budget_evictions"] == 1
+        assert snap["live_bytes"] == 2 * per
+        # Pin the LRU handle: the NEXT admission must skip it and
+        # evict the other.
+        self._commit_noop(store, "h3")    # h1 is now LRU
+        store.pin("h1")
+        store.create(_state("h4"))
+        assert store.ids() == ["h1", "h4"]
+
+    def test_failed_txn_does_not_refresh_lru(self):
+        """Review hardening: a txn that raises WITHOUT committing must
+        not bump last_served — a handle whose updates keep failing
+        typed cannot squat on residency by refreshing its own
+        eviction position."""
+        per = resident_handle_bytes(64, jnp.float32)
+        t = [0.0]
+
+        def clock():
+            t[0] += 1.0
+            return t[0]
+
+        store = HandleStore(budget=CapacityBudget(max_bytes=2 * per),
+                            clock=clock)
+        store.create(_state("sick"))
+        store.create(_state("healthy"))
+        self._commit_noop(store, "healthy")
+        with pytest.raises(RuntimeError):
+            with store.txn("sick"):
+                raise RuntimeError("gate exhausted, nothing committed")
+        store.create(_state("h3"))    # must evict the SICK handle
+        assert store.ids() == ["h3", "healthy"]
+
+    def test_concurrent_distinct_creates_never_overshoot_budget(self):
+        """Review hardening (admission atomic with install): racing
+        creates of DISTINCT ids can both pass the eviction pass, but
+        the install-time re-check under the store lock means live
+        bytes never exceed the ceiling — the loser re-evicts or
+        refuses typed, it never silently overshoots."""
+        per = resident_handle_bytes(64, jnp.float32)
+        store = HandleStore(budget=CapacityBudget(max_bytes=2 * per))
+        store.create(_state("seed"))
+        peak = []
+        refused = []
+
+        def creator(i):
+            try:
+                store.create(_state(f"d{i}"))
+            except CapacityExceededError:
+                refused.append(i)
+            with store._lock:
+                peak.append(store._live_bytes)
+
+        threads = [threading.Thread(target=creator, args=(i,))
+                   for i in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(30)
+        assert not any(th.is_alive() for th in threads)
+        assert max(peak) <= 2 * per
+        assert store.budget_snapshot()["live_bytes"] <= 2 * per
+
+    def test_same_id_recreate_credits_replaced_bytes(self):
+        """Review hardening: a same-id re-create REPLACES — its old
+        bytes are credited at admission, so a net-zero replacement
+        under a full budget neither refuses nor evicts an innocent
+        handle (and the ledger still reconciles: old bytes evicted by
+        the replace, new bytes created)."""
+        per = resident_handle_bytes(64, jnp.float32)
+        store = HandleStore(budget=CapacityBudget(max_bytes=2 * per))
+        store.create(_state("h1"))
+        store.create(_state("h2"))
+        store.create(_state("h1"))        # net-zero replacement
+        assert store.ids() == ["h1", "h2"]
+        snap = store.budget_snapshot()
+        assert snap["budget_evictions"] == 0
+        assert snap["refusals"] == 0
+        assert snap["live_bytes"] == 2 * per
+        # A single-handle budget replaces in place too.
+        tight = HandleStore(budget=CapacityBudget(max_bytes=per))
+        tight.create(_state("x"))
+        tight.create(_state("x"))
+        assert tight.ids() == ["x"]
+        assert tight.budget_snapshot()["refusals"] == 0
+
+    def test_all_pinned_admission_typed_refusal(self):
+        per = resident_handle_bytes(64, jnp.float32)
+        store = HandleStore(budget=CapacityBudget(max_bytes=2 * per))
+        store.create(_state("h1"))
+        store.create(_state("h2"))
+        store.pin("h1")
+        store.pin("h2")
+        with pytest.raises(CapacityExceededError):
+            store.create(_state("h3"))
+        assert store.ids() == ["h1", "h2"]      # nothing installed
+        assert store.budget_snapshot()["refusals"] == 1
+        store.unpin("h2")
+        store.create(_state("h3"))              # now h2 is evictable
+        assert store.ids() == ["h1", "h3"]
+
+    def test_eviction_events_recorded_with_cause(self):
+        from tpu_jordan.obs.recorder import RECORDER
+
+        per = resident_handle_bytes(64, jnp.float32)
+        store = HandleStore(budget=CapacityBudget(max_bytes=per))
+        mark = RECORDER.total
+        store.create(_state("h1"))
+        store.create(_state("h2"))              # budget-evicts h1
+        store.evict("h2")                       # caller lifecycle
+        evs = [e for e in RECORDER.since(mark)
+               if e["kind"] == "capacity_eviction"]
+        assert [(e["handle_id"], e["cause"]) for e in evs] == [
+            ("h1", "budget"), ("h2", "caller")]
+        assert evs[0]["budget_bytes"] == per
+        assert evs[0]["nbytes"] == per
+
+    def test_budget_evict_waits_out_inflight_update_txn(self):
+        """ISSUE 13 satellite: the budget evictor inherits the PR 11
+        STATE→STORE discipline — an admission that must evict a handle
+        mid-txn WAITS for the commit and re-checks identity, so a
+        committed update is never orphaned by the *budget* either."""
+        per = resident_handle_bytes(64, jnp.float32)
+        store = HandleStore(budget=CapacityBudget(max_bytes=per))
+        store.create(_state("x"))
+        entered = threading.Event()
+        release = threading.Event()
+        versions = []
+
+        def updater():
+            with store.txn("x") as live:
+                entered.set()
+                release.wait(10)
+                store.commit(live, a=np.eye(4), inverse=np.eye(4),
+                             kappa=1.0, rel_residual=0.0, drift=0.0)
+                versions.append(live.version)
+
+        t = threading.Thread(target=updater)
+        t.start()
+        assert entered.wait(10)
+        admitted = []
+        admitter = threading.Thread(
+            target=lambda: admitted.extend(store.ensure_capacity(per)))
+        admitter.start()
+        time.sleep(0.05)
+        assert admitter.is_alive()    # blocked on the txn, not racing
+        release.set()
+        t.join(10)
+        admitter.join(10)
+        assert versions == [1]        # the commit landed first ...
+        assert admitted == ["x"]      # ... THEN the budget evicted it
+        with pytest.raises(UnknownHandleError):
+            store.get("x")
+
+    def test_seeded_concurrent_updates_vs_budget_evictions(self):
+        """Seeded stress: update txns racing budget admissions never
+        deadlock, never orphan a commit — every commit that succeeded
+        happened on the then-live state, every loser is the typed
+        UnknownHandleError."""
+        rng = np.random.default_rng(7)
+        per = resident_handle_bytes(64, jnp.float32)
+        store = HandleStore(budget=CapacityBudget(max_bytes=2 * per))
+        store.create(_state("a"))
+        store.create(_state("b"))
+        outcomes = {"committed": 0, "typed": 0}
+        lock = threading.Lock()
+        order = rng.permutation(24)
+
+        def worker(i):
+            hid = "a" if order[i] % 2 else "b"
+            try:
+                with store.txn(hid) as st:
+                    store.commit(st, a=st.a, inverse=st.inverse,
+                                 kappa=1.0, rel_residual=0.0,
+                                 drift=0.0)
+                with lock:
+                    outcomes["committed"] += 1
+            except UnknownHandleError:
+                with lock:
+                    outcomes["typed"] += 1
+
+        def evictor(i):
+            try:
+                store.ensure_capacity(per)
+                store.create(_state("a" if order[i] % 2 else "b"))
+            except CapacityExceededError:
+                pass
+
+        threads = ([threading.Thread(target=worker, args=(i,))
+                    for i in range(16)]
+                   + [threading.Thread(target=evictor, args=(i,))
+                      for i in range(8)])
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(30)
+        assert not any(th.is_alive() for th in threads)
+        assert outcomes["committed"] + outcomes["typed"] == 16
+        snap = store.budget_snapshot()
+        assert snap["live_bytes"] <= 2 * per
+
+
+class TestServeAdmission:
+    @pytest.fixture(scope="class")
+    def warm_service(self):
+        """One warmed budgeted service per class (the compiles are the
+        expensive part); each test OWNS its handles — created under its
+        own ids and evicted on the way out — so every test passes in
+        isolation and in any order (review hardening)."""
+        from tpu_jordan.serve.service import JordanService
+
+        per = resident_handle_bytes(64, jnp.float32)
+        svc = JordanService(engine="auto", batch_cap=1, max_wait_ms=0.5,
+                            handle_budget_bytes=2 * per)
+        svc.warmup(update_shapes=[(48, 8)])
+        yield svc, per
+        svc.close()
+
+    @pytest.fixture
+    def budgeted_service(self, warm_service):
+        svc, per = warm_service
+        yield svc, per
+        for hid in svc.handles.ids():     # leave the store empty
+            svc.handles.unpin(hid)
+            svc.handles.evict(hid)
+
+    @pytest.mark.smoke    # the capacity round-trip (ISSUE 13 smoke)
+    def test_budgeted_resident_round_trip_warm_pins(self,
+                                                    budgeted_service,
+                                                    rng):
+        """The smoke-tier capacity round trip: with metering and a
+        budget ON, a resident create + update + budget eviction +
+        typed refusal runs with ZERO compiles and ZERO plan-cache
+        measurements after warmup — the observatory costs the warm
+        path nothing."""
+        from tpu_jordan.obs.metrics import REGISTRY
+
+        svc, per = budgeted_service
+        compiles = REGISTRY.counter("tpu_jordan_compiles_total")
+        meas = REGISTRY.counter("tpu_jordan_tuner_measurements_total")
+        c0, m0 = compiles.total(), meas.total()
+        a1 = rng.standard_normal((48, 48)).astype(np.float32)
+        a2 = rng.standard_normal((48, 48)).astype(np.float32)
+        a3 = rng.standard_normal((48, 48)).astype(np.float32)
+        r1 = svc.invert(a1, resident=True, handle_id="c1", timeout=600)
+        svc.invert(a2, resident=True, handle_id="c2", timeout=600)
+        u = rng.standard_normal((48, 4)).astype(np.float32) * 0.01
+        v = rng.standard_normal((48, 4)).astype(np.float32) * 0.01
+        res = svc.update(r1, u, v, timeout=600)
+        assert res.update_outcome == "refreshed"
+        # Budget full: the third create evicts the LRU (c2 — c1 was
+        # just served).
+        svc.invert(a3, resident=True, handle_id="c3", timeout=600)
+        assert svc.handles.ids() == ["c1", "c3"]
+        svc.handles.pin("c1")
+        svc.handles.pin("c3")
+        with pytest.raises(CapacityExceededError):
+            svc.invert(a2, resident=True, handle_id="c4", timeout=600)
+        svc.handles.unpin("c1")
+        svc.handles.unpin("c3")
+        assert compiles.total() - c0 == 0
+        assert meas.total() - m0 == 0
+        snap = svc.stats()
+        assert snap["handle_budget"]["max_bytes"] == 2 * per
+        assert snap["handle_budget"]["budget_evictions"] >= 1
+        assert snap["handle_budget"]["refusals"] >= 1
+
+    def test_refused_invert_never_submitted(self, budgeted_service,
+                                            rng):
+        """The typed refusal happens AT SUBMIT: the invert never enters
+        the queue, the request counter does not move, and the journey
+        closes with the typed error (no gap)."""
+        from tpu_jordan.obs.metrics import REGISTRY
+
+        svc, per = budgeted_service
+        for hid in ("r1", "r2"):
+            a = rng.standard_normal((48, 48)).astype(np.float32)
+            svc.invert(a, resident=True, handle_id=hid, timeout=600)
+            svc.handles.pin(hid)
+        req = REGISTRY.counter("tpu_jordan_serve_requests_total")
+        r0 = req.total()
+        a = rng.standard_normal((48, 48)).astype(np.float32)
+        with pytest.raises(CapacityExceededError):
+            svc.invert(a, resident=True, handle_id="r3", timeout=600)
+        assert req.total() == r0
+        ctx = svc.journey.contexts()[-1]
+        assert ctx.outcome() == ("error", "CapacityExceededError")
+
+    def test_budget_eviction_emits_journey_hop(self, budgeted_service,
+                                               rng):
+        """An admission-forced eviction is attributable to the request
+        that forced it: a capacity_evict hop on ITS journey, mirrored
+        into the flight recorder."""
+        from tpu_jordan.obs.recorder import RECORDER
+
+        svc, per = budgeted_service
+        for hid in ("j1", "j2"):          # fill the 2-handle budget
+            a = rng.standard_normal((48, 48)).astype(np.float32)
+            svc.invert(a, resident=True, handle_id=hid, timeout=600)
+        mark = RECORDER.total
+        a = rng.standard_normal((48, 48)).astype(np.float32)
+        svc.invert(a, resident=True, handle_id="j3", timeout=600)
+        hops = [e for e in RECORDER.since(mark)
+                if e["kind"] == "journey"
+                and e.get("event") == "capacity_evict"]
+        assert len(hops) == 1
+        assert hops[0]["cause"] == "budget"
+        assert hops[0]["handle"] == "j1"
+        evs = [e for e in RECORDER.since(mark)
+               if e["kind"] == "capacity_eviction"]
+        assert len(evs) == 1 and evs[0]["cause"] == "budget"
+
+    def test_project_capacity_before_any_compile(self):
+        """Lane bytes are projectable WITHOUT compiling: a fresh
+        service projects its whole update warmup set with the compile
+        counter untouched, and the projection gauge carries each
+        lane."""
+        from tpu_jordan.obs.metrics import REGISTRY
+        from tpu_jordan.serve.service import JordanService
+
+        compiles = REGISTRY.counter("tpu_jordan_compiles_total")
+        c0 = compiles.total()
+        with JordanService(engine="auto", batch_cap=4,
+                           max_wait_ms=0.5, autostart=False) as svc:
+            proj = svc.project_capacity(update_shapes=[(48, 8)])
+        assert compiles.total() == c0
+        assert set(proj) == {"invert:64:b4", "invert:64:b1",
+                             "update:64:b1:k8"}
+        assert all(v > 0 for v in proj.values())
+        g = REGISTRY.gauge("tpu_jordan_capacity_projected_lane_bytes")
+        assert g.value(lane="update:64:b1:k8") == proj["update:64:b1:k8"]
+
+    def test_executor_lane_metered_at_compile(self, rng):
+        """A compiled lane lands in the executor_lanes ledger with its
+        memory_analysis footprint (this CPU backend reports it) — and
+        the projection is its arg/out floor."""
+        from tpu_jordan.obs import capacity as cap
+        from tpu_jordan.serve.executors import projected_lane_bytes
+        from tpu_jordan.serve.service import JordanService
+
+        before = cap.live_bytes("executor_lanes")
+        with JordanService(engine="auto", batch_cap=2,
+                           max_wait_ms=0.5, autostart=False) as svc:
+            svc.warmup(shapes=[48])
+            ex = svc.executors.get(64, 2, svc._batcher.block_size)
+        grown = cap.live_bytes("executor_lanes") - before
+        assert grown > 0
+        if ex.cost.available and ex.cost.hbm_bytes is not None:
+            assert grown >= ex.cost.hbm_bytes > 0
+            assert (projected_lane_bytes(64, 2, "float32")
+                    <= ex.cost.hbm_bytes)
+        comps = cap.snapshot()["components"]["executor_lanes"]
+        assert comps["bytes_created"] == (comps["bytes_live"]
+                                          + comps["bytes_evicted"])
+
+    def test_shared_store_plus_budget_param_typed(self):
+        from tpu_jordan.driver import UsageError
+        from tpu_jordan.serve.service import JordanService
+
+        with pytest.raises(UsageError):
+            JordanService(shared_handles=HandleStore(),
+                          handle_budget_bytes=1024, autostart=False)
+
+
+class TestFleetCapacity:
+    def test_fleet_rollup_and_budgeted_store(self, rng):
+        """The fleet-level rollup (ISSUE 13): stats()['capacity']
+        carries every byte class with the reconciliation invariant,
+        and handle_budget_bytes attaches ONE fleet-wide budget."""
+        from tpu_jordan.fleet import JordanFleet
+
+        from tpu_jordan.obs.recorder import RECORDER
+
+        per = resident_handle_bytes(64, jnp.float32)
+        with JordanFleet(replicas=2, batch_cap=1, max_wait_ms=0.5,
+                         handle_budget_bytes=2 * per,
+                         autostart_supervisor=False) as fleet:
+            fleet.warmup([16])
+            for hid in ("f0", "f1"):
+                a = rng.standard_normal((16, 16)).astype(np.float32)
+                fleet.invert(a, resident=True, handle_id=hid,
+                             timeout=600)
+            # The budget is full: the next fleet resident invert
+            # evicts the LRU handle WITH a capacity_evict hop on the
+            # admitting request's own fleet journey (review
+            # hardening: fleet evictions are attributable too).
+            mark = RECORDER.total
+            a = rng.standard_normal((16, 16)).astype(np.float32)
+            fleet.invert(a, resident=True, handle_id="f2", timeout=600)
+            hops = [e for e in RECORDER.since(mark)
+                    if e["kind"] == "journey"
+                    and e.get("event") == "capacity_evict"]
+            assert len(hops) == 1 and hops[0]["handle"] == "f0"
+            assert hops[0]["request_id"].startswith("fleet")
+            stats = fleet.stats()
+        cap = stats["capacity"]["components"]
+        for name in ("handles", "executor_lanes", "flight_recorder",
+                     "device"):
+            assert name in cap
+        for doc in cap.values():
+            if doc["kind"] == "metered":
+                assert doc["bytes_created"] == (doc["bytes_live"]
+                                                + doc["bytes_evicted"])
+        assert stats["handle_budget"]["max_bytes"] == 2 * per
+        assert stats["handles"]["f1"]["nbytes"] == per
+
+    def test_fleet_store_and_budget_param_typed(self):
+        from tpu_jordan.driver import UsageError
+        from tpu_jordan.fleet import JordanFleet
+
+        with pytest.raises(UsageError):
+            JordanFleet(replicas=2, handle_store=HandleStore(),
+                        handle_budget_bytes=1024,
+                        autostart_supervisor=False)
+
+
+class TestDemoAndChecker:
+    @pytest.fixture(scope="class")
+    def demo_report(self):
+        return capacity_demo(n=48, budget_handles=2)
+
+    def test_demo_report_valid(self, demo_report):
+        errs, silent = check_capacity.check(demo_report)
+        assert errs == [] and silent == [], (errs, silent)
+        assert demo_report["budget_evictions"] == 1
+        assert demo_report["typed_overflow"]["raised"]
+        assert demo_report["compiles_on_capacity_path"] == 0
+
+    def test_doctored_reports_exit_2(self, demo_report, tmp_path):
+        """Both-ways gate: doctored unmetered residency, a stripped
+        eviction event, and a silent stale serve each exit 2; a
+        missing typed overflow is a bound violation (exit 1)."""
+        import copy
+        import json
+
+        def rc(rep, name):
+            p = tmp_path / name
+            p.write_text(json.dumps(rep))
+            return check_capacity.main([str(p)])
+
+        assert rc(demo_report, "ok.json") == 0
+        # Unmetered residency: live bytes nothing created.
+        d1 = copy.deepcopy(demo_report)
+        d1["ledger"]["components"]["handles"]["bytes_live"] += 4096
+        assert rc(d1, "unmetered.json") == 2
+        # A budget eviction with no recorded event.
+        d2 = copy.deepcopy(demo_report)
+        d2["evictions"] = []
+        assert rc(d2, "silent_evict.json") == 2
+        # An eviction event missing its budget context.
+        d3 = copy.deepcopy(demo_report)
+        del d3["evictions"][0]["budget_bytes"]
+        assert rc(d3, "unexplained.json") == 2
+        # A whole byte class vanishing from the ledger.
+        d4 = copy.deepcopy(demo_report)
+        del d4["ledger"]["components"]["executor_lanes"]
+        assert rc(d4, "no_lanes.json") == 2
+        # Update-after-evict not typed = a silently stale serve.
+        d5 = copy.deepcopy(demo_report)
+        d5["update_after_evict_typed"] = None
+        assert rc(d5, "stale_serve.json") == 2
+        # Typed overflow missing: a bound violation, not silence.
+        d6 = copy.deepcopy(demo_report)
+        d6["typed_overflow"] = {"raised": False, "error": None,
+                                "refusals": 0}
+        assert rc(d6, "overflow.json") == 1
+        # A compile on the warm capacity path: bound violation.
+        d7 = copy.deepcopy(demo_report)
+        d7["compiles_on_capacity_path"] = 1
+        assert rc(d7, "compile.json") == 1
+
+    def test_cli_flag_contract_exit_1(self):
+        from tpu_jordan.__main__ import main
+
+        assert main(["96", "32", "--capacity-demo", "--fleet-demo",
+                     "--quiet"]) == 1
+        assert main(["96", "32", "--capacity-demo", "--workers", "8",
+                     "--quiet"]) == 1
+        assert main(["96", "32", "--capacity-demo", "--workload",
+                     "solve", "--quiet"]) == 1
+        assert main(["96", "32", "--capacity-demo", "--numerics",
+                     "summary", "--quiet"]) == 1
+        assert main(["96", "32", "--capacity-demo", "--batch-cap", "4",
+                     "--quiet"]) == 1
+        assert main(["96", "32", "--capacity-demo", "--replicas", "2",
+                     "--quiet"]) == 1
+        assert main(["96", "32", "--capacity-demo", "--plan-cache",
+                     "/tmp/p.json", "--quiet"]) == 1
+        assert main(["96", "32", "--capacity-demo", "--slo-report",
+                     "--quiet"]) == 1
+
+    def test_capacity_report_flag_writes_snapshot(self, tmp_path):
+        import json
+
+        from tpu_jordan.__main__ import main
+
+        out = tmp_path / "cap.json"
+        assert main(["16", "8", "--quiet",
+                     "--capacity-report", str(out)]) == 0
+        doc = json.loads(out.read_text())
+        assert "components" in doc
+        assert doc["components"]["device"]["available"] is False

@@ -77,20 +77,34 @@ def load_round(path: str) -> dict | None:
     return None
 
 
+#: Accounting-class key suffixes (ISSUE 10/13): numbers that describe
+#: WHAT the compiler or the capacity ledger counted, not how fast the
+#: same execution ran — ``*_xla_gflops`` (compiler flop recounts) and
+#: the ``*_bytes`` capacity fields (``peak_hbm_bytes`` /
+#: ``resident_handle_bytes``: a jaxlib layout change, or a dtype/bucket
+#: change, re-prices the same execution).  Never compared across
+#: rounds — the first-call separation principle applied to accounting.
+ACCOUNTING_SUFFIXES = ("_xla_gflops", "_bytes")
+
+
+def is_accounting_key(key: str) -> bool:
+    return key.endswith(ACCOUNTING_SUFFIXES)
+
+
 def comparable_keys(row: dict) -> dict[str, float]:
     """The steady-state rate keys of one round: the headline ``value``
     (under its metric name) plus every numeric ``*_gflops`` extra.
     First-call keys never appear here by construction, and neither do
-    the ``*_xla_gflops`` accounting rows: their numerator is the
-    COMPILER's flop count, so a jaxlib upgrade that fuses better
-    recounts the same execution — a compiler-accounting change must
-    not page as an execution regression (the same separation principle
-    that keeps first-call times out)."""
+    the accounting-class rows (:func:`is_accounting_key`): the
+    ``*_xla_gflops`` recounts and the ``*_bytes`` capacity fields
+    describe the same execution differently priced — a compiler or
+    accounting change must not page as an execution regression (the
+    same separation principle that keeps first-call times out)."""
     out = {}
     if isinstance(row.get("value"), (int, float)):
         out[str(row.get("metric", "value"))] = float(row["value"])
     for k, v in (row.get("extra") or {}).items():
-        if (k.endswith("_gflops") and not k.endswith("_xla_gflops")
+        if (k.endswith("_gflops") and not is_accounting_key(k)
                 and isinstance(v, (int, float))):
             out[k] = float(v)
     return out

@@ -1,0 +1,184 @@
+#!/usr/bin/env python
+"""Validate a ``--capacity-demo`` report (ISSUE 13 CI satellite) — the
+capacity observatory's reconciliation gate, the memory analogue of
+``check_update.py``.
+
+Usage: ``python tools/check_capacity.py report.json [...]`` (or ``-``
+for stdin).  No jax import — this is the ``make capacity-demo`` gate
+and runs anywhere.  Exit codes: 0 = valid, 1 = bound/structure
+violations, 2 = UNMETERED RESIDENCY or a SILENT EVICTION (the alarm
+that must never be downgraded): a metered byte class whose ledger does
+not reconcile (``bytes_created != bytes_live + bytes_evicted`` —
+resident bytes nothing accounts for), or a budget eviction with no
+recorded ``capacity_eviction`` budget event (residency that vanished
+without evidence).
+
+What a valid capacity report must prove (docs/OBSERVABILITY.md):
+
+  * **every metered class reconciles** — for each ``kind == metered``
+    component in the ledger, bytes_created == bytes_live +
+    bytes_evicted (the exit-2 class: unmetered residency);
+  * **every budget eviction is explained** — the demo's budget-eviction
+    count equals the ``capacity_eviction`` events with
+    ``cause == budget`` in the embedded black-box slice, each carrying
+    ``handle_id``/``nbytes``/``budget_bytes``, and each paired with a
+    ``capacity_evict`` journey hop on the admitting request (exit 2:
+    a silent evict-without-event);
+  * **admission is typed** — the all-pinned over-budget resident invert
+    raised ``CapacityExceededError`` (counted), and an update against
+    the evicted handle was the typed ``UnknownHandleError`` — never a
+    silently stale serve;
+  * **the warm path is free with metering on** — ZERO compiles and
+    ZERO plan-cache measurements on the whole capacity path after
+    warmup (the PR 3/7 pins hold with the observatory on by default);
+  * **lanes were projected before they were paid for** — a non-empty
+    ``projected_lanes`` block with positive byte projections.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def check(report: dict) -> tuple[list[str], list[str]]:
+    """Return (violations, unmetered_violations); both empty = valid."""
+    errs: list[str] = []
+    silent: list[str] = []
+    if report.get("metric") != "capacity_demo":
+        return ([f"not a capacity_demo report (metric="
+                 f"{report.get('metric')!r})"], [])
+
+    # ---- ledger reconciliation (the exit-2 class) -------------------
+    components = (report.get("ledger") or {}).get("components") or {}
+    if not components:
+        silent.append("report carries no capacity ledger — nothing "
+                      "accounts for resident bytes")
+    metered = 0
+    for name, doc in sorted(components.items()):
+        if doc.get("kind") != "metered":
+            continue
+        metered += 1
+        created = int(doc.get("bytes_created", -1))
+        live = int(doc.get("bytes_live", 0))
+        evicted = int(doc.get("bytes_evicted", 0))
+        if created != live + evicted:
+            silent.append(
+                f"component {name!r} does not reconcile: "
+                f"bytes_created {created} != bytes_live {live} + "
+                f"bytes_evicted {evicted} — unmetered residency")
+    for name in ("handles", "executor_lanes"):
+        if name not in components:
+            silent.append(f"byte class {name!r} missing from the "
+                          f"ledger — its residency is unmetered")
+    if report.get("unmetered_components"):
+        silent.append(f"demo itself flagged unmetered components: "
+                      f"{report['unmetered_components']}")
+
+    # ---- every budget eviction explained (the exit-2 class) ---------
+    budget_evictions = int(report.get("budget_evictions", 0))
+    events = report.get("evictions") or []
+    budget_events = [e for e in events if e.get("cause") == "budget"]
+    if budget_evictions < 1:
+        errs.append("no budget eviction happened — the actuation leg "
+                    "was vacuous")
+    if budget_evictions != len(budget_events):
+        silent.append(
+            f"{budget_evictions} budget eviction(s) but "
+            f"{len(budget_events)} recorded capacity_eviction budget "
+            f"event(s) — an eviction without evidence is a silent "
+            f"evict")
+    for e in budget_events:
+        missing = [k for k in ("handle_id", "nbytes", "budget_bytes")
+                   if k not in e]
+        if missing:
+            silent.append(f"budget eviction event {e} lacks {missing} "
+                          f"— unexplained")
+    hops = int(report.get("journey_evict_hops", 0))
+    if hops < len(budget_events):
+        silent.append(
+            f"{len(budget_events)} budget eviction(s) but only {hops} "
+            f"capacity_evict journey hop(s) — an eviction not "
+            f"attributable to the request that forced it")
+
+    # ---- typed admission control ------------------------------------
+    overflow = report.get("typed_overflow") or {}
+    if not overflow.get("raised"):
+        errs.append(f"the all-pinned over-budget resident invert did "
+                    f"not raise CapacityExceededError "
+                    f"(got {overflow.get('error')!r})")
+    if overflow.get("refusals", 0) < 1:
+        errs.append("no admission refusal counted "
+                    "(tpu_jordan_capacity_exceeded_total)")
+    if report.get("update_after_evict_typed") != "UnknownHandleError":
+        silent.append(
+            f"an update against the evicted handle was "
+            f"{report.get('update_after_evict_typed')!r}, not the "
+            f"typed UnknownHandleError — a silently stale serve")
+
+    # ---- warm pins with metering on ---------------------------------
+    if report.get("compiles_on_capacity_path", 1) != 0:
+        errs.append(f"{report.get('compiles_on_capacity_path')} "
+                    f"compile(s) on the warm capacity path — the "
+                    f"zero-compile pin broke with metering on")
+    if report.get("measurements", 1) != 0:
+        errs.append(f"{report.get('measurements')} plan-cache "
+                    f"measurement(s) on the capacity path")
+
+    # ---- projections before compiles --------------------------------
+    projected = report.get("projected_lanes") or {}
+    if not projected or any(int(v) <= 0 for v in projected.values()):
+        errs.append(f"lane byte projections missing or non-positive "
+                    f"({projected}) — operators cannot see what a "
+                    f"bucket costs to open")
+
+    if report.get("silent_capacity", True):
+        silent.append("silent_capacity flagged by the demo itself")
+    return errs, silent
+
+
+def main(argv) -> int:
+    if not argv:
+        print("usage: check_capacity.py report.json [...]",
+              file=sys.stderr)
+        return 1
+    rc = 0
+    for path in argv:
+        try:
+            if path == "-":
+                report = json.load(sys.stdin)
+            else:
+                with open(path) as f:
+                    report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"FAIL {path}: unreadable report ({e})",
+                  file=sys.stderr)
+            rc = max(rc, 1)
+            continue
+        errs, silent = check(report)
+        for e in silent:
+            print(f"UNMETERED {path}: {e}", file=sys.stderr)
+        for e in errs:
+            print(f"FAIL {path}: {e}", file=sys.stderr)
+        if silent:
+            rc = 2
+        elif errs:
+            rc = max(rc, 1)
+        else:
+            comps = report["ledger"]["components"]
+            handles = comps.get("handles", {})
+            lanes = comps.get("executor_lanes", {})
+            print(f"OK {path}: handles "
+                  f"{handles.get('bytes_live')}/"
+                  f"{handles.get('bytes_created')} bytes live/created "
+                  f"(high water {handles.get('high_water_bytes')}), "
+                  f"lanes {lanes.get('bytes_live')} bytes over "
+                  f"{lanes.get('entries')} executable(s), "
+                  f"{report['budget_evictions']} budget eviction(s) "
+                  f"all event-explained, typed overflow raised, "
+                  f"0 compiles on the warm path")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -49,6 +49,22 @@ def _write_telemetry(metrics_out, trace_json, telemetry) -> None:
         print(f"warning: telemetry export failed: {e}", file=sys.stderr)
 
 
+def _write_capacity(path) -> None:
+    """``--capacity-report`` emission — the process-wide capacity
+    snapshot (obs/capacity.py), written on every exit path with the
+    same never-mask-the-exit-code discipline as ``_write_telemetry``.
+    The snapshot re-probes the device live-bytes watermark (ISSUE 13
+    satellite) on backends that report it."""
+    if not path:
+        return
+    try:
+        from .obs.capacity import write_report
+
+        write_report(path)
+    except OSError as e:
+        print(f"warning: capacity report failed: {e}", file=sys.stderr)
+
+
 def _write_blackbox(path) -> None:
     """Dump the always-on flight recorder (ISSUE 8): on demand via
     ``--blackbox-out``, and AUTOMATICALLY on every exit-2 path — the
@@ -295,6 +311,33 @@ def _main(argv, state) -> int:
                          "of the mutated matrix; prints ONE JSON line "
                          "(exit 2 = a silently stale inverse; "
                          "tools/check_update.py validates)")
+    ap.add_argument("--capacity-demo", action="store_true",
+                    help="run the capacity-observatory acceptance demo "
+                         "(tpu_jordan.obs.capacity.capacity_demo; "
+                         "ISSUE 13, docs/OBSERVABILITY.md): a warmed "
+                         "service under a resident-handle byte budget "
+                         "— lane bytes projected BEFORE compiling, "
+                         "resident creates fill the budget, the next "
+                         "create evicts the least-recently-served "
+                         "handle (journey hop + capacity_eviction "
+                         "event), an all-pinned admission is the typed "
+                         "CapacityExceededError at submit, and the "
+                         "ledger reconciles bytes_created == "
+                         "bytes_live + bytes_evicted per class; prints "
+                         "ONE JSON line (exit 2 = unmetered residency "
+                         "or a silent eviction; "
+                         "tools/check_capacity.py validates).  n is "
+                         "the handle size, m the block size; "
+                         "--chaos-seed seeds the fixtures")
+    ap.add_argument("--capacity-report", default=None, metavar="PATH",
+                    help="write the process-wide capacity snapshot "
+                         "(tpu_jordan_capacity_*: resident handles, "
+                         "compiled executor lanes, plan cache, "
+                         "flight-recorder ring, device live-bytes "
+                         "watermark — with high-water marks and the "
+                         "per-class created == live + evicted "
+                         "reconciliation) as one JSON document on "
+                         "exit (docs/OBSERVABILITY.md)")
     ap.add_argument("--rank", type=int, default=32, metavar="K",
                     help="--update-demo: rank of each mutation "
                          "(default 32; the FLOP/latency wins need "
@@ -450,6 +493,75 @@ def _main(argv, state) -> int:
             raise UsageError("--generator crand is complex-valued; a "
                              "real --dtype would silently discard the "
                              "imaginary part (use --dtype complex64)")
+        if args.capacity_demo:
+            # Capacity demo (ISSUE 13): the numerics-demo restriction
+            # shape (single device, deterministic seeded fixtures,
+            # gathered) and the same 0/1/2 taxonomy — exit 2 IS the
+            # unmetered-residency alarm (a byte class whose ledger
+            # does not reconcile, or a budget eviction with no
+            # recorded budget event).
+            if (args.serve_demo or args.chaos_demo or args.fleet_demo
+                    or args.numerics_demo or args.update_demo):
+                raise UsageError("--capacity-demo, --update-demo, "
+                                 "--fleet-demo, --chaos-demo, "
+                                 "--serve-demo and --numerics-demo are "
+                                 "distinct modes; pick one")
+            if args.file is not None or args.workers != 1 or not args.gather:
+                raise UsageError(
+                    "--capacity-demo runs on a single device (gathered "
+                    "output, deterministic seeded fixtures)")
+            if args.batch > 1 or args.tune or args.group != 0:
+                raise UsageError("--capacity-demo takes no "
+                                 "--batch/--tune/--group")
+            if args.workload != "invert":
+                raise UsageError("--capacity-demo streams resident-"
+                                 "invert + update requests; --workload "
+                                 "does not apply")
+            if args.numerics != "off":
+                raise UsageError("--capacity-demo's ledger semantics "
+                                 "are pinned; --numerics does not "
+                                 "apply")
+            if args.slo_report:
+                raise UsageError("--slo-report is a --fleet-demo leg "
+                                 "(the burn-rate monitor evaluates the "
+                                 "fleet's request-outcome series)")
+            if args.plan_cache is not None:
+                raise UsageError("--capacity-demo resolves its lanes "
+                                 "through the cost-only ladder; "
+                                 "--plan-cache does not apply")
+            if (args.serve_requests != 64 or args.batch_cap != 8
+                    or args.max_wait_ms != 2.0):
+                raise UsageError("--capacity-demo streams its own "
+                                 "fixed resident-invert/update mix "
+                                 "(cap-1 lanes); --serve-requests/"
+                                 "--batch-cap/--max-wait-ms do not "
+                                 "apply")
+            if (args.replicas != 3 or args.kills != 2
+                    or args.scaling_floor is not None):
+                raise UsageError("--replicas/--kills/--scaling-floor "
+                                 "are --fleet-demo/--update-demo "
+                                 "flags; --capacity-demo runs one "
+                                 "service under a handle budget")
+            import json as _json
+
+            from .obs.capacity import capacity_demo
+
+            report = capacity_demo(n=args.n, block_size=args.m,
+                                   seed=args.chaos_seed,
+                                   dtype=jnp.dtype(args.dtype))
+            if args.quiet:
+                # The checker needs the ledger and the blackbox slice;
+                # the per-handle numerics snapshot is operator color.
+                report.pop("handles", None)
+            print(_json.dumps(report))
+            if report["silent_capacity"]:
+                print(f"silent capacity violation: unmetered="
+                      f"{report['unmetered_components']}, "
+                      f"budget_evictions={report['budget_evictions']} "
+                      f"vs {len(report['evictions'])} recorded "
+                      f"events", file=sys.stderr)
+                return 2
+            return 0
         if args.update_demo:
             # Update demo (ISSUE 12): the fleet-demo restriction shape
             # (single device, deterministic seeded fixtures, gathered)
@@ -851,6 +963,7 @@ def _main(argv, state) -> int:
         return 1
     finally:
         _write_telemetry(args.metrics_out, args.trace_json, telemetry)
+        _write_capacity(args.capacity_report)
     if args.quiet:
         print(f"glob_time: {result.elapsed:.2f}")
         print(f"residual: {result.residual:e}")

@@ -37,6 +37,7 @@ from dataclasses import dataclass, field
 
 import jax.numpy as jnp
 
+from ..obs import capacity as _obs_capacity
 from ..obs import metrics as _obs_metrics
 from ..obs.journey import JourneyLog
 from ..resilience.policy import DEFAULT_POLICY, CircuitBreaker
@@ -107,6 +108,7 @@ class JordanFleet:
                  telemetry=None,
                  executor_store: ExecutorStore | None = None,
                  handle_store: HandleStore | None = None,
+                 handle_budget_bytes: int | None = None,
                  update_drift_budget_factor: float | None = None,
                  heartbeat_interval_s: float = 0.05,
                  liveness_deadline_s: float = 1.0,
@@ -127,8 +129,16 @@ class JordanFleet:
         # ONE instance shared by every replica — and every warm
         # replacement — so a replica_kill never loses resident state
         # and updates write through fleet-wide (docs/FLEET.md).
-        self.handles = (handle_store if handle_store is not None
-                        else HandleStore())
+        # ``handle_budget_bytes`` (ISSUE 13) attaches ONE fleet-wide
+        # resident-bytes budget to it — admission is a pool property,
+        # not a replica's (the store is the one shared-mutable thing);
+        # the shared-store-vs-budget wiring rule lives in
+        # ``serve.handles.build_handle_store``.
+        from ..serve.handles import build_handle_store
+
+        self.handles = build_handle_store(handle_store,
+                                          handle_budget_bytes,
+                                          "the fleet")
         self._handle_seq = 0
         self.policy = DEFAULT_POLICY if policy == "default" else policy
         if plan_cache is not None and plan_cache_read_only:
@@ -320,8 +330,42 @@ class JordanFleet:
         installs the result as a resident handle in the FLEET-SHARED
         handle store and returns the :class:`~..serve.handles.HandleRef`
         — any replica (including every future warm replacement) can
-        serve ``update(ref, u, v)`` against it."""
-        res = self.submit(a, deadline_ms=deadline_ms).result(timeout)
+        serve ``update(ref, u, v)`` against it.  With a store budget
+        (ISSUE 13) the new handle's bytes are admitted BEFORE the
+        invert is routed: LRU unpinned handles evicted fleet-wide to
+        make room — each eviction a ``capacity_evict`` hop on THIS
+        request's fleet journey — or the typed
+        ``CapacityExceededError`` at submit, never an OOM mid-launch
+        on some replica."""
+        if resident:
+            import numpy as _np
+
+            from ..serve.executors import bucket_for
+            from ..serve.handles import resident_handle_bytes
+
+            n = _np.asarray(a).shape[0]
+            bucket = bucket_for(n)
+            # The journey is minted BEFORE admission so every budget
+            # eviction is attributable to the request that forced it
+            # (the service-path discipline); the router threads it
+            # through instead of minting a second id.
+            ctx = self.journey.new(n, bucket)
+            try:
+                self.handles.ensure_capacity(
+                    resident_handle_bytes(bucket,
+                                          self._svc_kw["dtype"]),
+                    hop=ctx.event, replacing=handle_id)
+            except Exception as e:
+                ctx.close("error", error=type(e).__name__)
+                raise
+            if deadline_ms is None:
+                deadline_ms = self._svc_kw["default_deadline_ms"]
+            res = self.router.submit(a, self._svc_kw["dtype"],
+                                     deadline_ms=deadline_ms,
+                                     _ctx=ctx).result(timeout)
+        else:
+            res = self.submit(a,
+                              deadline_ms=deadline_ms).result(timeout)
         if res.singular:
             from ..driver import SingularMatrixError
 
@@ -478,5 +522,13 @@ class JordanFleet:
                                    in self.warm_update_shapes()],
             "executors_compiled": len(self.store),
             "handles": self.handles.snapshot(),
+            "handle_budget": self.handles.budget_snapshot(),
+            # The fleet-level capacity rollup (ISSUE 13): every byte
+            # class the process holds — resident handles, compiled
+            # lanes, the plan cache, the flight-recorder ring, and the
+            # device watermark (re-probed here on backends that report
+            # it) — with high-water marks and the created == live +
+            # evicted reconciliation per metered class.
+            "capacity": _obs_capacity.snapshot(),
             "slots": per_slot,
         }

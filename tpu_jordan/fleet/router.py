@@ -127,7 +127,13 @@ class Router:
 
     # ---- caller side -------------------------------------------------
 
-    def submit(self, a, dtype, deadline_ms: float | None = None) -> Future:
+    def submit(self, a, dtype, deadline_ms: float | None = None,
+               _ctx=None) -> Future:
+        """``_ctx`` (internal, ISSUE 13): an existing fleet journey
+        context to thread through — ``JordanFleet.invert(resident=)``
+        mints it BEFORE budget admission so a ``capacity_evict`` hop
+        lands on the admitting request's own journey; None (every
+        other caller) mints here as before."""
         a = np.asarray(a, dtype)
         if a.ndim != 2 or a.shape[0] != a.shape[1]:
             raise ValueError(f"expected a square (n, n) matrix, "
@@ -146,7 +152,8 @@ class Router:
             t_deadline=(None if deadline_ms is None
                         else now + float(deadline_ms) / 1e3),
             t_submit=now,
-            ctx=self.pool.journey.new(n, bucket))
+            ctx=(_ctx if _ctx is not None
+                 else self.pool.journey.new(n, bucket)))
         self.pool._record_bucket(req.bucket)
         self.pool._account_submitted()
         try:

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import threading
 
+from ..obs import capacity as _capacity
 from ..obs import metrics as _obs_metrics
 from ..obs import recorder as _recorder
 from .replica import DEAD, READY
@@ -188,8 +189,16 @@ class Supervisor:
             pool._install(slot, replacement)
             _M_RESTARTS.inc(replica=str(slot.index))
             self._withheld_recorded.discard(slot.index)
-            _recorder.record("restart", slot=slot.index,
-                             replica=replacement.name, cause="wedged")
+            # Capacity context (ISSUE 13): the compiled-lane residency
+            # the replacement warmed against — on the shared store a
+            # warm restart adds ZERO new lane bytes, and this field is
+            # how a post-mortem sees that (or sees the growth a
+            # private-store restart paid).
+            _recorder.record(
+                "restart", slot=slot.index, replica=replacement.name,
+                cause="wedged",
+                executor_lane_bytes=_capacity.live_bytes(
+                    "executor_lanes"))
         victim.kill(reason="wedged")
 
     def _try_restart(self, slot) -> None:
@@ -225,4 +234,6 @@ class Supervisor:
         _M_RESTARTS.inc(replica=str(slot.index))
         self._withheld_recorded.discard(slot.index)
         _recorder.record("restart", slot=slot.index,
-                         replica=replica.name, cause="death")
+                         replica=replica.name, cause="death",
+                         executor_lane_bytes=_capacity.live_bytes(
+                             "executor_lanes"))

@@ -1,0 +1,527 @@
+"""Process-wide capacity ledger — fleet memory accounting (ISSUE 13
+tentpole).
+
+The paper's MPI program accounts for every byte it holds: each rank's
+block buffers are sized up front from the row-cyclic decomposition
+(main.cpp:95-123).  Our serving stack grew the opposite habit — every
+``invert(resident=True)`` handle pins 2n² HBM-class bytes with eviction
+left to the caller (ROADMAP item 2a), every AOT lane has an XLA
+``memory_analysis`` footprint recorded per executable but never rolled
+up, and the PR 9 device live-bytes watermark was probed exactly once.
+The observability triad (PRs 4, 8, 9) covers time, requests, and
+numerics; this module adds the missing axis: WHAT IS RESIDENT, per
+byte class, with the same ledger-plus-checker discipline
+(arXiv:2112.09017's explicit per-core footprint accounting,
+arXiv:2412.14374's placement-aware resource budgeting).
+
+Two kinds of byte class:
+
+  * **metered** — residency with explicit create/evict lifecycles
+    registers and releases through :data:`LEDGER`:
+    ``handles`` (2n²·dtype per resident :class:`~..serve.handles.
+    HandleState`, metered at create/evict/re-create), ``executor_lanes``
+    (arg/out/temp HBM from the ``hwcost.executable_cost`` read at
+    compile — or the arg+out projection where the backend exposes no
+    ``memory_analysis``, labeled ``source=projected``, never silently
+    modeled as the real thing), and ``plan_cache`` (the serialized plan
+    document).  The reconciliation invariant ``bytes_created ==
+    bytes_live + bytes_evicted`` holds PER CLASS by construction —
+    ``tools/check_capacity.py`` exits 2 when a report breaks it
+    (unmetered residency).
+  * **sampled** — residency that churns too fast to meter per event is
+    probed at snapshot time: the flight-recorder ring and the device
+    allocator's live/peak watermark (re-probed at EVERY capacity/metrics
+    snapshot on backends that report it — the ISSUE 13 satellite fixing
+    the PR 9 one-shot; a backend reporting no allocator stats stays
+    ``available=False`` forever, never zeroed, never modeled).
+
+Accounting becomes actuation through :class:`CapacityBudget`: attached
+to a :class:`~..serve.handles.HandleStore` it enforces a resident-bytes
+ceiling with a pluggable eviction policy (:func:`lru_policy` over
+``last_served``, pinned handles exempt).  Evictions emit journey hops
+and flight-recorder events; an admission the budget cannot make room
+for is the typed :class:`~..resilience.policy.CapacityExceededError`
+at submit — never an OOM mid-launch.
+
+Exported as ``tpu_jordan_capacity_*`` gauges/counters with per-component
+labels and high-water marks; ``JordanFleet.stats()`` carries the fleet
+rollup, CLI ``--capacity-report PATH`` writes :func:`snapshot`, and
+``make capacity-demo`` + ``tools/check_capacity.py`` are the demo gate.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass, field
+
+from . import metrics as _metrics
+
+_M_LIVE = _metrics.gauge(
+    "tpu_jordan_capacity_bytes",
+    "live resident bytes per capacity component (handles, "
+    "executor_lanes, plan_cache; sampled components export at probe "
+    "time)")
+_M_HIGH = _metrics.gauge(
+    "tpu_jordan_capacity_high_water_bytes",
+    "high-water mark of live resident bytes per capacity component")
+_M_CREATED = _metrics.counter(
+    "tpu_jordan_capacity_bytes_created_total",
+    "resident bytes registered per capacity component (the ledger's "
+    "create side; created == live + evicted is the reconciliation "
+    "invariant check_capacity validates)")
+_M_EVICTED = _metrics.counter(
+    "tpu_jordan_capacity_bytes_evicted_total",
+    "resident bytes released per capacity component (the ledger's "
+    "evict side)")
+_M_EVICTIONS = _metrics.counter(
+    "tpu_jordan_capacity_evictions_total",
+    "resident-handle evictions, labeled by cause (budget = the "
+    "CapacityBudget's LRU evictor made room; caller = an explicit "
+    "lifecycle evict)")
+_M_REFUSED = _metrics.counter(
+    "tpu_jordan_capacity_exceeded_total",
+    "typed CapacityExceededError admission refusals — an over-budget "
+    "resident invert the evictor could not make room for (everything "
+    "evictable pinned), refused at submit instead of OOMing mid-launch")
+_M_PROJECTED = _metrics.gauge(
+    "tpu_jordan_capacity_projected_lane_bytes",
+    "projected arg+out bytes of a serve lane's AOT signature, recorded "
+    "BEFORE compiling (warmup/project_capacity) so operators see what "
+    "a bucket costs to open; temps are compiler-known only and appear "
+    "in the executor_lanes ledger after compile")
+
+
+class _Component:
+    """One metered byte class: {key: (bytes, detail)} entries plus the
+    running created/evicted/high-water counters.  All mutation under
+    the owning ledger's lock."""
+
+    def __init__(self):
+        self.entries: dict[object, tuple[int, str | None]] = {}
+        self.live = 0
+        self.created = 0
+        self.evicted = 0
+        self.high_water = 0
+
+
+class CapacityLedger:
+    """The thread-safe process-wide capacity ledger.  ``register`` /
+    ``release`` meter explicit-lifecycle residency; ``register_probe``
+    attaches a sampled class (probed at :meth:`snapshot`).  Re-register
+    of a live key REPLACES it — the old bytes count as evicted, so the
+    reconciliation invariant survives re-creates (a re-inverted handle,
+    a re-saved plan cache)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._components: dict[str, _Component] = {}
+        self._probes: dict[str, object] = {}
+
+    # ---- metered classes --------------------------------------------
+
+    def register(self, component: str, key, nbytes: int,
+                 detail: str | None = None) -> None:
+        nbytes = int(nbytes)
+        if nbytes < 0:
+            raise ValueError("nbytes must be >= 0")
+        with self._lock:
+            comp = self._components.setdefault(component, _Component())
+            old = comp.entries.pop(key, None)
+            if old is not None:                 # replacement: old bytes
+                comp.live -= old[0]             # are evicted, not lost
+                comp.evicted += old[0]
+            comp.entries[key] = (nbytes, detail)
+            comp.live += nbytes
+            comp.created += nbytes
+            comp.high_water = max(comp.high_water, comp.live)
+            live, high = comp.live, comp.high_water
+            evicted_delta = old[0] if old is not None else 0
+        _M_CREATED.inc(nbytes, component=component)
+        if evicted_delta:
+            _M_EVICTED.inc(evicted_delta, component=component)
+        _M_LIVE.set(live, component=component)
+        _M_HIGH.set(high, component=component)
+
+    def release(self, component: str, key) -> int:
+        """Release one entry; returns its bytes (0 when unknown — a
+        double release is a no-op, never a negative ledger)."""
+        with self._lock:
+            comp = self._components.get(component)
+            if comp is None:
+                return 0
+            old = comp.entries.pop(key, None)
+            if old is None:
+                return 0
+            comp.live -= old[0]
+            comp.evicted += old[0]
+            live = comp.live
+        _M_EVICTED.inc(old[0], component=component)
+        _M_LIVE.set(live, component=component)
+        return old[0]
+
+    def live_bytes(self, component: str | None = None) -> int:
+        with self._lock:
+            if component is not None:
+                comp = self._components.get(component)
+                return comp.live if comp is not None else 0
+            return sum(c.live for c in self._components.values())
+
+    # ---- sampled classes --------------------------------------------
+
+    def register_probe(self, component: str, probe) -> None:
+        """Attach a sampled byte class: ``probe()`` returns a dict with
+        at least ``{"bytes": int}`` (plus any extras), or None when the
+        source reports nothing — reported ``available=False``, never
+        zeroed, never modeled."""
+        with self._lock:
+            self._probes[component] = probe
+
+    # ---- the snapshot ------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """The per-component capacity document: metered classes carry
+        the full created/live/evicted/high-water reconciliation plus a
+        per-detail breakdown; sampled classes are probed NOW (the
+        ISSUE 13 satellite: the device watermark re-probes at every
+        snapshot on backends that support it)."""
+        with self._lock:
+            metered = {
+                name: {
+                    "kind": "metered",
+                    "entries": len(c.entries),
+                    "bytes_live": c.live,
+                    "bytes_created": c.created,
+                    "bytes_evicted": c.evicted,
+                    "high_water_bytes": c.high_water,
+                    "breakdown": _breakdown(c.entries),
+                }
+                for name, c in sorted(self._components.items())
+            }
+            probes = dict(self._probes)
+        for name, probe in sorted(probes.items()):
+            try:
+                sampled = probe()
+            except Exception:                        # noqa: BLE001
+                sampled = None                       # telemetry never raises
+            doc = {"kind": "sampled",
+                   "available": sampled is not None}
+            if sampled is not None:
+                doc["bytes_live"] = int(sampled.get("bytes", 0))
+                doc.update({k: v for k, v in sampled.items()
+                            if k != "bytes"})
+                _M_LIVE.set(doc["bytes_live"], component=name)
+            metered[name] = doc
+        return {
+            "components": metered,
+            "metered_bytes_live": sum(
+                d["bytes_live"] for d in metered.values()
+                if d["kind"] == "metered"),
+        }
+
+    def reset(self) -> None:
+        """Drop every entry and probe (TESTS ONLY — production ledgers
+        are monotone for the process's life, like the registry)."""
+        with self._lock:
+            self._components.clear()
+            self._probes.clear()
+
+
+def _breakdown(entries: dict) -> dict:
+    out: dict[str, int] = {}
+    for nbytes, detail in entries.values():
+        label = detail if detail is not None else "unlabeled"
+        out[label] = out.get(label, 0) + nbytes
+    return dict(sorted(out.items()))
+
+
+# ---- the eviction budget (accounting -> actuation) ------------------
+
+
+def lru_policy(candidates):
+    """The default eviction order: least-recently-served first
+    (``HandleState.last_served``, stamped at create and on every
+    committed update txn)."""
+    return sorted(candidates, key=lambda st: st.last_served)
+
+
+@dataclass
+class CapacityBudget:
+    """A resident-bytes ceiling for a :class:`~..serve.handles.
+    HandleStore` (ISSUE 13): admission of a new resident handle evicts
+    least-recently-served unpinned handles until the new state fits;
+    when nothing evictable remains, admission is refused with the typed
+    :class:`~..resilience.policy.CapacityExceededError` — at submit,
+    never an OOM mid-launch.  ``policy`` is pluggable: any callable
+    mapping candidate states to an eviction order (default
+    :func:`lru_policy`)."""
+
+    max_bytes: int
+    policy: object = field(default=lru_policy)
+
+    def __post_init__(self):
+        self.max_bytes = int(self.max_bytes)
+        if self.max_bytes < 1:
+            raise ValueError("CapacityBudget.max_bytes must be >= 1")
+
+    def victims(self, candidates):
+        return list(self.policy(candidates))
+
+
+def record_eviction(handle_id: str, nbytes: int, cause: str,
+                    live_bytes: int,
+                    budget_bytes: int | None = None) -> None:
+    """One eviction's observability fan-out: the cause-labeled counter
+    plus a flight-recorder ``capacity_eviction`` event (the budget
+    event ``check_capacity`` pairs every budget eviction with —
+    a budget eviction without one is the silent-evict class)."""
+    from . import recorder as _recorder
+
+    _M_EVICTIONS.inc(cause=cause)
+    ev = {"handle_id": handle_id, "nbytes": int(nbytes),
+          "cause": cause, "live_bytes": int(live_bytes)}
+    if budget_bytes is not None:
+        ev["budget_bytes"] = int(budget_bytes)
+    _recorder.record("capacity_eviction", **ev)
+
+
+def record_refusal(requested: int, live_bytes: int, budget_bytes: int,
+                   pinned: int) -> None:
+    """A typed admission refusal's observability fan-out (counter +
+    flight-recorder event) — refusals are answers, and answers leave
+    evidence."""
+    from . import recorder as _recorder
+
+    _M_REFUSED.inc()
+    _recorder.record("capacity_refused", requested=int(requested),
+                     live_bytes=int(live_bytes),
+                     budget_bytes=int(budget_bytes), pinned=int(pinned))
+
+
+def record_projection(lane: str, nbytes: int) -> None:
+    """One lane's projected arg+out bytes, recorded BEFORE its compile
+    (``JordanService.project_capacity`` / ``warmup``)."""
+    _M_PROJECTED.set(int(nbytes), lane=str(lane))
+
+
+# ---- THE process-wide ledger ----------------------------------------
+
+LEDGER = CapacityLedger()
+
+
+def register(component: str, key, nbytes: int,
+             detail: str | None = None) -> None:
+    LEDGER.register(component, key, nbytes, detail=detail)
+
+
+def release(component: str, key) -> int:
+    return LEDGER.release(component, key)
+
+
+def live_bytes(component: str | None = None) -> int:
+    return LEDGER.live_bytes(component)
+
+
+def _recorder_probe() -> dict:
+    """The flight-recorder ring's retained bytes (sampled — the ring
+    churns per event; serializing it is a snapshot-time cost only)."""
+    from . import recorder as _recorder
+
+    evs = _recorder.RECORDER.events()
+    return {
+        "bytes": sum(len(json.dumps(e, default=str)) for e in evs),
+        "events_retained": len(evs),
+        "ring_capacity": _recorder.RECORDER.capacity,
+    }
+
+
+def _device_probe() -> dict | None:
+    """The device allocator's live/peak watermark through the sticky
+    hwcost probe (ISSUE 13 satellite: re-probed at every snapshot on
+    backends that report allocator stats; a backend that reported none
+    on the FIRST probe stays unavailable forever — absent, not zero)."""
+    from . import hwcost as _hwcost
+
+    stats = _hwcost.WATERMARK.sample()
+    if stats is None:
+        return None
+    out = {"bytes": int(stats.get("bytes_in_use", 0))}
+    if stats.get("peak_bytes_in_use") is not None:
+        out["peak_bytes_in_use"] = int(stats["peak_bytes_in_use"])
+    return out
+
+
+LEDGER.register_probe("flight_recorder", _recorder_probe)
+LEDGER.register_probe("device", _device_probe)
+
+
+def snapshot() -> dict:
+    """The process-wide capacity document (CLI ``--capacity-report``,
+    ``JordanFleet.stats()['capacity']``)."""
+    return LEDGER.snapshot()
+
+
+def write_report(path: str) -> None:
+    """Write :func:`snapshot` as one JSON document."""
+    with open(path, "w") as f:
+        json.dump(snapshot(), f)
+
+
+# ---- the acceptance demo --------------------------------------------
+
+
+def capacity_demo(n: int = 96, block_size: int | None = None,
+                  seed: int = 0, dtype=None,
+                  budget_handles: int = 2) -> dict:
+    """The ``--capacity-demo`` CLI mode's engine (ISSUE 13 acceptance):
+    one warmed service under a :class:`CapacityBudget` sized for
+    ``budget_handles`` resident handles proves the whole
+    accounting-to-actuation chain:
+
+      1. lane bytes are PROJECTED before any compile
+         (``project_capacity``), then metered for real at compile;
+      2. resident creates fill the budget; an update touches the LRU
+         order; the next create evicts the least-recently-served
+         handle — the eviction emits a journey hop AND a
+         ``capacity_eviction`` budget event;
+      3. with every survivor pinned, one more resident invert is the
+         typed ``CapacityExceededError`` at submit (zero compiles, the
+         invert never launched) — never an OOM mid-launch;
+      4. an update against the evicted handle is the typed
+         ``UnknownHandleError`` — an eviction is always observable,
+         never a silently stale serve;
+      5. the ledger reconciles: bytes_created == bytes_live +
+         bytes_evicted per metered class, zero compiles and zero
+         plan-cache measurements on the whole capacity path after
+         warmup (metering is on by default and costs the warm path
+         nothing).
+
+    Returns the one-line JSON report ``tools/check_capacity.py``
+    validates (exit 2 = unmetered residency / silent eviction)."""
+    import time
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..resilience.policy import CapacityExceededError
+    from ..serve.executors import bucket_for
+    from ..serve.handles import (HandleStore, UnknownHandleError,
+                                 resident_handle_bytes)
+    from ..serve.service import JordanService
+    from .metrics import REGISTRY
+    from .recorder import RECORDER
+
+    t0 = time.perf_counter()
+    dtype = jnp.dtype(jnp.float32 if dtype is None else dtype)
+    if budget_handles < 2:
+        raise ValueError("capacity_demo needs budget_handles >= 2 "
+                         "(the LRU order needs two candidates)")
+    bucket = bucket_for(n)
+    per = resident_handle_bytes(bucket, dtype)
+    budget_bytes = budget_handles * per + per // 2
+    store = HandleStore(budget=CapacityBudget(max_bytes=budget_bytes))
+    rank = 8
+    rng = np.random.default_rng(seed)
+    mats = [rng.standard_normal((n, n)).astype(dtype)
+            for _ in range(budget_handles + 2)]
+    scale = 1.0 / np.sqrt(float(n) * rank)
+    u = rng.standard_normal((n, rank)).astype(dtype) * scale
+    v = rng.standard_normal((n, rank)).astype(dtype) * scale
+
+    def counters():
+        c = REGISTRY.counter
+        return {
+            "compiles": c("tpu_jordan_compiles_total").total(),
+            "measurements":
+                c("tpu_jordan_tuner_measurements_total").total(),
+            "budget_evictions": _M_EVICTIONS.value(cause="budget"),
+            "refusals": _M_REFUSED.total(),
+        }
+
+    mark = RECORDER.total
+    with JordanService(engine="auto", dtype=dtype, batch_cap=1,
+                       max_wait_ms=0.5, block_size=block_size,
+                       shared_handles=store) as svc:
+        projected = svc.project_capacity(update_shapes=[(n, rank)])
+        svc.warmup(update_shapes=[(n, rank)])
+        after_warm = counters()
+        refs = {}
+        for i in range(budget_handles):
+            hid = f"h{i + 1}"
+            refs[hid] = svc.invert(mats[i], resident=True,
+                                   handle_id=hid, timeout=600)
+        # Touch h1's LRU stamp: h2 (the other resident) becomes the
+        # least-recently-served candidate the next admission evicts.
+        svc.update(refs["h1"], u, v, timeout=600)
+        over_id = f"h{budget_handles + 1}"
+        refs[over_id] = svc.invert(mats[budget_handles], resident=True,
+                                   handle_id=over_id, timeout=600)
+        alive = store.ids()
+        for hid in alive:
+            store.pin(hid)
+        typed_overflow = None
+        try:
+            svc.invert(mats[budget_handles + 1], resident=True,
+                       handle_id=f"h{budget_handles + 2}", timeout=600)
+        except CapacityExceededError as e:
+            typed_overflow = type(e).__name__
+        update_after_evict = None
+        try:
+            svc.update(refs["h2"], u, v, timeout=600)
+        except UnknownHandleError as e:
+            update_after_evict = type(e).__name__
+        end = counters()
+        budget_snap = store.budget_snapshot()
+        handles_snap = store.snapshot()
+    blackbox = RECORDER.dump(events=RECORDER.since(mark))
+    ledger = snapshot()
+
+    eviction_events = [e for e in blackbox["events"]
+                       if e["kind"] == "capacity_eviction"]
+    budget_events = [e for e in eviction_events
+                     if e.get("cause") == "budget"]
+    journey_evicts = [e for e in blackbox["events"]
+                      if e["kind"] == "journey"
+                      and e.get("event") == "capacity_evict"]
+    budget_evictions = int(end["budget_evictions"]
+                           - after_warm["budget_evictions"])
+    unmetered = [name for name, doc in ledger["components"].items()
+                 if doc["kind"] == "metered"
+                 and doc["bytes_created"] != (doc["bytes_live"]
+                                              + doc["bytes_evicted"])]
+    silent_eviction = (budget_evictions != len(budget_events)
+                       or len(journey_evicts) < len(budget_events))
+    compiles_on_path = int(end["compiles"] - after_warm["compiles"])
+    silent_capacity = (
+        bool(unmetered) or silent_eviction
+        or typed_overflow != "CapacityExceededError"
+        or update_after_evict != "UnknownHandleError"
+        or "h2" in alive or compiles_on_path != 0)
+    return {
+        "metric": "capacity_demo",
+        "n": n, "bucket_n": bucket, "dtype": dtype.name, "seed": seed,
+        "handle_bytes": per,
+        "budget_bytes": budget_bytes,
+        "budget_handles": budget_handles,
+        "projected_lanes": projected,
+        "ledger": ledger,
+        "budget": budget_snap,
+        "handles_alive": alive,
+        "handles": handles_snap,
+        "evictions": eviction_events,
+        "journey_evict_hops": len(journey_evicts),
+        "budget_evictions": budget_evictions,
+        "typed_overflow": {
+            "raised": typed_overflow == "CapacityExceededError",
+            "error": typed_overflow,
+            "refusals": int(end["refusals"] - after_warm["refusals"]),
+        },
+        "update_after_evict_typed": update_after_evict,
+        "compiles_on_capacity_path": compiles_on_path,
+        "measurements": int(end["measurements"]
+                            - after_warm["measurements"]),
+        "unmetered_components": unmetered,
+        "silent_capacity": bool(silent_capacity),
+        "blackbox": blackbox,
+        "elapsed_s": round(time.perf_counter() - t0, 3),
+    }

@@ -149,7 +149,15 @@ def to_json_line(registry=None, telemetry=None, **extra) -> str:
 
 
 def write_metrics(path: str, registry=None) -> None:
-    """Write the Prometheus text format to ``path`` (``--metrics-out``)."""
+    """Write the Prometheus text format to ``path`` (``--metrics-out``).
+
+    A metrics snapshot re-samples the device live-bytes watermark first
+    (ISSUE 13 satellite: the scraped gauges reflect NOW on backends
+    that report allocator stats; a backend that never did stays absent
+    — never zeroed)."""
+    from . import hwcost as _hwcost
+
+    _hwcost.WATERMARK.sample()
     with open(path, "w") as f:
         f.write(to_prometheus(registry))
 

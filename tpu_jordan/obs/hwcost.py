@@ -40,6 +40,7 @@ Surfaces:
 
 from __future__ import annotations
 
+import threading
 from dataclasses import dataclass
 
 from . import metrics as _metrics
@@ -302,6 +303,57 @@ def observe_device_memory(device=None, **labels) -> dict | None:
     if peak is not None:
         _M_DEV_PEAK.set(float(peak), **labels)
     return stats
+
+
+class DeviceMemoryWatermark:
+    """The sticky live-bytes watermark probe (ISSUE 13 satellite,
+    fixing the PR 9 one-shot): availability is decided by the FIRST
+    probe and never re-litigated —
+
+      * a backend that reported no allocator stats on the first probe
+        (CPU) stays ``available=False`` forever: every later ``sample``
+        is a lock-check no-op, the gauges are never set, never zeroed,
+        never modeled;
+      * a backend that DID report stats is re-probed at every
+        capacity/metrics snapshot and every served batch
+        (``serve/stats.py``) — and a TRANSIENT empty read on such a
+        backend returns None without touching the gauges or flipping
+        availability (absent is honest; the old per-instance tri-state
+        disabled the watermark forever on one hiccup).
+
+    ``sampler`` is injectable (tests pin both behaviors without a TPU).
+    """
+
+    def __init__(self, sampler=None):
+        self._sampler = (sampler if sampler is not None
+                         else device_memory_stats)
+        self._lock = threading.Lock()
+        #: None = never probed; the first probe's verdict is final.
+        self.available: bool | None = None
+
+    def sample(self, **labels) -> dict | None:
+        with self._lock:
+            if self.available is False:
+                return None
+        stats = self._sampler()
+        with self._lock:
+            if self.available is None:
+                self.available = stats is not None
+        if stats is None:
+            return None
+        used = stats.get("bytes_in_use")
+        peak = stats.get("peak_bytes_in_use")
+        if used is not None:
+            _M_DEV_USED.set(float(used), **labels)
+        if peak is not None:
+            _M_DEV_PEAK.set(float(peak), **labels)
+        return stats
+
+
+#: THE process-wide watermark (the device allocator is process state):
+#: serve stats, the capacity snapshot, and the metrics exporter all
+#: sample through this one sticky probe.
+WATERMARK = DeviceMemoryWatermark()
 
 
 def runtime_env() -> dict:

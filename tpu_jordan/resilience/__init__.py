@@ -23,7 +23,8 @@ Three modules:
 from . import faults
 from .faults import (FaultPlan, FaultSpec, InjectedFaultError,
                      InjectedTransientError, activate)
-from .policy import (DEFAULT_POLICY, CircuitBreaker, CircuitOpenError,
+from .policy import (DEFAULT_POLICY, CapacityExceededError,
+                     CircuitBreaker, CircuitOpenError,
                      DeadlineExceededError, ResidualGateError,
                      ResiliencePolicy, ResultCorruptionError, RetryPolicy,
                      is_transient, retry_transient, retryable)
@@ -31,8 +32,8 @@ from .policy import (DEFAULT_POLICY, CircuitBreaker, CircuitOpenError,
 __all__ = [
     "faults", "FaultPlan", "FaultSpec", "InjectedFaultError",
     "InjectedTransientError", "activate",
-    "DEFAULT_POLICY", "CircuitBreaker", "CircuitOpenError",
-    "DeadlineExceededError", "ResidualGateError", "ResiliencePolicy",
-    "ResultCorruptionError", "RetryPolicy", "is_transient",
-    "retry_transient", "retryable",
+    "DEFAULT_POLICY", "CapacityExceededError", "CircuitBreaker",
+    "CircuitOpenError", "DeadlineExceededError", "ResidualGateError",
+    "ResiliencePolicy", "ResultCorruptionError", "RetryPolicy",
+    "is_transient", "retry_transient", "retryable",
 ]

@@ -81,6 +81,17 @@ class ResultCorruptionError(ArithmeticError):
     instead of a wrong answer reaching a caller."""
 
 
+class CapacityExceededError(MemoryError):
+    """A resident-bytes budget refused an admission (ISSUE 13): the
+    requested residency does not fit under the
+    :class:`~..obs.capacity.CapacityBudget` ceiling and the evictor
+    could not make room (everything evictable is pinned).  Raised at
+    SUBMIT time — before any device launch — so an over-budget
+    ``invert(resident=True)`` is a typed answer, never an OOM
+    mid-launch.  Evict or unpin a handle (``HandleStore.evict`` /
+    ``unpin``), or raise the budget, and retry."""
+
+
 def is_transient(e: Exception) -> bool:
     """Transient = a runtime/transport exception TYPE carrying one of
     the documented-transient message markers.  Both conditions required

@@ -106,6 +106,40 @@ class ExecutorKey:
     rhs: int = 0                  # RHS-width bucket (solve lanes only)
 
 
+def lane_label(workload: str, bucket_n: int, batch_cap: int,
+               rhs: int = 0) -> str:
+    """The capacity-ledger detail label of one lane — workload, bucket,
+    batch capacity, and (solve/update) the k-bucket."""
+    base = f"{workload}:{bucket_n}:b{batch_cap}"
+    return base if workload == "invert" else f"{base}:k{rhs}"
+
+
+def projected_lane_bytes(bucket_n: int, batch_cap: int, dtype,
+                         workload: str = "invert", rhs: int = 0) -> int:
+    """Projected argument + output bytes of a lane's AOT signature —
+    computable BEFORE compiling (ISSUE 13: ``warmup``/
+    ``project_capacity`` record this so operators see what a bucket
+    costs to open *before* paying the compile).  Temps are
+    compiler-known only: the post-compile ``memory_analysis`` footprint
+    in the ``executor_lanes`` capacity ledger is the full number; this
+    projection is its arg/out floor (exact on backends whose temp
+    residency is zero, e.g. the CPU lanes the tests pin)."""
+    it = jnp.dtype(dtype).itemsize
+    n2 = bucket_n * bucket_n
+    cap, k = int(batch_cap), int(rhs)
+    per_elem_out = 1 + 2 * it         # singular flag + kappa + rel
+    if workload == "invert":
+        args = cap * n2 * it + cap * 4
+        outs = cap * n2 * it + cap * per_elem_out
+    elif workload == "update":
+        args = 2 * n2 * it + 2 * bucket_n * k * it + 4
+        outs = 2 * n2 * it + per_elem_out
+    else:                             # solve lanes
+        args = cap * n2 * it + cap * bucket_n * k * it + cap * 4
+        outs = cap * bucket_n * k * it + cap * per_elem_out
+    return int(args + outs)
+
+
 class BucketExecutor:
     """One AOT-compiled batched-inversion executable for one bucket.
 
@@ -302,7 +336,30 @@ class ExecutorStore:
             ex = build()
             with self._lock:
                 self._executors[key] = ex
+            self._meter(key, ex)
             return ex, True
+
+    def _meter(self, key: ExecutorKey, ex) -> None:
+        """Capacity metering (ISSUE 13): one ``executor_lanes`` ledger
+        entry per compiled executable — its ``memory_analysis``
+        arg/out/temp HBM footprint, or the arg+out projection where
+        the backend exposes no analysis (labeled ``projected``, never
+        silently modeled as the compiler's number).  Executables are
+        never dropped, so this class only grows — honest: compiled
+        lanes ARE permanent residency."""
+        from ..obs import capacity as _capacity
+
+        nbytes = ex.cost.hbm_bytes if ex.cost.available else None
+        source = "memory_analysis"
+        if nbytes is None:
+            nbytes = projected_lane_bytes(key.bucket_n, key.batch_cap,
+                                          key.dtype, key.workload,
+                                          key.rhs)
+            source = "projected"
+        label = lane_label(key.workload, key.bucket_n, key.batch_cap,
+                           key.rhs)
+        _capacity.register("executor_lanes", (id(self), key), nbytes,
+                           detail=f"{label}:{source}")
 
     def keys(self):
         with self._lock:

@@ -31,8 +31,21 @@ between transactions always sees one committed version.
 from __future__ import annotations
 
 import threading
+import time
 from contextlib import contextmanager
 from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..obs import capacity as _capacity
+
+
+def resident_handle_bytes(bucket_n: int, dtype) -> int:
+    """The bytes ONE resident handle pins: the identity-padded mutated
+    matrix plus its padded inverse — 2·bucket²·dtype (ISSUE 13, the
+    unit every capacity budget and the ``resident_handle_bytes`` bench
+    accounting field are denominated in)."""
+    return 2 * int(bucket_n) * int(bucket_n) * np.dtype(dtype).itemsize
 
 
 class UnknownHandleError(KeyError):
@@ -79,6 +92,14 @@ class HandleState:
     reinverts: int = 0
     kappa: float = 0.0
     rel_residual: float = 0.0
+    #: capacity accounting (ISSUE 13): resident bytes (stamped by the
+    #: store at create), the LRU clock the budget evictor orders by
+    #: (stamped at create and on every COMMITTED txn — a failing
+    #: update never refreshes its handle's eviction position), and the
+    #: pin flag exempting this handle from budget eviction.
+    nbytes: int = 0
+    last_served: float = 0.0
+    pinned: bool = False
     lock: threading.Lock = field(default_factory=threading.Lock,
                                  repr=False)
 
@@ -97,6 +118,8 @@ class HandleState:
                 "updates_applied": self.updates_applied,
                 "reinverts": self.reinverts,
                 "rel_residual": float(self.rel_residual),
+                "nbytes": int(self.nbytes),
+                "pinned": bool(self.pinned),
             }
 
 
@@ -112,11 +135,30 @@ class HandleStore:
     bare map reads/writes take the store lock alone.  That ordering is
     what lets evict/create wait out an in-flight update without
     deadlock — and guarantees an update can never commit to an
-    orphaned state object (the silently-lost-update class)."""
+    orphaned state object (the silently-lost-update class).
 
-    def __init__(self):
+    Capacity (ISSUE 13): every create/evict/re-create meters the
+    process-wide ``obs.capacity`` ledger (component ``handles``), and
+    an attached :class:`~..obs.capacity.CapacityBudget` turns the
+    accounting into actuation — admission evicts least-recently-served
+    UNPINNED handles until the new state fits, or refuses with the
+    typed ``CapacityExceededError`` at submit.  The budget evictor
+    goes through :meth:`evict`, so it inherits the STATE → STORE
+    discipline: an in-flight ``txn`` is waited out and its committed
+    update lands before the removal — never orphaned by the budget
+    either."""
+
+    def __init__(self, budget=None, clock=None):
         self._lock = threading.Lock()
         self._handles: dict[str, HandleState] = {}
+        #: the resident-bytes ceiling (obs.capacity.CapacityBudget) or
+        #: None — the historical unmetered-admission behavior, with the
+        #: ledger still metering every byte.
+        self.budget = budget
+        self._clock = clock if clock is not None else time.monotonic
+        self._live_bytes = 0
+        self._budget_evictions = 0
+        self._refusals = 0
 
     def create(self, state: HandleState) -> HandleRef:
         """Install a freshly-inverted resident state; re-creating an
@@ -125,21 +167,65 @@ class HandleStore:
         replacement waits out any in-flight ``txn`` on the OLD state
         (its lock) before swapping, so an update never straddles the
         swap: it lands on the old state and is then superseded, or it
-        retries onto the new one — never both, never lost."""
+        retries onto the new one — never both, never lost.
+
+        Budget admission (ISSUE 13) runs FIRST — the store evicts LRU
+        unpinned handles until the new state fits, or raises the typed
+        ``CapacityExceededError`` before anything is installed — and
+        is RE-CHECKED under the store lock at install: two racing
+        creates of distinct ids can both pass the eviction pass, but
+        only admissions that still fit install; the loser loops back
+        to evict (or refuse typed) rather than silently overshooting
+        the ceiling.  A same-id replacement's old bytes are credited
+        (a net-zero re-create never evicts an innocent handle).  The
+        serving surface additionally pre-admits at submit
+        (``ensure_capacity``) so the refusal lands before the invert
+        ever launches."""
+        state.nbytes = resident_handle_bytes(state.bucket_n, state.dtype)
+        state.last_served = self._clock()
         ref = HandleRef(state.handle_id, state.n, state.bucket_n,
                         state.dtype)
         while True:
+            if self.budget is not None:
+                self.ensure_capacity(state.nbytes,
+                                     replacing=state.handle_id)
             with self._lock:
                 old = self._handles.get(state.handle_id)
                 if old is None:
-                    self._handles[state.handle_id] = state
-                    return ref
+                    if self._fits_locked(state.nbytes):
+                        self._install(state)
+                        return ref
+                    continue            # admission raced: re-evict
+            if old is None:
+                continue
             with old.lock:
                 with self._lock:
                     if self._handles.get(state.handle_id) is old:
-                        self._handles[state.handle_id] = state
-                        return ref
-            # old was itself replaced/evicted between the reads: retry.
+                        if self._fits_locked(state.nbytes
+                                             - old.nbytes):
+                            self._live_bytes -= old.nbytes
+                            self._install(state)
+                            return ref
+            # old was replaced/evicted between the reads (retry on the
+            # successor), or the replacement no longer fits because a
+            # racer consumed the credit (loop back to admission).
+
+    def _fits_locked(self, delta: int) -> bool:
+        """Does adding ``delta`` net bytes fit the budget?  Caller
+        holds the store lock — this is the install-time re-check that
+        makes admission atomic with installation."""
+        if self.budget is None:
+            return True
+        return self._live_bytes + delta <= self.budget.max_bytes
+
+    def _install(self, state: HandleState) -> None:
+        """Map write + ledger metering (caller holds the store lock).
+        A same-id replacement's old bytes are accounted evicted by the
+        ledger's replace semantics."""
+        self._handles[state.handle_id] = state
+        self._live_bytes += state.nbytes
+        _capacity.register("handles", (id(self), state.handle_id),
+                           state.nbytes, detail=f"n{state.bucket_n}")
 
     def get(self, handle_id: str) -> HandleState:
         with self._lock:
@@ -167,7 +253,17 @@ class HandleStore:
                 with self._lock:
                     current = self._handles.get(handle_id)
                 if current is st:
-                    yield st
+                    v0 = st.version
+                    try:
+                        yield st
+                    finally:
+                        # LRU stamp (ISSUE 13), COMMIT-gated: only a
+                        # txn that actually committed refreshes the
+                        # handle's eviction position — a handle whose
+                        # updates keep failing typed must not squat on
+                        # residency by bumping its own stamp.
+                        if st.version != v0:
+                            st.last_served = self._clock()
                     return
             # Replaced between lookup and lock: loop onto the
             # successor (or raise typed if it was evicted meanwhile).
@@ -191,12 +287,17 @@ class HandleStore:
             state.reinverts += 1
         return state.version
 
-    def evict(self, handle_id: str) -> bool:
+    def evict(self, handle_id: str, cause: str = "caller") -> bool:
         """Drop a resident handle (False when already gone).  Eviction
-        is the caller's lifecycle call — the store never ages state
-        out on its own (docs/SERVING.md).  An in-flight ``txn`` is
-        waited out (the state's lock) before removal, so a committed
-        update is never orphaned by a racing evict."""
+        is a lifecycle call — the caller's, or the attached budget's
+        LRU evictor (``cause="budget"``); the store never ages state
+        out on its own otherwise (docs/SERVING.md).  An in-flight
+        ``txn`` is waited out (the state's lock) before removal, so a
+        committed update is never orphaned by a racing evict — budget
+        evictions included.  Every eviction releases the capacity
+        ledger and records a ``capacity_eviction`` flight-recorder
+        event (a budget eviction without one is the silent-evict class
+        ``check_capacity`` exits 2 on)."""
         while True:
             with self._lock:
                 st = self._handles.get(handle_id)
@@ -206,8 +307,107 @@ class HandleStore:
                 with self._lock:
                     if self._handles.get(handle_id) is st:
                         del self._handles[handle_id]
+                        self._live_bytes -= st.nbytes
+                        if cause == "budget":
+                            self._budget_evictions += 1
+                        live = self._live_bytes
+                        _capacity.release("handles",
+                                          (id(self), handle_id))
+                        _capacity.record_eviction(
+                            handle_id, st.nbytes, cause, live,
+                            budget_bytes=(self.budget.max_bytes
+                                          if self.budget is not None
+                                          else None))
                         return True
             # st was replaced between the reads: retry on the successor.
+
+    # ---- capacity admission (ISSUE 13) -------------------------------
+
+    def pin(self, handle_id: str) -> None:
+        """Exempt a handle from budget eviction (it still counts
+        against the budget — pinned residency is residency)."""
+        self.get(handle_id).pinned = True
+
+    def unpin(self, handle_id: str) -> None:
+        self.get(handle_id).pinned = False
+
+    def ensure_capacity(self, nbytes: int, exempt=frozenset(),
+                        hop=None, replacing: str | None = None
+                        ) -> list[str]:
+        """Make room for ``nbytes`` of new resident state under the
+        attached budget: evict least-recently-served unpinned handles
+        (through :meth:`evict` — in-flight txns waited out, events
+        recorded) until the admission fits, or raise the typed
+        ``CapacityExceededError`` (counted + recorded) when nothing
+        evictable remains.  No-op without a budget.
+
+        ``replacing`` names a handle id this admission will REPLACE
+        (a same-id re-create): its live bytes are credited against the
+        request — a net-zero replacement admits without evicting an
+        innocent handle or refusing — and it is exempt from eviction
+        (evicting the handle being replaced would emit a spurious
+        budget event for bytes the replacement frees anyway).
+
+        ``hop`` (the serving surface passes the creating request's
+        journey ``ctx.event``) records one ``capacity_evict`` journey
+        hop per victim — the eviction is attributable to the request
+        whose admission forced it.  Returns the evicted ids."""
+        if self.budget is None:
+            return []
+        from ..resilience.policy import CapacityExceededError
+
+        nbytes = int(nbytes)
+        if replacing is not None:
+            exempt = frozenset(exempt) | {replacing}
+            with self._lock:
+                old = self._handles.get(replacing)
+                if old is not None:
+                    nbytes = max(0, nbytes - old.nbytes)
+        evicted: list[str] = []
+        while True:
+            with self._lock:
+                if self._live_bytes + nbytes <= self.budget.max_bytes:
+                    return evicted
+                candidates = [st for st in self._handles.values()
+                              if not st.pinned
+                              and st.handle_id not in exempt]
+                pinned = len(self._handles) - len(candidates)
+                live = self._live_bytes
+            if not candidates:
+                with self._lock:
+                    self._refusals += 1
+                _capacity.record_refusal(nbytes, live,
+                                         self.budget.max_bytes, pinned)
+                raise CapacityExceededError(
+                    f"resident-handle budget exceeded: {nbytes} new "
+                    f"bytes would not fit ({live} live of "
+                    f"{self.budget.max_bytes} budget, {pinned} "
+                    f"pinned/exempt handle(s), nothing evictable) — "
+                    f"evict or unpin a handle, or raise the budget")
+            victim = self.budget.victims(candidates)[0]
+            if self.evict(victim.handle_id, cause="budget"):
+                evicted.append(victim.handle_id)
+                if hop is not None:
+                    hop("capacity_evict", handle=victim.handle_id,
+                        bytes=victim.nbytes, cause="budget")
+            # A racing evictor may have removed the victim first (evict
+            # returned False): loop — the live-bytes re-check decides.
+
+    def budget_snapshot(self) -> dict:
+        """The store's capacity block in ``service.stats()`` /
+        the demo report."""
+        with self._lock:
+            pinned = sorted(h for h, st in self._handles.items()
+                            if st.pinned)
+            return {
+                "max_bytes": (self.budget.max_bytes
+                              if self.budget is not None else None),
+                "live_bytes": self._live_bytes,
+                "handles": len(self._handles),
+                "pinned": pinned,
+                "budget_evictions": self._budget_evictions,
+                "refusals": self._refusals,
+            }
 
     def ids(self) -> list[str]:
         with self._lock:
@@ -224,6 +424,32 @@ class HandleStore:
             return len(self._handles)
 
 
+def build_handle_store(shared, budget_bytes: int | None,
+                       owner: str) -> HandleStore:
+    """The ONE home for the shared-store-vs-budget wiring rule
+    (ISSUE 13): ``JordanService`` and ``JordanFleet`` both build their
+    handle store through this, so the mutual exclusion — a pre-built
+    shared store carries its OWN budget; attaching a second one at the
+    consumer would fork the admission policy — can never drift between
+    the two surfaces.  ``owner`` names the consumer for the typed
+    error."""
+    if shared is not None and budget_bytes is not None:
+        from ..driver import UsageError
+
+        raise UsageError(
+            f"handle_budget_bytes builds {owner}'s own budgeted store; "
+            f"a pre-built shared store carries its own budget "
+            f"(HandleStore(budget=CapacityBudget(...)) — one admission "
+            f"policy for everyone sharing it)")
+    if shared is not None:
+        return shared
+    if budget_bytes is not None:
+        from ..obs.capacity import CapacityBudget
+
+        return HandleStore(budget=CapacityBudget(max_bytes=budget_bytes))
+    return HandleStore()
+
+
 def create_resident_handle(store: HandleStore, dtype, a, res,
                            handle_id: str) -> HandleRef:
     """Install one resident handle from a completed invert — the ONE
@@ -232,8 +458,6 @@ def create_resident_handle(store: HandleStore, dtype, a, res,
     returned n×n slice with identity reconstructs the padded resident
     state exactly.  ``res`` is the creating invert's ``InvertResult``;
     the returned ref carries it."""
-    import numpy as np
-
     bucket, n = res.bucket_n, res.n
     a_pad = np.asarray(np.eye(bucket, dtype=dtype))
     a_pad[:n, :n] = np.asarray(a, dtype)

@@ -43,7 +43,7 @@ from .batcher import (InvertResult, MicroBatcher, ServiceClosedError,
                       ServiceOverloadedError)
 from .executors import (ExecutorCache, bucket_for, k_bucket_for,
                         rhs_bucket_for)
-from .handles import HandleRef, HandleStore
+from .handles import HandleRef
 from .stats import ServeStats
 
 
@@ -109,6 +109,15 @@ class JordanService:
         ``tpu_jordan_residual`` histogram with expected-error spikes
         into the flight recorder (ISSUE 10, docs/OBSERVABILITY.md).
         ``"trace"`` is a solve-path mode and a typed refusal here.
+      handle_budget_bytes: optional resident-bytes ceiling for the
+        private handle store (ISSUE 13, docs/SERVING.md): an
+        over-budget ``invert(resident=True)`` evicts least-recently-
+        served unpinned handles to make room — each eviction a journey
+        hop + flight-recorder event — or raises the typed
+        ``CapacityExceededError`` at submit.  None = unmetered
+        admission (the ledger still accounts every byte).  Mutually
+        exclusive with ``shared_handles`` (a shared store carries its
+        own budget).
     """
 
     def __init__(self, engine: str = "auto", plan_cache: str | None = None,
@@ -122,7 +131,8 @@ class JordanService:
                  metric_labels: dict | None = None,
                  numerics: str = "off",
                  shared_handles=None,
-                 update_drift_budget_factor: float | None = None):
+                 update_drift_budget_factor: float | None = None,
+                 handle_budget_bytes: int | None = None):
         self.dtype = jnp.dtype(dtype)
         self.batch_cap = int(batch_cap)
         self.telemetry = telemetry
@@ -152,9 +162,17 @@ class JordanService:
         # shared store to every replica (the ExecutorStore discipline),
         # so a replica kill never loses a handle and a warm replacement
         # has nothing to rebuild; None keeps a private store — the
-        # single-service behavior.
-        self.handles = (shared_handles if shared_handles is not None
-                        else HandleStore())
+        # single-service behavior.  ``handle_budget_bytes`` (ISSUE 13)
+        # caps the private store's resident bytes (LRU eviction over
+        # last-served, pinned exempt, typed CapacityExceededError at
+        # submit when nothing is evictable); a SHARED store's budget
+        # belongs to whoever built the store — the one wiring rule
+        # lives in ``handles.build_handle_store``.
+        from .handles import build_handle_store
+
+        self.handles = build_handle_store(shared_handles,
+                                          handle_budget_bytes,
+                                          "the service")
         self._handle_seq = 0
         self._stats = ServeStats(labels=metric_labels)
         self.executors = ExecutorCache(
@@ -277,15 +295,48 @@ class JordanService:
         instead of paying a fresh O(n³) elimination
         (docs/SERVING.md).  ``handle_id`` names the handle (demos pass
         deterministic ids so chaos replays compare); default: a
-        service-minted ``h<N>``."""
-        res = self.submit(a, deadline_ms=deadline_ms).result(timeout)
+        service-minted ``h<N>``.
+
+        Capacity admission (ISSUE 13): with a budget on the handle
+        store, the 2·bucket²·dtype the new handle would pin is admitted
+        BEFORE the invert is submitted — LRU unpinned handles are
+        evicted to make room (each eviction a ``capacity_evict``
+        journey hop on THIS request plus a flight-recorder event), and
+        an admission nothing evictable can satisfy raises the typed
+        ``CapacityExceededError`` here, at submit: the elimination
+        never launches, so over-budget residency can never OOM
+        mid-launch."""
+        if not resident:
+            res = self.submit(a, deadline_ms=deadline_ms).result(timeout)
+            if res.singular:
+                from ..driver import SingularMatrixError
+
+                raise SingularMatrixError("singular matrix")
+            return res
+        from .handles import resident_handle_bytes
+
+        arr = np.asarray(a, self.dtype)
+        if arr.ndim != 2 or arr.shape[0] != arr.shape[1]:
+            raise ValueError(f"expected a square (n, n) matrix, "
+                             f"got shape {arr.shape}")
+        n = arr.shape[0]
+        bucket = bucket_for(n)
+        ctx = self.journey.new(n, bucket, workload="invert")
+        try:
+            self.handles.ensure_capacity(
+                resident_handle_bytes(bucket, self.dtype),
+                hop=ctx.event, replacing=handle_id)
+            fut = self.submit(arr, deadline_ms=deadline_ms, _ctx=ctx)
+        except Exception as e:
+            ctx.close("error", error=type(e).__name__)
+            raise
+        fut.add_done_callback(ctx.close_from_future)
+        res = fut.result(timeout)
         if res.singular:
             from ..driver import SingularMatrixError
 
             raise SingularMatrixError("singular matrix")
-        if not resident:
-            return res
-        return self._create_handle(a, res, handle_id)
+        return self._create_handle(arr, res, handle_id)
 
     def _create_handle(self, a, res: InvertResult,
                        handle_id: str | None) -> HandleRef:
@@ -379,6 +430,42 @@ class JordanService:
 
     # ---- lifecycle ---------------------------------------------------
 
+    def project_capacity(self, shapes=(), solve_shapes=(),
+                         update_shapes=()) -> dict:
+        """Projected arg+out bytes per lane the given request mix would
+        open — WITHOUT compiling anything (ISSUE 13: what a bucket
+        costs to open, visible before paying for it).  Same lane
+        vocabulary as :meth:`warmup` (update shapes include each n's
+        invert lane and its cap-1 re_invert twin); every projection is
+        recorded on the ``tpu_jordan_capacity_projected_lane_bytes``
+        gauge.  Temps are compiler-known only: the post-compile
+        ``memory_analysis`` number lands in the ``executor_lanes``
+        capacity ledger."""
+        from ..obs import capacity as _capacity
+        from .executors import lane_label, projected_lane_bytes
+
+        cap = self.batch_cap
+        out = {}
+
+        def project(workload, bucket, batch_cap, rhs=0):
+            label = lane_label(workload, bucket, batch_cap, rhs)
+            out[label] = projected_lane_bytes(bucket, batch_cap,
+                                              self.dtype, workload, rhs)
+            _capacity.record_projection(label, out[label])
+
+        for n in shapes:
+            project("invert", bucket_for(int(n)), cap)
+        for n, k in solve_shapes:
+            project("solve", bucket_for(int(n)), cap,
+                    rhs_bucket_for(int(k)))
+        for n, k in update_shapes:
+            b = bucket_for(int(n))
+            project("invert", b, cap)
+            if cap != 1:
+                project("invert", b, 1)      # the re_invert cap-1 twin
+            project("update", b, 1, k_bucket_for(int(k)))
+        return out
+
     def warmup(self, shapes=(), solve_shapes=(), update_shapes=()) -> dict:
         """Pre-compile the executables for every bucket the given
         request sizes land in; returns {lane: resolved engine}.
@@ -396,7 +483,14 @@ class JordanService:
         CAP-1 invert twin (the "re_invert" degradation rung eliminates
         ONE mutated matrix — it must not pay batch_cap eliminations of
         identity fillers), so a warm update path performs zero compiles
-        even when a rung fires."""
+        even when a rung fires.
+
+        Every lane's projected arg+out bytes are recorded BEFORE its
+        compile (ISSUE 13, :meth:`project_capacity`) — the
+        ``tpu_jordan_capacity_projected_lane_bytes`` gauge tells an
+        operator what the warmup is about to pin before it pins it."""
+        self.project_capacity(shapes=shapes, solve_shapes=solve_shapes,
+                              update_shapes=update_shapes)
         out = {}
         for n in shapes:
             b = bucket_for(int(n))
@@ -479,6 +573,7 @@ class JordanService:
         snap["batch_cap"] = self.batch_cap
         snap["queued"] = self._batcher.queued
         snap["handles"] = self.handles.snapshot()
+        snap["handle_budget"] = self.handles.budget_snapshot()
         snap["breakers"] = {str(b): s for b, s
                             in self.executors.breaker_states().items()}
         return snap

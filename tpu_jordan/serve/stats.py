@@ -139,11 +139,6 @@ class ServeStats:
                 f"reserved metric label(s) {sorted(clash)} — these are "
                 f"stamped by ServeStats itself; pick different names")
         self._buckets: dict[int, _BucketStats] = {}
-        # Live-bytes device watermark gauges (ISSUE 10 hwcost): probed
-        # once on the first batch — a backend that reports no memory
-        # stats (CPU) disables the sampling forever, so the warm path
-        # pays nothing for a gauge that cannot exist.
-        self._device_mem_enabled: bool | None = None
 
     def _b(self, bucket, workload: str = "invert") -> _BucketStats:
         return self._buckets.setdefault(bucket, _BucketStats(workload))
@@ -212,9 +207,12 @@ class ServeStats:
         if singular:
             _M_SINGULAR.inc(singular, component="serve", bucket=bucket,
                             **self._labels)
-        if self._device_mem_enabled is not False:
-            sampled = _hwcost.observe_device_memory(**self._labels)
-            self._device_mem_enabled = sampled is not None
+        # Live-bytes device watermark (ISSUE 10, re-based by ISSUE 13):
+        # the process-wide sticky probe — a backend whose FIRST probe
+        # reported no allocator stats (CPU) stays disabled forever (the
+        # warm path pays one lock check), a supporting backend is
+        # re-sampled every batch and every capacity/metrics snapshot.
+        _hwcost.WATERMARK.sample(**self._labels)
 
     def snapshot(self) -> dict:
         with self._lock:

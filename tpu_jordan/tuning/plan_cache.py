@@ -135,7 +135,8 @@ class PlanCache:
     def __init__(self, path: str | None = None,
                  plans: dict[str, Plan] | None = None,
                  fallback_reason: str | None = None,
-                 read_only: bool = False):
+                 read_only: bool = False,
+                 resident_nbytes: int | None = None):
         self.path = path
         self.plans = dict(plans or {})
         #: frozen cache (the fleet's shared pre-tuned plans): ``put`` /
@@ -149,6 +150,26 @@ class PlanCache:
         #: succeed.  In-memory plans keep serving either way (ISSUE 5
         #: satellite: a full disk degrades, it does not crash a solve).
         self.last_write_error: str | None = None
+        # ``resident_nbytes`` lets load() pass the on-disk document's
+        # size it just read (no re-serialization on the startup path).
+        self._meter(resident_nbytes)
+
+    def _meter(self, nbytes: int | None = None) -> None:
+        """Capacity accounting (ISSUE 13): the serialized plan document
+        is resident process state — one ``plan_cache`` ledger entry per
+        cache instance, re-registered (replace semantics) whenever a
+        save rewrites the document.  ``save`` passes the length of the
+        document it just wrote (no second serialization); construction
+        serializes once itself."""
+        from ..obs import capacity as _capacity
+
+        if nbytes is None:
+            doc = {"version": CACHE_VERSION,
+                   "plans": {k: p.to_json()
+                             for k, p in sorted(self.plans.items())}}
+            nbytes = len(json.dumps(doc, indent=1, sort_keys=True)) + 1
+        _capacity.register("plan_cache", (id(self),), nbytes,
+                           detail=self.path or "<memory>")
 
     @classmethod
     def load(cls, path: str, read_only: bool = False) -> "PlanCache":
@@ -179,7 +200,8 @@ class PlanCache:
                                f"{CACHE_VERSION} — ignoring stale cache"))
             plans = {str(k): Plan.from_json(v)
                      for k, v in doc["plans"].items()}
-            return cls(path=path, plans=plans, read_only=read_only)
+            return cls(path=path, plans=plans, read_only=read_only,
+                       resident_nbytes=os.path.getsize(path))
         except (OSError, ValueError, KeyError, TypeError,
                 AttributeError) as e:
             # ValueError covers json.JSONDecodeError; Key/Type/Attribute
@@ -228,6 +250,7 @@ class PlanCache:
         doc = {"version": CACHE_VERSION,
                "plans": {k: p.to_json() for k, p in
                          sorted(self.plans.items())}}
+        text = json.dumps(doc, indent=1, sort_keys=True) + "\n"
         try:
             _faults.fire("plan_cache_write")
             d = os.path.dirname(os.path.abspath(path))
@@ -235,8 +258,7 @@ class PlanCache:
             fd, tmp = tempfile.mkstemp(dir=d, suffix=".plan.tmp")
             try:
                 with os.fdopen(fd, "w") as f:
-                    json.dump(doc, f, indent=1, sort_keys=True)
-                    f.write("\n")
+                    f.write(text)
                 os.replace(tmp, path)
             except BaseException:
                 try:
@@ -253,3 +275,4 @@ class PlanCache:
             _recorder.record("plan_cache_write_failure", error=str(e))
             return
         self.last_write_error = None
+        self._meter(len(text))   # re-register: the document grew
