@@ -13,7 +13,7 @@ WORKERS  ?= 1
 REQUESTS  ?= 64
 BATCH_CAP ?= 8
 
-.PHONY: all native tpu test smoke serve-demo solve-demo chaos-demo fleet-demo update-demo capacity-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
+.PHONY: all native tpu test smoke serve-demo solve-demo chaos-demo fleet-demo update-demo capacity-demo comm-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
 
 REPLICAS ?= 3
 
@@ -117,6 +117,20 @@ capacity-demo:
 	python -m tpu_jordan 96 32 --capacity-demo --quiet \
 	  > /tmp/tpu_jordan_capacity.json
 	python tools/check_capacity.py /tmp/tpu_jordan_capacity.json
+
+# Comm demo + validation (ISSUE 14, docs/OBSERVABILITY.md): five tiny
+# distributed solves (1D + 2D meshes, both gather modes, a grouped
+# engine, a ragged problem size) each reconciling the collective
+# multiset the traced program actually issued against the
+# layout-derived analytical inventory, plus one deliberate
+# measured-vs-projected drift leg whose out-of-band ratio must be a
+# RECORDED comm_drift event (exit 2 = an unaccounted collective or a
+# silent drift).  This row is the communication observatory's demo
+# gate, like capacity-demo/update-demo/fleet-demo for theirs.
+comm-demo:
+	python -m tpu_jordan 48 8 --comm-demo --quiet \
+	  > /tmp/tpu_jordan_comm.json
+	python tools/check_comm.py /tmp/tpu_jordan_comm.json
 
 # SLO demo + validation (docs/OBSERVABILITY.md): the fleet demo with
 # the --slo-report leg — declarative per-bucket availability SLOs

@@ -443,7 +443,15 @@ def _sharded_swapfree_row(extra):
     subprocess (the __graft_entry__ dryrun recipe) — the row evidences
     the memory-contract path (relative residual + per-shard bytes =
     exactly 1/8 of the matrix); its elapsed is CPU-mesh wall time and
-    is never compared to the chip baseline."""
+    is never compared to the chip baseline.
+
+    ISSUE 14: the row also carries the communication observatory's
+    numbers — ``*_comm_bytes`` (the layout-exact elimination-section
+    collective payload, an ACCOUNTING field check_bench never compares
+    across rounds: a layout/dtype change re-prices the same solve) and
+    ``*_comm_gbps`` (achieved interconnect GB/s = modeled wire bytes
+    over the measured non-compute residue — a RATE the sentinel pages
+    on like any ``*_gflops`` shortfall; the mesh bandwidth sentinel)."""
     import subprocess
     import sys
 
@@ -458,11 +466,19 @@ def _sharded_swapfree_row(extra):
         "b = r.inverse_blocks\n"
         "shard = max(s.data.nbytes for s in b.addressable_shards)\n"
         "assert r.inverse is None and shard * 8 == b.nbytes\n"
+        "d = r.comm.drift or {}\n"
         "print(json.dumps({'n': n, 'm': m, 'mesh': '2x4',\n"
         "                  'engine': 'swapfree', 'gather': False,\n"
         "                  'elapsed_s': round(r.elapsed, 3),\n"
         "                  'rel_residual': f'{r.rel_residual:.1e}',\n"
-        "                  'per_shard_mib': round(shard / 2**20, 2)}))\n"
+        "                  'per_shard_mib': round(shard / 2**20, 2),\n"
+        "                  'comm_payload_bytes': int(sum(\n"
+        "                      s.payload_bytes * s.executed\n"
+        "                      for s in r.comm.sigs\n"
+        "                      if s.section == 'engine')),\n"
+        "                  'comm_gbps': d.get('achieved_gbps'),\n"
+        "                  'comm_vs_projected':\n"
+        "                      d.get('comm_vs_projected')}))\n"
     )
     try:
         proc = subprocess.run(
@@ -471,6 +487,14 @@ def _sharded_swapfree_row(extra):
         row = json.loads(proc.stdout.strip().splitlines()[-1])
         row["note"] = "cpu-mesh memory-contract leg, not chip throughput"
         extra["sharded_swapfree_gather_false"] = row
+        # Top-level sentinel keys (tools/check_bench.py): the bytes key
+        # is accounting-class (never compared cross-round); the GB/s
+        # key is a rate — a quiet shortfall pages like a gflops one.
+        extra["sharded_swapfree_2048_comm_bytes"] = row[
+            "comm_payload_bytes"]
+        if row.get("comm_gbps") is not None:
+            extra["sharded_swapfree_2048_comm_gbps"] = round(
+                row["comm_gbps"], 4)
     except Exception as e:                      # noqa: BLE001
         extra["sharded_swapfree_gather_false_error"] = str(e)[:200]
 

@@ -282,7 +282,50 @@ def _fmt(n, m, pr, pc, chip, group=1, swapfree=False):
             f"{r['efficiency']*100:5.0f}% |")
 
 
-def main():
+def projection_rows() -> list:
+    """The north-star projections as structured rows (ISSUE 14
+    satellite): every future calibration round re-emits THIS one
+    artifact from ``topology_params()`` (``--comm-project``) and diffs
+    it, instead of hand-running the table and eyeballing — the
+    4×8@32768 79.9 ms / 8×8@65536 244.7 ms numbers quoted around the
+    repo are rows of this list, regenerable on demand."""
+    chips = topology_params()["chips"]
+    rows = []
+    for n, m, pr, pc, chip_name, g, sf in NORTH_STAR_ROWS:
+        r = predict(n, m, pr, pc, chips[chip_name], group=g, swapfree=sf)
+        rows.append({
+            "n": n, "m": m, "pr": pr, "pc": pc, "chip": chip_name,
+            "group": g, "swapfree": sf,
+            "elim_ms": round(r["elim"] * 1e3, 1),
+            "probe_ms": round(r["probe"] * 1e3, 1),
+            "comm_ms": round(r["comm"] * 1e3, 1),
+            "glue_ms": round(r["glue"] * 1e3, 1),
+            "total_ms": round(r["total"] * 1e3, 1),
+            "gflops": round(2.0 * n**3 / r["total"] / 1e9, 1),
+            "efficiency": round(r["efficiency"], 4),
+        })
+    return rows
+
+
+def main(argv=None):
+    import json
+    import sys
+
+    argv = sys.argv[1:] if argv is None else argv
+    if "--comm-project" in argv:
+        # ONE diffable JSON artifact re-emitted from topology_params()
+        # — the calibration-round workflow (ISSUE 14 satellite).
+        params = topology_params()
+        print(json.dumps({
+            "metric": "comm_projection",
+            "chips": {name: {"mxu_f32": c.mxu_f32, "hbm": c.hbm,
+                             "ici": c.ici, "vpu_scale": c.vpu_scale}
+                      for name, c in params["chips"].items()},
+            "latency_s": params["latency"],
+            "c_probe_v5e": params["c_probe_v5e"],
+            "rows": projection_rows(),
+        }))
+        return
     print("Sanity: single-chip v5e model vs measured 78.7 ms @ 8192 m=256")
     r = predict(8192, 256, 1, 1, V5E)
     print({k: round(v * 1e3, 1) for k, v in r.items() if k != "efficiency"})

@@ -343,3 +343,62 @@ class TestStructure:
             [("r1", _round(10000.0)), ("r2", _round(10000.0))])
         assert not regs2
         assert any("unknown" in n for n in notes2)
+
+
+class TestCommSentinel:
+    """ISSUE 14 satellite, trapped both ways: the distributed rows'
+    ``*_comm_bytes`` accounting fields are never compared cross-round
+    (a dtype/layout change re-prices the same solve), while a quiet
+    ``*_comm_gbps`` RATE shortfall — the mesh bandwidth sentinel —
+    pages exactly like a gflops one."""
+
+    def test_comm_bytes_accounting_never_pages(self, tmp_path):
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "sharded_swapfree_2048_comm_bytes": 4.1e7,
+                "invert_4096_spread_pct": 1.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "sharded_swapfree_2048_comm_bytes": 4.1e8,
+                "invert_4096_spread_pct": 1.0})),
+        ]
+        assert check_bench.main(files) == 0
+        assert check_bench.is_accounting_key(
+            "sharded_swapfree_2048_comm_bytes")
+        keys = check_bench.comparable_keys(
+            {"metric": "m", "value": 1.0,
+             "extra": {"sharded_swapfree_2048_comm_bytes": 4.1e7,
+                       "sharded_swapfree_2048_comm_gbps": 3.5}})
+        assert "sharded_swapfree_2048_comm_bytes" not in keys
+        assert "sharded_swapfree_2048_comm_gbps" in keys
+
+    def test_comm_gbps_quiet_shortfall_pages(self, tmp_path):
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "sharded_swapfree_2048_comm_gbps": 3.5,
+                "sharded_swapfree_2048_comm_spread_pct": 2.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "sharded_swapfree_2048_comm_gbps": 2.1,
+                "sharded_swapfree_2048_comm_spread_pct": 2.0})),
+        ]
+        assert check_bench.main(files) == 2
+
+    def test_comm_gbps_variance_and_unknown_rules_hold(self, tmp_path):
+        """A noisy session explains its own GB/s dip; a round without
+        spread stats (the single-run subprocess leg) is unknown, never
+        paged — and the exact-stem lookup binds the _gbps row's own
+        spread key."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "sharded_swapfree_2048_comm_gbps": 3.5})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "sharded_swapfree_2048_comm_gbps": 2.1,
+                "sharded_swapfree_2048_comm_spread_pct": 30.0})),
+        ]
+        assert check_bench.main(files) == 0
+        files[1] = _write(tmp_path, "r2b.json", _round(10000.0, {
+            "sharded_swapfree_2048_comm_gbps": 2.1}))
+        assert check_bench.main(files) == 0
+        row = {"extra": {"sharded_swapfree_2048_comm_spread_pct": 2.5}}
+        spread, _ = check_bench._variance_context(
+            "sharded_swapfree_2048_comm_gbps", row)
+        assert spread == 2.5

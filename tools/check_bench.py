@@ -77,14 +77,22 @@ def load_round(path: str) -> dict | None:
     return None
 
 
-#: Accounting-class key suffixes (ISSUE 10/13): numbers that describe
-#: WHAT the compiler or the capacity ledger counted, not how fast the
-#: same execution ran — ``*_xla_gflops`` (compiler flop recounts) and
-#: the ``*_bytes`` capacity fields (``peak_hbm_bytes`` /
-#: ``resident_handle_bytes``: a jaxlib layout change, or a dtype/bucket
-#: change, re-prices the same execution).  Never compared across
-#: rounds — the first-call separation principle applied to accounting.
+#: Accounting-class key suffixes (ISSUE 10/13/14): numbers that
+#: describe WHAT the compiler, the capacity ledger, or the comm
+#: observatory counted, not how fast the same execution ran —
+#: ``*_xla_gflops`` (compiler flop recounts) and the ``*_bytes``
+#: fields (``peak_hbm_bytes`` / ``resident_handle_bytes`` /
+#: ``*_comm_bytes``: a jaxlib layout change, a dtype/bucket change, or
+#: a collective-inventory change re-prices the same execution).  Never
+#: compared across rounds — the first-call separation principle
+#: applied to accounting.
 ACCOUNTING_SUFFIXES = ("_xla_gflops", "_bytes")
+
+#: Rate-class suffixes: slope-derived achieved rates on the cached
+#: executable — the keys the sentinel compares and pages on.
+#: ``*_gbps`` (ISSUE 14: achieved interconnect GB/s, the mesh
+#: bandwidth sentinel) pages exactly like a ``*_gflops`` shortfall.
+RATE_SUFFIXES = ("_gflops", "_gbps")
 
 
 def is_accounting_key(key: str) -> bool:
@@ -93,18 +101,18 @@ def is_accounting_key(key: str) -> bool:
 
 def comparable_keys(row: dict) -> dict[str, float]:
     """The steady-state rate keys of one round: the headline ``value``
-    (under its metric name) plus every numeric ``*_gflops`` extra.
-    First-call keys never appear here by construction, and neither do
-    the accounting-class rows (:func:`is_accounting_key`): the
-    ``*_xla_gflops`` recounts and the ``*_bytes`` capacity fields
-    describe the same execution differently priced — a compiler or
-    accounting change must not page as an execution regression (the
+    (under its metric name) plus every numeric ``*_gflops``/``*_gbps``
+    extra.  First-call keys never appear here by construction, and
+    neither do the accounting-class rows (:func:`is_accounting_key`):
+    the ``*_xla_gflops`` recounts and the ``*_bytes`` capacity/comm
+    fields describe the same execution differently priced — a compiler
+    or accounting change must not page as an execution regression (the
     same separation principle that keeps first-call times out)."""
     out = {}
     if isinstance(row.get("value"), (int, float)):
         out[str(row.get("metric", "value"))] = float(row["value"])
     for k, v in (row.get("extra") or {}).items():
-        if (k.endswith("_gflops") and not is_accounting_key(k)
+        if (k.endswith(RATE_SUFFIXES) and not is_accounting_key(k)
                 and isinstance(v, (int, float))):
             out[k] = float(v)
     return out
@@ -138,11 +146,12 @@ def _variance_context(key: str, row: dict) -> tuple[float | None, bool]:
     None = the round recorded no robust-capture stats for this row
     (pre-ISSUE-4 rounds) — unknown, not quiet."""
     extra = row.get("extra") or {}
-    if key.endswith("_gflops"):
-        stem = key[:-len("_gflops")]
-        if f"{stem}_spread_pct" in extra:
-            return (float(extra[f"{stem}_spread_pct"]),
-                    bool(extra.get(f"{stem}_variance_flag")))
+    for suffix in RATE_SUFFIXES:
+        if key.endswith(suffix):
+            stem = key[:-len(suffix)]
+            if f"{stem}_spread_pct" in extra:
+                return (float(extra[f"{stem}_spread_pct"]),
+                        bool(extra.get(f"{stem}_variance_flag")))
     m = _N_RE.search(key)
     n_tok = m.group(1) if m else None
     if n_tok is not None and f"spread_pct_{n_tok}" in extra:

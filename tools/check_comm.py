@@ -1,0 +1,240 @@
+#!/usr/bin/env python
+"""Validate a ``--comm-demo`` report (ISSUE 14).
+
+Usage: ``python tools/check_comm.py report.json`` (or ``-`` for
+stdin).  No jax import — this is the ``make comm-demo`` gate and runs
+anywhere.
+
+What a valid communication-observatory report must prove
+(docs/OBSERVABILITY.md):
+
+  * **the reconciliation invariant** — on every reconciliation leg
+    (1D and 2D meshes, both gather modes, a grouped engine, a RAGGED
+    problem size), the multiset of collectives the traced program
+    actually issued (the compat-shim recording layer: kind × mesh axis
+    × operand shape × dtype) EQUALS the layout-derived analytical
+    inventory.  The checker re-derives the comparison from the
+    report's own raw data — it never trusts the ``reconciled`` flag:
+    an observed collective the model does not predict is an
+    UNACCOUNTED collective; a predicted collective the trace never
+    issued is a stripped/phantom entry.  Both are the exit-2 class.
+  * **totals honesty** — the per-leg byte/message totals re-derive
+    from the signature list (shape × dtype width × launches), so a
+    report cannot claim totals its own inventory does not add up to.
+  * **no silent drift** — the drift leg is judged; when its
+    measured/projected ratio sits outside the stated band, a
+    ``comm_drift`` event MUST exist in the embedded flight-recorder
+    slice and the report's drift counters must agree.  An out-of-band
+    ratio with no recorded event is a silent drift — exit 2.
+  * the embedded black-box slice is gap-free (``dropped == 0``) and
+    ``silent_comm`` agrees with the re-derivation.
+
+Exit taxonomy (the check_fleet/check_slo convention): 0 = valid,
+1 = unreadable/structurally invalid, 2 = an unaccounted collective or
+a silent drift.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+#: dtype widths for total re-derivation (the report's own
+#: payload_bytes is cross-checked against these; an unknown dtype is a
+#: structural error — the analytical model only emits these).
+_ITEMSIZE = {
+    "float32": 4, "float64": 8, "float16": 2, "bfloat16": 2,
+    "int32": 4, "int64": 8, "complex64": 8, "complex128": 16,
+}
+
+
+def _nelems(shape) -> int:
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+def _sig_key(d: dict) -> tuple:
+    return (d["kind"], d["axis"], tuple(d["shape"]), d["dtype"])
+
+
+def _check_leg(name: str, comm: dict, *, require_engine_observed: bool,
+               errs: list, silent: list) -> None:
+    sigs = comm.get("sigs") or []
+    # -- totals re-derive from the inventory -------------------------
+    payload = explicit = messages = 0
+    for s in sigs:
+        if s["dtype"] not in _ITEMSIZE:
+            errs.append(f"{name}: unknown dtype {s['dtype']!r} in "
+                        f"inventory")
+            continue
+        nb = _nelems(s["shape"]) * _ITEMSIZE[s["dtype"]]
+        if nb != s.get("payload_bytes"):
+            errs.append(f"{name}: sig {s['kind']}@{s['axis']} "
+                        f"{s['shape']} claims {s.get('payload_bytes')} "
+                        f"payload bytes, shape x width says {nb}")
+        payload += nb * s["executed"]
+        if not s.get("implicit"):
+            explicit += nb * s["executed"]
+            messages += s["executed"]
+    tot = comm.get("totals") or {}
+    if tot.get("payload_bytes") != payload:
+        errs.append(f"{name}: totals.payload_bytes "
+                    f"{tot.get('payload_bytes')} != inventory sum "
+                    f"{payload}")
+    if tot.get("messages") != messages:
+        errs.append(f"{name}: totals.messages {tot.get('messages')} "
+                    f"!= inventory sum {messages}")
+
+    # -- the reconciliation invariant, re-derived ---------------------
+    observed = comm.get("observed") or {}
+    judged_engine = False
+    for section, recs in observed.items():
+        if recs is None:
+            continue            # trace-cache hit: honestly un-judged
+        if section == "engine":
+            judged_engine = True
+        want: dict[tuple, int] = {}
+        for s in sigs:
+            if (s.get("section") == section and not s.get("implicit")
+                    and s["traced"]):
+                k = _sig_key(s)
+                want[k] = want.get(k, 0) + s["traced"]
+        got: dict[tuple, int] = {}
+        for r in recs:
+            k = _sig_key(r)
+            got[k] = got.get(k, 0) + int(r["count"])
+        for k in sorted(set(want) | set(got), key=str):
+            w, g = want.get(k, 0), got.get(k, 0)
+            if g > w:
+                silent.append(
+                    f"{name}/{section}: UNACCOUNTED collective "
+                    f"{k[0]}@{k[1]} {list(k[2])} {k[3]}: observed {g} "
+                    f"vs analytical {w}")
+            elif w > g:
+                silent.append(
+                    f"{name}/{section}: stripped/phantom collective "
+                    f"{k[0]}@{k[1]} {list(k[2])} {k[3]}: analytical "
+                    f"{w} vs observed {g}")
+    if require_engine_observed and not judged_engine:
+        errs.append(f"{name}: engine section was never observed (the "
+                    f"reconciliation legs must capture a fresh trace)")
+    if comm.get("reconciled") is not True and require_engine_observed:
+        errs.append(f"{name}: reconciled={comm.get('reconciled')!r} "
+                    f"(must be strictly true on a reconciliation leg)")
+
+
+def check(report: dict) -> tuple[list[str], list[str]]:
+    """Returns ``(errs, silent)``: structural violations (exit 1) and
+    the exit-2 unaccounted-collective / silent-drift class."""
+    errs: list[str] = []
+    silent: list[str] = []
+    if report.get("metric") != "comm_demo":
+        return ([f"not a comm_demo report "
+                 f"(metric={report.get('metric')!r})"], [])
+    if not report.get("ragged"):
+        errs.append("demo problem size is not ragged (n % m == 0): "
+                    "the padded-tail inventory was never exercised")
+
+    legs = report.get("legs") or []
+    if len(legs) < 4:
+        errs.append(f"only {len(legs)} reconciliation legs; need 1D + "
+                    f"2D, both gather modes")
+    seen = set()
+    for leg in legs:
+        comm = leg.get("comm") or {}
+        mesh = comm.get("mesh", "")
+        seen.add(("2d" if "x" in mesh else "1d",
+                  bool(comm.get("gather"))))
+        _check_leg(leg.get("name", "?"), comm,
+                   require_engine_observed=True, errs=errs,
+                   silent=silent)
+    for want in (("1d", True), ("1d", False), ("2d", True),
+                 ("2d", False)):
+        if want not in seen:
+            errs.append(f"missing reconciliation coverage: "
+                        f"{want[0]} gather={want[1]}")
+
+    # -- drift leg ----------------------------------------------------
+    drift_leg = report.get("drift_leg") or {}
+    dcomm = drift_leg.get("comm") or {}
+    _check_leg(drift_leg.get("name", "drift"), dcomm,
+               require_engine_observed=False, errs=errs, silent=silent)
+    drift = dcomm.get("drift") or {}
+    if not drift.get("judged"):
+        errs.append("drift leg was not judged (set_drift_policy("
+                    "judge='always') is the demo's contract)")
+    ratio = drift.get("comm_vs_projected")
+    band = drift.get("band") or [0, 0]
+    out_of_band = (isinstance(ratio, (int, float))
+                   and not (band[0] <= ratio <= band[1]))
+    if out_of_band != bool(drift.get("out_of_band")):
+        errs.append(f"drift leg out_of_band={drift.get('out_of_band')}"
+                    f" disagrees with ratio {ratio} vs band {band}")
+    events = [e for e in (report.get("blackbox") or {}).get(
+        "events", []) if e.get("kind") == "comm_drift"]
+    if drift.get("judged") and out_of_band:
+        if not events:
+            silent.append(
+                f"SILENT DRIFT: measured/projected ratio {ratio} is "
+                f"outside the band {band} but no comm_drift event was "
+                f"recorded in the flight-recorder slice")
+        if not drift.get("event_recorded"):
+            silent.append("drift record claims event_recorded=false "
+                          "for an out-of-band judged ratio")
+    if report.get("drift_events") != len(events):
+        errs.append(f"report drift_events={report.get('drift_events')} "
+                    f"!= {len(events)} comm_drift events in the slice")
+
+    bb = report.get("blackbox") or {}
+    if bb.get("dropped", 1) != 0:
+        errs.append(f"flight-recorder slice dropped "
+                    f"{bb.get('dropped')} events — reconstruction has "
+                    f"gaps")
+    if bool(report.get("silent_comm")) != bool(silent):
+        errs.append(f"report silent_comm={report.get('silent_comm')} "
+                    f"disagrees with the re-derived verdict "
+                    f"({len(silent)} violations)")
+    return errs, silent
+
+
+def main(argv) -> int:
+    if not argv:
+        print("usage: check_comm.py report.json [...]", file=sys.stderr)
+        return 1
+    rc = 0
+    for path in argv:
+        try:
+            if path == "-":
+                report = json.load(sys.stdin)
+            else:
+                with open(path) as f:
+                    report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"FAIL {path}: unreadable report ({e})",
+                  file=sys.stderr)
+            return 1
+        errs, silent = check(report)
+        for msg in errs:
+            print(f"FAIL {path}: {msg}", file=sys.stderr)
+        for msg in silent:
+            print(f"SILENT {path}: {msg}", file=sys.stderr)
+        if silent:
+            rc = max(rc, 2)
+        elif errs:
+            rc = max(rc, 1)
+        else:
+            legs = report.get("legs") or []
+            drift = ((report.get("drift_leg") or {}).get("comm")
+                     or {}).get("drift") or {}
+            print(f"OK {path}: {len(legs)} legs reconciled "
+                  f"(observed == analytical), drift ratio "
+                  f"{drift.get('comm_vs_projected'):.3g} "
+                  f"{'recorded' if drift.get('event_recorded') else 'in band'}, "
+                  f"{report.get('drift_events')} comm_drift event(s)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -65,6 +65,22 @@ def _write_capacity(path) -> None:
         print(f"warning: capacity report failed: {e}", file=sys.stderr)
 
 
+def _write_comm(path) -> None:
+    """``--comm-report`` emission — the process-wide communication
+    snapshot (obs/comm.py: the last distributed solve's per-phase
+    collective accounting + reconciliation/drift record and the
+    tpu_jordan_comm_* counters), written on every exit path with the
+    same never-mask-the-exit-code discipline as ``_write_telemetry``."""
+    if not path:
+        return
+    try:
+        from .obs.comm import write_report
+
+        write_report(path)
+    except OSError as e:
+        print(f"warning: comm report failed: {e}", file=sys.stderr)
+
+
 def _write_blackbox(path) -> None:
     """Dump the always-on flight recorder (ISSUE 8): on demand via
     ``--blackbox-out``, and AUTOMATICALLY on every exit-2 path — the
@@ -329,6 +345,32 @@ def _main(argv, state) -> int:
                          "tools/check_capacity.py validates).  n is "
                          "the handle size, m the block size; "
                          "--chaos-seed seeds the fixtures")
+    ap.add_argument("--comm-demo", action="store_true",
+                    help="run the communication-observatory acceptance "
+                         "demo (tpu_jordan.obs.comm.comm_demo; "
+                         "ISSUE 14, docs/OBSERVABILITY.md): five tiny "
+                         "distributed solves — 1D and 2D meshes, both "
+                         "gather modes, a grouped engine, a ragged "
+                         "problem size — each reconciling the "
+                         "collective multiset the traced program "
+                         "actually issued (the compat-shim recording "
+                         "layer) against the layout-derived analytical "
+                         "inventory, plus one deliberate "
+                         "measured-vs-projected drift leg whose "
+                         "out-of-band ratio must be a RECORDED "
+                         "comm_drift event; prints ONE JSON line "
+                         "(exit 2 = an unaccounted collective or a "
+                         "silent drift; tools/check_comm.py "
+                         "validates).  n is the problem size, m the "
+                         "block size; runs on a forced 8-device "
+                         "virtual CPU mesh when needed")
+    ap.add_argument("--comm-report", default=None, metavar="PATH",
+                    help="write the process-wide communication "
+                         "snapshot (the last distributed solve's "
+                         "per-phase collective accounting + "
+                         "reconciliation/drift record and the "
+                         "tpu_jordan_comm_* counters) as one JSON "
+                         "document on exit (docs/OBSERVABILITY.md)")
     ap.add_argument("--capacity-report", default=None, metavar="PATH",
                     help="write the process-wide capacity snapshot "
                          "(tpu_jordan_capacity_*: resident handles, "
@@ -493,6 +535,76 @@ def _main(argv, state) -> int:
             raise UsageError("--generator crand is complex-valued; a "
                              "real --dtype would silently discard the "
                              "imaginary part (use --dtype complex64)")
+        if args.comm_demo:
+            # Comm demo (ISSUE 14): the capacity-demo restriction
+            # shape (fixed internal legs, deterministic fixtures) and
+            # the same 0/1/2 taxonomy — exit 2 IS the
+            # unaccounted-collective / silent-drift alarm.
+            if (args.serve_demo or args.chaos_demo or args.fleet_demo
+                    or args.numerics_demo or args.update_demo
+                    or args.capacity_demo):
+                raise UsageError("--comm-demo, --capacity-demo, "
+                                 "--update-demo, --fleet-demo, "
+                                 "--chaos-demo, --serve-demo and "
+                                 "--numerics-demo are distinct modes; "
+                                 "pick one")
+            if args.file is not None or args.workers != 1 or not args.gather:
+                raise UsageError(
+                    "--comm-demo builds its own 1D/2D meshes (forced "
+                    "virtual CPU devices when needed); file input, "
+                    "--workers and --no-gather do not apply")
+            if args.batch > 1 or args.tune or args.group != 0:
+                raise UsageError("--comm-demo takes no "
+                                 "--batch/--tune/--group")
+            if args.engine != "auto" or args.refine:
+                raise UsageError("--comm-demo runs a fixed engine-leg "
+                                 "set (inplace/grouped/swapfree, both "
+                                 "layouts); --engine/--refine do not "
+                                 "apply")
+            if args.workload != "invert":
+                raise UsageError("--comm-demo reconciles the "
+                                 "distributed invert engines; "
+                                 "--workload does not apply")
+            if args.numerics != "off":
+                raise UsageError("--comm-demo's reconciliation "
+                                 "semantics are pinned; --numerics "
+                                 "does not apply")
+            if args.slo_report or args.plan_cache is not None:
+                raise UsageError("--slo-report/--plan-cache do not "
+                                 "apply to --comm-demo")
+            if (args.serve_requests != 64 or args.batch_cap != 8
+                    or args.max_wait_ms != 2.0):
+                raise UsageError("--comm-demo runs driver solves, not "
+                                 "the service; --serve-requests/"
+                                 "--batch-cap/--max-wait-ms do not "
+                                 "apply")
+            if (args.replicas != 3 or args.kills != 2
+                    or args.scaling_floor is not None):
+                raise UsageError("--replicas/--kills/--scaling-floor "
+                                 "are --fleet-demo/--update-demo "
+                                 "flags; --comm-demo runs one process")
+            import json as _json
+
+            from .obs.comm import comm_demo
+
+            # --dtype / --generator are honored, not dropped: the
+            # inventories' byte figures scale with dtype width, so a
+            # float64 demo reconciles float64 inventories (complex is
+            # a typed refusal inside comm_demo — distributed engines
+            # are real-dtype).
+            report = comm_demo(n=args.n, block_size=args.m,
+                               seed=args.chaos_seed,
+                               dtype=jnp.dtype(args.dtype),
+                               generator=args.generator)
+            print(_json.dumps(report))
+            if report["silent_comm"]:
+                print(f"silent communication accounting violation: "
+                      f"unreconciled={report['unreconciled']}, "
+                      f"mismatches={len(report['mismatches'])}, "
+                      f"drift_events={report['drift_events']}",
+                      file=sys.stderr)
+                return 2
+            return 0
         if args.capacity_demo:
             # Capacity demo (ISSUE 13): the numerics-demo restriction
             # shape (single device, deterministic seeded fixtures,
@@ -964,6 +1076,7 @@ def _main(argv, state) -> int:
     finally:
         _write_telemetry(args.metrics_out, args.trace_json, telemetry)
         _write_capacity(args.capacity_report)
+        _write_comm(args.comm_report)
     if args.quiet:
         print(f"glob_time: {result.elapsed:.2f}")
         print(f"residual: {result.residual:e}")

@@ -85,6 +85,12 @@ class SolveResult:
     #   watermark, verified residual — mirrored into the metrics
     #   registry and spiking into the flight recorder BEFORE any
     #   recovery rung.  None at the "off" default (zero cost).
+    comm: object | None = None  # obs.comm.CommReport on every
+    #   DISTRIBUTED solve (ISSUE 14): the layout-derived per-phase
+    #   collective byte/message accounting, the observed-vs-analytical
+    #   reconciliation when obs.comm.recording() was active around the
+    #   solve, and the measured-vs-projected drift record.  None on
+    #   single-device solves (no collectives to account).
 
     @property
     def rel_residual(self) -> float | None:
@@ -1176,6 +1182,7 @@ def _solve_distributed_core(
     runs on the gathered inverse and therefore requires ``gather=True``
     (and, for file input, one full host read).
     """
+    from .obs import comm as _comm
     from .ops import newton_schulz
 
     if refine and not gather:
@@ -1208,9 +1215,27 @@ def _solve_distributed_core(
                      else generate(generator, (min(n, 10), min(n, 10)),
                                    dtype))
 
+    # The communication observatory (ISSUE 14): the layout-derived
+    # analytical collective accounting is built for EVERY distributed
+    # solve (host-side index math, no device cost); the observed
+    # trace-time counts are captured only under obs.comm.recording().
+    eng_name = engine or ("swapfree" if be.swapfree
+                          else "grouped" if be.group > 1
+                          else "inplace" if be.inplace else "augmented")
+    comm_rep = _comm.engine_report(
+        engine=eng_name, lay=be.lay, dtype=dtype, gather=gather,
+        refine=refine, group=be.group)
+
     with tel.span("compile", engine=engine, n=n) as csp:
         def _compile():
             _faults.fire("compile")
+            if _comm.recording_active():
+                with _comm.record_collectives() as rec:
+                    run = be.compile(W, precision)
+                # .lower() re-traces per call, so a compile always
+                # yields a fresh observed multiset to reconcile.
+                comm_rep.attach_observed("engine", rec.records)
+                return run
             return be.compile(W, precision)
         run = (policy.retry.call(_compile, component="solve.compile")
                if policy is not None else _compile())
@@ -1229,6 +1254,14 @@ def _solve_distributed_core(
     attribute_phases(esp, n, be.lay.m, distributed=True)
     _hwcost.attach_execute_cost(esp, exe_cost,
                                 analytical_flops=2.0 * float(n) ** 3)
+    # Per-solve comm accounting on the execute span + the registry
+    # counters, and the measured-vs-projected drift verdict (judged
+    # only where the projection claims to describe the hardware —
+    # obs/comm.DriftPolicy).
+    comm_rep.observe_metrics()
+    comm_rep.attach_span(esp)
+    _comm.observe_drift(comm_rep, elapsed, esp)
+    _comm.set_last_report(comm_rep)
     singular_flag = bool(singular.any())
     _solve_metrics(n, elapsed, esp, singular=singular_flag)
     if singular_flag:
@@ -1271,7 +1304,16 @@ def _solve_distributed_core(
                    if file is not None
                    else be.generate_a_blocks(generator, dtype))
             inv_bf = jnp.asarray(inv_b, dtype)
-            residual = float(be.residual(a_b, inv_bf))
+            if _comm.recording_active():
+                # The ring-GEMM / SUMMA verification's collectives are
+                # their own reconciliation section; an empty capture
+                # (the residual executable was jit-cache-hit, nothing
+                # re-traced) leaves the section un-judged.
+                with _comm.record_collectives() as rrec:
+                    residual = float(be.residual(a_b, inv_bf))
+                comm_rep.attach_observed("residual", rrec.records)
+            else:
+                residual = float(be.residual(a_b, inv_bf))
             norm_a = float(be.inf_norm_blocks(a_b))
             kappa = norm_a * float(be.inf_norm_blocks(inv_bf))
 
@@ -1298,4 +1340,5 @@ def _solve_distributed_core(
         layout=None if gather else be.lay,
         kappa=kappa,
         _norm_a=norm_a,
+        comm=comm_rep,
     )

@@ -87,6 +87,8 @@ class JordanSolver:
     policy: Any = None
     plan: Any = field(default=None, repr=False)
     cost: Any = field(default=None, repr=False)  # hwcost.ExecutableCost
+    comm: Any = field(default=None, repr=False)  # obs.comm.CommReport
+    #   (distributed solvers only, built at compile; ISSUE 14)
     _run: Any = field(default=None, repr=False)
     _be: Any = field(default=None, repr=False)
 
@@ -163,10 +165,31 @@ class JordanSolver:
         from ..driver import _record_compile
         from ..resilience import faults as _faults
 
+        if self._distributed:
+            # The communication observatory (ISSUE 14): the analytical
+            # per-phase collective accounting for the cached
+            # executable, with observed-vs-analytical reconciliation
+            # when obs.comm.recording() wraps the compile — the same
+            # record driver solves carry on SolveResult.comm.
+            from ..obs import comm as _comm
+
+            self.comm = _comm.engine_report(
+                engine=self.engine, lay=self._be.lay,
+                dtype=self._work_dtype, gather=self.gather,
+                refine=self.refine, group=self.group)
+
         with self._tel.span("compile", engine=self.engine, n=self.n) as csp:
             def compile_once():
                 _faults.fire("compile")
                 if self._distributed:
+                    from ..obs import comm as _comm
+
+                    if _comm.recording_active():
+                        with _comm.record_collectives() as rec:
+                            run = self._be.compile(sample,
+                                                   self._sweep_prec)
+                        self.comm.attach_observed("engine", rec.records)
+                        return run
                     return self._be.compile(sample, self._sweep_prec)
                 from ..driver import single_device_invert
 
@@ -210,6 +233,17 @@ class JordanSolver:
                 esp, self.cost if self.cost is not None
                 else _hwcost.UNAVAILABLE,
                 analytical_flops=2.0 * float(self.n) ** 3)
+            if self.comm is not None:
+                from ..obs import comm as _comm
+
+                # Per-launch comm accounting + drift, same as the
+                # driver's distributed core (ISSUE 14).  The residual
+                # section is NOT counted here: the solver's invert()
+                # never runs the ring/SUMMA pass — residual() counts
+                # it when (and only when) it really executes.
+                self.comm.observe_metrics(sections=("engine", "gather"))
+                self.comm.attach_span(esp)
+                _comm.observe_drift(self.comm, esp.duration, esp)
             return out
 
         return (self.policy.retry.call(run_once, component="solver.execute")
@@ -289,4 +323,9 @@ class JordanSolver:
                 jnp.asarray(inv, self._work_dtype))
         else:
             inv_blocks = jnp.asarray(inv, self._work_dtype)
-        return float(self._be.residual(a_blocks, inv_blocks))
+        out = float(self._be.residual(a_blocks, inv_blocks))
+        if self.comm is not None:
+            # The ring/SUMMA verification really ran: count ITS
+            # section now (invert() deliberately does not — ISSUE 14).
+            self.comm.observe_metrics(sections=("residual",))
+        return out

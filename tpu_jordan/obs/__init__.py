@@ -28,6 +28,16 @@ ISSUE 10 adds the numerics-and-hardware observatory:
     ``tpu_jordan_executable_*`` gauges, device live-bytes watermarks,
     and the ``runtime_env`` fingerprint BENCH rows record.
 
+ISSUE 14 adds the communication observatory:
+
+  * ``comm`` — layout-derived per-superstep collective accounting for
+    every distributed engine (bytes/messages by phase and kind, on
+    execute spans, ``tpu_jordan_comm_*`` counters and
+    ``SolveResult.comm``), the trace-time ``observed == analytical``
+    reconciliation behind ``parallel/compat.py``'s collective shims,
+    and measured-vs-projected drift against ``benchmarks/comm_model``
+    (``comm_drift`` events, ``tools/check_comm.py``).
+
 ISSUE 8 adds the request-scoped triad:
 
   * ``journey`` — per-request journey tracing: a deterministic
@@ -43,7 +53,10 @@ ISSUE 8 adds the request-scoped triad:
 Operator guide: ``docs/OBSERVABILITY.md``.
 """
 
-from . import export, hwcost, journey, metrics, numerics, recorder, slo, spans
+from . import (comm, export, hwcost, journey, metrics, numerics,
+               recorder, slo, spans)
+from .comm import (CommReport, comm_demo, engine_report,
+                   record_collectives, recording)
 from .export import (profiler_trace, to_chrome_trace, to_json_line,
                      to_prometheus, write_chrome_trace, write_metrics)
 from .hwcost import (ExecutableCost, attach_execute_cost,
@@ -60,8 +73,10 @@ from .spans import (NULL, NullTelemetry, Span, Telemetry,
                     timed_blocking)
 
 __all__ = [
-    "export", "hwcost", "journey", "metrics", "numerics", "recorder",
-    "slo", "spans",
+    "comm", "export", "hwcost", "journey", "metrics", "numerics",
+    "recorder", "slo", "spans",
+    "CommReport", "comm_demo", "engine_report", "record_collectives",
+    "recording",
     "profiler_trace", "to_chrome_trace", "to_json_line", "to_prometheus",
     "write_chrome_trace", "write_metrics",
     "ExecutableCost", "attach_execute_cost", "executable_cost",

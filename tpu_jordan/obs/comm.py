@@ -1,0 +1,1081 @@
+"""The communication observatory (ISSUE 14 tentpole).
+
+The paper's distributed core IS communication — the pivot-row
+broadcast (main.cpp:1097), the cross-worker row exchange
+(main.cpp:1093-1131), and the ring-shifted verification GEMM
+(main.cpp:534-641) — yet until this module the observability stack
+(spans, journeys, numerics, hwcost, capacity) was blind to the
+collective layer.  arXiv:2112.09017's achieved-vs-peak accounting
+discipline, already applied to FLOPs in ``obs/hwcost.py``, applies
+equally to interconnect bytes.  Three parts:
+
+1. **Analytical collective accounting** — for every distributed engine
+   configuration, the per-superstep collective inventory (kind, mesh
+   axis, operand shape, dtype) is derived EXACTLY from the layout math
+   (``parallel/layout.py`` shard geometry × dtype width): the pivot
+   reduction and H broadcast, the pivot-row psum, the row-exchange
+   psum (or the swap-free engines' deferred bucketed-ppermute rounds),
+   the 2D panel broadcast / swap fix-up / unscramble psums, the
+   ring-GEMM / SUMMA residual collectives, and the implicit XLA gather.
+   Attached to every distributed execute span, exported as
+   ``tpu_jordan_comm_{bytes,messages}_total{phase=,collective=}``, and
+   returned on ``SolveResult.comm``.
+
+2. **Collective instrumentation** — ``parallel/compat.py``'s
+   psum/pmin/pmax/ppermute shims note every collective the engines
+   issue at TRACE time (off = one list-truthiness check per traced
+   collective, zero warm-path cost, zero-compile pins intact).  With
+   :func:`recording` active, the driver captures the observed multiset
+   during each AOT compile and pins ``observed == analytical`` — the
+   reconciliation invariant (an engine issuing a collective the model
+   does not predict, or vice versa, is a typed mismatch, never a
+   silent drift of the accounting from the code).
+
+3. **Measured-vs-projected drift** — distributed execute spans gain
+   achieved interconnect GB/s (modeled wire bytes over the measured
+   non-compute residue) and a ``comm_vs_projected`` ratio against
+   ``benchmarks/comm_model.py``'s comm term for the same topology
+   point.  A ratio outside the model's stated accuracy band is a
+   ``comm_drift`` flight-recorder event plus a
+   ``tpu_jordan_comm_drift_total`` count — judged only where the
+   projection claims to describe the hardware (a real TPU backend, or
+   an explicit ``set_drift_policy(judge="always")``; on CPU meshes the
+   v5e constants are a RANKING stand-in, per tuning/registry.py, and
+   the honest ratio is recorded unjudged).  Judged measurements also
+   feed the optional registry cost-hook calibration
+   (:func:`cost_comm_scale` — ROADMAP item 5's self-pricing loop).
+
+Byte conventions (both derived, both labeled):
+
+  * ``payload_bytes`` — the collective operand's exact size (shape ×
+    dtype width): the reconciliation unit, layout-exact.
+  * ``wire_bytes`` — the modeled on-link traffic: ring all-reduce of S
+    payload bytes over an axis of a devices moves S·(a−1)/a per
+    direction (benchmarks/comm_model.py's convention); a single-hop
+    ppermute ships its whole buffer once.  The GB/s headline unit.
+
+Operator guide: docs/OBSERVABILITY.md (comm taxonomy + metric table +
+drift post-mortem howto).  Gate: ``make comm-demo`` →
+``tools/check_comm.py`` (exit 2 = an unaccounted collective or a
+silent drift).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import math
+import threading
+from collections import Counter
+from dataclasses import dataclass, field
+
+from . import metrics as _metrics
+from . import recorder as _recorder
+
+#: Phase vocabulary (docs/OBSERVABILITY.md): where in the superstep the
+#: bytes move.  ``pivot`` = the scalar pivot reduction + the H
+#: broadcast; ``row_bcast`` = the pivot-row psum (the grouped engines'
+#: stacked psum — both rows + U rows + the eager t-block fused into one
+#: collective — lands here too); ``row_exchange`` = the swap engines'
+#: row-t broadcast and the 2D swap fix-up; ``panel_bcast`` = the 2D
+#: t-chunk broadcast along "pc" (candidates AND eliminate multipliers —
+#: one psum serves both); ``permute`` = the swap-free engines' deferred
+#: bucketed-ppermute rounds; ``unscramble`` = the 2D column-swap replay
+#: psums; ``residual`` = the ring-GEMM / SUMMA verification;
+#: ``gather`` = the XLA-implicit all-gather of a gathered inverse
+#: (modeled — not visible to the shims; ``implicit=True``).
+PHASES = ("pivot", "row_bcast", "row_exchange", "panel_bcast",
+          "permute", "unscramble", "residual", "gather")
+
+_M_BYTES = _metrics.counter(
+    "tpu_jordan_comm_bytes_total",
+    "analytical per-solve collective payload bytes, by superstep phase "
+    "and collective kind (layout-derived; docs/OBSERVABILITY.md)")
+_M_MSGS = _metrics.counter(
+    "tpu_jordan_comm_messages_total",
+    "analytical per-solve collective launches, by superstep phase and "
+    "collective kind")
+_M_DRIFT = _metrics.counter(
+    "tpu_jordan_comm_drift_total",
+    "distributed solves whose measured non-compute residue fell "
+    "outside the comm model's projected band (judged backends only)")
+_M_GBPS = _metrics.gauge(
+    "tpu_jordan_comm_achieved_gbps",
+    "achieved interconnect GB/s of the last distributed solve per "
+    "engine (modeled wire bytes / measured non-compute residue)")
+
+
+def _itemsize(dtype: str) -> int:
+    import numpy as np
+
+    return np.dtype(dtype).itemsize
+
+
+def _nelems(shape: tuple) -> int:
+    out = 1
+    for s in shape:
+        out *= int(s)
+    return out
+
+
+@dataclass(frozen=True)
+class CollectiveSig:
+    """One collective signature of an engine configuration: the exact
+    (kind, mesh axis, operand shape, dtype) a traced program issues,
+    how many times it appears in ONE trace (``traced``) and how many
+    times it launches per solve (``executed`` — fori_loop bodies trace
+    once but run Nr times)."""
+
+    phase: str
+    kind: str           # psum | pmin | pmax | ppermute | all_gather
+    axis: str           # "p" | "pr" | "pc" | "pr,pc"
+    axis_size: int      # devices participating
+    shape: tuple
+    dtype: str
+    traced: int
+    executed: int
+    section: str = "engine"     # engine | residual | gather
+    implicit: bool = False      # XLA-inserted, invisible to the shims
+
+    @property
+    def payload_bytes(self) -> int:
+        """Operand bytes per launch (exact: shape × dtype width)."""
+        return _nelems(self.shape) * _itemsize(self.dtype)
+
+    @property
+    def wire_bytes(self) -> float:
+        """Modeled on-link bytes per launch (module docstring)."""
+        s = float(self.payload_bytes)
+        a = self.axis_size
+        if self.kind == "ppermute":
+            return s
+        return 0.0 if a <= 1 else s * (a - 1) / a
+
+    def key(self) -> tuple:
+        return (self.kind, self.axis, self.shape, self.dtype)
+
+    def to_json(self) -> dict:
+        return {
+            "phase": self.phase, "kind": self.kind, "axis": self.axis,
+            "axis_size": self.axis_size, "shape": list(self.shape),
+            "dtype": self.dtype, "traced": self.traced,
+            "executed": self.executed, "section": self.section,
+            "implicit": self.implicit,
+            "payload_bytes": self.payload_bytes,
+            "wire_bytes": round(self.wire_bytes, 1),
+        }
+
+
+# ---------------------------------------------------------------------
+# Observed side: the trace-time recorder behind the compat shims.
+# ---------------------------------------------------------------------
+
+
+class CollectiveRecorder:
+    """Sink for ``parallel/compat.py``'s shims: one (kind, axis, shape,
+    dtype) record per collective issued at trace time while the
+    recorder is registered."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.records: list[tuple] = []
+
+    def note(self, kind: str, axis: str, shape: tuple,
+             dtype: str) -> None:
+        with self._lock:
+            self.records.append((kind, axis, tuple(shape), str(dtype)))
+
+    def counts(self) -> Counter:
+        with self._lock:
+            return Counter(self.records)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self.records)
+
+
+@contextlib.contextmanager
+def record_collectives():
+    """Register a fresh :class:`CollectiveRecorder` with the compat
+    shims for the duration of the block; yields the recorder."""
+    from ..parallel import compat as _compat
+
+    rec = CollectiveRecorder()
+    _compat.add_collective_recorder(rec)
+    try:
+        yield rec
+    finally:
+        _compat.remove_collective_recorder(rec)
+
+
+_STATE = threading.local()
+
+
+@contextlib.contextmanager
+def recording():
+    """Enable driver-integrated observed-count capture for solves
+    inside the block: each distributed compile (and the first trace of
+    its residual executable) runs under a :class:`CollectiveRecorder`,
+    and ``SolveResult.comm`` carries the observed-vs-analytical
+    reconciliation.  Off (the default), solves still get the full
+    ANALYTICAL report — only the trace-time observation is skipped."""
+    prev = getattr(_STATE, "on", False)
+    _STATE.on = True
+    try:
+        yield
+    finally:
+        _STATE.on = prev
+
+
+def recording_active() -> bool:
+    return bool(getattr(_STATE, "on", False))
+
+
+# ---------------------------------------------------------------------
+# Analytical side: the layout-derived collective inventories.
+# ---------------------------------------------------------------------
+
+
+def _index_dtype() -> str:
+    """The dtype jax gives ``jnp.arange``-derived index scalars (the
+    pivot reduction's g_piv payloads): int64 under x64, else int32."""
+    import jax
+    import numpy as np
+
+    return str(jax.dtypes.canonicalize_dtype(np.int64))
+
+
+class _Builder:
+    def __init__(self):
+        self.sigs: list[CollectiveSig] = []
+
+    def add(self, phase, kind, axis, axis_size, shape, dtype,
+            traced, executed, section="engine", implicit=False):
+        self.sigs.append(CollectiveSig(
+            phase=phase, kind=kind, axis=axis, axis_size=int(axis_size),
+            shape=tuple(int(s) for s in shape), dtype=str(dtype),
+            traced=int(traced), executed=int(executed), section=section,
+            implicit=implicit))
+
+    def merged(self) -> list[CollectiveSig]:
+        """Collapse identical signatures, summing traced/executed."""
+        agg: dict[tuple, list] = {}
+        order: list[tuple] = []
+        for s in self.sigs:
+            k = (s.phase, s.kind, s.axis, s.axis_size, s.shape, s.dtype,
+                 s.section, s.implicit)
+            if k not in agg:
+                agg[k] = [0, 0]
+                order.append(k)
+            agg[k][0] += s.traced
+            agg[k][1] += s.executed
+        return [CollectiveSig(phase=k[0], kind=k[1], axis=k[2],
+                              axis_size=k[3], shape=k[4], dtype=k[5],
+                              traced=agg[k][0], executed=agg[k][1],
+                              section=k[6], implicit=k[7])
+                for k in order]
+
+
+def _group_schedule(Nr: int, group: int, unroll: bool):
+    """(kg, traced_steps, executed_steps) tuples for the grouped
+    engines: the unrolled flavor traces every group; the fori flavor
+    traces one full-size group body plus the unrolled tail."""
+    kgrp = max(1, min(group, Nr))
+    if unroll:
+        out = []
+        t0 = 0
+        while t0 < Nr:
+            kg = min(kgrp, Nr - t0)
+            out.append((kg, kg, kg))
+            t0 += kgrp
+        return out
+    G, tail = divmod(Nr, kgrp)
+    out = [(kgrp, kgrp, G * kgrp)] if G else []
+    if tail:
+        out.append((tail, tail, tail))
+    return out
+
+
+def _sigs_1d(b: _Builder, lay, dtype: str, engine: str, group: int,
+             unroll: bool) -> None:
+    """The 1D row-cyclic engines (parallel/sharded_inplace.py /
+    sharded_jordan.py) — collective inventory per superstep, exactly as
+    the step functions issue them (``_step`` / ``_step_fori`` /
+    ``_step_swapfree`` / ``_gstep`` / ``_local_step``)."""
+    m, N, Nr, p = lay.m, lay.N, lay.Nr, lay.p
+    bpw = lay.blocks_per_worker
+    i_dt = _index_dtype()
+    ax = ("p", p)
+
+    if engine == "swapfree":
+        # _step_swapfree (fori-only): 2 pmin + 3 psum per step; the
+        # win_pos tie-break key rides the int32 ``pos`` carry.
+        b.add("pivot", "pmin", *ax, (), dtype, 1, Nr)
+        b.add("pivot", "pmin", *ax, (), "int32", 1, Nr)
+        b.add("pivot", "psum", *ax, (), i_dt, 1, Nr)
+        b.add("pivot", "psum", *ax, (m, m), dtype, 1, Nr)
+        b.add("row_bcast", "psum", *ax, (m, N), dtype, 1, Nr)
+        # The deferred permutation: p−1 single-hop ppermute rounds of
+        # one padded shard-size bucket (parallel/permute.py).
+        if p > 1:
+            b.add("permute", "ppermute", *ax, (bpw, m, N), dtype,
+                  p - 1, p - 1)
+        return
+    if engine == "augmented":
+        # _local_step (fori-only), (m, 2N) augmented rows.
+        b.add("pivot", "pmin", *ax, (), dtype, 1, Nr)
+        b.add("pivot", "pmin", *ax, (), i_dt, 1, Nr)
+        b.add("pivot", "psum", *ax, (), i_dt, 1, Nr)
+        b.add("pivot", "psum", *ax, (m, m), dtype, 1, Nr)
+        b.add("row_bcast", "psum", *ax, (m, 2 * N), dtype, 1, Nr)
+        b.add("row_exchange", "psum", *ax, (m, 2 * N), dtype, 1, Nr)
+        return
+    if group > 1:
+        # _gstep: the two row psums + H fuse into ONE stacked
+        # (2m, N + kg·m + m) psum; tail groups stack narrower.
+        for kg, traced, executed in _group_schedule(Nr, group, unroll):
+            tr, ex = traced, executed
+            b.add("pivot", "pmin", *ax, (), dtype, tr, ex)
+            b.add("pivot", "pmin", *ax, (), i_dt, tr, ex)
+            b.add("pivot", "psum", *ax, (), i_dt, tr, ex)
+            b.add("pivot", "psum", *ax, (m, m), dtype, tr, ex)
+            b.add("row_bcast", "psum", *ax,
+                  (2 * m, N + kg * m + m), dtype, tr, ex)
+        return
+    # Plain in-place: _step (unrolled) / _step_fori.
+    tr = Nr if unroll else 1
+    b.add("pivot", "pmin", *ax, (), dtype, tr, Nr)
+    b.add("pivot", "pmin", *ax, (), i_dt, tr, Nr)
+    b.add("pivot", "psum", *ax, (), i_dt, tr, Nr)
+    b.add("pivot", "psum", *ax, (m, m), dtype, tr, Nr)
+    b.add("row_bcast", "psum", *ax, (m, N), dtype, tr, Nr)
+    b.add("row_exchange", "psum", *ax, (m, N), dtype, tr, Nr)
+
+
+def _sigs_2d(b: _Builder, lay, dtype: str, engine: str, group: int,
+             unroll: bool) -> None:
+    """The 2D block-cyclic engines (parallel/jordan2d_inplace.py /
+    jordan2d.py) — per-superstep inventory of ``_step2d`` /
+    ``_step2d_fori`` / ``_step2d_swapfree`` / ``_gstep2d`` /
+    ``_local_step2d`` plus the column-swap unscramble replay."""
+    m, N, Nr = lay.m, lay.N, lay.Nr
+    pr, pc, bpr, bc1 = lay.pr, lay.pc, lay.bpr, lay.bc1
+    Wc = N // pc
+    i_dt = _index_dtype()
+    axR = ("pr", pr)
+    axC = ("pc", pc)
+    axB = ("pr,pc", pr * pc)
+
+    def pivot(tr, ex):
+        b.add("pivot", "pmin", *axB, (), dtype, tr, ex)
+        b.add("pivot", "pmin", *axB, (),
+              "int32" if engine == "swapfree" else i_dt, tr, ex)
+        b.add("pivot", "psum", *axB, (), i_dt, tr, ex)
+        b.add("pivot", "psum", *axB, (m, m), dtype, tr, ex)
+
+    if engine == "swapfree":
+        b.add("panel_bcast", "psum", *axC, (bpr, m, m), dtype, 1, Nr)
+        pivot(1, Nr)
+        b.add("row_bcast", "psum", *axR, (m, Wc), dtype, 1, Nr)
+        # Deferred repairs: column chunks along "pc" alone, rows along
+        # "pr" alone (data moves only along the axis that shards it).
+        if pc > 1:
+            b.add("permute", "ppermute", *axC, (bc1, bpr, m, m), dtype,
+                  pc - 1, pc - 1)
+        if pr > 1:
+            b.add("permute", "ppermute", *axR, (bpr, m, Wc), dtype,
+                  pr - 1, pr - 1)
+        return
+    if engine == "augmented":
+        Wc2 = 2 * N // pc
+        b.add("panel_bcast", "psum", *axC, (bpr, m, m), dtype, 1, Nr)
+        pivot(1, Nr)
+        b.add("row_bcast", "psum", *axR, (m, Wc2), dtype, 1, Nr)
+        b.add("row_exchange", "psum", *axR, (m, Wc2), dtype, 1, Nr)
+        b.add("row_exchange", "psum", *axC, (m, m), dtype, 1, Nr)
+        return
+    if group > 1:
+        for kg, traced, executed in _group_schedule(Nr, group, unroll):
+            tr, ex = traced, executed
+            b.add("panel_bcast", "psum", *axC, (bpr, m, m), dtype,
+                  tr, ex)
+            pivot(tr, ex)
+            b.add("row_bcast", "psum", *axR,
+                  (2 * m, Wc + kg * m + m), dtype, tr, ex)
+        # Unscramble replay: 2 one-hot (bpr, m, m) psums along "pc"
+        # per step (unrolled traces all Nr; the fori twin traces one).
+        utr = Nr if unroll else 1
+        b.add("unscramble", "psum", *axC, (bpr, m, m), dtype,
+              2 * utr, 2 * Nr)
+        return
+    tr = Nr if unroll else 1
+    b.add("panel_bcast", "psum", *axC, (bpr, m, m), dtype, tr, Nr)
+    pivot(tr, Nr)
+    b.add("row_bcast", "psum", *axR, (m, Wc), dtype, tr, Nr)
+    b.add("row_exchange", "psum", *axR, (m, Wc), dtype, tr, Nr)
+    b.add("row_exchange", "psum", *axC, (m, m), dtype, tr, Nr)
+    b.add("unscramble", "psum", *axC, (bpr, m, m), dtype,
+          2 * tr, 2 * Nr)
+
+
+def _sigs_residual(b: _Builder, lay, dtype: str) -> None:
+    """The independent verification pass: the 1D systolic ring GEMM
+    (parallel/ring_gemm.py, main.cpp:534-641) or the 2D SUMMA
+    (parallel/jordan2d.py::_summa_residual_worker)."""
+    m, N, Nr = lay.m, lay.N, lay.Nr
+    if hasattr(lay, "pc"):
+        pr, pc, bpr = lay.pr, lay.pc, lay.bpr
+        Wc = N // pc
+        b.add("residual", "psum", "pc", pc, (bpr, m, m), dtype, 1, Nr,
+              section="residual")
+        b.add("residual", "psum", "pr", pr, (m, Wc), dtype, 1, Nr,
+              section="residual")
+        b.add("residual", "psum", "pc", pc, (bpr, m), dtype, 1, 1,
+              section="residual")
+        b.add("residual", "pmax", "pr,pc", pr * pc, (), dtype, 1, 1,
+              section="residual")
+        return
+    p = lay.p
+    bpw = lay.blocks_per_worker
+    # One ppermute in the fori body (traced once, rotated p times) +
+    # the scalar pmax that carries the verdict off the mesh.
+    b.add("residual", "ppermute", "p", p, (bpw, m, N), dtype, 1, p,
+          section="residual")
+    b.add("residual", "pmax", "p", p, (), dtype, 1, 1,
+          section="residual")
+
+
+def _sigs_gather(b: _Builder, lay, dtype: str) -> None:
+    """The XLA-implicit all-gather behind ``gather=True`` (jnp.take on
+    the sharded blocks outside shard_map): modeled, never shim-visible
+    (``implicit=True`` keeps it out of the reconciliation multiset)."""
+    N = lay.N
+    if hasattr(lay, "pc"):
+        axis, a = "pr,pc", lay.pr * lay.pc
+    else:
+        axis, a = "p", lay.p
+    b.add("gather", "all_gather", axis, a, (N, N), dtype, 0, 1,
+          section="gather", implicit=True)
+
+
+def engine_report(*, engine: str, lay, dtype, gather: bool = True,
+                  refine: int = 0, group: int = 0,
+                  unroll: bool | None = None) -> "CommReport":
+    """Build the analytical :class:`CommReport` for one distributed
+    engine configuration.  ``lay`` is the solve's ``CyclicLayout`` /
+    ``CyclicLayout2D``; ``dtype`` the WORKING dtype (the distributed
+    core computes in fp32 for sub-fp32 storage); ``unroll=None``
+    resolves exactly like the compile front ends (Nr ≤ MAX_UNROLL_NR
+    for the in-place/grouped engines; the swap-free and augmented
+    engines are fori-only).
+
+    ``refine > 0`` skips the residual section (the refine branch
+    verifies on the gathered full matrices — no ring/SUMMA pass), and
+    ``gather=True`` adds the implicit all-gather phase."""
+    import jax.numpy as jnp
+
+    from ..parallel.sharded_inplace import MAX_UNROLL_NR
+
+    dt = str(jnp.dtype(dtype))
+    if engine in ("swapfree", "augmented"):
+        unroll = False
+    elif unroll is None:
+        unroll = lay.Nr <= MAX_UNROLL_NR
+    b = _Builder()
+    two_d = hasattr(lay, "pc")
+    if two_d:
+        _sigs_2d(b, lay, dt, engine, group, unroll)
+        mesh = f"{lay.pr}x{lay.pc}"
+        workers: object = (lay.pr, lay.pc)
+    else:
+        _sigs_1d(b, lay, dt, engine, group, unroll)
+        mesh = f"1D p={lay.p}"
+        workers = lay.p
+    if not refine:
+        _sigs_residual(b, lay, dt)
+    if gather:
+        _sigs_gather(b, lay, dt)
+    return CommReport(engine=engine, mesh=mesh, workers=workers,
+                      n=lay.n, block_size=lay.m, dtype=dt,
+                      gather=bool(gather), group=int(group),
+                      sigs=b.merged())
+
+
+# ---------------------------------------------------------------------
+# The report: totals, reconciliation, metrics, span attrs.
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class CommReport:
+    """One distributed solve's communication record
+    (``SolveResult.comm``)."""
+
+    engine: str
+    mesh: str
+    workers: object
+    n: int
+    block_size: int
+    dtype: str
+    gather: bool
+    group: int
+    sigs: list = field(default_factory=list)
+    #: observed trace-time records per section ("engine"/"residual"),
+    #: None = not captured (recording off, or the executable's trace
+    #: was cache-hit — nothing re-traced, nothing to compare).
+    observed: dict = field(default_factory=dict)
+    #: per-section verdicts: True/False per captured section; overall
+    #: ``reconciled`` is False iff any captured section mismatches,
+    #: None iff nothing was captured.
+    reconciled: bool | None = None
+    mismatches: list = field(default_factory=list)
+    drift: dict | None = None
+
+    # ---- totals ------------------------------------------------------
+
+    def total_bytes(self, implicit: bool = True) -> int:
+        return sum(s.payload_bytes * s.executed for s in self.sigs
+                   if implicit or not s.implicit)
+
+    def total_wire_bytes(self, section: str | None = None) -> float:
+        return sum(s.wire_bytes * s.executed for s in self.sigs
+                   if section is None or s.section == section)
+
+    def total_messages(self) -> int:
+        return sum(s.executed for s in self.sigs if not s.implicit)
+
+    def phase_totals(self) -> dict:
+        """{(phase, kind): {"bytes": payload, "messages": launches}} —
+        the metric export unit."""
+        out: dict[tuple, dict] = {}
+        for s in self.sigs:
+            k = (s.phase, s.kind)
+            d = out.setdefault(k, {"bytes": 0, "messages": 0,
+                                   "wire_bytes": 0.0})
+            d["bytes"] += s.payload_bytes * s.executed
+            d["messages"] += 0 if s.implicit else s.executed
+            d["wire_bytes"] += s.wire_bytes * s.executed
+        return out
+
+    # ---- reconciliation ---------------------------------------------
+
+    def expected_traced(self, section: str) -> Counter:
+        """The multiset of (kind, axis, shape, dtype) one fresh trace
+        of ``section`` must issue through the compat shims."""
+        c: Counter = Counter()
+        for s in self.sigs:
+            if s.section == section and not s.implicit and s.traced:
+                c[s.key()] += s.traced
+        return c
+
+    def attach_observed(self, section: str, records) -> None:
+        """Record one section's trace-time observations (a list of
+        (kind, axis, shape, dtype) tuples from a
+        :class:`CollectiveRecorder`); None or an empty capture of a
+        section that predicts collectives means the trace was cache-hit
+        and the section stays un-judged."""
+        if records is None:
+            self.observed[section] = None
+            return
+        recs = [tuple(r) for r in records]
+        if not recs and self.expected_traced(section):
+            self.observed[section] = None
+            return
+        self.observed[section] = recs
+        self._reconcile()
+
+    def _reconcile(self) -> None:
+        self.mismatches = []
+        judged = False
+        ok = True
+        for section, recs in self.observed.items():
+            if recs is None:
+                continue
+            judged = True
+            want = self.expected_traced(section)
+            got = Counter((str(k), str(a), tuple(sh), str(dt))
+                          for k, a, sh, dt in recs)
+            for key in sorted(set(want) | set(got)):
+                w, g = want.get(key, 0), got.get(key, 0)
+                if w != g:
+                    ok = False
+                    kind, axis, shape, dt = key
+                    self.mismatches.append(
+                        f"{section}: {kind}@{axis} {list(shape)} {dt}: "
+                        f"analytical {w} vs observed {g}")
+        self.reconciled = ok if judged else None
+
+    # ---- export ------------------------------------------------------
+
+    def observe_metrics(self, sections: tuple | None = None) -> None:
+        """Increment the per-solve comm counters (analytical totals —
+        exact layout math, recorded whether or not observation ran).
+
+        ``sections`` restricts the export to the report sections that
+        actually ran: the driver's distributed core counts everything
+        (its solve always verifies), while ``JordanSolver`` counts
+        engine+gather per ``invert`` launch and the residual section
+        only when ``residual()`` really runs the ring/SUMMA pass — the
+        counters must never report verification traffic that did not
+        move."""
+        for s in self.sigs:
+            if sections is not None and s.section not in sections:
+                continue
+            nb = s.payload_bytes * s.executed
+            if nb:
+                _M_BYTES.inc(nb, phase=s.phase, collective=s.kind)
+            if s.executed and not s.implicit:
+                _M_MSGS.inc(s.executed, phase=s.phase,
+                            collective=s.kind)
+
+    def attach_span(self, span) -> None:
+        """Comm attrs on a distributed ``execute`` span: total payload
+        and modeled wire bytes of the ELIMINATION section (what the
+        span's wall actually brackets), plus message count."""
+        span.attrs["comm_payload_bytes"] = int(sum(
+            s.payload_bytes * s.executed for s in self.sigs
+            if s.section == "engine"))
+        span.attrs["comm_wire_bytes"] = round(
+            self.total_wire_bytes("engine"), 1)
+        span.attrs["comm_messages"] = int(sum(
+            s.executed for s in self.sigs
+            if s.section == "engine" and not s.implicit))
+
+    def to_json(self) -> dict:
+        obs = {}
+        for section, recs in self.observed.items():
+            if recs is None:
+                obs[section] = None
+                continue
+            got = Counter((str(k), str(a), tuple(sh), str(dt))
+                          for k, a, sh, dt in recs)
+            obs[section] = [
+                {"kind": k, "axis": a, "shape": list(sh), "dtype": dt,
+                 "count": c}
+                for (k, a, sh, dt), c in sorted(got.items())]
+        return {
+            "engine": self.engine, "mesh": self.mesh,
+            "workers": (list(self.workers)
+                        if isinstance(self.workers, tuple)
+                        else self.workers),
+            "n": self.n, "block_size": self.block_size,
+            "dtype": self.dtype, "gather": self.gather,
+            "group": self.group,
+            "sigs": [s.to_json() for s in self.sigs],
+            "totals": {
+                "payload_bytes": self.total_bytes(),
+                "explicit_payload_bytes": self.total_bytes(False),
+                "wire_bytes": round(self.total_wire_bytes(), 1),
+                "messages": self.total_messages(),
+            },
+            "observed": obs,
+            "reconciled": self.reconciled,
+            "mismatches": list(self.mismatches),
+            "drift": self.drift,
+        }
+
+
+#: The last distributed solve's report (the ``--comm-report`` CLI
+#: snapshot source; process-level, like hwcost.WATERMARK).
+_LAST_LOCK = threading.Lock()
+LAST_REPORT: CommReport | None = None
+
+
+def set_last_report(report: CommReport) -> None:
+    """Record the most recent distributed solve's report (the
+    ``--comm-report`` snapshot source; called by the driver)."""
+    global LAST_REPORT
+    with _LAST_LOCK:
+        LAST_REPORT = report
+
+
+# ---------------------------------------------------------------------
+# Measured-vs-projected drift.
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class DriftPolicy:
+    """When a measured/projected comm ratio becomes a ``comm_drift``
+    event.  ``tolerance`` is the model's stated accuracy band (the
+    projections are 'WHERE the collectives dominate, not 3-digit
+    accuracy' — benchmarks/comm_model.py; a measured TPU calibration
+    round can tighten it).  ``judge``:
+
+      * "auto" — judge only where the projection claims to describe
+        the hardware (jax backend is a real TPU); elsewhere the ratio
+        is recorded as an attr, unjudged (the v5e constants off-chip
+        are a cost-RANKING stand-in, tuning/registry.py).
+      * "always" / "never" — force (the demo's drift leg uses
+        "always" to exercise the event path on a CPU mesh)."""
+
+    tolerance: float = 4.0
+    judge: str = "auto"
+
+
+_DRIFT_LOCK = threading.Lock()
+_DRIFT = DriftPolicy()
+
+
+def drift_policy() -> DriftPolicy:
+    with _DRIFT_LOCK:
+        return _DRIFT
+
+
+@contextlib.contextmanager
+def set_drift_policy(tolerance: float | None = None,
+                     judge: str | None = None):
+    """Scoped drift-policy override (context manager)."""
+    global _DRIFT
+    if judge is not None and judge not in ("auto", "always", "never"):
+        raise ValueError(f"judge {judge!r}: auto/always/never")
+    with _DRIFT_LOCK:
+        prev = _DRIFT
+        _DRIFT = DriftPolicy(
+            tolerance=(prev.tolerance if tolerance is None
+                       else float(tolerance)),
+            judge=prev.judge if judge is None else judge)
+    try:
+        yield
+    finally:
+        with _DRIFT_LOCK:
+            _DRIFT = prev
+
+
+def _projection(n: int, m: int, workers, engine: str, group: int):
+    """comm_model's phase projection for this topology point, with the
+    chip the registry's cost hooks would rank it on."""
+    import jax
+
+    from ..tuning.registry import comm_model
+
+    _cm = comm_model()
+    params = _cm.topology_params()
+    backend = jax.default_backend()
+    chip_name = params["backend_chip"].get(backend, "v5e")
+    chip = params["chips"][chip_name]
+    pr, pc = (workers if isinstance(workers, (tuple, list))
+              else (workers, 1))
+    kw = {}
+    if engine == "swapfree":
+        kw["swapfree"] = True
+    elif group > 1:
+        kw["group"] = group
+    r = _cm.predict(n, m, int(pr), int(pc), chip, **kw)
+    scale = 2.0 if engine == "augmented" else 1.0  # [A|B] doubles bytes
+    return {
+        "chip": chip_name, "backend": backend,
+        "comm_s": scale * r["comm"],
+        "compute_s": r["elim"] + r["probe"] + r["glue"],
+        "total_s": r["total"],
+    }
+
+
+def observe_drift(report: CommReport, elapsed: float,
+                  span=None) -> dict:
+    """Compare the measured non-compute residue of one distributed
+    execute against the comm model's projected comm term; record the
+    achieved GB/s gauge, the span attrs, and — on a judged backend
+    with a ratio outside the band — a ``comm_drift`` flight-recorder
+    event + counter.  Judged measurements also feed the cost-hook
+    calibration (:func:`cost_comm_scale`)."""
+    pol = drift_policy()
+    proj = _projection(report.n, report.block_size, report.workers,
+                       report.engine, report.group)
+    residue = max(float(elapsed) - proj["compute_s"], 0.0)
+    wire = report.total_wire_bytes("engine")
+    gbps = (wire / residue / 1e9) if residue > 0 else None
+    ratio = (residue / proj["comm_s"]) if proj["comm_s"] > 0 else None
+    judged = (pol.judge == "always"
+              or (pol.judge == "auto" and proj["backend"] == "tpu"))
+    band = [1.0 / pol.tolerance, pol.tolerance]
+    out_of_band = (judged and ratio is not None
+                   and not (band[0] <= ratio <= band[1]))
+    drift = {
+        "elapsed_s": float(elapsed),
+        "projected_comm_s": proj["comm_s"],
+        "projected_compute_s": proj["compute_s"],
+        "residue_s": residue,
+        "comm_vs_projected": ratio,
+        "band": band,
+        "chip": proj["chip"],
+        "backend": proj["backend"],
+        "judged": judged,
+        "out_of_band": out_of_band,
+        "achieved_gbps": gbps,
+        "wire_bytes": round(wire, 1),
+        "event_recorded": False,
+    }
+    if gbps is not None:
+        _M_GBPS.set(gbps, engine=report.engine)
+    if span is not None:
+        if ratio is not None:
+            span.attrs["comm_vs_projected"] = float(f"{ratio:.4g}")
+        if gbps is not None:
+            span.attrs["comm_achieved_gbps"] = float(f"{gbps:.4g}")
+        span.attrs["comm_projection_chip"] = proj["chip"]
+        span.attrs["comm_drift_judged"] = judged
+    if out_of_band:
+        _M_DRIFT.inc(engine=report.engine)
+        _recorder.record(
+            "comm_drift", engine=report.engine, mesh=report.mesh,
+            n=report.n, ratio=float(ratio), band=band,
+            chip=proj["chip"], residue_s=residue,
+            projected_comm_s=proj["comm_s"])
+        drift["event_recorded"] = True
+    if judged and ratio is not None and math.isfinite(ratio):
+        _record_calibration(ratio)
+    report.drift = drift
+    return drift
+
+
+# ---------------------------------------------------------------------
+# Cost-hook feedback (ROADMAP item 5: the measured roofline turned
+# from a report into a control signal — opt-in, default inert).
+# ---------------------------------------------------------------------
+
+_CAL_LOCK = threading.Lock()
+_CAL = {"enabled": False, "ratio": None, "samples": 0}
+_CAL_ALPHA = 0.25          # EWMA weight of the newest judged solve
+_CAL_CLAMP = (0.25, 16.0)  # a calibration can re-price, not erase
+
+
+def _record_calibration(ratio: float) -> None:
+    with _CAL_LOCK:
+        r = min(max(float(ratio), _CAL_CLAMP[0]), _CAL_CLAMP[1])
+        if _CAL["ratio"] is None:
+            _CAL["ratio"] = r
+        else:
+            _CAL["ratio"] = ((1 - _CAL_ALPHA) * _CAL["ratio"]
+                             + _CAL_ALPHA * r)
+        _CAL["samples"] += 1
+
+
+def set_cost_feedback(enabled: bool) -> None:
+    """Let judged measured/projected comm ratios scale the registry
+    cost hooks' comm term (``tuning/registry.projected_seconds``).
+    Default OFF: with it off — or with no judged measurement recorded —
+    :func:`cost_comm_scale` is exactly 1.0 and every cost ranking is
+    byte-identical to the pre-ISSUE-14 behavior."""
+    with _CAL_LOCK:
+        _CAL["enabled"] = bool(enabled)
+
+
+def cost_comm_scale() -> float:
+    """The comm-term multiplier for the registry cost hooks: the EWMA
+    of judged measured/projected ratios when feedback is enabled, 1.0
+    otherwise (see :func:`set_cost_feedback`)."""
+    with _CAL_LOCK:
+        if not _CAL["enabled"] or _CAL["ratio"] is None:
+            return 1.0
+        return float(_CAL["ratio"])
+
+
+def calibration_state() -> dict:
+    with _CAL_LOCK:
+        return dict(_CAL)
+
+
+def reset_calibration() -> None:
+    """Drop the measured comm calibration and disable feedback (TESTS
+    ONLY — production calibration is meant to accumulate)."""
+    with _CAL_LOCK:
+        _CAL.update({"enabled": False, "ratio": None, "samples": 0})
+
+
+# ---------------------------------------------------------------------
+# The --comm-report snapshot.
+# ---------------------------------------------------------------------
+
+
+def snapshot() -> dict:
+    """The process-wide comm snapshot (``--comm-report``): the last
+    distributed solve's full report plus the comm counter families."""
+    reg = _metrics.REGISTRY.snapshot()
+    with _LAST_LOCK:
+        last = LAST_REPORT
+    return {
+        "metric": "comm_report",
+        "last_solve": None if last is None else last.to_json(),
+        "counters": {name: reg[name] for name in (
+            "tpu_jordan_comm_bytes_total",
+            "tpu_jordan_comm_messages_total",
+            "tpu_jordan_comm_drift_total") if name in reg},
+        "calibration": calibration_state(),
+    }
+
+
+def write_report(path: str) -> None:
+    import json
+
+    with open(path, "w") as f:
+        json.dump(snapshot(), f)
+
+
+# ---------------------------------------------------------------------
+# The acceptance demo (`make comm-demo`, CLI --comm-demo).
+# ---------------------------------------------------------------------
+
+
+def _cpu_env(n_devices: int) -> dict:
+    """Force an n-device virtual CPU platform from interpreter start
+    (the __graft_entry__/conftest recipe) and make the repo importable
+    from the child."""
+    import os
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = [f for f in env.get("XLA_FLAGS", "").split()
+             if "xla_force_host_platform_device_count" not in f]
+    flags.append(f"--xla_force_host_platform_device_count={n_devices}")
+    env["XLA_FLAGS"] = " ".join(flags)
+    repo = _repo_root()
+    env["PYTHONPATH"] = (repo + (os.pathsep + env["PYTHONPATH"]
+                                 if env.get("PYTHONPATH") else ""))
+    return env
+
+
+def _repo_root() -> str:
+    import os
+
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _demo_leg(name: str, *, n: int, m: int, workers, engine: str,
+              gather: bool, group: int = 0, dtype=None,
+              generator: str = "absdiff") -> dict:
+    import jax.numpy as jnp
+
+    from ..driver import solve
+
+    with recording():
+        res = solve(n, m, workers=workers, engine=engine, group=group,
+                    gather=gather, generator=generator,
+                    dtype=dtype if dtype is not None else jnp.float32)
+    rep = res.comm
+    leg = {"name": name, "n": n, "block_size": m,
+           "elapsed_s": res.elapsed,
+           "rel_residual": res.rel_residual,
+           "comm": rep.to_json()}
+    return leg
+
+
+def comm_demo(n: int = 48, block_size: int = 8, seed: int = 0,
+              dtype=None, generator: str = "absdiff") -> dict:
+    """The ISSUE 14 acceptance run: four tiny distributed solves —
+    1D and 2D meshes, both gather modes, a grouped engine, and a
+    RAGGED problem size (n not a multiple of the block size, so the
+    identity-padded tail is part of every reconciled inventory) — each
+    with collective recording on, reconciling the observed trace-time
+    multiset against the layout-derived analytical inventory; then one
+    deliberate drift leg (``judge="always"`` with a tight band on this
+    CPU-mesh host, where the measured residue is nowhere near a v5e
+    ICI projection) proving an out-of-band ratio is a RECORDED
+    ``comm_drift`` event, never a silent number.
+
+    Returns the one-line-JSON report ``tools/check_comm.py`` validates
+    (exit 2 = an unaccounted collective or a silent drift).  Needs an
+    8-device mesh: re-execs itself on a forced virtual CPU platform
+    when the current process cannot host one (the dryrun recipe)."""
+    import json
+    import subprocess
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+
+    del seed  # the demo fixtures are deterministic generators
+    dt = jnp.dtype(dtype if dtype is not None else jnp.float32)
+    if dt.kind == "c":
+        from ..driver import UsageError
+
+        raise UsageError(
+            "--comm-demo reconciles the DISTRIBUTED engines and "
+            "complex dtypes run single-device (driver.solve's "
+            "contract); use a real dtype")
+    try:
+        can_inline = len(jax.devices()) >= 8
+    except RuntimeError:
+        can_inline = False
+    if not can_inline:
+        x64 = ("jax.config.update('jax_enable_x64', True)\n"
+               if dt.itemsize == 8 else "")
+        code = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            + x64 +
+            "import json\n"
+            "from tpu_jordan.obs.comm import comm_demo\n"
+            f"print(json.dumps(comm_demo(n={int(n)}, "
+            f"block_size={int(block_size)}, dtype={dt.name!r}, "
+            f"generator={generator!r})))\n")
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=_cpu_env(8),
+            cwd=_repo_root(), capture_output=True, text=True,
+            timeout=900)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"comm_demo subprocess failed (rc={proc.returncode}): "
+                f"{proc.stderr[-2000:]}")
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    m = block_size
+    # A ragged point: n chosen so n % m != 0 (the padded identity tail
+    # rides through every inventory below).
+    n_rag = n - m // 2 if n % m == 0 else n
+    mark = _recorder.RECORDER.total
+    kw = {"dtype": dt, "generator": generator}
+    legs = [
+        _demo_leg("1d_p4_inplace_gathered", n=n_rag, m=m, workers=4,
+                  engine="inplace", gather=True, **kw),
+        _demo_leg("1d_p4_grouped2_gathered", n=n_rag, m=m, workers=4,
+                  engine="grouped", gather=True, group=2, **kw),
+        _demo_leg("1d_p4_swapfree_sharded", n=n_rag, m=m, workers=4,
+                  engine="swapfree", gather=False, **kw),
+        _demo_leg("2d_2x2_inplace_gathered", n=n_rag, m=m,
+                  workers=(2, 2), engine="inplace", gather=True, **kw),
+        _demo_leg("2d_2x2_swapfree_sharded", n=n_rag, m=m,
+                  workers=(2, 2), engine="swapfree", gather=False,
+                  **kw),
+    ]
+    # The deliberate drift leg: judged with a tight band — on this
+    # host the measured residue is host-dispatch wall time, orders of
+    # magnitude beyond a v5e ICI projection, so the event MUST fire.
+    with set_drift_policy(tolerance=1.5, judge="always"):
+        drift_leg = _demo_leg("1d_p4_inplace_drift", n=n_rag, m=m,
+                              workers=4, engine="inplace", gather=True,
+                              **kw)
+    blackbox = _recorder.RECORDER.dump(
+        events=_recorder.RECORDER.since(mark))
+    drift_events = [e for e in blackbox["events"]
+                    if e["kind"] == "comm_drift"]
+    # The five reconciliation legs must judge strictly True (each is a
+    # fresh configuration, so its compile traces fresh).  The drift leg
+    # repeats leg 1's configuration — its lowering is jax-cache-hit, so
+    # its comm sections are legitimately un-judged (None); it must only
+    # never judge False.
+    unreconciled = [leg["name"] for leg in legs
+                    if leg["comm"]["reconciled"] is not True]
+    if drift_leg["comm"]["reconciled"] is False:
+        unreconciled.append(drift_leg["name"])
+    mismatches = [msg for leg in legs + [drift_leg]
+                  for msg in leg["comm"]["mismatches"]]
+    dr = drift_leg["comm"]["drift"] or {}
+    silent_drift = bool(dr.get("judged") and dr.get("out_of_band")
+                        and not drift_events)
+    reg = _metrics.REGISTRY.snapshot()
+    return {
+        "metric": "comm_demo",
+        "n": n_rag, "block_size": m,
+        "dtype": dt.name, "generator": generator,
+        "ragged": n_rag % m != 0,
+        "legs": legs,
+        "drift_leg": drift_leg,
+        "drift_events": len(drift_events),
+        "comm_drift_total": sum(
+            s.get("value", 0) for s in reg.get(
+                "tpu_jordan_comm_drift_total", {}).get("series", [])),
+        "unreconciled": unreconciled,
+        "mismatches": mismatches,
+        "silent_comm": bool(unreconciled or mismatches or silent_drift),
+        "blackbox": blackbox,
+    }

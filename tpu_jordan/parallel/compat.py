@@ -1,4 +1,6 @@
-"""jax API compatibility: ``shard_map`` and ``pcast`` across versions.
+"""jax API compatibility: ``shard_map`` and ``pcast`` across versions —
+plus the ONE place every explicit collective the engines issue passes
+through (ISSUE 14).
 
 The engines are written against the current jax surface — top-level
 ``jax.shard_map`` with the varying-type system and ``lax.pcast`` to
@@ -12,11 +14,26 @@ same collectives, just without the newer static type layer.  ``pcast``
 degrades to identity there: with no varying types, there is nothing to
 cast.  Every sharded module imports these two names from here instead
 of from jax, so the version split lives in exactly one file.
+
+Collective accounting (ISSUE 14, the communication observatory): the
+``psum``/``pmin``/``pmax``/``ppermute`` wrappers below are what every
+engine module imports instead of the ``lax`` originals.  With no
+recorder registered they ARE the originals up to one list-truthiness
+check that runs only at TRACE time (a cached executable never
+re-enters Python, so the warm path — and its zero-compile pins — pays
+nothing).  With a recorder registered (``obs/comm.py``'s
+``CollectiveRecorder``), each wrapper notes the collective's kind,
+mesh axis, operand shape and dtype as the tracer passes through — the
+host-side "what did this program actually issue" half of the
+``observed == analytical`` reconciliation invariant.  The recording
+changes NOTHING about the traced program: the note happens beside the
+``lax`` call, not inside it.
 """
 
 from __future__ import annotations
 
 import inspect
+import threading
 
 try:
     from jax import shard_map as _shard_map          # jax >= 0.7 surface
@@ -42,3 +59,79 @@ else:
         """No varying-type system in this jax: nothing to cast."""
         del axis_name, to
         return x
+
+
+# ---------------------------------------------------------------------
+# Collective recording (ISSUE 14): the opt-in trace-time observer.
+# ---------------------------------------------------------------------
+
+#: Active collective sinks (obs/comm.CollectiveRecorder instances).
+#: Registration is rare (a reconciliation window); the hot check in the
+#: wrappers is one list-truthiness test per traced collective.  The
+#: list is shared across threads deliberately: a recorder wants every
+#: collective traced anywhere in its window (jit tracing happens on the
+#: registering thread in practice; the lock only guards mutation).
+_RECORDERS: list = []
+_REC_LOCK = threading.Lock()
+
+
+def add_collective_recorder(sink) -> None:
+    """Register a sink whose ``note(kind, axis, shape, dtype)`` is
+    called for every explicit collective issued at trace time while it
+    is registered (``obs/comm.record_collectives`` is the public way)."""
+    with _REC_LOCK:
+        _RECORDERS.append(sink)
+
+
+def remove_collective_recorder(sink) -> None:
+    with _REC_LOCK:
+        try:
+            _RECORDERS.remove(sink)
+        except ValueError:
+            pass
+
+
+def recorders_active() -> bool:
+    return bool(_RECORDERS)
+
+
+def _axis_label(axis_name) -> str:
+    if isinstance(axis_name, (tuple, list)):
+        return ",".join(str(a) for a in axis_name)
+    return str(axis_name)
+
+
+def _note(kind: str, x, axis_name) -> None:
+    if not _RECORDERS:
+        return
+    shape = tuple(getattr(x, "shape", ()))
+    dtype = str(getattr(x, "dtype", ""))
+    label = _axis_label(axis_name)
+    with _REC_LOCK:
+        sinks = list(_RECORDERS)
+    for s in sinks:
+        s.note(kind, label, shape, dtype)
+
+
+def psum(x, axis_name):
+    """``lax.psum`` with trace-time accounting (see module docstring)."""
+    _note("psum", x, axis_name)
+    return _lax.psum(x, axis_name)
+
+
+def pmin(x, axis_name):
+    """``lax.pmin`` with trace-time accounting."""
+    _note("pmin", x, axis_name)
+    return _lax.pmin(x, axis_name)
+
+
+def pmax(x, axis_name):
+    """``lax.pmax`` with trace-time accounting."""
+    _note("pmax", x, axis_name)
+    return _lax.pmax(x, axis_name)
+
+
+def ppermute(x, axis_name, perm):
+    """``lax.ppermute`` with trace-time accounting."""
+    _note("ppermute", x, axis_name)
+    return _lax.ppermute(x, axis_name, perm)

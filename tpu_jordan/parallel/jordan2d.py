@@ -40,7 +40,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from .compat import pcast, shard_map
+from .compat import pcast, pmax, pmin, psum, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..config import eps_for
@@ -76,7 +76,7 @@ def _local_step2d(t, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
     own_c = kc == (t % pc)
     u_t = t // pc
     chunk = lax.dynamic_slice(Wloc, (0, 0, u_t * m), (bpr, m, m))
-    chunk_all = lax.psum(
+    chunk_all = psum(
         jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
 
     probe_dtype = jnp.float32 if jnp.dtype(dtype).itemsize < 4 else dtype
@@ -101,14 +101,14 @@ def _local_step2d(t, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
 
     # --- PIVOT REDUCTION over the whole mesh; ties to lowest global row
     # (same rule as the 1D and single-device paths).
-    kmin = lax.pmin(my_key, BOTH)
-    win_g = lax.pmin(
+    kmin = pmin(my_key, BOTH)
+    win_g = pmin(
         jnp.where(my_key == kmin, g_cand, lay.Nr), BOTH
     )
     singular = singular | ~jnp.isfinite(kmin)   # all-singular agreement
     i_won = (my_key == kmin) & (g_cand == win_g)
-    g_piv = lax.psum(jnp.where(i_won, g_cand, 0), BOTH)
-    H = lax.psum(
+    g_piv = psum(jnp.where(i_won, g_cand, 0), BOTH)
+    H = psum(
         jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0), BOTH
     ).astype(dtype)
 
@@ -116,14 +116,14 @@ def _local_step2d(t, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
     # the pivot row and of row t (one-hot psums riding ICI).
     own_piv = kr == (g_piv % pr)
     slot_piv = jnp.where(own_piv, g_piv // pr, 0)
-    row_piv = lax.psum(
+    row_piv = psum(
         jnp.where(own_piv,
                   lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False), 0.0),
         AXIS_R,
     )                                           # (m, Wc)
     own_t = kr == (t % pr)
     slot_t = t // pr
-    row_t = lax.psum(
+    row_t = psum(
         jnp.where(own_t,
                   lax.dynamic_index_in_dim(Wloc, slot_t, 0, False), 0.0),
         AXIS_R,
@@ -141,7 +141,7 @@ def _local_step2d(t, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
     # jordan2d_inplace._step2d): the slot that received old row t in the
     # swap gets row_t's t-chunk via one extra (m, m) psum; the slot now
     # holding global row t is zeroed (its multiplier is the prow write).
-    row_t_chunk = lax.psum(
+    row_t_chunk = psum(
         jnp.where(own_c,
                   lax.dynamic_slice(row_t, (0, u_t * m), (m, m)), 0.0),
         AXIS_C,
@@ -308,10 +308,10 @@ def _summa_residual_worker(a_loc, b_loc, *, lay: CyclicLayout2D, precision):
         own_ac = kc == (kb % pc)
         u = kb // pc
         a_panel = lax.dynamic_slice(a_loc, (0, 0, u * m), (bpr, m, m))
-        a_panel = lax.psum(jnp.where(own_ac, a_panel, 0.0), AXIS_C)
+        a_panel = psum(jnp.where(own_ac, a_panel, 0.0), AXIS_C)
         own_br = kr == (kb % pr)
         s = kb // pr
-        b_panel = lax.psum(
+        b_panel = psum(
             jnp.where(own_br,
                       lax.dynamic_index_in_dim(b_loc, s, 0, False), 0.0),
             AXIS_R,
@@ -328,8 +328,8 @@ def _summa_residual_worker(a_loc, b_loc, *, lay: CyclicLayout2D, precision):
     gcb = jnp.arange(wc // m) * pc + kc
     gj = (gcb[:, None] * m + jnp.arange(m)[None, :]).reshape(-1)[None, None, :]
     d = d - (gi == gj).astype(d.dtype)
-    rowsum = lax.psum(jnp.sum(jnp.abs(d), axis=2), AXIS_C)   # full row sums
-    return lax.pmax(jnp.max(rowsum), BOTH)[None, None]
+    rowsum = psum(jnp.sum(jnp.abs(d), axis=2), AXIS_C)   # full row sums
+    return pmax(jnp.max(rowsum), BOTH)[None, None]
 
 
 @partial(jax.jit, static_argnames=("mesh", "lay", "precision"))

@@ -32,7 +32,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from .compat import pcast, shard_map
+from .compat import pcast, pmin, psum, shard_map
 from jax.sharding import Mesh, PartitionSpec
 
 from ..config import eps_for
@@ -180,7 +180,7 @@ def _step2d(t: int, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
     # --- CHUNK BROADCAST along "pc" (pre-swap): candidates AND (after
     # the swap fix-up below) the eliminate multipliers.
     chunk = Wloc[:, :, u_t * m:(u_t + 1) * m]   # (bpr, m, m)
-    chunk_all = lax.psum(
+    chunk_all = psum(
         jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
 
     # --- PIVOT PROBE (layout per resolve_probe_layout).
@@ -196,12 +196,12 @@ def _step2d(t: int, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
     g_cand = gidx[slot_best]
 
     # --- PIVOT REDUCTION over the whole mesh; ties to lowest global row.
-    kmin = lax.pmin(my_key, BOTH)
-    win_g = lax.pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), BOTH)
+    kmin = pmin(my_key, BOTH)
+    win_g = pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), BOTH)
     singular = singular | ~jnp.isfinite(kmin)
     i_won = (my_key == kmin) & (g_cand == win_g)
-    g_piv = lax.psum(jnp.where(i_won, g_cand, 0), BOTH)
-    H = lax.psum(
+    g_piv = psum(jnp.where(i_won, g_cand, 0), BOTH)
+    H = psum(
         jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0), BOTH
     ).astype(dtype)
 
@@ -209,14 +209,14 @@ def _step2d(t: int, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
     # path's bytes (main.cpp:1097 / 1122-1129).
     own_piv = kr == (g_piv % pr)
     slot_piv = jnp.where(own_piv, g_piv // pr, 0)
-    row_piv = lax.psum(
+    row_piv = psum(
         jnp.where(own_piv,
                   lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False), 0.0),
         AXIS_R,
     )                                           # (m, Wc)
     own_t = kr == (t % pr)
     slot_t = t // pr                            # static (== s0)
-    row_t = lax.psum(
+    row_t = psum(
         jnp.where(own_t, Wloc[slot_t], 0.0), AXIS_R
     )                                           # (m, Wc)
 
@@ -237,7 +237,7 @@ def _step2d(t: int, Wloc, singular, *, lay: CyclicLayout2D, eps, precision,
     # needs old row t's t-chunk — broadcast along "pc" as one (m, m)
     # psum (the only collective this step adds vs round 3); the slot now
     # holding global row t is zeroed (its multiplier is the prow write).
-    row_t_chunk = lax.psum(
+    row_t_chunk = psum(
         jnp.where(own_c, row_t[:, u_t * m:(u_t + 1) * m], 0.0), AXIS_C
     ).astype(dtype)                             # (m, m)
     cur_Epiv = lax.dynamic_index_in_dim(chunk_all, slot_piv, 0, False)
@@ -291,7 +291,7 @@ def _step2d_swapfree(t, Wloc, alive, singular, pos, ipos, swaps, *,
 
     # --- CHUNK BROADCAST along "pc": candidates + multipliers.
     chunk = lax.dynamic_slice(Wloc, (z, z, u_t * m), (bpr, m, m))
-    chunk_all = lax.psum(
+    chunk_all = psum(
         jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
 
     # --- PROBE over all slots (alive-masked; the scattered dead rows
@@ -312,21 +312,21 @@ def _step2d_swapfree(t, Wloc, alive, singular, pos, ipos, swaps, *,
     my_pos = posg[slot_best]
 
     # --- PIVOT REDUCTION over the whole mesh, ties by swap coordinate.
-    kmin = lax.pmin(my_key, BOTH)
+    kmin = pmin(my_key, BOTH)
     finite = jnp.isfinite(kmin)
-    win_pos = lax.pmin(jnp.where(my_key == kmin, my_pos, lay.Nr), BOTH)
+    win_pos = pmin(jnp.where(my_key == kmin, my_pos, lay.Nr), BOTH)
     singular = singular | ~finite
     i_won = (my_key == kmin) & (my_pos == win_pos) & finite
-    g_piv = lax.psum(jnp.where(i_won, gidx[slot_best], 0), BOTH)
+    g_piv = psum(jnp.where(i_won, gidx[slot_best], 0), BOTH)
     g_piv = jnp.where(finite, g_piv, ipos[tt])
-    H = lax.psum(
+    H = psum(
         jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0), BOTH
     ).astype(dtype)
 
     # --- THE one row broadcast along "pr": the pivot's physical row.
     own_piv_r = kr == (g_piv % pr)
     slot_piv = jnp.where(own_piv_r, g_piv // pr, 0)
-    row_piv = lax.psum(
+    row_piv = psum(
         jnp.where(own_piv_r,
                   lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False), 0.0),
         AXIS_R,
@@ -435,11 +435,11 @@ def _unscramble_step(t: int, piv, Wloc, *, lay: CyclicLayout2D):
     own_cp = kc == (piv % pc)
     up = jnp.where(own_cp, piv // pc, 0)
 
-    col_t = lax.psum(
+    col_t = psum(
         jnp.where(own_ct, Wloc[:, :, u_t * m:(u_t + 1) * m], 0.0), AXIS_C
     )
     loc_p = lax.dynamic_slice(Wloc, (0, 0, up * m), (bpr, m, m))
-    col_p = lax.psum(jnp.where(own_cp, loc_p, 0.0), AXIS_C)
+    col_p = psum(jnp.where(own_cp, loc_p, 0.0), AXIS_C)
     # Chunk-granular writes: col_t into piv's chunk first, then col_p into
     # t's chunk — when t == piv both land on the same chunk with the same
     # value.
@@ -472,7 +472,7 @@ def _step2d_fori(t, Wloc, singular, swaps, *, lay: CyclicLayout2D, eps,
     # --- CHUNK BROADCAST along "pc" (pre-swap): candidates + (after the
     # swap fix-up) the eliminate multipliers — see _step2d.
     chunk = lax.dynamic_slice(Wloc, (0, 0, u_t * m), (bpr, m, m))
-    chunk_all = lax.psum(
+    chunk_all = psum(
         jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
 
     # --- PIVOT PROBE (layout per resolve_probe_layout; traced t ->
@@ -489,26 +489,26 @@ def _step2d_fori(t, Wloc, singular, swaps, *, lay: CyclicLayout2D, eps,
     g_cand = gidx[slot_best]
 
     # --- PIVOT REDUCTION over the whole mesh (identical to _step2d).
-    kmin = lax.pmin(my_key, BOTH)
-    win_g = lax.pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), BOTH)
+    kmin = pmin(my_key, BOTH)
+    win_g = pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), BOTH)
     singular = singular | ~jnp.isfinite(kmin)
     i_won = (my_key == kmin) & (g_cand == win_g)
-    g_piv = lax.psum(jnp.where(i_won, g_cand, 0), BOTH)
-    H = lax.psum(
+    g_piv = psum(jnp.where(i_won, g_cand, 0), BOTH)
+    H = psum(
         jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0), BOTH
     ).astype(dtype)
 
     # --- ROW BROADCASTS along "pr": (m, Wc) slices.
     own_piv = kr == (g_piv % pr)
     slot_piv = jnp.where(own_piv, g_piv // pr, 0)
-    row_piv = lax.psum(
+    row_piv = psum(
         jnp.where(own_piv,
                   lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False), 0.0),
         AXIS_R,
     )                                           # (m, Wc)
     own_t = kr == (t % pr)
     slot_t = t // pr
-    row_t = lax.psum(
+    row_t = psum(
         jnp.where(own_t,
                   lax.dynamic_index_in_dim(Wloc, slot_t, 0, False), 0.0),
         AXIS_R,
@@ -527,7 +527,7 @@ def _step2d_fori(t, Wloc, singular, swaps, *, lay: CyclicLayout2D, eps,
 
     # --- MULTIPLIERS from the pre-swap broadcast + swap fix-up (see
     # _step2d): one extra (m, m) psum, no second panel broadcast.
-    row_t_chunk = lax.psum(
+    row_t_chunk = psum(
         jnp.where(own_c,
                   lax.dynamic_slice(row_t, (0, u_t * m), (m, m)), 0.0),
         AXIS_C,
@@ -570,9 +570,9 @@ def _unscramble_step_fori(t, piv, Wloc, *, lay: CyclicLayout2D):
     up = jnp.where(own_cp, piv // pc, z)
 
     loc_t = lax.dynamic_slice(Wloc, (z, z, u_t * m), (bpr, m, m))
-    col_t = lax.psum(jnp.where(own_ct, loc_t, 0.0), AXIS_C)
+    col_t = psum(jnp.where(own_ct, loc_t, 0.0), AXIS_C)
     loc_p = lax.dynamic_slice(Wloc, (z, z, up * m), (bpr, m, m))
-    col_p = lax.psum(jnp.where(own_cp, loc_p, 0.0), AXIS_C)
+    col_p = psum(jnp.where(own_cp, loc_p, 0.0), AXIS_C)
     # Chunk-granular writes, same order as the static version: col_t into
     # piv's chunk first, then col_p into t's chunk.
     Wloc = lax.dynamic_update_slice(
@@ -628,7 +628,7 @@ def _gstep2d(t, j: int, Wloc, Uloc, Ploc, singular, *, lay: CyclicLayout2D,
         chunk = chunk - jnp.matmul(
             Uloc[:, :, :j * m].reshape(bpr * m, j * m), Ptc,
             precision=precision).reshape(bpr, m, m)
-    chunk_all = lax.psum(
+    chunk_all = psum(
         jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
 
     # --- PIVOT PROBE (layout per resolve_probe_layout; main.cpp:1039).
@@ -646,14 +646,14 @@ def _gstep2d(t, j: int, Wloc, Uloc, Ploc, singular, *, lay: CyclicLayout2D,
     # --- PIVOT REDUCTION over the whole mesh + the all-singular pin
     # (H := 0, g_piv := t — both flavors stay bit-equal on singular
     # inputs; the flags make the output invalid anyway).
-    kmin = lax.pmin(my_key, BOTH)
+    kmin = pmin(my_key, BOTH)
     finite = jnp.isfinite(kmin)
-    win_g = lax.pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), BOTH)
+    win_g = pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), BOTH)
     singular = singular | ~finite
     i_won = (my_key == kmin) & (g_cand == win_g) & finite
-    g_piv = lax.psum(jnp.where(i_won, g_cand, 0), BOTH)
+    g_piv = psum(jnp.where(i_won, g_cand, 0), BOTH)
     g_piv = jnp.where(finite, g_piv, tt.astype(g_piv.dtype))
-    H = lax.psum(
+    H = psum(
         jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0), BOTH
     ).astype(dtype)
 
@@ -679,7 +679,7 @@ def _gstep2d(t, j: int, Wloc, Uloc, Ploc, singular, *, lay: CyclicLayout2D,
         lax.dynamic_index_in_dim(Uloc, slot_t, 0, False),
         lax.dynamic_index_in_dim(chunk_all, slot_t, 0, False),
     ], axis=1)
-    stacked = lax.psum(jnp.concatenate([
+    stacked = psum(jnp.concatenate([
         jnp.where(own_piv_r, row1, 0.0),
         jnp.where(own_t_r, row2, 0.0),
     ], axis=0), AXIS_R)                         # (2m, Wc + Uw + m)

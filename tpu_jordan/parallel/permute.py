@@ -45,6 +45,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 from jax import lax
+from .compat import ppermute
 
 
 def ppermute_bucketed(items, dest, axis_name, p: int):
@@ -77,9 +78,9 @@ def ppermute_bucketed(items, dest, axis_name, p: int):
     fperm = [(i, (i + 1) % p) for i in range(p)]
     bperm = [(i, (i - 1) % p) for i in range(p)]
     for d in range(1, p // 2 + 1):
-        fwd = lax.ppermute(fwd, axis_name, fperm)       # from k - d
+        fwd = ppermute(fwd, axis_name, fperm)       # from k - d
         out = extract(out, fwd, (k - d) % p)
         if d <= (p - 1) // 2:
-            bwd = lax.ppermute(bwd, axis_name, bperm)   # from k + d
+            bwd = ppermute(bwd, axis_name, bperm)   # from k + d
             out = extract(out, bwd, (k + d) % p)
     return out

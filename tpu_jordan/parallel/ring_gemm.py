@@ -20,7 +20,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from .compat import pcast, shard_map
+from .compat import pcast, pmax, ppermute, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from .layout import CyclicLayout, cyclic_gather_perm, cyclic_scatter_perm
@@ -49,7 +49,7 @@ def _ring_worker(a_loc, b_loc, *, lay: CyclicLayout, precision):
         # Ring rotate: receive from (k+1)%p, send to (k-1+p)%p
         # (main.cpp:564-565, 639).
         perm = [(i, (i - 1 + p) % p) for i in range(p)]
-        buf = lax.ppermute(buf, AXIS, perm)
+        buf = ppermute(buf, AXIS, perm)
         return d, buf
 
     # pcast-to-varying: the accumulator is device-varying from step one (it mixes the
@@ -86,7 +86,7 @@ def _ring_residual_worker(a_loc, b_loc, *, lay: CyclicLayout, precision):
     gj = jnp.arange(lay.N)[None, None, :]
     d = d - (gi == gj).astype(d.dtype)
     local = jnp.max(jnp.sum(jnp.abs(d), axis=2))          # local ∞-norm part
-    return lax.pmax(local, AXIS)[None]                    # (1,) per worker
+    return pmax(local, AXIS)[None]                    # (1,) per worker
 
 
 @partial(jax.jit, static_argnames=("mesh", "lay", "precision"))

@@ -41,7 +41,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from .compat import pcast, shard_map
+from .compat import pcast, pmin, psum, shard_map
 from jax.sharding import Mesh, PartitionSpec
 
 from ..config import eps_for
@@ -81,14 +81,14 @@ def _step(t: int, Wloc, singular, *, lay: CyclicLayout, eps, precision,
     # --- PIVOT REDUCTION: two-stage composite-key pmin, ties to the lowest
     # global block row (replaces the custom MPI op, main.cpp:729-744,
     # 1000-1024, 1074).
-    kmin = lax.pmin(my_key, AXIS)
+    kmin = pmin(my_key, AXIS)
     g_cand = gidx[slot_best]
-    win_g = lax.pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), AXIS)
+    win_g = pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), AXIS)
     singular = singular | ~jnp.isfinite(kmin)   # all-singular (main.cpp:1075-83)
     i_won = (my_key == kmin) & (g_cand == win_g)
 
-    g_piv = lax.psum(jnp.where(i_won, g_cand, 0), AXIS)
-    H = lax.psum(
+    g_piv = psum(jnp.where(i_won, g_cand, 0), AXIS)
+    H = psum(
         jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0).astype(dtype),
         AXIS,
     )
@@ -97,14 +97,14 @@ def _step(t: int, Wloc, singular, *, lay: CyclicLayout, eps, precision,
     # psums (main.cpp:1097 / 1122-1129) — half the bytes of the augmented
     # path's (m, 2N) rows.
     safe_best = jnp.where(i_won, slot_best + s0, 0)
-    row_piv = lax.psum(
+    row_piv = psum(
         jnp.where(i_won, lax.dynamic_index_in_dim(Wloc, safe_best, 0, False),
                   0.0),
         AXIS,
     )                                           # (m, N)
     own_t = k == (t % p)
     slot_t = t // p                             # static (== s0)
-    row_t = lax.psum(
+    row_t = psum(
         jnp.where(own_t, Wloc[slot_t], 0.0), AXIS
     )                                           # (m, N)
 
@@ -164,28 +164,28 @@ def _step_fori(t, Wloc, singular, swaps, *, lay: CyclicLayout, eps,
     my_key = key[slot_best]
 
     # --- PIVOT REDUCTION (identical to _step).
-    kmin = lax.pmin(my_key, AXIS)
+    kmin = pmin(my_key, AXIS)
     g_cand = gidx[slot_best]
-    win_g = lax.pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), AXIS)
+    win_g = pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), AXIS)
     singular = singular | ~jnp.isfinite(kmin)
     i_won = (my_key == kmin) & (g_cand == win_g)
 
-    g_piv = lax.psum(jnp.where(i_won, g_cand, 0), AXIS)
-    H = lax.psum(
+    g_piv = psum(jnp.where(i_won, g_cand, 0), AXIS)
+    H = psum(
         jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0).astype(dtype),
         AXIS,
     )
 
     # --- ROW BROADCASTS (m, N), one-hot psums (main.cpp:1097/1122-1129).
     safe_best = jnp.where(i_won, slot_best, 0)
-    row_piv = lax.psum(
+    row_piv = psum(
         jnp.where(i_won, lax.dynamic_index_in_dim(Wloc, safe_best, 0, False),
                   0.0),
         AXIS,
     )                                           # (m, N)
     own_t = k == (t % p)
     slot_t = t // p
-    row_t = lax.psum(
+    row_t = psum(
         jnp.where(own_t, lax.dynamic_index_in_dim(Wloc, slot_t, 0, False),
                   0.0),
         AXIS,
@@ -273,23 +273,23 @@ def _step_swapfree(t, Wloc, alive, singular, pos, ipos, swaps, *,
     my_key = lmin
     my_pos = posl[slot_best]
 
-    kmin = lax.pmin(my_key, AXIS)
+    kmin = pmin(my_key, AXIS)
     finite = jnp.isfinite(kmin)
-    win_pos = lax.pmin(jnp.where(my_key == kmin, my_pos, lay.Nr), AXIS)
+    win_pos = pmin(jnp.where(my_key == kmin, my_pos, lay.Nr), AXIS)
     singular = singular | ~finite
     i_won = (my_key == kmin) & (my_pos == win_pos) & finite
-    g_piv = lax.psum(jnp.where(i_won, gidx[slot_best], 0), AXIS)
+    g_piv = psum(jnp.where(i_won, gidx[slot_best], 0), AXIS)
     # All-singular pin: the physical row at swap position t (the swap
     # engines' benign self-swap target), H := 0 — deterministic.
     g_piv = jnp.where(finite, g_piv, ipos[t])
-    H = lax.psum(
+    H = psum(
         jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0).astype(dtype),
         AXIS,
     )
 
     # --- THE one row broadcast (m, N): the pivot's physical row.
     safe_best = jnp.where(i_won, slot_best, 0)
-    row_piv = lax.psum(
+    row_piv = psum(
         jnp.where(i_won, lax.dynamic_index_in_dim(Wloc, safe_best, 0, False),
                   0.0),
         AXIS,
@@ -448,15 +448,15 @@ def _gstep(t, j: int, Wloc, Uloc, P, singular, *, lay: CyclicLayout, eps,
     # pin: when no candidate anywhere is invertible, H := 0 and
     # g_piv := t (a benign self-swap), so both flavors stay bit-equal
     # even on singular inputs (the flags make the output invalid anyway).
-    kmin = lax.pmin(my_key, AXIS)
+    kmin = pmin(my_key, AXIS)
     finite = jnp.isfinite(kmin)
     g_cand = gidx[slot_best]
-    win_g = lax.pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), AXIS)
+    win_g = pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), AXIS)
     singular = singular | ~finite
     i_won = (my_key == kmin) & (g_cand == win_g) & finite
-    g_piv = lax.psum(jnp.where(i_won, g_cand, 0), AXIS)
+    g_piv = psum(jnp.where(i_won, g_cand, 0), AXIS)
     g_piv = jnp.where(finite, g_piv, tt.astype(g_piv.dtype))
-    H = lax.psum(
+    H = psum(
         jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0).astype(dtype),
         AXIS,
     )
@@ -477,7 +477,7 @@ def _gstep(t, j: int, Wloc, Uloc, P, singular, *, lay: CyclicLayout, eps,
         lax.dynamic_index_in_dim(Uloc, slot_t, 0, False),
         lax.dynamic_index_in_dim(col, slot_t, 0, False),
     ], axis=1)
-    stacked = lax.psum(jnp.concatenate([
+    stacked = psum(jnp.concatenate([
         jnp.where(i_won, row1, 0.0),
         jnp.where(own_t, row2, 0.0),
     ], axis=0), AXIS)                            # (2m, N + Uw + m)

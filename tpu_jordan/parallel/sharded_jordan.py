@@ -35,7 +35,7 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 from jax import lax
-from .compat import pcast, shard_map
+from .compat import pcast, pmin, psum, shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from ..config import eps_for
@@ -98,16 +98,16 @@ def _local_step(t, Wloc, singular, *, lay: CyclicLayout, eps, precision,
     # like the single-device argmin (not lowest worker id, which can own a
     # higher global row).  g_cand values are distinct across workers
     # (gidx ≡ k mod p), so the winner is unique even when every key is inf.
-    kmin = lax.pmin(my_key, AXIS)
+    kmin = pmin(my_key, AXIS)
     g_cand = gidx[slot_best]
-    win_g = lax.pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), AXIS)
+    win_g = pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), AXIS)
     singular = singular | ~jnp.isfinite(kmin)   # all-singular (main.cpp:1075-83)
     i_won = (my_key == kmin) & (g_cand == win_g)
 
     # Pivot's global block row and its inverse, shared one-hot (the scalar
     # payload of the reference's custom reduction).
-    g_piv = lax.psum(jnp.where(i_won, g_cand, 0), AXIS)
-    H = lax.psum(
+    g_piv = psum(jnp.where(i_won, g_cand, 0), AXIS)
+    H = psum(
         jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0).astype(dtype),
         AXIS,
     )
@@ -116,13 +116,13 @@ def _local_step(t, Wloc, singular, *, lay: CyclicLayout, eps, precision,
     # (the Send/Recv half of the swap, main.cpp:1122-1129), both as one-hot
     # psums riding ICI.
     safe_best = jnp.where(i_won, slot_best, 0)
-    row_piv = lax.psum(
+    row_piv = psum(
         jnp.where(i_won, lax.dynamic_index_in_dim(Wloc, safe_best, 0, False), 0.0),
         AXIS,
     )                                          # (m, 2N)
     own_t = k == (t % p)
     slot_t = t // p
-    row_t = lax.psum(
+    row_t = psum(
         jnp.where(own_t, lax.dynamic_index_in_dim(Wloc, slot_t, 0, False), 0.0),
         AXIS,
     )                                          # (m, 2N)

@@ -213,12 +213,27 @@ def _chip_for(point: TunePoint):
 def projected_seconds(point: TunePoint, group: int = 1,
                       swapfree: bool = False) -> float:
     """comm_model's projected total wall seconds for one engine at a
-    point — the shared backing of every cost hook below."""
+    point — the shared backing of every cost hook below.
+
+    ISSUE 14 (ROADMAP item 5's self-pricing loop, first rung): the
+    comm TERM of the projection is scaled by the communication
+    observatory's measured calibration
+    (``obs/comm.cost_comm_scale`` — the EWMA of judged
+    measured/projected comm ratios).  Feedback is OPT-IN
+    (``obs.comm.set_cost_feedback(True)``) and the scale is exactly
+    1.0 otherwise, so default cost rankings are byte-identical to the
+    pre-ISSUE-14 behavior; with it on, a chip whose measured
+    interconnect runs slower/faster than the model's constants
+    re-prices every comm-dominated engine from evidence instead of
+    hand-edited constants."""
     pr, pc = point.mesh_shape
-    return comm_model().predict(
+    r = comm_model().predict(
         point.n, point.block_size, pr, pc, _chip_for(point),
         group=group, swapfree=swapfree,
-    )["total"]
+    )
+    from ..obs.comm import cost_comm_scale
+
+    return r["total"] + (cost_comm_scale() - 1.0) * r["comm"]
 
 
 def _cost_inplace(pt: TunePoint) -> float:
