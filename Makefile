@@ -118,9 +118,11 @@ capacity-demo:
 	  > /tmp/tpu_jordan_capacity.json
 	python tools/check_capacity.py /tmp/tpu_jordan_capacity.json
 
-# Comm demo + validation (ISSUE 14, docs/OBSERVABILITY.md): five tiny
-# distributed solves (1D + 2D meshes, both gather modes, a grouped
-# engine, a ragged problem size) each reconciling the collective
+# Comm demo + validation (ISSUE 14 + the ISSUE 15 solve legs,
+# docs/OBSERVABILITY.md): seven tiny distributed solves (1D + 2D
+# meshes, both gather modes, a grouped engine, a ragged problem size,
+# and the two distributed-SOLVE legs — the [A | B] elimination's own
+# inventory) each reconciling the collective
 # multiset the traced program actually issued against the
 # layout-derived analytical inventory, plus one deliberate
 # measured-vs-projected drift leg whose out-of-band ratio must be a

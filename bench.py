@@ -499,6 +499,138 @@ def _sharded_swapfree_row(extra):
         extra["sharded_swapfree_gather_false_error"] = str(e)[:200]
 
 
+def _solve_sharded_row(extra):
+    """ISSUE 15 capture row ``solve_sharded_4096``: the distributed
+    [A | B] elimination (k=8 RHS) on a 1D p=8 mesh.  This bench host
+    exposes ONE chip, so the leg runs on a forced 8-virtual-device CPU
+    mesh in a subprocess (the __graft_entry__ dryrun recipe) — elapsed
+    is CPU-mesh wall time; the row's evidence is the per-device
+    ``cost_analysis`` FLOP share (pinned ~1/p of the single-device
+    solve's), the backward-error gate, and the communication
+    observatory's numbers: ``*_comm_bytes`` (layout-exact
+    elimination-section payload, accounting-class — never compared
+    cross-round) and ``*_comm_gbps`` (achieved GB/s — a RATE the
+    sentinel pages on, the mesh bandwidth sentinel).  GFLOP/s uses the
+    workload-aware n³(1+k/n) convention with median-of-3 spread."""
+    import subprocess
+    import sys
+
+    from __graft_entry__ import _REPO, _cpu_env
+
+    child = (
+        "import jax, json\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "from tpu_jordan.linalg import solve_system\n"
+        "from tpu_jordan.obs import hwcost as _hwcost\n"
+        "from tpu_jordan.ops import generate\n"
+        "from tpu_jordan.tuning.measure import measure_direct\n"
+        "import jax.numpy as jnp\n"
+        "n, m, k, p = 4096, 128, 8, 8\n"
+        "a = generate('rand', (n, n), jnp.float32)\n"
+        "b = generate('rand', (n, k), jnp.float32, row_offset=n)\n"
+        "r = solve_system(a, b, block_size=m, workers=p)\n"
+        "assert r.engine == 'solve_sharded', r.engine\n"
+        "from tpu_jordan.linalg.api import solve_mesh_backend\n"
+        "mesh, lay, sc_a, sc_b, compile_fn, _ = "
+        "solve_mesh_backend(p, n, m)\n"
+        "W = sc_a(a, lay, mesh); X = sc_b(b, lay, mesh)\n"
+        "run = compile_fn(W, X, mesh, lay)\n"
+        "meas = measure_direct(\n"
+        "    lambda: jax.block_until_ready(run(W, X)[0]),\n"
+        "    samples=3, warmup=1)\n"
+        "flops = _hwcost.baseline_workload_flops(n, 'solve', k=k)\n"
+        "d = r.comm.drift or {}\n"
+        "print(json.dumps({'n': n, 'm': m, 'k': k, 'mesh': f'p{p}',\n"
+        "    'engine': r.engine,\n"
+        "    'elapsed_s': round(meas.seconds, 3),\n"
+        "    'gflops': round(flops / meas.seconds / 1e9, 1),\n"
+        "    'spread_pct': meas.spread_pct,\n"
+        "    'variance_flag': meas.variance_flag,\n"
+        "    'rel_backward_error': r.rel_residual,\n"
+        "    'comm_payload_bytes': int(sum(\n"
+        "        s.payload_bytes * s.executed for s in r.comm.sigs\n"
+        "        if s.section == 'engine')),\n"
+        "    'comm_gbps': d.get('achieved_gbps'),\n"
+        "    'comm_vs_projected': d.get('comm_vs_projected')}))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", child], env=_cpu_env(8), cwd=_REPO,
+            capture_output=True, text=True, timeout=900, check=True)
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        row["note"] = ("cpu-mesh distributed-solve leg, not chip "
+                       "throughput; flops convention n^3*(1+k/n)")
+        extra["solve_sharded_4096"] = row
+        extra["solve_sharded_4096_k8_gflops"] = row["gflops"]
+        extra["solve_sharded_4096_k8_spread_pct"] = row["spread_pct"]
+        if row.get("variance_flag"):
+            extra["solve_sharded_4096_k8_variance_flag"] = row[
+                "variance_flag"]
+        # Sentinel classes (tools/check_bench.py): bytes = accounting
+        # (never compared cross-round), GB/s = rate (pages on quiet
+        # shortfalls) — the ISSUE 14 convention.
+        extra["solve_sharded_4096_comm_bytes"] = row[
+            "comm_payload_bytes"]
+        if row.get("comm_gbps") is not None:
+            extra["solve_sharded_4096_comm_gbps"] = round(
+                row["comm_gbps"], 4)
+    except Exception as e:                      # noqa: BLE001
+        extra["solve_sharded_4096_error"] = str(e)[:200]
+
+
+def _solve_fori_row(extra):
+    """ISSUE 15 capture row ``solve_fori_8192``: the fori-compiled
+    single-device solve engine at n=8192, m=64 — Nr=128, a point the
+    UNROLLED solve engine refuses (MAX_UNROLL_NR=64): the row is the
+    evidence that the cap is really lifted, captured with the standard
+    robust fields.  GFLOP/s stays on the n³(1+k/n) useful-work
+    convention; the executable's own ``cost_analysis`` FLOPs sit next
+    to it (the fori engine's full-width updates pay ~2n³ —
+    ``xla_vs_convention`` shows that honestly, like every accounting
+    field)."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_jordan.linalg.engine import block_jordan_solve_fori
+    from tpu_jordan.obs import hwcost as _hwcost
+    from tpu_jordan.ops import generate
+    from tpu_jordan.tuning.measure import measure_direct
+
+    n, m, k = 8192, 64, 8
+    try:
+        a = generate("rand", (n, n), jnp.float32)
+        b = generate("rand", (n, k), jnp.float32, row_offset=n)
+        compiled = jax.jit(
+            lambda aa, bb: block_jordan_solve_fori(aa, bb, block_size=m)
+        ).lower(a, b).compile()
+        cost = _hwcost.executable_cost(compiled)
+        x, sing = compiled(a, b)
+        jax.block_until_ready(x)
+        if bool(sing):
+            raise _Singular("solve_fori_8192: fixture flagged singular")
+
+        def call(_c=compiled, _a=a, _b=b):
+            jax.block_until_ready(_c(_a, _b)[0])
+
+        meas = _retry_transient(
+            lambda: measure_direct(call, samples=3, warmup=1))
+        flops = _hwcost.baseline_workload_flops(n, "solve", k=k)
+        extra["solve_fori_8192_k8_gflops"] = round(
+            flops / meas.seconds / 1e9, 1)
+        extra["solve_fori_8192_k8_spread_pct"] = meas.spread_pct
+        if meas.variance_flag:
+            extra["solve_fori_8192_k8_variance_flag"] = \
+                meas.variance_flag
+        extra["solve_fori_8192_flops_convention"] = "n^3*(1+k/n)"
+        extra["solve_fori_8192_nr"] = -(-n // m)
+        if cost.available and cost.flops:
+            extra["solve_fori_8192_xla_flops"] = cost.flops
+            extra["solve_fori_8192_xla_vs_convention"] = round(
+                cost.flops / flops, 2)
+    except Exception as e:                      # noqa: BLE001
+        extra["solve_fori_8192_error"] = str(e)[:200]
+
+
 #: BENCH_r04.json's 4096² number of record — the high-water mark the
 #: r04→r05 dip fell from (diagnosed as single-sample session-lottery
 #: noise, BASELINE.md "The r04→r05 4096² dip"); the dip guard row
@@ -925,6 +1057,13 @@ def main(argv=None):
     # best-effort — a failure records an error key, never loses the
     # chip rows above.
     _sharded_swapfree_row(extra)
+
+    # Distributed-solve tiers (ISSUE 15 satellite): the sharded [A | B]
+    # elimination on the virtual 1D mesh (comm bytes + GB/s sentinel
+    # fields) and the fori solve engine at Nr=128 — the point the
+    # unrolled engine refuses.  Best-effort like every non-contract row.
+    _solve_sharded_row(extra)
+    _solve_fori_row(extra)
 
     print(json.dumps({
         "metric": "invert_4096x4096_f32_gflops",

@@ -382,6 +382,61 @@ class TestCommSentinel:
         ]
         assert check_bench.main(files) == 2
 
+    def test_solve_sharded_gflops_quiet_regression_pages(self,
+                                                         tmp_path):
+        """ISSUE 15 satellite, trapped both ways (1/2): a quiet
+        shortfall on the new ``solve_sharded_4096_k8_gflops`` rate key
+        — low recorded spread on both ends — is the exit-2 class."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "solve_sharded_4096_k8_gflops": 120.0,
+                "solve_sharded_4096_k8_spread_pct": 2.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "solve_sharded_4096_k8_gflops": 80.0,
+                "solve_sharded_4096_k8_spread_pct": 2.0})),
+        ]
+        assert check_bench.main(files) == 2
+
+    def test_solve_row_accounting_keys_never_page(self, tmp_path):
+        """ISSUE 15 satellite, trapped both ways (2/2): the sharded
+        row's ``*_comm_bytes`` (and the fori row's ``*_xla_flops``)
+        are accounting-class — a 10x change never pages — while the
+        ``*_comm_gbps`` twin and the ``solve_fori_8192_k8_gflops``
+        rate page like any gflops shortfall."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "solve_sharded_4096_comm_bytes": 3.2e9,
+                "solve_fori_8192_xla_flops": 1.1e12,
+                "solve_fori_8192_k8_gflops": 50.0,
+                "solve_fori_8192_k8_spread_pct": 1.5})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "solve_sharded_4096_comm_bytes": 3.2e8,
+                "solve_fori_8192_xla_flops": 1.1e11,
+                "solve_fori_8192_k8_gflops": 49.0,
+                "solve_fori_8192_k8_spread_pct": 1.5})),
+        ]
+        assert check_bench.main(files) == 0
+        assert check_bench.is_accounting_key(
+            "solve_sharded_4096_comm_bytes")
+        # Raw flop counts are not rate keys: never comparable at all.
+        keys = check_bench.comparable_keys(
+            {"metric": "m", "value": 1.0,
+             "extra": {"solve_fori_8192_xla_flops": 1.1e12,
+                       "solve_sharded_4096_comm_bytes": 3.2e9,
+                       "solve_fori_8192_k8_gflops": 50.0}})
+        assert "solve_fori_8192_xla_flops" not in keys
+        assert "solve_sharded_4096_comm_bytes" not in keys
+        assert "solve_fori_8192_k8_gflops" in keys
+        files[1] = _write(tmp_path, "r2b.json", _round(10000.0, {
+            "solve_sharded_4096_comm_gbps": 1.0,
+            "solve_fori_8192_k8_gflops": 30.0,
+            "solve_fori_8192_k8_spread_pct": 1.5}))
+        files[0] = _write(tmp_path, "r1b.json", _round(10000.0, {
+            "solve_sharded_4096_comm_gbps": 3.5,
+            "solve_fori_8192_k8_gflops": 50.0,
+            "solve_fori_8192_k8_spread_pct": 1.5}))
+        assert check_bench.main(files) == 2
+
     def test_comm_gbps_variance_and_unknown_rules_hold(self, tmp_path):
         """A noisy session explains its own GB/s dip; a round without
         spread stats (the single-run subprocess leg) is unknown, never

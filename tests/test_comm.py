@@ -314,6 +314,123 @@ class TestReconciliation:
         assert rep2.reconciled is None
 
 
+def _reconcile_solve_1d(n, m, p, k, unroll):
+    from tpu_jordan.parallel.ring_gemm import _to_identity_padded_blocks
+    from tpu_jordan.parallel.sharded_inplace import (
+        compile_sharded_jordan_solve, scatter_rhs_1d,
+    )
+
+    mesh = make_mesh(p)
+    lay = CyclicLayout.create(n, m, p)
+    a = generate("absdiff", (n, n), jnp.float32)
+    b = generate("rand", (n, k), jnp.float32, row_offset=n)
+    W = _to_identity_padded_blocks(a, lay, mesh)
+    X = scatter_rhs_1d(b, lay, mesh)
+    rep = comm.engine_report(engine="solve_sharded", lay=lay,
+                             dtype="float32", unroll=unroll, rhs=k)
+    with comm.record_collectives() as rec:
+        compile_sharded_jordan_solve(W, X, mesh, lay, unroll=unroll)
+    rep.attach_observed("engine", rec.records)
+    return rep
+
+
+def _reconcile_solve_2d(n, m, pr, pc, k, unroll):
+    from tpu_jordan.parallel.jordan2d import scatter_matrix_2d
+    from tpu_jordan.parallel.jordan2d_inplace import (
+        compile_sharded_jordan_solve_2d, scatter_rhs_2d,
+    )
+
+    mesh = make_mesh_2d(pr, pc)
+    lay = CyclicLayout2D.create(n, m, pr, pc)
+    a = generate("absdiff", (n, n), jnp.float32)
+    b = generate("rand", (n, k), jnp.float32, row_offset=n)
+    W = scatter_matrix_2d(a, lay, mesh)
+    X = scatter_rhs_2d(b, lay, mesh)
+    rep = comm.engine_report(engine="solve_sharded", lay=lay,
+                             dtype="float32", unroll=unroll, rhs=k)
+    with comm.record_collectives() as rec:
+        compile_sharded_jordan_solve_2d(W, X, mesh, lay, unroll=unroll)
+    rep.attach_observed("engine", rec.records)
+    return rep
+
+
+class TestSolveReconciliation:
+    """ISSUE 15: the distributed-solve flavors reconcile multiset-exact
+    like every other engine — including the unrolled flavor's
+    per-superstep SHRINKING stacked-row shapes (each step its own
+    signature), the fori flavor's full-width once-traced rows, and a
+    ragged size (padded tail in the inventory)."""
+
+    @pytest.mark.parametrize("unroll", [True, False])
+    def test_1d_solve_flavors(self, unroll):
+        rep = _reconcile_solve_1d(56, 8, 4, 3, unroll)
+        assert rep.reconciled is True, rep.mismatches
+
+    def test_2d_solve_unrolled(self):
+        rep = _reconcile_solve_2d(56, 8, 2, 2, 2, True)
+        assert rep.reconciled is True, rep.mismatches
+
+    @pytest.mark.slow   # heavy duplicates of the tier-1 flavors above
+    @pytest.mark.parametrize("pr,pc,k,unroll", [
+        (2, 4, 1, False),      # fori on the rectangular mesh
+        (2, 2, 5, False),
+    ])
+    def test_2d_solve_fori_meshes(self, pr, pc, k, unroll):
+        rep = _reconcile_solve_2d(72, 8, pr, pc, k, unroll)
+        assert rep.reconciled is True, rep.mismatches
+
+    def test_ragged_solve_inventory_reconciles(self):
+        rep = _reconcile_solve_1d(52, 8, 4, 2, True)   # Nr pads 7 -> 8
+        assert rep.reconciled is True, rep.mismatches
+        # The shrinking unrolled row shapes really are per-step sigs.
+        widths = sorted({s.shape[-1] for s in rep.sigs
+                        if s.phase == "row_bcast"})
+        assert len(widths) == rep.sigs[0].executed == 8
+
+    def test_solve_report_has_no_residual_section(self):
+        lay = CyclicLayout.create(56, 8, 4)
+        rep = comm.engine_report(engine="solve_sharded", lay=lay,
+                                 dtype="float32", rhs=3)
+        assert not [s for s in rep.sigs if s.section == "residual"]
+        gather_sigs = [s for s in rep.sigs if s.section == "gather"]
+        assert len(gather_sigs) == 1 and gather_sigs[0].implicit
+        assert gather_sigs[0].shape == (lay.N, 3)
+
+    def test_unknown_engine_has_no_inventory_and_fails_loudly(self):
+        lay = CyclicLayout.create(56, 8, 4)
+        with pytest.raises(ValueError, match="inventory"):
+            comm.engine_report(engine="solve_sharded_v2", lay=lay,
+                               dtype="float32")
+
+    def test_registry_lint_every_distributed_solve_config_accounted(
+            self):
+        """The ISSUE 15 registry lint: every solve-workload registry
+        config that is legal at ANY distributed point must name an
+        engine with a registered comm inventory — a new distributed
+        engine without accounting fails loudly here, not silently in
+        production."""
+        from tpu_jordan.tuning.registry import CONFIGS, TunePoint
+
+        points = [
+            TunePoint.create(4096, 128, "float32", workers=8,
+                             workload=w)
+            for w in ("solve", "solve_spd")
+        ] + [
+            TunePoint.create(4096, 128, "float32", workers=(2, 4),
+                             workload=w)
+            for w in ("solve", "solve_spd")
+        ]
+        for cfg in CONFIGS:
+            if not cfg.workload.startswith("solve"):
+                continue
+            if any(cfg.workload == pt.workload and cfg.legal(pt)
+                   for pt in points):
+                assert cfg.engine in comm.INVENTORY_ENGINES, (
+                    f"registry config {cfg.name!r} ({cfg.engine}) is "
+                    f"legal at a distributed point but has NO comm "
+                    f"inventory (obs/comm.INVENTORY_ENGINES)")
+
+
 # ---------------------------------------------------------------------
 # Driver + solver integration.
 # ---------------------------------------------------------------------

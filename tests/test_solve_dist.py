@@ -1,0 +1,249 @@
+"""Distributed solve (ISSUE 15): the [A | B] elimination sharded over
+the 1D/2D meshes plus the fori solve engine that lifts MAX_UNROLL_NR.
+
+Parity discipline (the house style): cross-program pins (distributed vs
+single-device) run float64 fixtures — BIT-EXACT on block-aligned sizes
+(n % m == 0, where the two XLA programs provably compute identical op
+sequences; pinned), tight allclose on ragged ones (identity-pad
+constant-folding reorders XLA reductions at the ulp level — the same
+caveat the invert parity suite carries); same-family pins (unrolled vs
+fori, 1D flavor vs 1D flavor) are bitwise everywhere."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_jordan.driver import UsageError
+from tpu_jordan.linalg import solve_system
+from tpu_jordan.linalg.engine import (block_jordan_solve,
+                                      block_jordan_solve_fori)
+from tpu_jordan.ops import generate
+
+
+def _fixture(n, k, dtype=jnp.float64, gen="rand"):
+    a = generate(gen, (n, n), dtype)
+    b = generate("crand" if jnp.dtype(dtype).kind == "c" else "rand",
+                 (n, k), dtype, row_offset=n)
+    return a, b
+
+
+class TestDistributedSolveParity:
+    @pytest.mark.smoke      # the distributed-solve engine-parity case
+    def test_1d_p2_bitmatches_single_device(self):
+        a, b = _fixture(48, 3)
+        x_ref, s_ref = block_jordan_solve(a, b, block_size=8)
+        res = solve_system(a, b, block_size=8, workers=2)
+        assert res.engine == "solve_sharded"
+        assert bool(s_ref) is False and res.singular is False
+        assert np.array_equal(np.asarray(res.x), np.asarray(x_ref)), \
+            "1D distributed solve diverged bitwise from single-device"
+
+    def test_1d_tied_pivots_bitmatch(self):
+        # |i-j| has exactly-repeated candidate blocks: the composite-key
+        # pmin must reproduce argmin's lowest-global-row tie rule.
+        a, b = _fixture(64, 2, gen="absdiff")
+        x_ref, _ = block_jordan_solve(a, b, block_size=8)
+        res = solve_system(a, b, block_size=8, workers=4)
+        assert np.array_equal(np.asarray(res.x), np.asarray(x_ref))
+
+    def test_ragged_n_k1_edge(self):
+        # Ragged n (identity-pad tail mid-block) + the thinnest RHS:
+        # unrolled and fori distributed flavors stay BITWISE equal;
+        # vs the single-device engine the pin is tight allclose (see
+        # module docstring).
+        from tpu_jordan.parallel import make_mesh
+        from tpu_jordan.parallel.layout import CyclicLayout
+        from tpu_jordan.parallel.ring_gemm import (
+            _to_identity_padded_blocks)
+        from tpu_jordan.parallel.sharded_inplace import (
+            compile_sharded_jordan_solve, gather_solution_1d,
+            scatter_rhs_1d)
+
+        n, m, p = 45, 8, 4
+        a, b = _fixture(n, 1)
+        x_ref, _ = block_jordan_solve(a, b, block_size=m)
+        mesh = make_mesh(p)
+        lay = CyclicLayout.create(n, m, p)
+        W = _to_identity_padded_blocks(a, lay, mesh)
+        X = scatter_rhs_1d(b, lay, mesh)
+        outs = []
+        for unroll in (True, False):
+            run = compile_sharded_jordan_solve(W, X, mesh, lay,
+                                               unroll=unroll)
+            xb, sing = run(W, X)
+            assert not bool(sing.any())
+            outs.append(np.asarray(gather_solution_1d(xb, lay, n)))
+        assert np.array_equal(outs[0], outs[1]), \
+            "1D solve fori flavor diverged bitwise from unrolled"
+        np.testing.assert_allclose(outs[0], np.asarray(x_ref),
+                                   rtol=1e-9, atol=1e-12)
+
+    def test_2d_2x4_gather_false_bitmatches(self):
+        a, b = _fixture(48, 2)
+        x_ref, _ = block_jordan_solve(a, b, block_size=8)
+        res = solve_system(a, b, block_size=8, workers=(2, 4),
+                           gather=False)
+        assert res.engine == "solve_sharded"
+        # gather=False still returns the dense X (it is O(n·k) and the
+        # verification needs it) PLUS the sharded row blocks.
+        assert np.array_equal(np.asarray(res.x), np.asarray(x_ref))
+        assert res.x_blocks is not None and res.layout is not None
+        from tpu_jordan.parallel.jordan2d_inplace import (
+            gather_solution_2d)
+
+        x2 = gather_solution_2d(res.x_blocks, res.layout, 48)
+        assert np.array_equal(np.asarray(x2), np.asarray(res.x))
+
+    @pytest.mark.slow   # heavy duplicate of the 2x4 leg (tier-1 keeps
+    #   the smoke p=2 + 2x4 pins; the gathered 2D twin runs nightly)
+    def test_2d_2x2_gathered_bitmatches(self):
+        a, b = _fixture(64, 3)
+        x_ref, _ = block_jordan_solve(a, b, block_size=8)
+        res = solve_system(a, b, block_size=8, workers=(2, 2))
+        assert np.array_equal(np.asarray(res.x), np.asarray(x_ref))
+        assert res.x_blocks is None
+
+    def test_per_device_flops_strictly_below_single_device(self):
+        # The acceptance FLOP pin: the sharded executable's OWN
+        # cost_analysis (the per-device SPMD program) must land
+        # strictly below the single-device solve's at the same n.
+        import jax
+
+        from tpu_jordan.obs import hwcost as _hwcost
+        from tpu_jordan.parallel import make_mesh
+        from tpu_jordan.parallel.layout import CyclicLayout
+        from tpu_jordan.parallel.ring_gemm import (
+            _to_identity_padded_blocks)
+        from tpu_jordan.parallel.sharded_inplace import (
+            compile_sharded_jordan_solve, scatter_rhs_1d)
+
+        n, m, k, p = 128, 16, 4, 4
+        a, b = _fixture(n, k, jnp.float32)
+        single = jax.jit(
+            lambda aa, bb: block_jordan_solve(aa, bb, block_size=m)
+        ).lower(a, b).compile()
+        fs = _hwcost.executable_cost(single).flops
+        mesh = make_mesh(p)
+        lay = CyclicLayout.create(n, m, p)
+        W = _to_identity_padded_blocks(a, lay, mesh)
+        X = scatter_rhs_1d(b, lay, mesh)
+        run = compile_sharded_jordan_solve(W, X, mesh, lay)
+        fd = _hwcost.executable_cost(run).flops
+        assert fs and fd, "cost_analysis unavailable on this backend"
+        assert fd < fs, (
+            f"per-device flops {fd} not below single-device {fs}")
+        # ~1/p up to the unsharded probe/glue share: well under 1/2
+        # at p=4.
+        assert fd / fs < 0.5
+
+
+class TestSolveForiEngine:
+    def test_bitmatches_unrolled(self):
+        for gen, n, m, k, dt, spd in [
+            ("rand", 48, 8, 3, jnp.float64, False),
+            ("kms", 48, 8, 2, jnp.float64, True),
+            ("crand", 32, 8, 2, jnp.complex64, False),
+        ]:
+            a, b = _fixture(n, k, dt, gen)
+            xu, su = block_jordan_solve(a, b, block_size=m, spd=spd)
+            xf, sf = block_jordan_solve_fori(a, b, block_size=m,
+                                             spd=spd)
+            assert bool(su) == bool(sf) is False
+            assert np.array_equal(np.asarray(xu), np.asarray(xf)), \
+                f"fori diverged bitwise ({gen}, spd={spd})"
+
+    def test_unroll_cap_is_typed_and_names_the_remedy(self):
+        # ISSUE 15 satellite: the old ValueError became a typed
+        # UsageError that names the fori engine as the remedy.
+        n, m = 520, 8          # Nr = 65 > MAX_UNROLL_NR = 64
+        a, b = _fixture(n, 1, jnp.float32)
+        with pytest.raises(UsageError, match="solve_fori"):
+            block_jordan_solve(a, b, block_size=m)
+
+    def test_auto_resolves_large_nr_to_fori(self):
+        # engine="auto" beyond MAX_UNROLL_NR lands on the fori engine
+        # (solve_aug is illegal there) and the solve still gates clean.
+        n, m = 520, 8
+        a, b = _fixture(n, 2, jnp.float32)
+        res = solve_system(a, b, block_size=m)
+        assert res.engine == "solve_fori"
+        assert res.rel_residual < 1e-5
+
+    def test_fori_trace_refusal_is_typed(self):
+        n, m = 520, 8
+        a, b = _fixture(n, 1, jnp.float32)
+        with pytest.raises(UsageError, match="numerics='trace'"):
+            solve_system(a, b, block_size=m, numerics="trace")
+
+
+class TestDistributedSolveFlagContract:
+    def test_numerics_trace_distributed_typed_refusal(self):
+        a, b = _fixture(32, 1)
+        with pytest.raises(UsageError, match="summary"):
+            solve_system(a, b, block_size=8, workers=2,
+                         numerics="trace")
+
+    def test_spd_distributed_typed_refusal(self):
+        a, b = _fixture(32, 1)
+        with pytest.raises(UsageError, match="spd"):
+            solve_system(a, b, block_size=8, workers=2, assume="spd")
+
+    def test_complex_distributed_typed_refusal(self):
+        a, b = _fixture(32, 1, jnp.complex64, "crand")
+        with pytest.raises(UsageError, match="complex"):
+            solve_system(a, b, block_size=8, workers=2)
+
+    def test_solve_sharded_requires_a_mesh(self):
+        a, b = _fixture(32, 1)
+        with pytest.raises(UsageError, match="workers"):
+            solve_system(a, b, block_size=8, engine="solve_sharded")
+
+    def test_single_device_engine_refused_on_mesh(self):
+        a, b = _fixture(32, 1)
+        with pytest.raises(UsageError, match="solve_sharded"):
+            solve_system(a, b, block_size=8, workers=2,
+                         engine="solve_aug")
+
+    def test_gather_false_single_device_typed(self):
+        a, b = _fixture(32, 1)
+        with pytest.raises(UsageError, match="gather"):
+            solve_system(a, b, block_size=8, gather=False)
+
+    def test_numerics_summary_distributed_ok(self):
+        a, b = _fixture(32, 2)
+        res = solve_system(a, b, block_size=8, workers=2,
+                           numerics="summary")
+        assert res.numerics is not None
+        assert res.numerics.workload == "solve"
+
+
+class TestDistributedSolvePolicy:
+    def test_refine_rung_reuses_the_sharded_executable(self):
+        # A policy on the distributed path: the gate judges the dense
+        # verification; a clean solve climbs zero rungs.
+        from tpu_jordan.resilience import ResiliencePolicy
+
+        a, b = _fixture(48, 2)
+        res = solve_system(a, b, block_size=8, workers=2,
+                           policy=ResiliencePolicy())
+        assert res.recovery == ()
+        assert res.rel_residual < 1e-12
+
+    def test_recovered_x_blocks_are_rescattered(self):
+        # Review-hardening pin: a recovery rung replaces x — the
+        # gather=False blocks must be RE-SCATTERED from the recovered
+        # solution, never the stale gate-failing one.  An fp32 gate
+        # SLO on a bf16-storage solve forces the refine rung (which
+        # re-runs the SAME sharded executable on the residual RHS).
+        from tpu_jordan.parallel.sharded_inplace import (
+            gather_solution_1d)
+        from tpu_jordan.resilience import ResiliencePolicy
+
+        a, b = _fixture(48, 2, jnp.bfloat16)
+        res = solve_system(a, b, block_size=8, workers=2, gather=False,
+                           policy=ResiliencePolicy(
+                               gate_dtype=jnp.float32))
+        assert [r["rung"] for r in res.recovery] == ["refine"]
+        x2 = gather_solution_1d(res.x_blocks, res.layout, 48)
+        assert np.array_equal(np.asarray(x2), np.asarray(res.x))
+        assert res.rel_residual < 1e-5

@@ -59,18 +59,33 @@ class TestRegistry:
         the registered fallback; invert candidacy is untouched."""
         slv = TunePoint.create(256, 64, jnp.float32, 1, True,
                                workload="solve")
-        assert {c.name for c in candidates(slv)} == {"solve_aug"}
+        assert {c.name for c in candidates(slv)} == {
+            "solve_aug", "solve_fori"}
         assert select_by_cost(slv).engine == "solve_aug"
         spd = TunePoint.create(256, 64, jnp.float32, 1, True,
                                workload="solve_spd")
         assert {c.name for c in candidates(spd)} == {
-            "solve_spd", "solve_aug_spd"}
+            "solve_spd", "solve_aug_spd", "solve_fori_spd"}
         assert select_by_cost(spd).engine == "solve_spd"
-        # Every solve engine prices strictly below every invert engine
-        # at the same point (the never-materializes-A⁻¹ cost story).
+        # The UNROLLED solve engines price strictly below every invert
+        # engine at the same point (the never-materializes-A⁻¹ cost
+        # story); the fori engine's full-width 2n³-class cost is the
+        # honest exception (it exists for Nr > MAX_UNROLL_NR, not to
+        # win rankings).
         inv = TunePoint.create(256, 64, jnp.float32, 1, True)
         inv_best = min(c.cost(inv) for c in candidates(inv))
-        assert all(c.cost(slv) < inv_best for c in candidates(slv))
+        assert all(c.cost(slv) < inv_best for c in candidates(slv)
+                   if c.engine != "solve_fori")
+        # ISSUE 15: distributed solve points rank solve_sharded alone;
+        # beyond MAX_UNROLL_NR single-device, the fori engine is the
+        # only (and selected) candidate.
+        dslv = TunePoint.create(4096, 128, jnp.float32, 8, True,
+                                workload="solve")
+        assert {c.name for c in candidates(dslv)} == {"solve_sharded"}
+        assert select_by_cost(dslv).engine == "solve_sharded"
+        big = TunePoint.create(8192, 64, jnp.float32, 1, True,
+                               workload="solve")     # Nr = 128 > 64
+        assert {c.name for c in candidates(big)} == {"solve_fori"}
 
     def test_complex_points_route_to_augmented_family(self):
         """Complex dtypes (ISSUE 11): the invert zoo's only complex
@@ -80,7 +95,14 @@ class TestRegistry:
         assert {c.name for c in candidates(cx)} == {"augmented"}
         cxs = TunePoint.create(256, 64, "complex64", 1, True,
                                workload="solve")
-        assert {c.name for c in candidates(cxs)} == {"solve_aug"}
+        assert {c.name for c in candidates(cxs)} == {
+            "solve_aug", "solve_fori"}
+        # Distributed complex solve points have NO candidates (the
+        # sharded engine is real-dtype, like the invert mesh engines) —
+        # linalg/api.py types the refusal before selection.
+        cxd = TunePoint.create(256, 64, "complex64", 8, True,
+                               workload="solve")
+        assert candidates(cxd) == []
 
     def test_legality(self):
         single = TunePoint.create(64, 8, jnp.float32, 1, True)

@@ -155,6 +155,14 @@ def check(report: dict) -> tuple[list[str], list[str]]:
         if want not in seen:
             errs.append(f"missing reconciliation coverage: "
                         f"{want[0]} gather={want[1]}")
+    # ISSUE 15: the demo must also reconcile the distributed SOLVE
+    # engine (the [A | B] elimination's own inventory — a solve engine
+    # without a reconciled leg is exactly the unaccounted-collective
+    # class this gate exists for).
+    if not any((leg.get("comm") or {}).get("engine") == "solve_sharded"
+               for leg in legs):
+        errs.append("missing reconciliation coverage: the distributed "
+                    "solve leg (engine='solve_sharded')")
 
     # -- drift leg ----------------------------------------------------
     drift_leg = report.get("drift_leg") or {}

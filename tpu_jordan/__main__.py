@@ -943,9 +943,11 @@ def _main(argv, state) -> int:
                                  "--serve-demo/--batch are distinct "
                                  "modes; pick one (the service accepts "
                                  "solve requests via submit(a, b))")
-            if args.workers != 1 or not args.gather:
-                raise UsageError("--workload solve/lstsq run on a "
-                                 "single device (gathered output)")
+            if args.workload == "lstsq" and (args.workers != 1
+                                             or not args.gather):
+                raise UsageError("--workload lstsq runs on a single "
+                                 "device (gathered output); --workload "
+                                 "solve is the distributed one")
             if args.engine != "auto" or args.group != 0:
                 raise UsageError("--workload solve/lstsq resolve their "
                                  "engine through the workload-scoped "
@@ -972,9 +974,16 @@ def _main(argv, state) -> int:
                 else:
                     amat = generate(args.generator, (args.n, args.n),
                                     dtype)
+                # --workers routes the distributed [A | B] elimination
+                # (ISSUE 15) through engine="auto" exactly like invert:
+                # the workload-scoped tuner resolves distributed points
+                # to solve_sharded, Nr > MAX_UNROLL_NR single-device
+                # points to the fori engine.
                 result = _solve_system(
                     amat, bmat, block_size=args.m, dtype=dtype,
-                    assume=args.assume, engine="auto", tune=args.tune,
+                    assume=args.assume, engine="auto",
+                    workers=args.workers, gather=args.gather,
+                    tune=args.tune,
                     plan_cache=args.plan_cache, telemetry=telemetry,
                     numerics=args.numerics, verbose=not args.quiet)
             else:

@@ -5,19 +5,25 @@ path), the pivot-free ``assume="spd"`` route, and complex dtypes —
 wired through the tuning registry (workload-scoped engine="auto"), the
 plan cache (``|wsolve`` key segments; invert keys byte-identical), the
 serve buckets (``JordanService.submit(a, b)``), the ‖A·X − B‖ residual
-gate, and the numerics observatory.  docs/WORKLOADS.md is the guide.
+gate, and the numerics observatory.  ISSUE 15 adds the distributed
+solve (``solve_system(workers=p | (pr, pc))`` — the [A | B]
+elimination sharded over the 1D/2D meshes, comm-reconciled) and the
+fori engine (``block_jordan_solve_fori``) that lifts MAX_UNROLL_NR.
+docs/WORKLOADS.md is the guide.
 """
 
 from .api import (LstsqResult, SolveSystemResult, lstsq,
                   resolve_solve_engine, solve_system)
-from .engine import block_jordan_solve, solve_batch_metrics
+from .engine import (block_jordan_solve, block_jordan_solve_fori,
+                     solve_batch_metrics)
 from .update import (DRIFT_BUDGET_FACTOR, UpdateResult, drift_budget,
                      drift_exceeded, smw_update, smw_update_with_metrics,
                      solve_update)
 
 __all__ = [
     "DRIFT_BUDGET_FACTOR", "LstsqResult", "SolveSystemResult",
-    "UpdateResult", "block_jordan_solve", "drift_budget",
+    "UpdateResult", "block_jordan_solve", "block_jordan_solve_fori",
+    "drift_budget",
     "drift_exceeded", "lstsq", "resolve_solve_engine", "smw_update",
     "smw_update_with_metrics", "solve_batch_metrics", "solve_system",
     "solve_update",

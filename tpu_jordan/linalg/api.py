@@ -71,6 +71,16 @@ class SolveSystemResult:
     recovery: tuple = ()          # ladder rungs (policy= solves only)
     numerics: object | None = None
     trace: object | None = None
+    workers: object = 1           # the mesh the solve ran on (ISSUE 15)
+    x_blocks: jax.Array | None = None  # sharded X row blocks
+    #   (gather=False distributed solves; cyclic row storage order)
+    layout: object | None = None  # CyclicLayout/CyclicLayout2D of
+    #   x_blocks
+    comm: object | None = None    # obs.comm.CommReport on every
+    #   DISTRIBUTED solve (the ISSUE 14 accounting, extended to the
+    #   solve engines): per-phase collective bytes/messages, the
+    #   observed == analytical reconciliation under
+    #   obs.comm.recording(), and the drift record.  None single-device.
     _norm_a: float | None = None
     _norm_x: float | None = None
     _norm_b: float | None = None
@@ -161,6 +171,8 @@ def solve_system(
     dtype=None,
     assume: str = "general",
     engine: str = "auto",
+    workers=1,
+    gather: bool = True,
     tune: bool = False,
     plan_cache: str | None = None,
     telemetry=None,
@@ -170,6 +182,30 @@ def solve_system(
     verbose: bool = False,
 ) -> SolveSystemResult:
     """Solve A·X = B — Gauss–Jordan on [A | B], no inverse ever formed.
+
+    ``workers`` (ISSUE 15) routes the solve exactly like
+    ``driver.solve``: 1 = single device; ``p`` = the 1D row-block-cyclic
+    mesh; a ``(pr, pc)`` tuple = the 2D block-cyclic mesh.  Distributed
+    points resolve ``engine="auto"`` through the workload-scoped tuner
+    to the sharded [A | B] elimination (``solve_sharded`` —
+    parallel/sharded_inplace.py and its 2D twin): the k RHS columns
+    ride the pivot-probe / row-broadcast / eliminate supersteps, the
+    live-column window still shrinks statically per shard (per-device
+    ``cost_analysis`` FLOPs land ~1/p of the single-device solve's),
+    and X bit-matches the single-device engine on block-aligned
+    fixtures.  ``SolveSystemResult.comm`` carries the full ISSUE 14
+    collective accounting (reconciled observed == analytical under
+    ``obs.comm.recording()``).  ``gather=False`` (distributed only)
+    additionally returns the sharded X row blocks
+    (``result.x_blocks`` + ``result.layout``); unlike the invert
+    engines X is O(n·k), so the dense ``result.x`` is assembled — and
+    verified — in either mode (A itself never gathers on any
+    distributed path).  Distributed solves are real-dtype (complex
+    stays single-device, like invert), general-pivoting only
+    (``assume="spd"`` is the single-device fast path), and support
+    ``numerics="summary"`` (``"trace"`` is a typed refusal — the
+    per-superstep stats are host-visible on the single-device unrolled
+    engines only).
 
     The solve twin of ``driver.solve`` (docs/WORKLOADS.md is the
     product guide): ``engine="auto"`` resolves through the tuner ladder
@@ -218,8 +254,30 @@ def solve_system(
         block_size = default_block_size(n)
     m = min(block_size, n)
 
+    distributed = isinstance(workers, tuple) or workers > 1
+    if not distributed and not gather:
+        raise UsageError(
+            "gather=False is only meaningful on distributed solves "
+            "(workers > 1 or a (pr, pc) tuple)")
+    if distributed:
+        if jnp.issubdtype(dtype, jnp.complexfloating):
+            raise UsageError(
+                "complex dtypes run single-device (the distributed "
+                "scatter/collective paths are real-dtype, the invert "
+                "engines' contract); workers must be 1")
+        if assume == "spd":
+            raise UsageError(
+                "assume='spd' is the single-device pivot-free fast "
+                "path; the distributed [A | B] elimination pivots "
+                "(workers must be 1, or drop the spd promise)")
+
     from ..obs.numerics import resolve_mode
     numerics = resolve_mode(numerics)
+    if numerics == "trace" and distributed:
+        raise UsageError(
+            "numerics='trace' instruments the single-device unrolled "
+            "engines (the per-superstep stats are host-visible there); "
+            "distributed solves support numerics='summary'")
     if numerics == "trace" and assume == "spd":
         # The trace instruments the condition-based pivot PROBE; the
         # pivot-free fast path probes exactly one candidate per
@@ -234,6 +292,16 @@ def solve_system(
             "assume='general'")
 
     engine, workload = resolve_solve_engine(engine, assume)
+    if engine == "solve_sharded" and not distributed:
+        raise UsageError(
+            "engine='solve_sharded' is the distributed [A | B] "
+            "elimination (its win is the mesh); pass workers=p or "
+            "workers=(pr, pc)")
+    if distributed and engine not in ("auto", "solve_sharded"):
+        raise UsageError(
+            f"engine={engine!r} is a single-device solve engine; "
+            f"distributed points run engine='solve_sharded' (or "
+            f"'auto', which resolves there)")
     if (tune or plan_cache is not None) and engine != "auto":
         raise UsageError("tune/plan_cache apply to engine='auto' only "
                          "(an explicit engine leaves nothing to tune)")
@@ -241,16 +309,28 @@ def solve_system(
     if engine == "auto":
         from ..tuning.tuner import auto_select
 
-        engine, _, plan = auto_select(n, m, dtype, 1, True, tune=tune,
+        engine, _, plan = auto_select(n, m, dtype, workers, gather,
+                                      tune=tune,
                                       plan_cache=plan_cache,
                                       telemetry=tel, workload=workload)
+    if numerics == "trace" and engine == "solve_fori":
+        raise UsageError(
+            "numerics='trace' instruments the UNROLLED solve engine "
+            "only (the fori engine's traced supersteps have no "
+            "host-visible stats twin); use a larger block_size so "
+            "Nr <= MAX_UNROLL_NR, or numerics='summary'")
     spd = engine == "solve_spd"
     _count_workload(workload)
 
     with tel.span("solve_system", n=n, k=k, workload=workload) as root:
-        result = _solve_system_impl(
-            a, b2, n, k, m, dtype, engine, spd, workload, plan, tel,
-            policy, numerics, check, verbose)
+        if engine == "solve_sharded":
+            result = _solve_system_dist_impl(
+                a, b2, n, k, m, dtype, workers, gather, workload, plan,
+                tel, policy, numerics, check, verbose)
+        else:
+            result = _solve_system_impl(
+                a, b2, n, k, m, dtype, engine, spd, workload, plan, tel,
+                policy, numerics, check, verbose)
     if telemetry is not None:
         result.trace = root
     if squeezed and result.x is not None:
@@ -285,14 +365,23 @@ def _solve_system_impl(a, b2, n, k, m, dtype, engine, spd, workload,
     # trace twin, stacked into the SAME compiled executable — X bits
     # untouched, pivot sequence pinned equal to the invert engine's.
     collect = numerics == "trace"
+    if engine == "solve_fori":
+        from .engine import block_jordan_solve_fori
+
+        def _solve_fn(aa, bb):
+            # The fori-compiled engine (ISSUE 15): traced supersteps,
+            # compile cost flat in Nr — Nr > MAX_UNROLL_NR is legal
+            # here; X bit-matches the unrolled engine.
+            return block_jordan_solve_fori(aa, bb, block_size=m,
+                                           spd=spd)
+    else:
+        def _solve_fn(aa, bb):
+            return block_jordan_solve(aa, bb, block_size=m, spd=spd,
+                                      collect_stats=collect)
     with tel.span("compile", engine=engine, n=n, k=k) as csp:
         def _compile():
             _faults.fire("compile")
-            return jax.jit(
-                lambda aa, bb: block_jordan_solve(
-                    aa, bb, block_size=m, spd=spd,
-                    collect_stats=collect)
-            ).lower(a, b2).compile()
+            return jax.jit(_solve_fn).lower(a, b2).compile()
         compiled = (policy.retry.call(_compile,
                                       component="solve_system.compile")
                     if policy is not None else _compile())
@@ -372,6 +461,194 @@ def _solve_system_impl(a, b2, n, k, m, dtype, engine, spd, workload,
         gflops=(flops / elapsed / 1e9) if elapsed > 0 else 0.0,
         engine=engine, workload=workload, singular=False, plan=plan,
         kappa_est=kappa_est, recovery=recovery, numerics=nreport,
+        _norm_a=norm_a, _norm_x=norm_x, _norm_b=norm_b)
+
+
+def _fresh_solve_fn(n, m, spd):
+    """The legal single-device solve engine for a FRESH re-solve at
+    this (n, m): the unrolled engine inside its MAX_UNROLL_NR reach,
+    the fori engine beyond — so the recovery ladder's repivot/resolve
+    rungs never trip the unrolled engine's typed refusal on a
+    large-Nr solve."""
+    from ..parallel.sharded_inplace import MAX_UNROLL_NR
+
+    from .engine import block_jordan_solve_fori
+
+    if -(-n // m) > MAX_UNROLL_NR:
+        return lambda aa, bb: block_jordan_solve_fori(
+            aa, bb, block_size=m, spd=spd)
+    return lambda aa, bb: block_jordan_solve(aa, bb, block_size=m,
+                                             spd=spd)
+
+
+def solve_mesh_backend(workers, n: int, m: int):
+    """ONE mesh-shape dispatch for the distributed solve (ISSUE 15):
+    ``(mesh, lay, scatter_a, scatter_b, compile_fn, gather_x)`` for a
+    workers spec (int p -> 1D row-cyclic, (pr, pc) -> 2D block-cyclic)
+    — shared by :func:`solve_system`, the tuner's ``measure_config``,
+    and bench's sharded row, so the measured/benched executable can
+    never silently diverge from the one solve_system ships."""
+    if isinstance(workers, tuple):
+        from ..parallel import make_mesh_2d
+        from ..parallel.jordan2d import scatter_matrix_2d
+        from ..parallel.jordan2d_inplace import (
+            compile_sharded_jordan_solve_2d, gather_solution_2d,
+            scatter_rhs_2d)
+        from ..parallel.layout import CyclicLayout2D
+
+        pr, pc = workers
+        return (make_mesh_2d(pr, pc),
+                CyclicLayout2D.create(n, m, pr, pc),
+                scatter_matrix_2d, scatter_rhs_2d,
+                compile_sharded_jordan_solve_2d, gather_solution_2d)
+    from ..parallel import make_mesh
+    from ..parallel.layout import CyclicLayout
+    from ..parallel.ring_gemm import _to_identity_padded_blocks
+    from ..parallel.sharded_inplace import (
+        compile_sharded_jordan_solve, gather_solution_1d,
+        scatter_rhs_1d)
+
+    return (make_mesh(workers), CyclicLayout.create(n, m, workers),
+            _to_identity_padded_blocks, scatter_rhs_1d,
+            compile_sharded_jordan_solve, gather_solution_1d)
+
+
+def _solve_system_dist_impl(a, b2, n, k, m, dtype, workers, gather,
+                            workload, plan, tel, policy, numerics,
+                            check, verbose):
+    """The distributed solve skeleton (ISSUE 15): scatter [A | B] over
+    the 1D/2D mesh, run the sharded elimination (unrolled below
+    MAX_UNROLL_NR, fori beyond), reconcile the collective inventory
+    (obs/comm.py), assemble X (O(n·k) — cheap in either gather mode),
+    and verify ‖A·X − B‖ densely against the CALLER's A and B (they
+    are in hand — solve_system takes arrays, so the verification
+    needs no mesh collectives and the comm inventory has no residual
+    section, unlike the invert driver's ring-GEMM pass)."""
+    from ..driver import SingularMatrixError, _record_compile
+    from ..obs import comm as _comm
+    from ..parallel.sharded_inplace import MAX_UNROLL_NR
+
+    in_dtype = jnp.dtype(dtype)
+    work = jnp.float32 if in_dtype.itemsize < 4 else in_dtype
+    (mesh, lay, scatter_a, scatter_b, compile_fn,
+     gather_x) = solve_mesh_backend(workers, n, m)
+
+    with tel.span("load"):
+        W = scatter_a(jnp.asarray(a, work), lay, mesh)
+        Xb = scatter_b(jnp.asarray(b2, work), lay, mesh)
+
+    # The layout-derived analytical collective inventory (ISSUE 14,
+    # extended with the solve flavors) — built for every distributed
+    # solve; observed counts captured only under obs.comm.recording().
+    unroll = lay.Nr <= MAX_UNROLL_NR
+    comm_rep = _comm.engine_report(
+        engine="solve_sharded", lay=lay, dtype=work, gather=gather,
+        unroll=unroll, rhs=k)
+
+    with tel.span("compile", engine="solve_sharded", n=n, k=k) as csp:
+        def _compile():
+            _faults.fire("compile")
+            if _comm.recording_active():
+                with _comm.record_collectives() as rec:
+                    run = compile_fn(W, Xb, mesh, lay)
+                comm_rep.attach_observed("engine", rec.records)
+                return run
+            return compile_fn(W, Xb, mesh, lay)
+        run = (policy.retry.call(_compile,
+                                 component="solve_system.compile")
+               if policy is not None else _compile())
+    _record_compile(csp, "solve_system")
+    exe_cost = _hwcost.executable_cost(run)
+
+    # Distributed execute is NOT retried (the driver's contract: the
+    # sharded working state may alias into the engine) — a mid-flight
+    # failure propagates typed, never silently.
+    _faults.fire("execute")
+    (xb, singular), esp = timed_blocking(run, W, Xb, telemetry=tel,
+                                         name="execute",
+                                         engine="solve_sharded",
+                                         workload=workload)
+    elapsed = esp.duration
+    flops = _hwcost.baseline_workload_flops(n, workload, k=k)
+    if elapsed > 0:
+        esp.attrs["gflops"] = round(flops / elapsed / 1e9, 3)
+    _hwcost.attach_execute_cost(esp, exe_cost, analytical_flops=flops)
+    comm_rep.observe_metrics()
+    comm_rep.attach_span(esp)
+    _comm.observe_drift(comm_rep, elapsed, esp)
+    _comm.set_last_report(comm_rep)
+    _obs_metrics.histogram(
+        "tpu_jordan_solve_seconds",
+        "timed elimination wall seconds (the glob_time analog)",
+    ).observe(elapsed, workload=workload)
+
+    singular = bool(singular.any())
+    if singular:
+        _obs_metrics.counter("tpu_jordan_singular_total",
+                             "solves/requests flagged singular"
+                             ).inc(component="solve_system")
+        if check:
+            raise SingularMatrixError("singular matrix")
+        return SolveSystemResult(
+            x=None, elapsed=elapsed, residual=float("inf"), n=n, k=k,
+            block_size=m, gflops=0.0, engine="solve_sharded",
+            workload=workload, singular=True, plan=plan,
+            workers=workers, comm=comm_rep)
+
+    with tel.span("gather", gathered=gather):
+        # X is O(n·k): assembled in EITHER mode (the verification needs
+        # it; the memory contract is about A, which never gathers).
+        x = gather_x(xb, lay, n)
+        if in_dtype != work:
+            x = x.astype(in_dtype)
+            xb = xb.astype(in_dtype)
+
+    with tel.span("residual"):
+        residual, norm_a, norm_x, norm_b = _residual_stats(a, x, b2)
+    rel = _rel(residual, norm_a, norm_x, norm_b)
+    kappa_est = (norm_a * norm_x / norm_b) if norm_b else None
+
+    nreport = None
+    if numerics != "off":
+        nreport = _solve_numerics(n, m, "solve_sharded", workload, rel,
+                                  kappa_est, norm_a, dtype, policy)
+
+    recovery = ()
+    if policy is not None:
+        # The refine rung re-runs THE SAME sharded executable on a
+        # re-scattered residual RHS (zero recompiles — W is still
+        # resident); deeper rungs fall back to a fresh single-device
+        # re-solve (_solve_recover's ladder).
+        def _rerun(aa, rr):
+            del aa
+            Xr = scatter_b(jnp.asarray(rr, work), lay, mesh)
+            ob, s = run(W, Xr)
+            return gather_x(ob, lay, n), s.any()
+
+        x, residual, norm_a, norm_x, norm_b, recovery = _solve_recover(
+            policy, tel, a=a, b=b2, x=x, compiled=_rerun,
+            residual=residual, norm_a=norm_a, norm_x=norm_x,
+            norm_b=norm_b, n=n, k=k, m=m, dtype=dtype, spd=False,
+            workload=workload)
+        if recovery and not gather:
+            # A rung replaced X: re-scatter the RECOVERED solution so
+            # x_blocks can never silently hand out the gate-failing
+            # pre-recovery answer next to a recovered x/residual.
+            xb = scatter_b(jnp.asarray(x), lay, mesh)
+
+    if verbose:
+        print(f"glob_time: {elapsed:.2f}")
+        print(f"residual: {residual:e}")
+
+    return SolveSystemResult(
+        x=x, elapsed=elapsed, residual=residual, n=n, k=k,
+        block_size=m,
+        gflops=(flops / elapsed / 1e9) if elapsed > 0 else 0.0,
+        engine="solve_sharded", workload=workload, singular=False,
+        plan=plan, kappa_est=kappa_est, recovery=recovery,
+        numerics=nreport, workers=workers,
+        x_blocks=None if gather else xb,
+        layout=None if gather else lay, comm=comm_rep,
         _norm_a=norm_a, _norm_x=norm_x, _norm_b=norm_b)
 
 
@@ -470,10 +747,7 @@ def _solve_recover(policy, tel, *, a, b, x, compiled, residual, norm_a,
         # ---- rung 2: repivot (the SPD promise may be unsound) -------
         if spd:
             with tel.span("repivot") as sp:
-                x3, sing3 = jax.jit(
-                    lambda aa, bb: block_jordan_solve(
-                        aa, bb, block_size=m, spd=False)
-                )(a, b)
+                x3, sing3 = jax.jit(_fresh_solve_fn(n, m, False))(a, b)
                 passed, out = _judge(x3, sp, "repivot")
             if passed and not bool(sing3):
                 rsp.attrs["recovered_by"] = "repivot"
@@ -483,10 +757,8 @@ def _solve_recover(policy, tel, *, a, b, x, compiled, residual, norm_a,
         # ---- rung 3: fp32 re-solve (sub-fp32 storage only) ----------
         if policy.escalate and in_dtype.itemsize < 4:
             with tel.span("resolve") as sp:
-                x4, sing4 = jax.jit(
-                    lambda aa, bb: block_jordan_solve(
-                        aa, bb, block_size=m, spd=spd)
-                )(a.astype(jnp.float32), b.astype(jnp.float32))
+                x4, sing4 = jax.jit(_fresh_solve_fn(n, m, spd))(
+                    a.astype(jnp.float32), b.astype(jnp.float32))
                 passed, out = _judge(x4, sp, "resolve",
                                      dtype=str(x4.dtype))
             if passed and not bool(sing4):

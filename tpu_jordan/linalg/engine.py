@@ -141,10 +141,19 @@ def block_jordan_solve(
     Nr = -(-n // m)
     from ..parallel.sharded_inplace import MAX_UNROLL_NR
     if Nr > MAX_UNROLL_NR:
-        raise ValueError(
-            f"block_jordan_solve is unrolled-only (the live-column "
-            f"window shrinks statically) and Nr={Nr} exceeds "
-            f"MAX_UNROLL_NR={MAX_UNROLL_NR}; use a larger block_size")
+        # Typed (ISSUE 15): large-Nr solves are LEGAL now — through the
+        # fori engine below (solve_system routes engine="auto" there) —
+        # so the unrolled engine's refusal must name the remedy instead
+        # of reading like a hard ceiling on the workload.
+        from ..driver import UsageError
+
+        raise UsageError(
+            f"block_jordan_solve is the UNROLLED engine (the live-column "
+            f"window shrinks statically — the FLOP-cheap flavor) and "
+            f"Nr={Nr} exceeds MAX_UNROLL_NR={MAX_UNROLL_NR}; use "
+            f"block_jordan_solve_fori (engine='solve_fori', compile "
+            f"cost flat in Nr), a larger block_size, or a distributed "
+            f"mesh (solve_system(workers=...))")
     N = Nr * m
     A = pad_with_identity(a, N)
     X = jnp.zeros((N, k), dtype).at[:n].set(b)
@@ -226,6 +235,109 @@ def block_jordan_solve(
 
     if stats is not None:
         return X[:n], singular, stats.stacked()
+    return X[:n], singular
+
+
+@partial(jax.jit, static_argnames=("block_size", "eps", "precision",
+                                   "spd"))
+def block_jordan_solve_fori(
+    a: jnp.ndarray,
+    b: jnp.ndarray,
+    block_size: int | None = None,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    spd: bool = False,
+):
+    """The fori-compiled solve engine (ISSUE 15): ``lax.fori_loop``
+    supersteps with traced offsets, so compile cost is flat in Nr and
+    ``Nr > MAX_UNROLL_NR`` becomes legal — the window shrink moves from
+    Python unrolling to masked/dynamic-slice indexing, the same trick
+    the invert fori engines use.
+
+    The price is honest and documented: with a traced ``t`` the
+    elimination cannot slice a shrinking static width, so updates run
+    full-width (~2n³ + 2n²k FLOPs vs the unrolled engine's
+    n³(1 + 2k/n)) — the dead columns receive EXACT zeros (the pivot
+    row is exactly zero there), which is also why X is BIT-IDENTICAL
+    to the unrolled engine on nonsingular inputs (pinned by
+    tests/test_linalg.py).  The probe masks dead candidates instead of
+    slicing them away (``batched_block_inverse`` is per-candidate
+    independent, so probing a dead block never changes a live one's
+    arithmetic) — dtype-generic, so complex64/complex128 flow through
+    exactly like the unrolled engine.  ``spd=True`` probes only the
+    diagonal block, same promise semantics as the unrolled path.
+
+    Same ``(x, singular)`` contract as :func:`block_jordan_solve`; no
+    ``collect_stats`` twin (the per-superstep trace instruments the
+    unrolled engines only — linalg/api.py types that refusal)."""
+    n = a.shape[-1]
+    k = b.shape[-1]
+    in_dtype = a.dtype
+    if jnp.dtype(in_dtype).itemsize < 4:
+        x, singular = block_jordan_solve_fori(
+            a.astype(jnp.float32), b.astype(jnp.float32), block_size,
+            eps, precision, spd)
+        return x.astype(in_dtype), singular
+    dtype = a.dtype
+    b = b.astype(dtype)
+    if block_size is None:
+        block_size = default_block_size(n)
+    m = min(block_size, n)
+    if eps is None:
+        eps = eps_for(dtype)
+
+    Nr = -(-n // m)
+    N = Nr * m
+    A0 = pad_with_identity(a, N)
+    X0 = jnp.zeros((N, k), dtype).at[:n].set(b)
+    row_blocks = jnp.arange(N) // m
+    blk = jnp.arange(Nr)
+
+    def body(t, carry):
+        A, X, singular = carry
+        tt = jnp.asarray(t, jnp.int32)
+        z = jnp.int32(0)
+        lo = tt * m
+        if spd:
+            C = lax.dynamic_slice(A, (lo, lo), (m, m))
+            invs, sing = batched_block_inverse(C[None], None, eps)
+            singular = singular | sing[0]
+            H = invs[0]
+            rows_p_A = lax.dynamic_slice(A, (lo, z), (m, N))
+            rows_p_X = lax.dynamic_slice(X, (lo, z), (m, k))
+        else:
+            cands = lax.dynamic_slice(A, (z, lo), (N, m)).reshape(
+                Nr, m, m)
+            invs, sing = batched_block_inverse(cands, None, eps)
+            inv_norms = block_inf_norms(invs)
+            valid = (blk >= tt) & ~sing
+            key = jnp.where(valid, inv_norms,
+                            jnp.asarray(jnp.inf, inv_norms.dtype))
+            rel = jnp.asarray(jnp.argmin(key), jnp.int32)  # ABSOLUTE
+            singular = singular | ~jnp.any(valid)
+            H = jnp.take(invs, rel, axis=0).astype(dtype)
+            piv_row = rel * m
+            rows_t_A = lax.dynamic_slice(A, (lo, z), (m, N))
+            rows_t_X = lax.dynamic_slice(X, (lo, z), (m, k))
+            rows_p_A = lax.dynamic_slice(A, (piv_row, z), (m, N))
+            rows_p_X = lax.dynamic_slice(X, (piv_row, z), (m, k))
+            A = lax.dynamic_update_slice(A, rows_t_A, (piv_row, z))
+            X = lax.dynamic_update_slice(X, rows_t_X, (piv_row, z))
+
+        prow_A = jnp.matmul(H, rows_p_A, precision=precision)
+        prow_X = jnp.matmul(H, rows_p_X, precision=precision)
+
+        E = lax.dynamic_slice(A, (z, lo), (N, m))
+        E = jnp.where((row_blocks == tt)[:, None],
+                      jnp.asarray(0, dtype), E)
+        A = A - jnp.matmul(E, prow_A, precision=precision)
+        X = X - jnp.matmul(E, prow_X, precision=precision)
+        A = lax.dynamic_update_slice(A, prow_A, (lo, z))
+        X = lax.dynamic_update_slice(X, prow_X, (lo, z))
+        return A, X, singular
+
+    _, X, singular = lax.fori_loop(0, Nr, body,
+                                   (A0, X0, jnp.asarray(False)))
     return X[:n], singular
 
 

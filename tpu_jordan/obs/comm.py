@@ -417,6 +417,92 @@ def _sigs_2d(b: _Builder, lay, dtype: str, engine: str, group: int,
           2 * tr, 2 * Nr)
 
 
+def _sigs_solve_1d(b: _Builder, lay, dtype: str, nrhs: int,
+                   unroll: bool) -> None:
+    """The 1D distributed SOLVE engine (ISSUE 15,
+    parallel/sharded_inplace.py::_solve_step): per superstep, the
+    pivot reduction + TWO stacked [A_live | X] row psums — the pivot
+    row (``row_bcast``) and the swap's row t (``row_exchange``).  The
+    unrolled flavor's row shapes SHRINK with t (the statically
+    shrinking live-column window — each step traces its own shape);
+    the fori flavor broadcasts full width once-traced."""
+    m, N, Nr, p = lay.m, lay.N, lay.Nr, lay.p
+    i_dt = _index_dtype()
+    ax = ("p", p)
+    tr = Nr if unroll else 1
+    b.add("pivot", "pmin", *ax, (), dtype, tr, Nr)
+    b.add("pivot", "pmin", *ax, (), i_dt, tr, Nr)
+    b.add("pivot", "psum", *ax, (), i_dt, tr, Nr)
+    b.add("pivot", "psum", *ax, (m, m), dtype, tr, Nr)
+    if unroll:
+        for t in range(Nr):
+            shape = (m, N - t * m + nrhs)
+            b.add("row_bcast", "psum", *ax, shape, dtype, 1, 1)
+            b.add("row_exchange", "psum", *ax, shape, dtype, 1, 1)
+    else:
+        shape = (m, N + nrhs)
+        b.add("row_bcast", "psum", *ax, shape, dtype, 1, Nr)
+        b.add("row_exchange", "psum", *ax, shape, dtype, 1, Nr)
+
+
+def _sigs_solve_2d(b: _Builder, lay, dtype: str, nrhs: int,
+                   unroll: bool) -> None:
+    """The 2D distributed SOLVE engine
+    (parallel/jordan2d_inplace.py::_solve_step_2d): the t-chunk panel
+    psum along "pc", the whole-mesh pivot reduction, two stacked
+    [A_live | X] row psums along "pr" (live width shrinking statically
+    in the unrolled flavor), and the (m, m) swap fix-up psum along
+    "pc".  No unscramble — the solve never replays column swaps (A is
+    discarded; X alone is the product)."""
+    m, N, Nr = lay.m, lay.N, lay.Nr
+    pr, pc, bpr, bc1 = lay.pr, lay.pc, lay.bpr, lay.bc1
+    Wc = N // pc
+    i_dt = _index_dtype()
+    axR = ("pr", pr)
+    axC = ("pc", pc)
+    axB = ("pr,pc", pr * pc)
+    tr = Nr if unroll else 1
+    b.add("panel_bcast", "psum", *axC, (bpr, m, m), dtype, tr, Nr)
+    b.add("pivot", "pmin", *axB, (), dtype, tr, Nr)
+    b.add("pivot", "pmin", *axB, (), i_dt, tr, Nr)
+    b.add("pivot", "psum", *axB, (), i_dt, tr, Nr)
+    b.add("pivot", "psum", *axB, (m, m), dtype, tr, Nr)
+    if unroll:
+        for t in range(Nr):
+            lw = (bc1 - t // pc) * m
+            shape = (m, lw + nrhs)
+            b.add("row_bcast", "psum", *axR, shape, dtype, 1, 1)
+            b.add("row_exchange", "psum", *axR, shape, dtype, 1, 1)
+    else:
+        shape = (m, Wc + nrhs)
+        b.add("row_bcast", "psum", *axR, shape, dtype, 1, Nr)
+        b.add("row_exchange", "psum", *axR, shape, dtype, 1, Nr)
+    b.add("row_exchange", "psum", *axC, (m, m), dtype, tr, Nr)
+
+
+def _sigs_gather_solve(b: _Builder, lay, dtype: str, nrhs: int) -> None:
+    """The XLA-implicit all-gather assembling X's row blocks: (N, k) —
+    present in EITHER gather mode (X is O(n·k); it is assembled for
+    the dense verification regardless — linalg/api.py)."""
+    N = lay.N
+    if hasattr(lay, "pc"):
+        axis, a = "pr,pc", lay.pr * lay.pc
+    else:
+        axis, a = "p", lay.p
+    b.add("gather", "all_gather", axis, a, (N, nrhs), dtype, 0, 1,
+          section="gather", implicit=True)
+
+
+#: Engines with a registered collective inventory — the registry lint
+#: (tests/test_comm.py) pins every DISTRIBUTED-legal registry config's
+#: engine to this set, and :func:`engine_report` refuses unknown names:
+#: a new distributed engine without analytical accounting fails loudly
+#: at its first report, never silently reconciling against the wrong
+#: (or an empty) inventory.
+INVENTORY_ENGINES = frozenset(
+    {"inplace", "grouped", "swapfree", "augmented", "solve_sharded"})
+
+
 def _sigs_residual(b: _Builder, lay, dtype: str) -> None:
     """The independent verification pass: the 1D systolic ring GEMM
     (parallel/ring_gemm.py, main.cpp:534-641) or the 2D SUMMA
@@ -459,45 +545,71 @@ def _sigs_gather(b: _Builder, lay, dtype: str) -> None:
 
 def engine_report(*, engine: str, lay, dtype, gather: bool = True,
                   refine: int = 0, group: int = 0,
-                  unroll: bool | None = None) -> "CommReport":
+                  unroll: bool | None = None,
+                  rhs: int = 0) -> "CommReport":
     """Build the analytical :class:`CommReport` for one distributed
     engine configuration.  ``lay`` is the solve's ``CyclicLayout`` /
     ``CyclicLayout2D``; ``dtype`` the WORKING dtype (the distributed
     core computes in fp32 for sub-fp32 storage); ``unroll=None``
     resolves exactly like the compile front ends (Nr ≤ MAX_UNROLL_NR
-    for the in-place/grouped engines; the swap-free and augmented
-    engines are fori-only).
+    for the in-place/grouped/solve engines; the swap-free and
+    augmented engines are fori-only).
 
     ``refine > 0`` skips the residual section (the refine branch
     verifies on the gathered full matrices — no ring/SUMMA pass), and
-    ``gather=True`` adds the implicit all-gather phase."""
+    ``gather=True`` adds the implicit all-gather phase.
+
+    ``rhs`` (ISSUE 15) is the solve workload's RHS column count — the
+    k riding the stacked row broadcasts of ``engine="solve_sharded"``.
+    Solve reports have NO residual section (the verification is dense
+    against the caller's own A and B — linalg/api.py) and model the
+    implicit X assembly in either gather mode.
+
+    An engine name outside :data:`INVENTORY_ENGINES` is a hard
+    ``ValueError``: accounting is part of shipping an engine."""
     import jax.numpy as jnp
 
     from ..parallel.sharded_inplace import MAX_UNROLL_NR
 
+    if engine not in INVENTORY_ENGINES:
+        raise ValueError(
+            f"no collective inventory registered for engine "
+            f"{engine!r} (obs/comm.INVENTORY_ENGINES); a distributed "
+            f"engine ships WITH its analytical accounting — add its "
+            f"_sigs_* builder before wiring it anywhere")
     dt = str(jnp.dtype(dtype))
     if engine in ("swapfree", "augmented"):
         unroll = False
     elif unroll is None:
         unroll = lay.Nr <= MAX_UNROLL_NR
+    solve = engine == "solve_sharded"
     b = _Builder()
     two_d = hasattr(lay, "pc")
     if two_d:
-        _sigs_2d(b, lay, dt, engine, group, unroll)
+        if solve:
+            _sigs_solve_2d(b, lay, dt, int(rhs), unroll)
+        else:
+            _sigs_2d(b, lay, dt, engine, group, unroll)
         mesh = f"{lay.pr}x{lay.pc}"
         workers: object = (lay.pr, lay.pc)
     else:
-        _sigs_1d(b, lay, dt, engine, group, unroll)
+        if solve:
+            _sigs_solve_1d(b, lay, dt, int(rhs), unroll)
+        else:
+            _sigs_1d(b, lay, dt, engine, group, unroll)
         mesh = f"1D p={lay.p}"
         workers = lay.p
-    if not refine:
-        _sigs_residual(b, lay, dt)
-    if gather:
-        _sigs_gather(b, lay, dt)
+    if solve:
+        _sigs_gather_solve(b, lay, dt, int(rhs))
+    else:
+        if not refine:
+            _sigs_residual(b, lay, dt)
+        if gather:
+            _sigs_gather(b, lay, dt)
     return CommReport(engine=engine, mesh=mesh, workers=workers,
                       n=lay.n, block_size=lay.m, dtype=dt,
                       gather=bool(gather), group=int(group),
-                      sigs=b.merged())
+                      rhs=int(rhs), sigs=b.merged())
 
 
 # ---------------------------------------------------------------------
@@ -518,6 +630,7 @@ class CommReport:
     dtype: str
     gather: bool
     group: int
+    rhs: int = 0            # solve-workload RHS columns (0 = invert)
     sigs: list = field(default_factory=list)
     #: observed trace-time records per section ("engine"/"residual"),
     #: None = not captured (recording off, or the executable's trace
@@ -659,7 +772,7 @@ class CommReport:
                         else self.workers),
             "n": self.n, "block_size": self.block_size,
             "dtype": self.dtype, "gather": self.gather,
-            "group": self.group,
+            "group": self.group, "rhs": self.rhs,
             "sigs": [s.to_json() for s in self.sigs],
             "totals": {
                 "payload_bytes": self.total_bytes(),
@@ -960,6 +1073,28 @@ def _demo_leg(name: str, *, n: int, m: int, workers, engine: str,
     return leg
 
 
+def _solve_demo_leg(name: str, *, n: int, m: int, workers, gather: bool,
+                    k: int, dtype, generator: str) -> dict:
+    """One distributed-SOLVE reconciliation leg (ISSUE 15): the sharded
+    [A | B] elimination under collective recording — the PR 13 safety
+    net extended to the solve engine flavors."""
+    import jax.numpy as jnp
+
+    from ..linalg import solve_system
+    from ..ops import generate
+
+    dt = jnp.dtype(dtype if dtype is not None else jnp.float32)
+    a = generate(generator, (n, n), dt)
+    bmat = generate("rand", (n, k), dt, row_offset=n)
+    with recording():
+        res = solve_system(a, bmat, block_size=m, workers=workers,
+                           gather=gather)
+    return {"name": name, "n": n, "block_size": m,
+            "elapsed_s": res.elapsed,
+            "rel_residual": res.rel_residual,
+            "comm": res.comm.to_json()}
+
+
 def comm_demo(n: int = 48, block_size: int = 8, seed: int = 0,
               dtype=None, generator: str = "absdiff") -> dict:
     """The ISSUE 14 acceptance run: four tiny distributed solves —
@@ -1036,6 +1171,15 @@ def comm_demo(n: int = 48, block_size: int = 8, seed: int = 0,
         _demo_leg("2d_2x2_swapfree_sharded", n=n_rag, m=m,
                   workers=(2, 2), engine="swapfree", gather=False,
                   **kw),
+        # The distributed-solve legs (ISSUE 15): the [A | B]
+        # elimination's own inventory — shrinking stacked-row psums,
+        # no residual section — reconciled on both mesh shapes.
+        _solve_demo_leg("1d_p4_solve_gathered", n=n_rag, m=m,
+                        workers=4, gather=True, k=3, dtype=dt,
+                        generator=generator),
+        _solve_demo_leg("2d_2x2_solve_sharded", n=n_rag, m=m,
+                        workers=(2, 2), gather=False, k=2, dtype=dt,
+                        generator=generator),
     ]
     # The deliberate drift leg: judged with a tight band — on this
     # host the measured residue is host-dispatch wall time, orders of

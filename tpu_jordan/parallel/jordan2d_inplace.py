@@ -912,6 +912,282 @@ def _sharded_jordan2d_inplace(W, mesh, lay: CyclicLayout2D, eps, precision,
     )(W)
 
 
+# ---------------------------------------------------------------------
+# Distributed SOLVE (ISSUE 15): the [A | B] elimination on the 2D
+# block-cyclic mesh — the 2D twin of sharded_inplace._solve_step.
+# ---------------------------------------------------------------------
+
+
+def _solve_step_2d(t, Wloc, Xloc, singular, *, lay: CyclicLayout2D,
+                   nrhs: int, eps, precision, use_pallas: bool,
+                   probe_cols: bool):
+    """One solve super-step on one worker's (bpr, m, Wc) A shard plus
+    the (bpr, m, nrhs) RHS rows — X is row-sharded along "pr" and
+    REPLICATED along "pc" (the k RHS columns are tiny next to Wc; every
+    mesh column applies the same X update from the same replicated
+    E/prow operands, so the replicas stay bit-identical).
+
+    ``t`` static (unrolled: the live chunk window [t//pc, bc1) shrinks
+    statically per worker — per-device FLOPs ~1/(pr·pc) of the
+    single-device solve's) or traced (fori: full-width updates, dead
+    columns exact zeros).  Pivot choices and X bits match the
+    single-device engine (same probe arithmetic per candidate off the
+    one panel broadcast, same composite-key tie rule).
+
+    Like the 1D solve there is NO in-place column replacement and NO
+    unscramble: A is driven to identity and discarded.
+
+    Collectives per step: the (bpr, m, m) panel psum along "pc", the
+    whole-mesh pivot reduction, TWO stacked [A_live | X] row psums
+    along "pr" — (m, (bc1 − t//pc)·m + k) unrolled, (m, Wc + k) fori —
+    and the (m, m) swap fix-up psum along "pc"."""
+    pr, pc, m, bpr = lay.pr, lay.pc, lay.m, lay.bpr
+    static_t = isinstance(t, int)
+    kr = lax.axis_index(AXIS_R)
+    kc = lax.axis_index(AXIS_C)
+    dtype = Wloc.dtype
+    Wc = Wloc.shape[-1]
+    z = jnp.int32(0)
+    tt = jnp.asarray(t, jnp.int32)
+    u_t = tt // pc                              # owner column's local chunk
+    own_c = kc == (tt % pc)
+
+    # --- CHUNK BROADCAST along "pc": candidates + eliminate multipliers
+    # (one psum serves both, the _step2d discipline).
+    chunk = lax.dynamic_slice(Wloc, (z, z, u_t * m), (bpr, m, m))
+    chunk_all = psum(
+        jnp.where(own_c, chunk, jnp.asarray(0, dtype)), AXIS_C)
+
+    # --- PIVOT PROBE (layout per resolve_probe_layout).
+    invs, sing, idx = _probe_candidates(
+        chunk_all, tt, lay=lay, eps=eps, use_pallas=use_pallas,
+        probe_cols=probe_cols,
+        static_s0=(t // pr if static_t else None))
+    gidx = idx * pr + kr
+    valid = (idx < bpr) & (gidx >= tt) & ~sing
+    norms = block_inf_norms(invs)
+    key = jnp.where(valid, norms, jnp.asarray(jnp.inf, norms.dtype))
+    slot_best = jnp.argmin(key)
+    my_key = key[slot_best]
+    g_cand = gidx[slot_best]
+
+    # --- PIVOT REDUCTION over the whole mesh (identical to _step2d).
+    kmin = pmin(my_key, BOTH)
+    win_g = pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), BOTH)
+    singular = singular | ~jnp.isfinite(kmin)
+    i_won = (my_key == kmin) & (g_cand == win_g)
+    g_piv = psum(jnp.where(i_won, g_cand, 0), BOTH)
+    H = psum(
+        jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0), BOTH
+    ).astype(dtype)
+
+    # --- STACKED ROW BROADCASTS along "pr": [A_live | X] of the pivot
+    # row and of row t (X is replicated along "pc", so the same one-hot
+    # masking delivers it to every column without double counting).
+    if static_t:
+        loW = (t // pc) * m                     # min live chunk offset
+        live = Wc - loW
+    else:
+        loW = 0
+        live = Wc
+
+    def rowcat(slot):
+        slot = jnp.asarray(slot, jnp.int32)
+        if static_t:
+            a_row = lax.dynamic_slice(Wloc, (slot, z, jnp.int32(loW)),
+                                      (1, m, live))[0]
+        else:
+            a_row = lax.dynamic_index_in_dim(Wloc, slot, 0, False)
+        return jnp.concatenate(
+            [a_row, lax.dynamic_index_in_dim(Xloc, slot, 0, False)],
+            axis=1)
+
+    own_piv_r = kr == (g_piv % pr)
+    slot_piv = jnp.asarray(jnp.where(own_piv_r, g_piv // pr, 0),
+                           jnp.int32)
+    row_piv = psum(jnp.where(own_piv_r, rowcat(slot_piv), 0.0), AXIS_R)
+    own_t_r = kr == (tt % pr)
+    slot_t = tt // pr
+    row_t = psum(jnp.where(own_t_r, rowcat(slot_t), 0.0), AXIS_R)
+
+    # --- SWAP-BY-COPY: pivot owner's slot receives old row t in A's
+    # live columns and in X; slot t is rewritten from prow below.
+    if static_t:
+        cur_A = lax.dynamic_slice(Wloc, (slot_piv, z, jnp.int32(loW)),
+                                  (1, m, live))
+        Wloc = lax.dynamic_update_slice(
+            Wloc, jnp.where(own_piv_r, row_t[None, :, :live], cur_A),
+            (slot_piv, z, jnp.int32(loW)))
+    else:
+        cur_A = lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False)
+        Wloc = lax.dynamic_update_index_in_dim(
+            Wloc, jnp.where(own_piv_r, row_t[:, :live], cur_A),
+            slot_piv, 0)
+    cur_X = lax.dynamic_index_in_dim(Xloc, slot_piv, 0, False)
+    Xloc = lax.dynamic_update_index_in_dim(
+        Xloc, jnp.where(own_piv_r, row_t[:, live:], cur_X), slot_piv, 0)
+
+    # --- NORMALIZE: separate A/X matmuls (the single-device op
+    # structure, the bit-match contract).
+    prow_A = jnp.matmul(H, row_piv[:, :live], precision=precision)
+    prow_X = jnp.matmul(H, row_piv[:, live:], precision=precision)
+
+    # --- MULTIPLIERS from the pre-swap panel + the swap fix-up: the
+    # slot that received old row t needs old row t's t-chunk — one
+    # (m, m) psum along "pc"; the slot holding global row t is zeroed
+    # (its multiplier is the prow write below).
+    if static_t:
+        # Owner column's t-chunk sits at the HEAD of its live slice
+        # (u_t == t // pc == loW / m there).
+        row_t_chunk_loc = row_t[:, :m]
+    else:
+        row_t_chunk_loc = lax.dynamic_slice(row_t, (z, u_t * m), (m, m))
+    row_t_chunk = psum(
+        jnp.where(own_c, row_t_chunk_loc, 0.0), AXIS_C).astype(dtype)
+    cur_Epiv = lax.dynamic_index_in_dim(chunk_all, slot_piv, 0, False)
+    E = lax.dynamic_update_index_in_dim(
+        chunk_all, jnp.where(own_piv_r, row_t_chunk, cur_Epiv),
+        slot_piv, 0)
+    gr = jnp.arange(bpr) * pr + kr
+    E = jnp.where((gr == tt)[:, None, None], jnp.asarray(0, dtype), E)
+
+    # --- ELIMINATE: one local MXU matmul pair over the live columns
+    # and the replicated RHS.
+    Ef = E.reshape(bpr * m, m)
+    upd_A = jnp.matmul(Ef, prow_A, precision=precision)
+    upd_X = jnp.matmul(Ef, prow_X, precision=precision)
+    if static_t:
+        Wloc = Wloc.at[:, :, loW:].add(-upd_A.reshape(bpr, m, live))
+    else:
+        Wloc = Wloc - upd_A.reshape(bpr, m, Wc)
+    Xloc = Xloc - upd_X.reshape(bpr, m, nrhs)
+
+    # Row t becomes the normalized pivot row (owning mesh row only).
+    if static_t:
+        cur_t = lax.dynamic_slice(Wloc, (slot_t, z, jnp.int32(loW)),
+                                  (1, m, live))
+        Wloc = lax.dynamic_update_slice(
+            Wloc, jnp.where(own_t_r, prow_A[None], cur_t),
+            (slot_t, z, jnp.int32(loW)))
+    else:
+        cur_t = lax.dynamic_index_in_dim(Wloc, slot_t, 0, False)
+        Wloc = lax.dynamic_update_index_in_dim(
+            Wloc, jnp.where(own_t_r, prow_A, cur_t), slot_t, 0)
+    cur_tx = lax.dynamic_index_in_dim(Xloc, slot_t, 0, False)
+    Xloc = lax.dynamic_update_index_in_dim(
+        Xloc, jnp.where(own_t_r, prow_X, cur_tx), slot_t, 0)
+    return Wloc, Xloc, singular
+
+
+_SPEC_X2 = PartitionSpec(AXIS_R, None, None)
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "nrhs", "eps", "precision",
+                          "use_pallas", "probe_cols"))
+def _sharded_jordan_solve_2d(W, X, mesh, lay: CyclicLayout2D, nrhs, eps,
+                             precision, use_pallas, probe_cols=True):
+    """The unrolled 2D solve engine (static shrinking live-chunk
+    window; Nr <= MAX_UNROLL_NR)."""
+    def worker(Wloc, Xloc):
+        singular = pcast(jnp.asarray(False), BOTH, to='varying')
+        for t in range(lay.Nr):
+            Wloc, Xloc, singular = _solve_step_2d(
+                t, Wloc, Xloc, singular, lay=lay, nrhs=nrhs, eps=eps,
+                precision=precision, use_pallas=use_pallas,
+                probe_cols=probe_cols)
+        return Xloc, singular[None, None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=(_SPEC_W, _SPEC_X2),
+        out_specs=(_SPEC_X2, PartitionSpec(AXIS_R, AXIS_C)),
+    )(W, X)
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "nrhs", "eps", "precision",
+                          "use_pallas", "probe_cols"))
+def _sharded_jordan_solve_2d_fori(W, X, mesh, lay: CyclicLayout2D, nrhs,
+                                  eps, precision, use_pallas,
+                                  probe_cols=True):
+    """The fori_loop 2D solve engine: compile cost flat in Nr —
+    identical pivot choices and X bits to the unrolled flavor."""
+    def worker(Wloc, Xloc):
+        def body(t, carry):
+            Wl, Xl, sing = carry
+            return _solve_step_2d(t, Wl, Xl, sing, lay=lay, nrhs=nrhs,
+                                  eps=eps, precision=precision,
+                                  use_pallas=use_pallas,
+                                  probe_cols=probe_cols)
+
+        sing0 = pcast(jnp.asarray(False), BOTH, to='varying')
+        Wloc, Xloc, singular = lax.fori_loop(
+            0, lay.Nr, body, (Wloc, Xloc, sing0))
+        return Xloc, singular[None, None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=(_SPEC_W, _SPEC_X2),
+        out_specs=(_SPEC_X2, PartitionSpec(AXIS_R, AXIS_C)),
+    )(W, X)
+
+
+def scatter_rhs_2d(b: jnp.ndarray, lay: CyclicLayout2D, mesh: Mesh):
+    """(n, k) RHS -> (Nr, m, k) zero-padded row blocks in cyclic row
+    storage order, sharded along "pr" and replicated along "pc"."""
+    from jax.sharding import NamedSharding
+
+    n, k = b.shape
+    bp = jnp.zeros((lay.N, k), b.dtype).at[:n].set(b)
+    blocks = jnp.take(bp.reshape(lay.Nr, lay.m, k),
+                      jnp.asarray(lay.row_perm(), jnp.int32), axis=0)
+    return jax.device_put(blocks, NamedSharding(mesh, _SPEC_X2))
+
+
+def gather_solution_2d(xb: jnp.ndarray, lay: CyclicLayout2D, n: int):
+    """Cyclic row storage order -> natural order; strip the pad rows."""
+    from .jordan2d import _inv_perm
+
+    xb = jnp.take(xb, _inv_perm(jnp.asarray(lay.row_perm(), jnp.int32)),
+                  axis=0)
+    return xb.reshape(lay.N, -1)[:n]
+
+
+def compile_sharded_jordan_solve_2d(
+    Wblocks: jnp.ndarray,
+    Xblocks: jnp.ndarray,
+    mesh: Mesh,
+    lay: CyclicLayout2D,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    use_pallas: bool | None = None,
+    unroll: bool | None = None,
+    probe_layout: str = "auto",
+):
+    """AOT-compile the 2D distributed solve.  ``run(W, X) ->
+    (x_blocks, singular_grid)``; ``unroll=None`` picks the unrolled
+    trace for Nr <= MAX_UNROLL_NR and the fori engine beyond."""
+    from .jordan2d import resolve_use_pallas_2d
+
+    if eps is None:
+        eps = eps_for(Wblocks.dtype)
+    if use_pallas is None:
+        use_pallas = resolve_use_pallas_2d(Wblocks.dtype, lay.m)
+    if unroll is None:
+        unroll = lay.Nr <= MAX_UNROLL_NR
+    probe_cols = resolve_probe_layout(probe_layout, mesh)
+    nrhs = int(Xblocks.shape[-1])
+    engine = (_sharded_jordan_solve_2d if unroll
+              else _sharded_jordan_solve_2d_fori)
+    return engine.lower(
+        Wblocks, Xblocks, mesh, lay, nrhs, eps, precision, use_pallas,
+        probe_cols
+    ).compile()
+
+
 def gather_inverse_inplace_2d(out: jnp.ndarray, lay: CyclicLayout2D, n: int):
     """2D-cyclic storage (both axes) -> natural order; unpad."""
     from ..ops.padding import unpad

@@ -362,6 +362,41 @@ def _cost_solve_spd(pt: TunePoint) -> float:
     return 0.45 * projected_seconds(pt)
 
 
+def _legal_solve_fori(pt: TunePoint) -> bool:
+    # The fori-compiled solve engine (linalg/engine.py::
+    # block_jordan_solve_fori, ISSUE 15): single-device, ANY Nr (the
+    # compile cost is flat in Nr — what makes Nr > MAX_UNROLL_NR legal),
+    # dtype-generic incl. complex.
+    return not pt.distributed
+
+
+def _cost_solve_fori(pt: TunePoint) -> float:
+    # Full-width updates (traced offsets cannot slice a shrinking
+    # static window): ~2n³ + 2n²k vs the unrolled engine's n³(1+k/n) —
+    # ranked strictly above both unrolled solve flavors wherever those
+    # are legal, so it is only auto-picked beyond MAX_UNROLL_NR (or by
+    # measured evidence).
+    return 1.1 * projected_seconds(pt)
+
+
+def _legal_solve_sharded(pt: TunePoint) -> bool:
+    # The distributed [A | B] elimination (ISSUE 15 tentpole):
+    # parallel/sharded_inplace.py (1D) and jordan2d_inplace.py (2D),
+    # legal at any mesh shape and EITHER gather mode (X is O(n·k) and
+    # always assembled; A stays sharded end to end), any Nr (unrolled
+    # vs fori resolved inside by Nr), real dtypes only (the scatter/
+    # collective paths follow the invert engines' real-dtype contract).
+    return pt.distributed and _real_dtype(pt)
+
+
+def _cost_solve_sharded(pt: TunePoint) -> float:
+    # Same n³(1+k/n)-vs-2n³ discount as the single-device solve,
+    # applied to the distributed projection (per-device FLOPs land
+    # ~1/p of the single-device solve's — the comm terms are the
+    # invert model's: same pivot/row-psum superstep structure).
+    return 0.55 * projected_seconds(pt)
+
+
 def _legal_update(pt: TunePoint) -> bool:
     # The SMW update (linalg/update.py): three GEMMs, a k×k capacitance
     # solve, and the in-launch verification matmul — single-device
@@ -431,6 +466,31 @@ CONFIGS: tuple[EngineConfig, ...] = (
         "the pivoting solve engine at SPD points: the cross-check and "
         "recovery fallback (never cost-preferred over the pivot-free "
         "path, but a legal candidate the measuring tuner can promote)",
+        workload="solve_spd"),
+    EngineConfig(
+        "solve_sharded", "solve_sharded", 0, _legal_solve_sharded,
+        _cost_solve_sharded,
+        "the [A | B] elimination sharded over the 1D/2D meshes "
+        "(ISSUE 15): the k RHS columns ride the pivot/row-broadcast/"
+        "eliminate supersteps, live-column window statically shrinking "
+        "per shard (unrolled) or fori beyond MAX_UNROLL_NR; X "
+        "bit-matches the single-device engine",
+        workload="solve"),
+    EngineConfig(
+        "solve_fori", "solve_fori", 0, _legal_solve_fori,
+        _cost_solve_fori,
+        "fori-compiled [A | B] solve: traced supersteps, compile cost "
+        "flat in Nr — the engine that makes Nr > MAX_UNROLL_NR legal "
+        "single-device; full-width updates (~2n³), X bit-matches the "
+        "unrolled engine",
+        workload="solve"),
+    EngineConfig(
+        "solve_fori_spd", "solve_fori", 0, _legal_solve_fori,
+        _cost_solve_fori,
+        "the pivoting fori solve engine at SPD points: the large-Nr "
+        "fallback under the assume='spd' promise (condition-based "
+        "pivoting stays sound there; never cost-preferred over the "
+        "unrolled pivot-free path where that is legal)",
         workload="solve_spd"),
     # ---- resident-inverse updates (ISSUE 12, tpu_jordan/linalg) ------
     EngineConfig(

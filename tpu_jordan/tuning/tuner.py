@@ -92,17 +92,44 @@ def measure_config(point: TunePoint, cfg: EngineConfig,
         # representative single-RHS point — engine ranking is measured
         # to depend on n/dtype, not on the RHS width, which the point
         # deliberately does not carry (docs/WORKLOADS.md).
-        from ..linalg.engine import block_jordan_solve
+        from ..linalg.engine import (block_jordan_solve,
+                                     block_jordan_solve_fori)
 
         a = generate("kms" if cfg.workload == "solve_spd" else "rand",
                      (n, n), dtype)
         b = generate("crand" if point.dtype.startswith("complex")
                      else "rand", (n, 1), dtype)
-        spd = cfg.engine == "solve_spd"
-        compiled = jax.jit(
-            lambda aa, bb: block_jordan_solve(aa, bb, block_size=m,
-                                              spd=spd)
-        ).lower(a, b).compile()
+        if cfg.engine == "solve_sharded":
+            # The distributed [A | B] elimination (ISSUE 15): measure
+            # the REAL sharded executable on the point's mesh — timing
+            # the single-device engine under a distributed key would be
+            # exactly the bogus-plan class the typed refusals exist
+            # for.  ONE mesh dispatch (linalg.api.solve_mesh_backend)
+            # shared with solve_system, so the measured executable can
+            # never diverge from the shipped one.
+            from ..linalg.api import solve_mesh_backend
+
+            mesh, lay, scatter_a, scatter_b, compile_fn, _ = \
+                solve_mesh_backend(point.workers, n, m)
+            W = scatter_a(a, lay, mesh)
+            X = scatter_b(b, lay, mesh)
+            run = compile_fn(W, X, mesh, lay)
+
+            def call():
+                jax.block_until_ready(run(W, X)[0])
+
+            return measure_direct(call, samples=samples)
+        if cfg.engine == "solve_fori":
+            compiled = jax.jit(
+                lambda aa, bb: block_jordan_solve_fori(aa, bb,
+                                                       block_size=m)
+            ).lower(a, b).compile()
+        else:
+            spd = cfg.engine == "solve_spd"
+            compiled = jax.jit(
+                lambda aa, bb: block_jordan_solve(aa, bb, block_size=m,
+                                                  spd=spd)
+            ).lower(a, b).compile()
 
         def call():
             jax.block_until_ready(compiled(a, b)[0])
