@@ -118,11 +118,13 @@ capacity-demo:
 	  > /tmp/tpu_jordan_capacity.json
 	python tools/check_capacity.py /tmp/tpu_jordan_capacity.json
 
-# Comm demo + validation (ISSUE 14 + the ISSUE 15 solve legs,
-# docs/OBSERVABILITY.md): seven tiny distributed solves (1D + 2D
-# meshes, both gather modes, a grouped engine, a ragged problem size,
-# and the two distributed-SOLVE legs — the [A | B] elimination's own
-# inventory) each reconciling the collective
+# Comm demo + validation (ISSUE 14 + the ISSUE 15 solve legs + the
+# ISSUE 16 probe-ahead legs, docs/OBSERVABILITY.md): nine tiny
+# distributed solves (1D + 2D meshes, both gather modes, a grouped
+# engine, a ragged problem size, the two distributed-SOLVE legs — the
+# [A | B] elimination's own inventory — and the lookahead invert +
+# solve legs, whose reordered schedule must keep the collective
+# multiset identical) each reconciling the collective
 # multiset the traced program actually issued against the
 # layout-derived analytical inventory, plus one deliberate
 # measured-vs-projected drift leg whose out-of-band ratio must be a

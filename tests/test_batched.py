@@ -57,6 +57,7 @@ class TestBatchedInvert:
         assert bool((sing_b == sing_v).all())
         assert bool((inv_b == inv_v).all()), "small-n batch engine diverged"
 
+    @pytest.mark.slow  # tier-1 budget: the batched smoke parity case stays
     def test_smalln_engine_per_element_singularity_and_swaps(self, rng):
         # Pivoting fixtures per element: |i-j| (zero diagonal — swaps
         # required) mixed with a singular element and a random one.

@@ -457,3 +457,64 @@ class TestCommSentinel:
         spread, _ = check_bench._variance_context(
             "sharded_swapfree_2048_comm_gbps", row)
         assert spread == 2.5
+
+
+class TestLookaheadSentinel:
+    """ISSUE 16 satellite, trapped both ways: the probe-ahead rows'
+    rate keys page on quiet shortfalls; the ``*_overlap_frac`` modeled
+    headroom is accounting-class (a comm-model re-weighting re-prices
+    the same schedule) and never pages."""
+
+    def test_lookahead_gflops_quiet_regression_pages(self, tmp_path):
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "lookahead_4096_gflops": 5000.0,
+                "lookahead_4096_spread_pct": 2.0,
+                "solve_lookahead_sharded_4096_k8_gflops": 120.0,
+                "solve_lookahead_sharded_4096_k8_spread_pct": 2.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "lookahead_4096_gflops": 3200.0,
+                "lookahead_4096_spread_pct": 2.0,
+                "solve_lookahead_sharded_4096_k8_gflops": 118.0,
+                "solve_lookahead_sharded_4096_k8_spread_pct": 2.0})),
+        ]
+        assert check_bench.main(files) == 2
+
+    def test_overlap_frac_accounting_never_pages(self, tmp_path):
+        # A 10x overlap_frac change (re-weighted comm model) with flat
+        # rates: exit 0 — while the same rows' gflops keys stay
+        # comparable and a quiet solve-row shortfall still pages.
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "lookahead_4096_overlap_frac": 0.21,
+                "solve_lookahead_sharded_4096_overlap_frac": 0.34,
+                "solve_lookahead_sharded_4096_comm_bytes": 3.2e9,
+                "lookahead_4096_gflops": 5000.0,
+                "lookahead_4096_spread_pct": 2.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "lookahead_4096_overlap_frac": 0.021,
+                "solve_lookahead_sharded_4096_overlap_frac": 0.034,
+                "solve_lookahead_sharded_4096_comm_bytes": 3.2e8,
+                "lookahead_4096_gflops": 4980.0,
+                "lookahead_4096_spread_pct": 2.0})),
+        ]
+        assert check_bench.main(files) == 0
+        assert check_bench.is_accounting_key(
+            "lookahead_4096_overlap_frac")
+        assert check_bench.is_accounting_key(
+            "solve_lookahead_sharded_4096_overlap_frac")
+        keys = check_bench.comparable_keys(
+            {"metric": "m", "value": 1.0,
+             "extra": {"lookahead_4096_overlap_frac": 0.21,
+                       "lookahead_4096_gflops": 5000.0,
+                       "solve_lookahead_sharded_4096_k8_gflops": 120.0}})
+        assert "lookahead_4096_overlap_frac" not in keys
+        assert "lookahead_4096_gflops" in keys
+        assert "solve_lookahead_sharded_4096_k8_gflops" in keys
+        files[1] = _write(tmp_path, "r2b.json", _round(10000.0, {
+            "solve_lookahead_sharded_4096_k8_gflops": 80.0,
+            "solve_lookahead_sharded_4096_k8_spread_pct": 2.0}))
+        files[0] = _write(tmp_path, "r1b.json", _round(10000.0, {
+            "solve_lookahead_sharded_4096_k8_gflops": 120.0,
+            "solve_lookahead_sharded_4096_k8_spread_pct": 2.0}))
+        assert check_bench.main(files) == 2

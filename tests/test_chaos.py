@@ -56,6 +56,7 @@ class TestChaosAcceptance:
         # Makefile target runs).
         assert check_chaos.check(report) == []
 
+    @pytest.mark.slow  # tier-1 budget: test_seeded_chaos_more_seeds keeps the replay pin
     def test_seeded_chaos_vs_fault_free_replay(self):
         self._pin(chaos_demo(n=96, requests=50, batch_cap=4, seed=0))
 

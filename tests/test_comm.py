@@ -180,7 +180,7 @@ class TestAnalytical:
 
 
 def _reconcile_1d(n, m, p, engine, group=0, unroll=None,
-                  swapfree=False):
+                  swapfree=False, lookahead=False):
     from tpu_jordan.parallel.ring_gemm import _to_identity_padded_blocks
     from tpu_jordan.parallel.sharded_inplace import (
         compile_sharded_jordan_inplace,
@@ -195,13 +195,14 @@ def _reconcile_1d(n, m, p, engine, group=0, unroll=None,
     with comm.record_collectives() as rec:
         compile_sharded_jordan_inplace(W, mesh, lay, group=group,
                                        unroll=unroll,
-                                       swapfree=swapfree)
+                                       swapfree=swapfree,
+                                       lookahead=lookahead)
     rep.attach_observed("engine", rec.records)
     return rep
 
 
 def _reconcile_2d(n, m, pr, pc, engine, group=0, unroll=None,
-                  swapfree=False):
+                  swapfree=False, lookahead=False):
     from tpu_jordan.parallel.jordan2d import scatter_matrix_2d
     from tpu_jordan.parallel.jordan2d_inplace import (
         compile_sharded_jordan_inplace_2d,
@@ -216,7 +217,8 @@ def _reconcile_2d(n, m, pr, pc, engine, group=0, unroll=None,
     with comm.record_collectives() as rec:
         compile_sharded_jordan_inplace_2d(W, mesh, lay, group=group,
                                           unroll=unroll,
-                                          swapfree=swapfree)
+                                          swapfree=swapfree,
+                                          lookahead=lookahead)
     rep.attach_observed("engine", rec.records)
     return rep
 
@@ -303,6 +305,7 @@ class TestReconciliation:
         assert any("analytical" in m and "observed" in m
                    for m in rep.mismatches)
 
+    @pytest.mark.slow  # tier-1 budget: the engine-matrix reconciliations stay fast
     def test_cache_hit_is_unjudged_never_false(self):
         """Re-compiling an identical configuration hits jax's lowering
         cache — no fresh trace, honestly un-judged (None), never a
@@ -314,7 +317,7 @@ class TestReconciliation:
         assert rep2.reconciled is None
 
 
-def _reconcile_solve_1d(n, m, p, k, unroll):
+def _reconcile_solve_1d(n, m, p, k, unroll, lookahead=False):
     from tpu_jordan.parallel.ring_gemm import _to_identity_padded_blocks
     from tpu_jordan.parallel.sharded_inplace import (
         compile_sharded_jordan_solve, scatter_rhs_1d,
@@ -326,15 +329,17 @@ def _reconcile_solve_1d(n, m, p, k, unroll):
     b = generate("rand", (n, k), jnp.float32, row_offset=n)
     W = _to_identity_padded_blocks(a, lay, mesh)
     X = scatter_rhs_1d(b, lay, mesh)
-    rep = comm.engine_report(engine="solve_sharded", lay=lay,
+    eng = "solve_lookahead" if lookahead else "solve_sharded"
+    rep = comm.engine_report(engine=eng, lay=lay,
                              dtype="float32", unroll=unroll, rhs=k)
     with comm.record_collectives() as rec:
-        compile_sharded_jordan_solve(W, X, mesh, lay, unroll=unroll)
+        compile_sharded_jordan_solve(W, X, mesh, lay, unroll=unroll,
+                                     lookahead=lookahead)
     rep.attach_observed("engine", rec.records)
     return rep
 
 
-def _reconcile_solve_2d(n, m, pr, pc, k, unroll):
+def _reconcile_solve_2d(n, m, pr, pc, k, unroll, lookahead=False):
     from tpu_jordan.parallel.jordan2d import scatter_matrix_2d
     from tpu_jordan.parallel.jordan2d_inplace import (
         compile_sharded_jordan_solve_2d, scatter_rhs_2d,
@@ -346,10 +351,12 @@ def _reconcile_solve_2d(n, m, pr, pc, k, unroll):
     b = generate("rand", (n, k), jnp.float32, row_offset=n)
     W = scatter_matrix_2d(a, lay, mesh)
     X = scatter_rhs_2d(b, lay, mesh)
-    rep = comm.engine_report(engine="solve_sharded", lay=lay,
+    eng = "solve_lookahead" if lookahead else "solve_sharded"
+    rep = comm.engine_report(engine=eng, lay=lay,
                              dtype="float32", unroll=unroll, rhs=k)
     with comm.record_collectives() as rec:
-        compile_sharded_jordan_solve_2d(W, X, mesh, lay, unroll=unroll)
+        compile_sharded_jordan_solve_2d(W, X, mesh, lay, unroll=unroll,
+                                        lookahead=lookahead)
     rep.attach_observed("engine", rec.records)
     return rep
 
@@ -429,6 +436,94 @@ class TestSolveReconciliation:
                     f"registry config {cfg.name!r} ({cfg.engine}) is "
                     f"legal at a distributed point but has NO comm "
                     f"inventory (obs/comm.INVENTORY_ENGINES)")
+
+    def test_registry_lint_every_distributed_invert_config_accounted(
+            self):
+        """The ISSUE 16 extension of the lint above: every INVERT
+        registry config legal at a distributed point (that includes
+        every new *_lookahead config) names an engine with a
+        registered comm inventory."""
+        from tpu_jordan.tuning.registry import CONFIGS, TunePoint
+
+        points = [
+            TunePoint.create(4096, 128, "float32", workers=8),
+            TunePoint.create(4096, 128, "float32", workers=(2, 4)),
+        ]
+        checked = set()
+        for cfg in CONFIGS:
+            if cfg.workload != "invert":
+                continue
+            if any(cfg.legal(pt) for pt in points):
+                checked.add(cfg.name)
+                assert cfg.engine in comm.INVENTORY_ENGINES, (
+                    f"registry config {cfg.name!r} ({cfg.engine}) is "
+                    f"legal at a distributed point but has NO comm "
+                    f"inventory (obs/comm.INVENTORY_ENGINES)")
+        assert "lookahead" in checked   # the ISSUE 16 config IS linted
+
+
+class TestLookaheadReconciliation:
+    """ISSUE 16: the probe-ahead engines reconcile multiset-exact
+    against the PLAIN flavors' analytical inventory — the lookahead
+    schedule issues step t+1's condition probe one superstep early
+    (prologue probe + Nr−1 in-loop probes = the same Nr probes), so
+    the collective multiset, and the total payload bytes, are
+    IDENTICAL by construction.  Each case compiles a unique size
+    (fresh trace; the module's config-hygiene rule)."""
+
+    def test_1d_invert_lookahead_gathered(self):
+        rep = _reconcile_1d(50, 8, 4, "lookahead", lookahead=True)
+        assert rep.reconciled is True, rep.mismatches
+        # Identical analytical inventory — total payload unchanged.
+        lay = CyclicLayout.create(50, 8, 4)
+        plain = comm.engine_report(engine="inplace", lay=lay,
+                                   dtype="float32", gather=True)
+        assert rep.total_bytes() == plain.total_bytes()
+        assert rep.total_messages() == plain.total_messages()
+
+    def test_1d_invert_lookahead_sharded(self):
+        # gather=False flavor: no implicit all-gather sig, the engine
+        # section still reconciles exact on a fresh size.
+        from tpu_jordan.parallel.ring_gemm import (
+            _to_identity_padded_blocks)
+        from tpu_jordan.parallel.sharded_inplace import (
+            compile_sharded_jordan_inplace)
+
+        mesh = make_mesh(4)
+        lay = CyclicLayout.create(54, 8, 4)
+        a = generate("absdiff", (54, 54), jnp.float32)
+        W = _to_identity_padded_blocks(a, lay, mesh)
+        rep = comm.engine_report(engine="lookahead", lay=lay,
+                                 dtype="float32", gather=False)
+        with comm.record_collectives() as rec:
+            compile_sharded_jordan_inplace(W, mesh, lay,
+                                           lookahead=True)
+        rep.attach_observed("engine", rec.records)
+        assert rep.reconciled is True, rep.mismatches
+        assert not [s for s in rep.sigs if s.section == "gather"]
+
+    @pytest.mark.slow       # tier-1 budget: the 1D pins + the dryrun
+    def test_2d_invert_lookahead(self):  # 2D legs cover the fast path
+        rep = _reconcile_2d(62, 8, 2, 2, "lookahead", lookahead=True)
+        assert rep.reconciled is True, rep.mismatches
+        lay = CyclicLayout2D.create(62, 8, 2, 2)
+        plain = comm.engine_report(engine="inplace", lay=lay,
+                                   dtype="float32", gather=True)
+        assert rep.total_bytes() == plain.total_bytes()
+
+    def test_1d_solve_lookahead(self):
+        rep = _reconcile_solve_1d(44, 8, 4, 3, True, lookahead=True)
+        assert rep.reconciled is True, rep.mismatches
+        lay = CyclicLayout.create(44, 8, 4)
+        plain = comm.engine_report(engine="solve_sharded", lay=lay,
+                                   dtype="float32", unroll=True, rhs=3)
+        assert rep.total_bytes() == plain.total_bytes()
+        assert rep.total_messages() == plain.total_messages()
+
+    @pytest.mark.slow       # same tier-1 budget call as the 2D invert
+    def test_2d_solve_lookahead(self):
+        rep = _reconcile_solve_2d(68, 8, 2, 2, 2, True, lookahead=True)
+        assert rep.reconciled is True, rep.mismatches
 
 
 # ---------------------------------------------------------------------
@@ -647,11 +742,14 @@ class TestWarmPathPins:
 @pytest.fixture(scope="module")
 def demo_report():
     """One cached comm_demo run (inline — this process already hosts 8
-    virtual devices) shared by every checker test below."""
-    return comm.comm_demo(n=48, block_size=8)
+    virtual devices) shared by every checker test below.  n=32 (the
+    smallest size every leg's layout admits at Nr=4) keeps the nine-leg fixture inside the tier-1 budget; the
+    CLI/`make comm-demo` gate still runs the n=48 default."""
+    return comm.comm_demo(n=30, block_size=8)
 
 
 class TestDemoAndChecker:
+    @pytest.mark.slow  # tier-1 budget: the checker + demo fixture legs pin dtype threading nightly-fast
     def test_demo_dtype_and_generator_are_honored(self):
         """Review finding (ISSUE 14): --dtype/--generator thread into
         the demo legs (byte figures scale with dtype width — a float64

@@ -208,6 +208,7 @@ class TestEngineSelection:
         r = solve(64, 8, workers=workers, dtype=jnp.float64, engine=engine)
         assert r.residual < 1e-9 * 64 * 63   # |i-j| norm-scaled bound
 
+    @pytest.mark.slow  # tier-1 budget: registry-ranking + smoke grouped parity stay
     def test_grouped_matches_auto_to_rounding(self):
         r_a = solve(64, 8, dtype=jnp.float64)
         r_g = solve(64, 8, dtype=jnp.float64, engine="grouped")

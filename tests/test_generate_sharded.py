@@ -56,6 +56,7 @@ class TestShardedGenerate:
 
 
 class TestDeviceResidentSolve:
+    @pytest.mark.slow  # tier-1 budget: test_gathered_matches_host_path stays
     def test_generator_solve_no_host_matrix(self, mesh8, monkeypatch):
         # The generator-driven distributed path must never call the host
         # n×n generator.

@@ -107,6 +107,7 @@ class TestGenerate2D:
 
 
 class TestDriver2D:
+    @pytest.mark.slow  # tier-1 budget: the 2D solve parity + comm reconciliation siblings stay
     def test_solve_2d_generator(self):
         from tpu_jordan.driver import solve
 

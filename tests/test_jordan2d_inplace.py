@@ -33,7 +33,9 @@ class TestSharded2DInplace:
     @pytest.mark.smoke      # the 2D-layout engine case
     def test_matches_linalg_inv(self, rng):
         mesh = make_mesh_2d(2, 4)
-        a = jnp.asarray(rng.standard_normal((96, 96)), jnp.float64)
+        # n=48 still wraps the column cycle (6 blocks over pc=4) at
+        # half the unrolled-trace cost of the old 96 (smoke budget).
+        a = jnp.asarray(rng.standard_normal((48, 48)), jnp.float64)
         inv, sing = sharded_jordan_invert_inplace_2d(a, mesh, 8)
         assert not bool(sing)
         np.testing.assert_allclose(
@@ -55,6 +57,7 @@ class TestSharded2DInplace:
             np.asarray(inv_i), np.asarray(inv_a), rtol=1e-9, atol=1e-12
         )
 
+    @pytest.mark.slow  # tier-1 budget: test_matches_linalg_inv keeps the fast-run 2D pin
     def test_singular_collective_agreement(self):
         mesh = make_mesh_2d(2, 4)
         _, sing = sharded_jordan_invert_inplace_2d(
@@ -132,7 +135,9 @@ class TestSharded2DGrouped:
                                    rtol=1e-9, atol=1e-9)
 
     @pytest.mark.parametrize("n,m,k", [
-        (96, 8, 4),
+        # tier-1 budget: test_grouped_tied_pivots_cross_mesh_columns
+        # keeps the fast-run 2D grouped pin; the size ladder is nightly.
+        pytest.param(96, 8, 4, marks=pytest.mark.slow),
         pytest.param(128, 16, 4, marks=pytest.mark.slow),
         pytest.param(100, 8, 3, marks=pytest.mark.slow)])
     def test_grouped_matches_plain_to_rounding(self, rng, n, m, k):
@@ -243,6 +248,7 @@ class TestProbeLayoutSwitch:
             a, mesh, 8, group=2, probe_layout="owner")
         assert bool(jnp.all(x_c == x_o))
 
+    @pytest.mark.slow  # tier-1 budget: the layout-switch policy siblings stay fast
     def test_layouts_bitmatch_tied_pivots(self):
         # |i-j|: exact ties — the tie-break must not depend on which
         # device probed the candidate.
@@ -302,7 +308,8 @@ class TestSwapFree2D:
     engines, ties included."""
 
     @pytest.mark.parametrize("shape,n,m", [
-        ((2, 4), 96, 8),
+        # tier-1 budget: the (4, 2) case keeps the fast-run pin.
+        pytest.param((2, 4), 96, 8, marks=pytest.mark.slow),
         ((4, 2), 64, 8),
         pytest.param((2, 2), 100, 8, marks=pytest.mark.slow),
         pytest.param((2, 4), 256, 8,
@@ -361,3 +368,67 @@ class TestSwapFree2D:
         assert r.inverse is None
         assert r.inverse_blocks.shape == (12, 8, 96)
         assert r.residual < 1e-9 * 96 * 95
+
+
+class TestLookahead2D:
+    """The 2D probe-ahead engine (ISSUE 16): step t+1's chunk broadcast
+    along "pc" + probe reduction over the whole mesh issue right after
+    the critical panel, before the trailing eliminate.  Bits, pivot
+    sequence, and the collective multiset (tests/test_comm.py) pin
+    identical to the plain 2D engine."""
+
+    @pytest.mark.smoke      # the 2D probe-ahead engine-parity case
+    def test_tied_pivots_and_forced_swaps_bitmatch(self, rng):
+        # absdiff forces a row swap every superstep with exact ties;
+        # ragged n puts the identity-padded tail inside the carried
+        # panel; (2, 4) exercises cross-mesh-column panel ownership.
+        # n kept at the smallest ragged size with a swap per superstep
+        # (smoke budget: the unrolled trace cost scales with Nr).
+        mesh = make_mesh_2d(2, 4)
+        a = generate("absdiff", (44, 44), jnp.float64)
+        x_p, s_p = sharded_jordan_invert_inplace_2d(a, mesh, 8)
+        x_l, s_l = sharded_jordan_invert_inplace_2d(a, mesh, 8,
+                                                    lookahead=True)
+        assert bool(s_p) == bool(s_l) is False
+        assert bool(jnp.all(x_p == x_l)), \
+            "2D probe-ahead engine diverged bitwise from inplace"
+
+    @pytest.mark.slow  # tier-1 budget (ISSUE 16): the smoke bitmatch keeps a tier-1 sibling
+    def test_bitmatches_inplace_rand(self, rng):
+        mesh = make_mesh_2d(2, 2)
+        a = jnp.asarray(rng.standard_normal((64, 64)), jnp.float64)
+        x_p, s_p = sharded_jordan_invert_inplace_2d(a, mesh, 8)
+        x_l, s_l = sharded_jordan_invert_inplace_2d(a, mesh, 8,
+                                                    lookahead=True)
+        assert bool(s_p) == bool(s_l) is False
+        assert bool(jnp.all(x_p == x_l))
+
+    def test_driver_engine_string_routes_and_bitmatches(self):
+        from tpu_jordan.driver import solve
+
+        r_l = solve(64, 8, workers=(2, 2), dtype=jnp.float64,
+                    engine="lookahead", gather=False)
+        r_p = solve(64, 8, workers=(2, 2), dtype=jnp.float64,
+                    engine="inplace", gather=False)
+        assert r_l.engine == "lookahead"
+        assert bool(jnp.all(jnp.asarray(r_l.inverse_blocks)
+                            == jnp.asarray(r_p.inverse_blocks)))
+
+    def test_usage_gates_are_typed(self, rng):
+        from tpu_jordan.driver import UsageError
+        from tpu_jordan.parallel.sharded_inplace import MAX_UNROLL_NR
+
+        mesh = make_mesh_2d(2, 2)
+        a = jnp.asarray(rng.standard_normal((64, 64)), jnp.float64)
+        with pytest.raises(UsageError, match="swapfree/group"):
+            sharded_jordan_invert_inplace_2d(a, mesh, 8, lookahead=True,
+                                             swapfree=True)
+        with pytest.raises(UsageError, match="swapfree/group"):
+            sharded_jordan_invert_inplace_2d(a, mesh, 8, lookahead=True,
+                                             group=2)
+        n_big = 8 * (MAX_UNROLL_NR + 4)
+        a_big = jnp.asarray(rng.standard_normal((n_big, n_big)),
+                            jnp.float32)
+        with pytest.raises(UsageError, match="unrolled-only"):
+            sharded_jordan_invert_inplace_2d(a_big, mesh, 8,
+                                             lookahead=True)

@@ -486,6 +486,7 @@ class TestServeSolve:
             with pytest.raises(ValueError, match="k>=1"):
                 svc.submit(a, np.zeros((16, 0), np.float32))
 
+    @pytest.mark.slow  # tier-1 budget: the serve-solve round-trip sibling stays
     def test_journey_workload_stamped(self):
         from tpu_jordan.serve import JordanService
 

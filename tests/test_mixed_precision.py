@@ -49,6 +49,7 @@ def test_solve_mixed_single_device():
     assert res.residual / (96 * 96 / 2) < 1e-5
 
 
+@pytest.mark.slow  # tier-1 budget: the single-device + 2D mixed-solve siblings stay
 def test_solve_mixed_distributed():
     res = solve(n=96, block_size=8, workers=4, precision="mixed")
     assert res.residual / (96 * 96 / 2) < 1e-5

@@ -244,6 +244,7 @@ def test_fused_kernel_hc2_matches_reference(monkeypatch, rng):
         jax.clear_caches()
 
 
+@pytest.mark.slow  # tier-1 budget: the per-kernel dispatch/parity siblings stay
 def test_dispatch_policy(monkeypatch):
     # Pin WHICH kernel each block size dispatches to, so a future budget
     # or gate change is deliberate: fused needs a panel width, m % 128

@@ -92,6 +92,7 @@ def test_swapfree_no_gather_1d_shard_bytes_and_bitmatch():
                         == jnp.asarray(r_swap.inverse_blocks)))
 
 
+@pytest.mark.slow  # tier-1 budget: TestAutoEngineLegs keeps the no-gather fast-run coverage
 def test_swapfree_no_gather_2d_shard_bytes_and_bitmatch():
     n, m, pr, pc = 512, 32, 2, 4
     r_sf = solve(n, m, workers=(pr, pc), gather=False, dtype=jnp.float64,
@@ -148,21 +149,29 @@ class TestAutoEngineLegs:
                                 == jnp.asarray(direct.inverse_blocks)))
 
     def test_auto_gather_false_swapfree_selection(self, tmp_path):
-        """The gather=False swap-free auto-selection leg: (a) the cost
-        model routes the v5p pod-scale north-star meshes to the
-        swap-free engine under gather=False (the ISSUE 2 promise — the
+        """The gather=False auto-selection leg on the v5p pod-scale
+        north-star meshes: (a) at unrolled-reach Nr the probe-ahead
+        engine ranks first (ISSUE 16 — taking the condition probe off
+        the superstep critical path is a bigger projected saving than
+        deferring swaps), while beyond MAX_UNROLL_NR the swap-free
+        engine still owns the point (the ISSUE 2 promise — the
         projections in benchmarks/PHASES.md say SF wins there), and
         (b) an executed CPU-mesh solve honoring a swap-free plan from a
         warm cache runs swapfree and bit-matches the direct request."""
+        from tpu_jordan.parallel.sharded_inplace import MAX_UNROLL_NR
         from tpu_jordan.tuning import (Plan, PlanCache, TunePoint,
                                        plan_key, select_by_cost)
 
-        for mesh in ((4, 8), (8, 8)):
-            n = 32768 if mesh == (4, 8) else 65536
-            pt = TunePoint.create(n, 512, jnp.float32, mesh, gather=False,
-                                  backend="tpu", chip="v5p")
-            assert select_by_cost(pt).engine == "swapfree", \
-                f"v5p {mesh} @ {n} gather=False must rank swap-free first"
+        pt = TunePoint.create(32768, 512, jnp.float32, (4, 8),
+                              gather=False, backend="tpu", chip="v5p")
+        assert -(-32768 // 512) <= MAX_UNROLL_NR
+        assert select_by_cost(pt).engine == "lookahead", \
+            "v5p (4, 8) @ 32768 gather=False must rank probe-ahead first"
+        pt = TunePoint.create(65536, 512, jnp.float32, (8, 8),
+                              gather=False, backend="tpu", chip="v5p")
+        assert -(-65536 // 512) > MAX_UNROLL_NR
+        assert select_by_cost(pt).engine == "swapfree", \
+            "v5p (8, 8) @ 65536 gather=False must rank swap-free first"
         # Executed leg: seed a plan cache with the swap-free plan for
         # this CPU-mesh point; auto must honor it (zero measurements)
         # and bit-match engine='swapfree' requested directly.

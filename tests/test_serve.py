@@ -45,6 +45,7 @@ class TestBuckets:
         with pytest.raises(ValueError):
             bucket_for(0)
 
+    @pytest.mark.slow  # tier-1 budget: the executor-cache one-compile pin stays
     def test_block_size_is_part_of_executor_key(self):
         """A direct cache user requesting a different m must get a
         fresh executable, never a stale-m cache hit."""
@@ -381,6 +382,7 @@ class TestSustainedThroughput:
     occupancy > 1; every result bit-matching a direct solve of the same
     padded shape; backpressure typed, not dropping."""
 
+    @pytest.mark.slow  # tier-1 budget: the smoke serve round-trip + executor-cache pins stay
     def test_acceptance_demo(self, rng, tmp_path):
         sizes = [24, 48, 96, 130, 200]      # buckets 64, 64, 128, 256, 256
         reqs = _mats(rng, sizes, copies=13)  # 65 requests

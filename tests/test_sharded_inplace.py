@@ -54,7 +54,9 @@ class TestShardedInplace:
     def test_tied_pivots_match_single_device(self, mesh4):
         # |i-j| has exactly-repeated candidate blocks: ties must resolve to
         # the lowest global block row, matching the single-device argmin.
-        a = generate("absdiff", (96, 96), jnp.float64)
+        # n=48 keeps the cyclic wrap (6 blocks over 4 workers) at half
+        # the unrolled-trace cost of the old 96 (smoke budget).
+        a = generate("absdiff", (48, 48), jnp.float64)
         inv_d, s_d = sharded_jordan_invert_inplace(a, mesh4, 8)
         inv_s, s_s = block_jordan_invert_inplace(a, block_size=8)
         assert bool(s_d) == bool(s_s) is False
@@ -141,6 +143,7 @@ class TestShardedGrouped:
         np.testing.assert_allclose(np.asarray(x_g), np.asarray(x_p),
                                    rtol=1e-9, atol=1e-9)
 
+    @pytest.mark.slow  # tier-1 budget: grouped singular/beyond-cap/fori siblings stay
     def test_grouped_matches_single_chip_grouped(self, rng, mesh4):
         # Same grouped algorithm on both layouts -> rounding-level
         # agreement with the single-chip delayed-group-update engine.
@@ -312,3 +315,76 @@ class TestDriverEngineSelection:
         assert r.inverse_blocks.shape == (12, 8, 96)
         assert r.residual < 1e-10 * 96 * 95
 
+
+
+class TestLookahead1D:
+    """The 1D probe-ahead engine (ISSUE 16): step t+1's condition probe
+    — candidate panel, batched inverses, composite-key pmin — issues
+    right after the critical panel, BEFORE the trailing eliminate, so
+    the cross-worker reduction overlaps the bulk rank-m GEMM.  Same
+    arithmetic in a reordered schedule: bits, pivot sequence, and the
+    collective multiset (tests/test_comm.py) pin identical to the plain
+    1D engine."""
+
+    @pytest.mark.parametrize("n,m", [
+        (64, 8),
+        pytest.param(128, 16, marks=pytest.mark.slow)])
+    def test_bitmatches_inplace(self, rng, mesh8, n, m):
+        a = jnp.asarray(rng.standard_normal((n, n)), jnp.float64)
+        x_p, s_p = sharded_jordan_invert_inplace(a, mesh8, m)
+        x_l, s_l = sharded_jordan_invert_inplace(a, mesh8, m,
+                                                 lookahead=True)
+        assert bool(s_p) == bool(s_l) is False
+        assert bool(jnp.all(x_p == x_l)), \
+            "1D probe-ahead engine diverged bitwise from inplace"
+
+    @pytest.mark.smoke      # the 1D probe-ahead engine-parity case
+    def test_tied_pivots_and_forced_swaps_bitmatch(self, mesh4):
+        # |i-j|: zero diagonal forces a swap every superstep AND repeats
+        # candidate blocks exactly — the carried decision must reproduce
+        # the in-loop probe's lowest-global-row tie rule; ragged n puts
+        # the identity-padded tail inside the carried panel.  n kept at
+        # the smallest ragged size with a swap per superstep (smoke
+        # budget: the unrolled trace cost scales with Nr).
+        a = generate("absdiff", (44, 44), jnp.float64)
+        x_p, s_p = sharded_jordan_invert_inplace(a, mesh4, 8)
+        x_l, s_l = sharded_jordan_invert_inplace(a, mesh4, 8,
+                                                 lookahead=True)
+        assert bool(s_p) == bool(s_l) is False
+        assert bool(jnp.all(x_p == x_l))
+
+    def test_singular_collective_agreement(self, mesh4):
+        _, sing = sharded_jordan_invert_inplace(
+            jnp.ones((64, 64), jnp.float64), mesh4, 8, lookahead=True)
+        assert bool(sing)
+
+    def test_driver_engine_string_routes_and_bitmatches(self, mesh4):
+        from tpu_jordan.driver import solve
+
+        r_l = solve(64, 8, workers=4, dtype=jnp.float64,
+                    engine="lookahead", gather=False)
+        r_p = solve(64, 8, workers=4, dtype=jnp.float64,
+                    engine="inplace", gather=False)
+        assert r_l.engine == "lookahead"
+        assert bool(jnp.all(jnp.asarray(r_l.inverse_blocks)
+                            == jnp.asarray(r_p.inverse_blocks)))
+
+    def test_usage_gates_are_typed(self, mesh4, rng):
+        # Composition gates: the panel/trailing split is defined on the
+        # plain per-step schedule only, and only for the unrolled trace.
+        from tpu_jordan.driver import UsageError
+        from tpu_jordan.parallel.sharded_inplace import MAX_UNROLL_NR
+
+        a = jnp.asarray(rng.standard_normal((64, 64)), jnp.float64)
+        with pytest.raises(UsageError, match="swapfree/group"):
+            sharded_jordan_invert_inplace(a, mesh4, 8, lookahead=True,
+                                          swapfree=True)
+        with pytest.raises(UsageError, match="swapfree/group"):
+            sharded_jordan_invert_inplace(a, mesh4, 8, lookahead=True,
+                                          group=2)
+        n_big = 8 * (MAX_UNROLL_NR + 4)
+        a_big = jnp.asarray(rng.standard_normal((n_big, n_big)),
+                            jnp.float32)
+        with pytest.raises(UsageError, match="unrolled-only"):
+            sharded_jordan_invert_inplace(a_big, mesh4, 8,
+                                          lookahead=True)

@@ -54,6 +54,7 @@ class TestJordanSolver:
         with pytest.raises(ValueError, match="expected"):
             s.invert(rng.standard_normal((8, 8)))
 
+    @pytest.mark.slow  # tier-1 budget: test_workers4 + the smoke 2D layout stay
     def test_workers_2d_mesh(self, rng):
         # VERDICT r2 #8: the solver must accept a (pr, pc) mesh like the
         # driver does (2D block-cyclic layout, SUMMA residual).

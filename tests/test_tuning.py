@@ -76,13 +76,16 @@ class TestRegistry:
         inv_best = min(c.cost(inv) for c in candidates(inv))
         assert all(c.cost(slv) < inv_best for c in candidates(slv)
                    if c.engine != "solve_fori")
-        # ISSUE 15: distributed solve points rank solve_sharded alone;
-        # beyond MAX_UNROLL_NR single-device, the fori engine is the
-        # only (and selected) candidate.
+        # ISSUE 15/16: distributed solve points rank the sharded
+        # engine pair, and at unrolled-reach Nr the probe-ahead twin
+        # is the cost pick (its probe term is projected off the
+        # critical path); beyond MAX_UNROLL_NR single-device, the fori
+        # engine is the only (and selected) candidate.
         dslv = TunePoint.create(4096, 128, jnp.float32, 8, True,
                                 workload="solve")
-        assert {c.name for c in candidates(dslv)} == {"solve_sharded"}
-        assert select_by_cost(dslv).engine == "solve_sharded"
+        assert {c.name for c in candidates(dslv)} == {
+            "solve_sharded", "solve_lookahead_sharded"}
+        assert select_by_cost(dslv).engine == "solve_lookahead"
         big = TunePoint.create(8192, 64, jnp.float32, 1, True,
                                workload="solve")     # Nr = 128 > 64
         assert {c.name for c in candidates(big)} == {"solve_fori"}
@@ -108,9 +111,9 @@ class TestRegistry:
         single = TunePoint.create(64, 8, jnp.float32, 1, True)
         dist = TunePoint.create(64, 8, jnp.float32, 8, False)
         assert {c.name for c in candidates(single)} == {
-            "inplace", "grouped2", "augmented"}
+            "inplace", "grouped2", "augmented", "lookahead"}
         assert {c.name for c in candidates(dist)} == {
-            "inplace", "grouped2", "augmented", "swapfree"}
+            "inplace", "grouped2", "augmented", "swapfree", "lookahead"}
 
     def test_candidates_sorted_by_cost(self):
         pt = TunePoint.create(2048, 128, jnp.float32, (2, 4), False)
@@ -448,13 +451,14 @@ class TestTuner:
         assert t.measurements == 0
 
     def test_fake_timings_deterministic_selection(self):
-        # inplace injected fastest: measurement must overrule the cost
-        # ranking (which puts grouped2 first at this point).
-        timings = {"inplace": 1e-3, "grouped2": 5e-3, "swapfree": 7e-3,
+        # lookahead injected fastest: measurement must overrule the
+        # cost ranking (which puts grouped2 first at this point; the
+        # survivor cut here is grouped2/swapfree/lookahead).
+        timings = {"lookahead": 1e-3, "grouped2": 5e-3, "swapfree": 7e-3,
                    "augmented": 9e-3}
         t = Tuner(measure=True, measure_fn=_fake_measure(timings))
         plan = t.select(self.point())
-        assert plan.config == "inplace" and plan.source == "measured"
+        assert plan.config == "lookahead" and plan.source == "measured"
         assert plan.seconds == 1e-3
         assert t.measurements == len(plan.trials) == 3   # survivor cut
         # Measured-vs-projected drift is recorded on every trial.
@@ -468,7 +472,7 @@ class TestTuner:
         warm plan cache performs ZERO measurements."""
         path = str(tmp_path / "plans.json")
         timings = {"inplace": 2e-3, "grouped2": 1e-3, "swapfree": 3e-3,
-                   "augmented": 9e-3}
+                   "lookahead": 4e-3, "augmented": 9e-3}
         t1 = Tuner(cache=PlanCache(path), measure=True,
                    measure_fn=_fake_measure(timings))
         plan1 = t1.select(self.point())
@@ -488,7 +492,7 @@ class TestTuner:
         satisfied by the measured entry."""
         path = str(tmp_path / "plans.json")
         timings = {"inplace": 2e-3, "grouped2": 1e-3, "swapfree": 3e-3,
-                   "augmented": 9e-3}
+                   "lookahead": 4e-3, "augmented": 9e-3}
         plain = Tuner(cache=PlanCache(path))
         assert plain.select(self.point()).source == "cost_model"
         t = Tuner(cache=PlanCache.load(path), measure=True,
@@ -544,7 +548,7 @@ class TestSolveSurface:
 
         def fake(point, cfg, samples=5):
             t = {"inplace": 2e-3, "grouped2": 3e-3, "swapfree": 1e-3,
-                 "augmented": 9e-3}[cfg.name]
+                 "lookahead": 5e-3, "augmented": 9e-3}[cfg.name]
             calls.append(cfg.name)
             return Measurement(seconds=t, samples=(t,), accepted=(t,))
 

@@ -495,6 +495,7 @@ class TestServeLane:
 
 
 class TestFleetDurability:
+    @pytest.mark.slow  # tier-1 budget: test_rolling_restart_serves_updates_warm stays
     def test_kill_mid_update_stream_bitmatches_fault_free(self, rng):
         """The ISSUE 12 chaos pin at test scale: a seeded replica_kill
         mid-update-stream loses nothing — every per-update outcome AND

@@ -83,10 +83,12 @@ def load_round(path: str) -> dict | None:
 #: ``*_xla_gflops`` (compiler flop recounts) and the ``*_bytes``
 #: fields (``peak_hbm_bytes`` / ``resident_handle_bytes`` /
 #: ``*_comm_bytes``: a jaxlib layout change, a dtype/bucket change, or
-#: a collective-inventory change re-prices the same execution).  Never
-#: compared across rounds — the first-call separation principle
-#: applied to accounting.
-ACCOUNTING_SUFFIXES = ("_xla_gflops", "_bytes")
+#: a collective-inventory change re-prices the same execution), plus
+#: ``*_overlap_frac`` (ISSUE 16: the probe-ahead rows' modeled
+#: probe-overlap headroom — a cost-model re-weighting re-prices the
+#: same schedule).  Never compared across rounds — the first-call
+#: separation principle applied to accounting.
+ACCOUNTING_SUFFIXES = ("_xla_gflops", "_bytes", "_overlap_frac")
 
 #: Rate-class suffixes: slope-derived achieved rates on the cached
 #: executable — the keys the sentinel compares and pages on.

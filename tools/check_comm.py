@@ -163,6 +163,17 @@ def check(report: dict) -> tuple[list[str], list[str]]:
                for leg in legs):
         errs.append("missing reconciliation coverage: the distributed "
                     "solve leg (engine='solve_sharded')")
+    # ISSUE 16: the probe-ahead engines reorder the schedule but must
+    # keep the collective multiset identical — a demo without their
+    # reconciled legs would let a lookahead-only extra collective ship
+    # unaccounted.
+    for la_engine, what in (("lookahead", "invert"),
+                            ("solve_lookahead", "solve")):
+        if not any((leg.get("comm") or {}).get("engine") == la_engine
+                   for leg in legs):
+            errs.append(f"missing reconciliation coverage: the "
+                        f"probe-ahead {what} leg (engine="
+                        f"'{la_engine}')")
 
     # -- drift leg ----------------------------------------------------
     drift_leg = report.get("drift_leg") or {}

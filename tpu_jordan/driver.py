@@ -153,7 +153,34 @@ def _attribute_solve_phases(tel, esp, engine: str, n: int,
         attribute_phases_measured(esp, fractions,
                                   source="kernel_bracket")
     else:
-        attribute_phases(esp, n, block_size)
+        attribute_phases(esp, n, block_size,
+                         lookahead=engine == "lookahead")
+
+
+def _dist_workers(be):
+    """The driver workers spec a distributed backend was built from
+    (p or (pr, pc)) — recovered from the layout, for TunePoint keys."""
+    lay = be.lay
+    return (lay.pr, lay.pc) if hasattr(lay, "pc") else lay.p
+
+
+def _attach_overlap_evidence(esp, n: int, block_size: int,
+                             workers) -> None:
+    """Scheduling evidence on a lookahead execute span (ISSUE 16): the
+    comm model's projected probe-overlap headroom —
+    min(probe, elim)/total, the SAME number the registry's lookahead
+    cost hooks discount by — attached next to the hwcost attrs so a
+    trace reader can compare the projected hideable fraction against
+    the measured wall.  Best-effort: a point the comm model cannot
+    price leaves the attr absent, never fabricated."""
+    try:
+        from .tuning.registry import TunePoint, probe_overlap_headroom
+
+        pt = TunePoint.create(n, block_size, workers=workers)
+        esp.attrs["probe_overlap_headroom"] = float(
+            f"{probe_overlap_headroom(pt):.4g}")
+    except Exception:                            # noqa: BLE001
+        pass
 
 
 def _solve_metrics(n: int, elapsed: float, exec_span,
@@ -264,6 +291,11 @@ def resolve_engine(engine: str, group: int):
                          "grouped variant")
     if group > 1 and engine == "swapfree":
         raise UsageError("the swap-free engine has no grouped variant")
+    if engine == "lookahead":
+        # group >= 2 selects the grouped lookahead twin (single-device
+        # only — the distributed compile fns refuse the combination with
+        # a typed UsageError of their own).
+        return "lookahead", (group if group > 1 else 0)
     if engine == "grouped":
         return "grouped", (group if group > 1 else 2)
     if engine in PALLAS_ENGINES:
@@ -328,8 +360,12 @@ def solve(
 
     ``engine``/``group`` select the elimination engine (resolve_engine:
     "auto" | "inplace" | "grouped" | "augmented" | "swapfree" |
-    "grouped_pallas" | "grouped_pallas_bf16"; the measured dispatch
-    policy lives in its docstring).  Engines differ in speed and
+    "grouped_pallas" | "grouped_pallas_bf16" | "lookahead"; the measured
+    dispatch policy lives in its docstring).  ``engine="lookahead"``
+    (ISSUE 16) reorders each superstep — critical panel, then the NEXT
+    step's pivot probe, then the trailing eliminate — so the probe
+    overlaps the bulk GEMM; bit-identical results to the plain/grouped
+    engines on every flavor.  Engines differ in speed and
     summation order only — same pivot rule, same results to rounding.
     The fused-kernel engines are single-device; ``grouped_pallas_bf16``
     (bf16-compute/fp32-accumulate dots, arXiv:2112.09017) auto-attaches
@@ -794,6 +830,7 @@ def make_distributed_backend(workers, n: int, block_size: int,
     be.inplace = engine != "augmented"
     be.group = group
     be.swapfree = engine == "swapfree"
+    be.lookahead = engine == "lookahead"
     return be
 
 
@@ -849,12 +886,38 @@ def single_device_invert(n: int, block_size: int, engine: str = "auto",
         block_jordan_invert_inplace_fori,
         block_jordan_invert_inplace_grouped,
         block_jordan_invert_inplace_grouped_fori,
+        block_jordan_invert_inplace_grouped_lookahead,
         block_jordan_invert_inplace_grouped_pallas,
+        block_jordan_invert_inplace_lookahead,
     )
     from .parallel.sharded_inplace import MAX_UNROLL_NR
 
     Nr = -(-n // min(block_size, n))
     unroll = Nr <= MAX_UNROLL_NR
+    if engine == "lookahead" and not unroll:
+        raise UsageError(
+            f"engine='lookahead' is unrolled-only (the critical-panel "
+            f"split needs static column offsets) and Nr={Nr} exceeds "
+            f"MAX_UNROLL_NR={MAX_UNROLL_NR}; use engine='inplace' (its "
+            f"fori twin) or a larger block_size")
+    if engine == "lookahead":
+        # The probe-ahead twins bit-match the plain/grouped engines, so
+        # the numerics trace instruments the lookahead engine ITSELF
+        # (collect_stats=True on the same executable) — the trace
+        # describes the solve that actually ran, and its pivot column
+        # pins the sequence equal to the non-lookahead twin's.
+        eng_la = (block_jordan_invert_inplace_grouped_lookahead
+                  if group > 1 else block_jordan_invert_inplace_lookahead)
+        kw_la = {"group": group} if group > 1 else {}
+
+        def fn_la(a, block_size=None, refine=0,
+                  precision=_lax.Precision.HIGHEST):
+            return eng_la(a, block_size=block_size, refine=refine,
+                          precision=precision,
+                          collect_stats=collect_stats, **kw_la)
+
+        return jax.jit(fn_la, static_argnames=("block_size", "refine",
+                                               "precision"))
     if collect_stats:
         if engine == "augmented":
             raise UsageError(
@@ -968,6 +1031,7 @@ class _Dist1D:
         self.inplace = True
         self.group = 0
         self.swapfree = False
+        self.lookahead = False
 
     def generate_W(self, generator, dtype):
         from .parallel import sharded_generate
@@ -993,7 +1057,8 @@ class _Dist1D:
             return compile_sharded_jordan_inplace(W, self.mesh, self.lay,
                                                   precision=precision,
                                                   group=self.group,
-                                                  swapfree=self.swapfree)
+                                                  swapfree=self.swapfree,
+                                                  lookahead=self.lookahead)
         from .parallel.sharded_jordan import compile_sharded_jordan
 
         return compile_sharded_jordan(W, self.mesh, self.lay,
@@ -1073,6 +1138,7 @@ class _Dist2D:
         self.inplace = True
         self.group = 0
         self.swapfree = False
+        self.lookahead = False
 
     def generate_W(self, generator, dtype):
         from .parallel.jordan2d import sharded_generate_2d
@@ -1095,10 +1161,10 @@ class _Dist2D:
                 compile_sharded_jordan_inplace_2d,
             )
 
-            return compile_sharded_jordan_inplace_2d(W, self.mesh, self.lay,
-                                                     precision=precision,
-                                                     group=self.group,
-                                                     swapfree=self.swapfree)
+            return compile_sharded_jordan_inplace_2d(
+                W, self.mesh, self.lay, precision=precision,
+                group=self.group, swapfree=self.swapfree,
+                lookahead=self.lookahead)
         from .parallel.jordan2d import compile_sharded_jordan_2d
 
         return compile_sharded_jordan_2d(W, self.mesh, self.lay,
@@ -1220,6 +1286,7 @@ def _solve_distributed_core(
     # solve (host-side index math, no device cost); the observed
     # trace-time counts are captured only under obs.comm.recording().
     eng_name = engine or ("swapfree" if be.swapfree
+                          else "lookahead" if getattr(be, "lookahead", False)
                           else "grouped" if be.group > 1
                           else "inplace" if be.inplace else "augmented")
     comm_rep = _comm.engine_report(
@@ -1251,9 +1318,12 @@ def _solve_distributed_core(
     (out, singular), esp = timed_blocking(run, W, telemetry=tel,
                                           name="execute", engine=engine)
     elapsed = esp.duration
-    attribute_phases(esp, n, be.lay.m, distributed=True)
+    la = bool(getattr(be, "lookahead", False))
+    attribute_phases(esp, n, be.lay.m, distributed=True, lookahead=la)
     _hwcost.attach_execute_cost(esp, exe_cost,
                                 analytical_flops=2.0 * float(n) ** 3)
+    if la:
+        _attach_overlap_evidence(esp, n, be.lay.m, _dist_workers(be))
     # Per-solve comm accounting on the execute span + the registry
     # counters, and the measured-vs-projected drift verdict (judged
     # only where the projection claims to describe the hardware —

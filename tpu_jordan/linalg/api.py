@@ -147,6 +147,18 @@ def resolve_solve_engine(engine: str, assume: str):
             "engine='solve_spd' is the pivot-free path and requires "
             "the assume='spd' promise (skipping pivoting on a general "
             "matrix is unsound)")
+    if engine == "solve_lookahead" and assume == "spd":
+        # ISSUE 16: the probe-ahead schedule overlaps the CONDITION
+        # PROBE with the trailing eliminate; the pivot-free flavor has
+        # no probe to move — a typed refusal, never a silent fallback.
+        raise UsageError(
+            "engine='solve_lookahead' overlaps the pivot-condition "
+            "probe with the trailing eliminate; the assume='spd' "
+            "pivot-free path has nothing to probe ahead — legal "
+            "lookahead engines are engine='solve_lookahead' "
+            "(assume='general', workers>1) and driver.solve "
+            "engine='lookahead'; under spd use engine='solve_spd' or "
+            "'auto'")
     return engine, workload
 
 
@@ -297,11 +309,22 @@ def solve_system(
             "engine='solve_sharded' is the distributed [A | B] "
             "elimination (its win is the mesh); pass workers=p or "
             "workers=(pr, pc)")
-    if distributed and engine not in ("auto", "solve_sharded"):
+    if engine == "solve_lookahead" and not distributed:
+        # ISSUE 16: lookahead is NOT wired on the single-device
+        # augmented [A | B] engine (solve_aug's fused sweep has no
+        # separable panel to reorder) — typed, naming the legal homes.
+        raise UsageError(
+            "engine='solve_lookahead' is the probe-ahead distributed "
+            "[A | B] elimination; it is not wired on the single-device "
+            "augmented engine — pass workers=p or workers=(pr, pc), "
+            "or use engine='solve_aug'/'auto' single-device (for "
+            "inverses, driver.solve engine='lookahead')")
+    if distributed and engine not in ("auto", "solve_sharded",
+                                      "solve_lookahead"):
         raise UsageError(
             f"engine={engine!r} is a single-device solve engine; "
-            f"distributed points run engine='solve_sharded' (or "
-            f"'auto', which resolves there)")
+            f"distributed points run engine='solve_sharded' or "
+            f"'solve_lookahead' (or 'auto', which resolves there)")
     if (tune or plan_cache is not None) and engine != "auto":
         raise UsageError("tune/plan_cache apply to engine='auto' only "
                          "(an explicit engine leaves nothing to tune)")
@@ -323,10 +346,10 @@ def solve_system(
     _count_workload(workload)
 
     with tel.span("solve_system", n=n, k=k, workload=workload) as root:
-        if engine == "solve_sharded":
+        if engine in ("solve_sharded", "solve_lookahead"):
             result = _solve_system_dist_impl(
                 a, b2, n, k, m, dtype, workers, gather, workload, plan,
-                tel, policy, numerics, check, verbose)
+                tel, policy, numerics, check, verbose, engine=engine)
         else:
             result = _solve_system_impl(
                 a, b2, n, k, m, dtype, engine, spd, workload, plan, tel,
@@ -515,7 +538,7 @@ def solve_mesh_backend(workers, n: int, m: int):
 
 def _solve_system_dist_impl(a, b2, n, k, m, dtype, workers, gather,
                             workload, plan, tel, policy, numerics,
-                            check, verbose):
+                            check, verbose, engine="solve_sharded"):
     """The distributed solve skeleton (ISSUE 15): scatter [A | B] over
     the 1D/2D mesh, run the sharded elimination (unrolled below
     MAX_UNROLL_NR, fori beyond), reconcile the collective inventory
@@ -523,8 +546,15 @@ def _solve_system_dist_impl(a, b2, n, k, m, dtype, workers, gather,
     and verify ‖A·X − B‖ densely against the CALLER's A and B (they
     are in hand — solve_system takes arrays, so the verification
     needs no mesh collectives and the comm inventory has no residual
-    section, unlike the invert driver's ring-GEMM pass)."""
-    from ..driver import SingularMatrixError, _record_compile
+    section, unlike the invert driver's ring-GEMM pass).
+
+    ``engine="solve_lookahead"`` (ISSUE 16) compiles the probe-ahead
+    twin: same scatter/gather/verify skeleton, same analytical
+    collective multiset (the schedule reorders, never adds), X bits
+    pinned identical — only the compile flag and the report labels
+    change."""
+    from ..driver import (SingularMatrixError, _attach_overlap_evidence,
+                          _record_compile)
     from ..obs import comm as _comm
     from ..parallel.sharded_inplace import MAX_UNROLL_NR
 
@@ -541,19 +571,20 @@ def _solve_system_dist_impl(a, b2, n, k, m, dtype, workers, gather,
     # extended with the solve flavors) — built for every distributed
     # solve; observed counts captured only under obs.comm.recording().
     unroll = lay.Nr <= MAX_UNROLL_NR
+    la = engine == "solve_lookahead"
     comm_rep = _comm.engine_report(
-        engine="solve_sharded", lay=lay, dtype=work, gather=gather,
+        engine=engine, lay=lay, dtype=work, gather=gather,
         unroll=unroll, rhs=k)
 
-    with tel.span("compile", engine="solve_sharded", n=n, k=k) as csp:
+    with tel.span("compile", engine=engine, n=n, k=k) as csp:
         def _compile():
             _faults.fire("compile")
             if _comm.recording_active():
                 with _comm.record_collectives() as rec:
-                    run = compile_fn(W, Xb, mesh, lay)
+                    run = compile_fn(W, Xb, mesh, lay, lookahead=la)
                 comm_rep.attach_observed("engine", rec.records)
                 return run
-            return compile_fn(W, Xb, mesh, lay)
+            return compile_fn(W, Xb, mesh, lay, lookahead=la)
         run = (policy.retry.call(_compile,
                                  component="solve_system.compile")
                if policy is not None else _compile())
@@ -566,13 +597,15 @@ def _solve_system_dist_impl(a, b2, n, k, m, dtype, workers, gather,
     _faults.fire("execute")
     (xb, singular), esp = timed_blocking(run, W, Xb, telemetry=tel,
                                          name="execute",
-                                         engine="solve_sharded",
+                                         engine=engine,
                                          workload=workload)
     elapsed = esp.duration
     flops = _hwcost.baseline_workload_flops(n, workload, k=k)
     if elapsed > 0:
         esp.attrs["gflops"] = round(flops / elapsed / 1e9, 3)
     _hwcost.attach_execute_cost(esp, exe_cost, analytical_flops=flops)
+    if la:
+        _attach_overlap_evidence(esp, n, m, workers)
     comm_rep.observe_metrics()
     comm_rep.attach_span(esp)
     _comm.observe_drift(comm_rep, elapsed, esp)
@@ -591,7 +624,7 @@ def _solve_system_dist_impl(a, b2, n, k, m, dtype, workers, gather,
             raise SingularMatrixError("singular matrix")
         return SolveSystemResult(
             x=None, elapsed=elapsed, residual=float("inf"), n=n, k=k,
-            block_size=m, gflops=0.0, engine="solve_sharded",
+            block_size=m, gflops=0.0, engine=engine,
             workload=workload, singular=True, plan=plan,
             workers=workers, comm=comm_rep)
 
@@ -610,7 +643,7 @@ def _solve_system_dist_impl(a, b2, n, k, m, dtype, workers, gather,
 
     nreport = None
     if numerics != "off":
-        nreport = _solve_numerics(n, m, "solve_sharded", workload, rel,
+        nreport = _solve_numerics(n, m, engine, workload, rel,
                                   kappa_est, norm_a, dtype, policy)
 
     recovery = ()
@@ -644,7 +677,7 @@ def _solve_system_dist_impl(a, b2, n, k, m, dtype, workers, gather,
         x=x, elapsed=elapsed, residual=residual, n=n, k=k,
         block_size=m,
         gflops=(flops / elapsed / 1e9) if elapsed > 0 else 0.0,
-        engine="solve_sharded", workload=workload, singular=False,
+        engine=engine, workload=workload, singular=False,
         plan=plan, kappa_est=kappa_est, recovery=recovery,
         numerics=nreport, workers=workers,
         x_blocks=None if gather else xb,

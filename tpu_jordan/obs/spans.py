@@ -230,7 +230,8 @@ def attribute_phases_measured(span: Span, fractions: dict,
 
 
 def attribute_phases(span: Span, n: int, block_size: int,
-                     distributed: bool = False) -> list[Span]:
+                     distributed: bool = False,
+                     lookahead: bool = False) -> list[Span]:
     """Subdivide a measured ``execute`` span into the paper's hot-loop
     phases as MODEL-attributed children (``modeled=True`` + the fraction
     on every child — never mistakable for measured sub-brackets).
@@ -242,6 +243,16 @@ def attribute_phases(span: Span, n: int, block_size: int,
     term weighted heavier on distributed meshes (ICI rounds vs local
     copies).  Kernel-level ground truth is the jax.profiler tier
     (``obs/export.profiler_trace``), not this model.
+
+    ``lookahead=True`` (ISSUE 16, the probe-ahead engines) keeps the
+    three tiling children UNCHANGED — the schedule reorders work, it
+    never changes the arithmetic — and nests a ``probe_ahead`` child
+    inside ``eliminate``: the step-(t+1) condition probe re-issued
+    inside the trailing-sweep window, where the XLA latency-hiding
+    scheduler can overlap its collective with the trailing GEMMs.  Its
+    ``fraction`` is the probe share that is hideable (bounded by the
+    eliminate share), with ``overlapped=True`` so readers never sum it
+    into the tiling.
     """
     m = max(1, min(block_size, n))
     weights = {
@@ -256,7 +267,14 @@ def attribute_phases(span: Span, n: int, block_size: int,
         frac = weights[phase] / total
         t1 = (span.t_end if i == len(PHASES) - 1
               else t + frac * span.duration)
-        out.append(span.child(phase, t, t1, modeled=True,
-                              fraction=round(frac, 6)))
+        sp = span.child(phase, t, t1, modeled=True,
+                        fraction=round(frac, 6))
+        if lookahead and phase == "eliminate":
+            hid = min(weights["pivot"], weights["eliminate"])
+            sp.child("probe_ahead", t,
+                     t + (hid / weights["eliminate"]) * (t1 - t),
+                     modeled=True, overlapped=True,
+                     fraction=round(hid / total, 6))
+        out.append(sp)
         t = t1
     return out

@@ -7,7 +7,9 @@ from .jordan_inplace import (
     block_jordan_invert_inplace_fori,
     block_jordan_invert_inplace_grouped,
     block_jordan_invert_inplace_grouped_fori,
+    block_jordan_invert_inplace_grouped_lookahead,
     block_jordan_invert_inplace_grouped_pallas,
+    block_jordan_invert_inplace_lookahead,
 )
 from .norms import block_inf_norms, condition_inf, inf_norm
 from .padding import pad_with_identity, unpad
@@ -26,7 +28,9 @@ __all__ = [
     "block_jordan_invert_inplace_fori",
     "block_jordan_invert_inplace_grouped",
     "block_jordan_invert_inplace_grouped_fori",
+    "block_jordan_invert_inplace_grouped_lookahead",
     "block_jordan_invert_inplace_grouped_pallas",
+    "block_jordan_invert_inplace_lookahead",
     "gauss_jordan_inverse",
     "generate",
     "hilbert",

@@ -254,6 +254,150 @@ def block_jordan_invert_inplace(
 
 
 @partial(jax.jit, static_argnames=(
+    "block_size", "eps", "precision", "refine", "use_pallas",
+    "collect_stats"))
+def block_jordan_invert_inplace_lookahead(
+    a: jnp.ndarray,
+    block_size: int | None = None,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    refine: int = 0,
+    use_pallas: bool | None = None,
+    collect_stats: bool = False,
+):
+    """The in-place engine with PROBE-AHEAD scheduling (ISSUE 16): step
+    t+1's pivot probe no longer waits for step t's full eliminate sweep.
+
+    Each superstep's eliminate is split into the CRITICAL PANEL — the
+    one column block that is step t+1's candidate column — and the
+    TRAILING update (every other column).  The panel update is emitted
+    first, step t+1's probe (batched block inverses + argmin) launches
+    immediately after it, and only then does the trailing eliminate run
+    — so a latency-hiding scheduler can overlap the probe with the bulk
+    of the rank-m GEMM instead of serializing them.
+
+    Same arithmetic in a reordered schedule: the panel value is the
+    column slice of the very matmul the plain engine computes
+    (``matmul(E, prow)[:, cols] == matmul(E, prow[:, cols])``
+    element-for-element at HIGHEST — each output element is the same
+    full contraction over m), so pivot choices, the numerics trace, and
+    the inverse bits are pinned IDENTICAL to
+    ``block_jordan_invert_inplace``
+    (tests/test_jordan_inplace.py::TestLookahead).
+
+    On one chip the probe and the GEMM share the compute units, so the
+    single-device win is scheduling slack only; the payoff is on the
+    distributed flavors (sharded_inplace/jordan2d_inplace), where the
+    probe's cross-worker pmin reduction comes off the superstep critical
+    path.  This twin exists so the schedule is validated (and the
+    numerics trace comparable) without a mesh.
+    """
+    precision, refine = resolve_precision(precision, refine)
+    n = a.shape[-1]
+    in_dtype = a.dtype
+    if jnp.dtype(in_dtype).itemsize < 4:
+        out = block_jordan_invert_inplace_lookahead(
+            a.astype(jnp.float32), block_size, eps, precision, refine,
+            use_pallas, collect_stats,
+        )
+        if collect_stats:
+            x, singular, stats = out
+            return x.astype(in_dtype), singular, stats
+        x, singular = out
+        return x.astype(in_dtype), singular
+    dtype = a.dtype
+    if block_size is None:
+        block_size = default_block_size(n)
+    m = min(block_size, n)
+    if eps is None:
+        eps = eps_for(dtype)
+    Nr = -(-n // m)
+    N = Nr * m
+    V = pad_with_identity(a, N)
+    if use_pallas is None:
+        use_pallas = _use_pallas_default(dtype) and m % 8 == 0 and m >= 32
+    probe_dtype = dtype
+
+    def probe_col(cands, t):
+        """The plain engine's probe, verbatim, on a (nc, m, m) candidate
+        stack for step ``t`` — returns the step's full pivot decision."""
+        if use_pallas:
+            from .pallas_block_inverse import pallas_batched_block_inverse
+
+            invs, sing = pallas_batched_block_inverse(cands, eps)
+        else:
+            invs, sing = batched_block_inverse(cands, None, eps)
+        key = jnp.where(sing, jnp.asarray(jnp.inf, probe_dtype),
+                        block_inf_norms(invs))
+        rel = jnp.argmin(key)                     # ties -> lowest row
+        H = jnp.take(invs, rel, axis=0).astype(dtype)
+        return H, t + rel, key, sing
+
+    singular = jnp.asarray(False)
+    stats = _StepStats() if collect_stats else None
+    rswaps = []
+    # --- PROLOGUE: step 0's probe runs on the untouched first column
+    # (bit-equal to the plain engine's t=0 slice).
+    cands0 = lax.slice(V, (0, 0), (N, m)).reshape(Nr, m, m)
+    ahead = probe_col(cands0.astype(probe_dtype), 0)
+    for t in range(Nr):
+        H, piv, key, sing = ahead
+        singular = singular | jnp.all(sing)
+        if stats is not None:
+            stats.probe(piv, key, sing)
+
+        # --- SWAP block rows t <-> piv (swap-by-copy, main.cpp:1093-1131).
+        rows_t = lax.slice(V, (t * m, 0), ((t + 1) * m, N))
+        rows_p = lax.dynamic_slice(V, (piv * m, 0), (m, N))
+        V = lax.dynamic_update_slice(V, rows_t, (piv * m, 0))
+
+        # --- NORMALIZE (same fold as the plain engine).
+        prow = jnp.matmul(H, rows_p, precision=precision)       # (m, N)
+        prow = prow.at[:, t * m:(t + 1) * m].set(H)
+        E = lax.slice(V, (0, t * m), (N, (t + 1) * m))          # (N, m)
+        E = E.at[t * m:(t + 1) * m].set(jnp.asarray(0, dtype))
+        V = V.at[:, t * m:(t + 1) * m].set(jnp.asarray(0, dtype))
+
+        if t < Nr - 1:
+            # --- CRITICAL PANEL first: step t+1's candidate column gets
+            # its rank-m update ahead of everything else.  The probe's
+            # candidate rows start at (t+1)·m, below the pivot-row write
+            # — the slice the plain engine probes next step is exactly
+            # this panel.
+            c0 = (t + 1) * m
+            panel = (lax.slice(V, (0, c0), (N, c0 + m))
+                     - jnp.matmul(E, prow[:, c0:c0 + m],
+                                  precision=precision))
+            # --- PROBE-AHEAD: step t+1's pivot decision, issued before
+            # the trailing eliminate so the two can overlap.
+            ahead = probe_col(
+                panel[c0:].reshape(Nr - t - 1, m, m).astype(probe_dtype),
+                t + 1)
+            # --- TRAILING ELIMINATE: the remaining columns (same sliced
+            # contractions; concat restores the plain engine's V bits).
+            left = (lax.slice(V, (0, 0), (N, c0))
+                    - jnp.matmul(E, prow[:, :c0], precision=precision))
+            right = (lax.slice(V, (0, c0 + m), (N, N))
+                     - jnp.matmul(E, prow[:, c0 + m:],
+                                  precision=precision))
+            V = jnp.concatenate([left, panel, right], axis=1)
+        else:
+            V = V - jnp.matmul(E, prow, precision=precision)
+        V = V.at[t * m:(t + 1) * m, :].set(prow)
+        rswaps.append(piv)
+        if stats is not None:
+            stats.sample_growth(V)
+
+    # --- Unscramble: the composed swap permutation, one blocked gather.
+    V = apply_col_perm(V, compose_swap_perm(jnp.stack(rswaps), Nr), m)
+    x = unpad(V, n)
+    x = newton_schulz(a, x, refine, lax.Precision.HIGHEST)
+    if stats is not None:
+        return x, singular, stats.stacked()
+    return x, singular
+
+
+@partial(jax.jit, static_argnames=(
     "block_size", "eps", "precision", "refine", "use_pallas", "group",
     "collect_stats"))
 def block_jordan_invert_inplace_grouped(
@@ -398,6 +542,154 @@ def block_jordan_invert_inplace_grouped(
     # --- Unscramble: the composed swap permutation, one blocked gather.
     V = apply_col_perm(V, compose_swap_perm(jnp.stack(rswaps), Nr), m)
 
+    x = unpad(V, n)
+    x = newton_schulz(a, x, refine, lax.Precision.HIGHEST)
+    if stats is not None:
+        return x, singular, stats.stacked()
+    return x, singular
+
+
+@partial(jax.jit, static_argnames=(
+    "block_size", "eps", "precision", "refine", "use_pallas", "group",
+    "collect_stats"))
+def block_jordan_invert_inplace_grouped_lookahead(
+    a: jnp.ndarray,
+    block_size: int | None = None,
+    eps: float | None = None,
+    precision=lax.Precision.HIGHEST,
+    refine: int = 0,
+    use_pallas: bool | None = None,
+    group: int = 4,
+    collect_stats: bool = False,
+):
+    """The delayed-group-update engine with PROBE-AHEAD scheduling
+    (ISSUE 16): the grouped engine already overlaps WITHIN a group (its
+    eager side-updates keep the probe off the trailing matmul), so the
+    serial seam left is the group BOUNDARY — the next group's first
+    probe waits for the group-end ``V − U·P``.  This twin hoists that
+    step's eager candidate column (``V[:, tn] − U·P[:, tn]``, the column
+    slice of the very trailing matmul — same full contractions, so the
+    values are bit-equal to what the grouped engine slices after the
+    update) plus its probe ABOVE the trailing matmul, so the probe can
+    run concurrently with the group-end GEMM.
+
+    Pivot choices and the inverse bit-match
+    ``block_jordan_invert_inplace_grouped`` exactly
+    (tests/test_jordan_inplace.py::TestLookahead)."""
+    precision, refine = resolve_precision(precision, refine)
+    n = a.shape[-1]
+    in_dtype = a.dtype
+    if jnp.dtype(in_dtype).itemsize < 4:
+        out = block_jordan_invert_inplace_grouped_lookahead(
+            a.astype(jnp.float32), block_size, eps, precision, refine,
+            use_pallas, group, collect_stats,
+        )
+        if collect_stats:
+            x, singular, stats = out
+            return x.astype(in_dtype), singular, stats
+        x, singular = out
+        return x.astype(in_dtype), singular
+    dtype = a.dtype
+    if block_size is None:
+        block_size = default_block_size(n)
+    m = min(block_size, n)
+    if eps is None:
+        eps = eps_for(dtype)
+    Nr = -(-n // m)
+    N = Nr * m
+    k = max(1, min(group, Nr))
+    V = pad_with_identity(a, N)
+    if use_pallas is None:
+        use_pallas = _use_pallas_default(dtype) and m % 8 == 0 and m >= 32
+    from .block_inverse import probe_blocks
+
+    def probe_col(col, t):
+        """The grouped engine's probe, verbatim, on an eager (N, m)
+        candidate column for step ``t``."""
+        cands = col[t * m:].reshape(Nr - t, m, m)
+        invs, sing = probe_blocks(cands, eps, use_pallas)
+        key = jnp.where(sing, jnp.asarray(jnp.inf, dtype),
+                        block_inf_norms(invs))
+        rel = jnp.argmin(key)                  # ties -> lowest row
+        H = jnp.take(invs, rel, axis=0).astype(dtype)
+        return col, H, t + rel, key, sing
+
+    singular = jnp.asarray(False)
+    stats = _StepStats() if collect_stats else None
+    rswaps = []
+    # --- PROLOGUE: group 0's first probe on the untouched first column.
+    ahead = probe_col(lax.slice(V, (0, 0), (N, m)), 0)
+    for t0 in range(0, Nr, k):
+        kg = min(k, Nr - t0)                   # this group's width
+        U = jnp.zeros((N, kg * m), dtype)
+        P = jnp.zeros((kg * m, N), dtype)
+        for j in range(kg):
+            t = t0 + j
+            if j:
+                # --- EAGER CANDIDATE COLUMN + PROBE, in-group (the
+                # grouped engine's own schedule — already overlapped).
+                col = lax.slice(V, (0, t * m), (N, (t + 1) * m))
+                col = col - jnp.matmul(
+                    U[:, :j * m], P[:j * m, t * m:(t + 1) * m],
+                    precision=precision)
+                col, H, piv, key, sing = probe_col(col, t)
+            else:
+                # --- PROBE-AHEAD: this group's first decision was made
+                # before the previous group-end trailing matmul.
+                col, H, piv, key, sing = ahead
+            singular = singular | jnp.all(sing)
+            if stats is not None:
+                stats.probe(piv, key, sing)
+
+            # --- SWAP rows t <-> piv in V and U.
+            rows_t = lax.slice(V, (t * m, 0), ((t + 1) * m, N))
+            rows_p = lax.dynamic_slice(V, (piv * m, 0), (m, N))
+            V = lax.dynamic_update_slice(V, rows_t, (piv * m, 0))
+            u_t = lax.slice(U, (t * m, 0), ((t + 1) * m, kg * m))
+            u_p = lax.dynamic_slice(U, (piv * m, 0), (m, kg * m))
+            U = lax.dynamic_update_slice(U, u_t, (piv * m, 0))
+
+            # --- EAGER PIVOT ROW: old piv row minus pending panels.
+            if j:
+                rows_p = rows_p - jnp.matmul(u_p[:, :j * m], P[:j * m],
+                                             precision=precision)
+            prow = jnp.matmul(H, rows_p, precision=precision)   # (m, N)
+            prow = prow.at[:, t * m:(t + 1) * m].set(H)
+
+            # --- RECORD the panel (grouped-engine bookkeeping verbatim).
+            col_t_blk = col[t * m:(t + 1) * m]
+            col = lax.dynamic_update_slice(col, col_t_blk, (piv * m, 0))
+            col = col.at[t * m:(t + 1) * m].set(jnp.asarray(0, dtype))
+            V = V.at[:, t * m:(t + 1) * m].set(jnp.asarray(0, dtype))
+            if j:
+                P = P.at[:j * m, t * m:(t + 1) * m].set(
+                    jnp.asarray(0, dtype))
+            V = V.at[t * m:(t + 1) * m, :].set(prow)
+            U = U.at[t * m:(t + 1) * m, :].set(jnp.asarray(0, dtype))
+            U = U.at[:, j * m:(j + 1) * m].set(col)
+            P = P.at[j * m:(j + 1) * m, :].set(prow)
+            rswaps.append(piv)
+            if stats is not None:
+                stats.sample_growth(V, U)
+
+        tn = t0 + kg
+        if tn < Nr:
+            # --- CRITICAL PANEL + PROBE-AHEAD: the next group's first
+            # eager column is the column slice of the group-end trailing
+            # matmul — compute it (and the probe) BEFORE that matmul so
+            # the probe overlaps the fat GEMM.
+            coln = (lax.slice(V, (0, tn * m), (N, (tn + 1) * m))
+                    - jnp.matmul(U, P[:, tn * m:(tn + 1) * m],
+                                 precision=precision))
+            ahead = probe_col(coln, tn)
+
+        # --- GROUP-END TRAILING UPDATE: one fat MXU matmul.
+        V = V - jnp.matmul(U, P, precision=precision)
+        if stats is not None:
+            stats.refresh(V)
+
+    # --- Unscramble: the composed swap permutation, one blocked gather.
+    V = apply_col_perm(V, compose_swap_perm(jnp.stack(rswaps), Nr), m)
     x = unpad(V, n)
     x = newton_schulz(a, x, refine, lax.Precision.HIGHEST)
     if stats is not None:

@@ -912,6 +912,197 @@ def _sharded_jordan2d_inplace(W, mesh, lay: CyclicLayout2D, eps, precision,
     )(W)
 
 
+def _probe_reduce_2d(chunk_all, t: int, kr, *, lay: CyclicLayout2D, eps,
+                     use_pallas: bool, probe_cols: bool, dtype):
+    """Step ``t``'s pivot probe + whole-mesh reduction, factored out of
+    ``_step2d`` VERBATIM (same _probe_candidates call, same collective
+    multiset: two whole-mesh pmins + the g_piv psum + the (m, m) H
+    psum) so the 2D lookahead engines can issue it EARLY — right after
+    step t−1's critical panel, before its trailing eliminate.
+
+    ``chunk_all`` is step ``t``'s broadcast t-chunk panel, which the
+    caller already psummed along "pc" (one step ahead of schedule —
+    the SAME (bpr, m, m) payload ``_step2d`` broadcasts, because the
+    panel doubles as the eliminate multipliers E).  Returns the carry
+    ``(chunk_all, H, g_piv, step_sing)``; ``chunk_all`` rides along
+    because step ``t``'s E is built from it."""
+    pr, bpr = lay.pr, lay.bpr
+    invs, sing, idx = _probe_candidates(
+        chunk_all, jnp.int32(t), lay=lay, eps=eps, use_pallas=use_pallas,
+        probe_cols=probe_cols, static_s0=t // pr)
+    gidx = idx * pr + kr
+    valid = (idx < bpr) & (gidx >= t) & ~sing
+    norms = block_inf_norms(invs)
+    key = jnp.where(valid, norms, jnp.asarray(jnp.inf, norms.dtype))
+    slot_best = jnp.argmin(key)
+    my_key = key[slot_best]
+    g_cand = gidx[slot_best]
+
+    kmin = pmin(my_key, BOTH)
+    win_g = pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), BOTH)
+    step_sing = ~jnp.isfinite(kmin)
+    i_won = (my_key == kmin) & (g_cand == win_g)
+    g_piv = psum(jnp.where(i_won, g_cand, 0), BOTH)
+    H = psum(
+        jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0), BOTH
+    ).astype(dtype)
+    return chunk_all, H, g_piv, step_sing
+
+
+def _step2d_lookahead(t: int, Wloc, singular, probe, *,
+                      lay: CyclicLayout2D, eps, precision,
+                      use_pallas: bool, probe_cols: bool = True):
+    """One super-step of the PROBE-AHEAD 2D engine (ISSUE 16).
+
+    ``probe`` carries step ``t``'s pivot decision AND its broadcast
+    t-chunk panel (``chunk_all`` — the eliminate multipliers), both
+    issued at the end of step t−1.  The eliminate splits: the CRITICAL
+    PANEL — the local chunk holding step t+1's pivot column on its
+    owner mesh column (every column updates that chunk slot; for
+    non-owners it is just that chunk's trailing update done early) —
+    goes first, then step t+1's chunk broadcast + probe + reduction,
+    then the TRAILING chunks.  Panel and trailing are column slices of
+    ``_step2d``'s one HIGHEST-precision update matmul, so pivot
+    choices, result bits, and the collective MULTISET pin identical —
+    the chunk psum and the probe reduction each move one step earlier
+    in the schedule; none are added."""
+    pr, pc, m, bpr = lay.pr, lay.pc, lay.m, lay.bpr
+    kr = lax.axis_index(AXIS_R)
+    kc = lax.axis_index(AXIS_C)
+    dtype = Wloc.dtype
+    u_t = t // pc                               # owner column's local chunk
+    own_c = kc == (t % pc)
+    chunk_all, H, g_piv, step_sing = probe
+    singular = singular | step_sing
+
+    # --- ROW BROADCASTS along "pr" (identical to _step2d).
+    own_piv = kr == (g_piv % pr)
+    slot_piv = jnp.where(own_piv, g_piv // pr, 0)
+    row_piv = psum(
+        jnp.where(own_piv,
+                  lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False), 0.0),
+        AXIS_R,
+    )                                           # (m, Wc)
+    own_t = kr == (t % pr)
+    slot_t = t // pr
+    row_t = psum(
+        jnp.where(own_t, Wloc[slot_t], 0.0), AXIS_R
+    )                                           # (m, Wc)
+
+    # --- SWAP-BY-COPY, row-granular (identical to _step2d).
+    cur_piv = lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False)
+    Wloc = lax.dynamic_update_index_in_dim(
+        Wloc, jnp.where(own_piv, row_t, cur_piv), slot_piv, 0
+    )
+
+    # --- NORMALIZE; owner column's t-chunk of the pivot row becomes H.
+    prow = jnp.matmul(H, row_piv, precision=precision)      # (m, Wc)
+    prow = jnp.where(own_c, prow.at[:, u_t * m:(u_t + 1) * m].set(H), prow)
+
+    # --- MULTIPLIERS from the CARRIED broadcast + swap fix-up.
+    row_t_chunk = psum(
+        jnp.where(own_c, row_t[:, u_t * m:(u_t + 1) * m], 0.0), AXIS_C
+    ).astype(dtype)                             # (m, m)
+    cur_Epiv = lax.dynamic_index_in_dim(chunk_all, slot_piv, 0, False)
+    E = lax.dynamic_update_index_in_dim(
+        chunk_all, jnp.where(own_piv, row_t_chunk, cur_Epiv), slot_piv, 0
+    )
+    gr = jnp.arange(bpr) * pr + kr
+    E = jnp.where((gr == t)[:, None, None], jnp.asarray(0, dtype), E)
+    cur_chunk = Wloc[:, :, u_t * m:(u_t + 1) * m]
+    Wloc = Wloc.at[:, :, u_t * m:(u_t + 1) * m].set(
+        jnp.where(own_c, jnp.zeros_like(cur_chunk), cur_chunk)
+    )
+    Ef = E.reshape(bpr * m, m)
+
+    next_probe = None
+    if t < lay.Nr - 1:
+        # --- CRITICAL PANEL: the local chunk where global column t+1
+        # lives on its owner mesh column ((t+1) % pc); the same chunk
+        # slot on other columns holds a different global column and
+        # simply takes its trailing update early — identical values.
+        u2 = (t + 1) // pc
+        c0 = u2 * m
+        panel = (Wloc[:, :, c0:c0 + m]
+                 - jnp.matmul(Ef, prow[:, c0:c0 + m],
+                              precision=precision).reshape(bpr, m, m))
+        # _step2d broadcasts the t+1 chunk AFTER its slot_t prow write —
+        # apply the same overwrite to the broadcast view only (the
+        # panel that re-enters Wloc stays unfixed; the final slot_t
+        # write below covers it).
+        panel_cand = panel.at[slot_t].set(
+            jnp.where(own_t, prow[:, c0:c0 + m], panel[slot_t]))
+        # --- CHUNK BROADCAST for step t+1, one step early: the SAME
+        # (bpr, m, m) "pc" psum _step2d opens step t+1 with.
+        own_c2 = kc == ((t + 1) % pc)
+        chunk_all_next = psum(
+            jnp.where(own_c2, panel_cand, jnp.asarray(0, dtype)), AXIS_C)
+        # --- PROBE-AHEAD: step t+1's probe + whole-mesh reduction,
+        # before the trailing eliminate.
+        next_probe = _probe_reduce_2d(
+            chunk_all_next, t + 1, kr, lay=lay, eps=eps,
+            use_pallas=use_pallas, probe_cols=probe_cols, dtype=dtype)
+        # --- TRAILING ELIMINATE: the remaining chunks.
+        left = (Wloc[:, :, :c0]
+                - jnp.matmul(Ef, prow[:, :c0],
+                             precision=precision).reshape(bpr, m, c0))
+        right = (Wloc[:, :, c0 + m:]
+                 - jnp.matmul(Ef, prow[:, c0 + m:],
+                              precision=precision).reshape(
+                                  bpr, m, Wloc.shape[-1] - c0 - m))
+        Wloc = jnp.concatenate([left, panel, right], axis=2)
+    else:
+        update = jnp.matmul(Ef, prow, precision=precision)
+        Wloc = Wloc - update.reshape(Wloc.shape)
+
+    # Row t becomes the normalized pivot row (owning mesh row only).
+    Wloc = Wloc.at[slot_t].set(jnp.where(own_t, prow, Wloc[slot_t]))
+    return Wloc, singular, g_piv, next_probe
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas",
+                          "probe_cols"))
+def _sharded_jordan2d_inplace_lookahead(W, mesh, lay: CyclicLayout2D, eps,
+                                        precision, use_pallas,
+                                        probe_cols=True):
+    """The 2D in-place engine with PROBE-AHEAD scheduling (ISSUE 16):
+    step t+1's chunk broadcast, probe, and pivot reduction are issued
+    right after step t's critical-panel update, BEFORE its trailing
+    eliminate — both collectives come off the superstep critical path.
+    Unrolled only.  Results, pivot choices, and the collective multiset
+    are identical to ``_sharded_jordan2d_inplace``."""
+    def worker(Wloc):
+        kr = lax.axis_index(AXIS_R)
+        kc = lax.axis_index(AXIS_C)
+        singular = pcast(jnp.asarray(False), BOTH, to='varying')
+        # --- PROLOGUE: step 0's chunk broadcast + probe.
+        chunk0 = psum(
+            jnp.where(kc == 0, Wloc[:, :, :lay.m],
+                      jnp.asarray(0, Wloc.dtype)), AXIS_C)
+        probe = _probe_reduce_2d(
+            chunk0, 0, kr, lay=lay, eps=eps, use_pallas=use_pallas,
+            probe_cols=probe_cols, dtype=Wloc.dtype)
+        swaps = []
+        for t in range(lay.Nr):
+            Wloc, singular, g_piv, probe = _step2d_lookahead(
+                t, Wloc, singular, probe, lay=lay, eps=eps,
+                precision=precision, use_pallas=use_pallas,
+                probe_cols=probe_cols,
+            )
+            swaps.append(g_piv)
+        for t in reversed(range(lay.Nr)):
+            Wloc = _unscramble_step(t, swaps[t], Wloc, lay=lay)
+        return Wloc, singular[None, None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=_SPEC_W,
+        out_specs=(_SPEC_W, PartitionSpec(AXIS_R, AXIS_C)),
+    )(W)
+
+
 # ---------------------------------------------------------------------
 # Distributed SOLVE (ISSUE 15): the [A | B] elimination on the 2D
 # block-cyclic mesh — the 2D twin of sharded_inplace._solve_step.
@@ -1135,6 +1326,158 @@ def _sharded_jordan_solve_2d_fori(W, X, mesh, lay: CyclicLayout2D, nrhs,
     )(W, X)
 
 
+def _solve_step_2d_lookahead(t: int, Wloc, Xloc, singular, probe, *,
+                             lay: CyclicLayout2D, nrhs: int, eps,
+                             precision, use_pallas: bool,
+                             probe_cols: bool):
+    """One PROBE-AHEAD 2D solve super-step (ISSUE 16): the carry holds
+    step ``t``'s broadcast t-chunk panel + pivot decision, issued at
+    the end of step t−1 after its critical panel.  The A eliminate
+    splits panel-first / trailing-after; the X update (replicated along
+    "pc") stays entirely in the trailing phase.  X bits, pivot
+    sequence, and the collective multiset pin identical to
+    ``_solve_step_2d``.  Unrolled only (static shrinking window)."""
+    pr, pc, m, bpr = lay.pr, lay.pc, lay.m, lay.bpr
+    kr = lax.axis_index(AXIS_R)
+    kc = lax.axis_index(AXIS_C)
+    dtype = Wloc.dtype
+    Wc = Wloc.shape[-1]
+    z = jnp.int32(0)
+    own_c = kc == (t % pc)
+    loW = (t // pc) * m                         # min live chunk offset
+    live = Wc - loW
+    chunk_all, H, g_piv, step_sing = probe
+    singular = singular | step_sing
+
+    # --- STACKED ROW BROADCASTS along "pr" (identical to the static
+    # path of _solve_step_2d).
+    def rowcat(slot):
+        slot = jnp.asarray(slot, jnp.int32)
+        a_row = lax.dynamic_slice(Wloc, (slot, z, jnp.int32(loW)),
+                                  (1, m, live))[0]
+        return jnp.concatenate(
+            [a_row, lax.dynamic_index_in_dim(Xloc, slot, 0, False)],
+            axis=1)
+
+    own_piv_r = kr == (g_piv % pr)
+    slot_piv = jnp.asarray(jnp.where(own_piv_r, g_piv // pr, 0),
+                           jnp.int32)
+    row_piv = psum(jnp.where(own_piv_r, rowcat(slot_piv), 0.0), AXIS_R)
+    own_t_r = kr == (t % pr)
+    slot_t = t // pr
+    row_t = psum(jnp.where(own_t_r, rowcat(slot_t), 0.0), AXIS_R)
+
+    # --- SWAP-BY-COPY (identical to _solve_step_2d).
+    cur_A = lax.dynamic_slice(Wloc, (slot_piv, z, jnp.int32(loW)),
+                              (1, m, live))
+    Wloc = lax.dynamic_update_slice(
+        Wloc, jnp.where(own_piv_r, row_t[None, :, :live], cur_A),
+        (slot_piv, z, jnp.int32(loW)))
+    cur_X = lax.dynamic_index_in_dim(Xloc, slot_piv, 0, False)
+    Xloc = lax.dynamic_update_index_in_dim(
+        Xloc, jnp.where(own_piv_r, row_t[:, live:], cur_X), slot_piv, 0)
+
+    # --- NORMALIZE: separate A/X matmuls (the bit contract).
+    prow_A = jnp.matmul(H, row_piv[:, :live], precision=precision)
+    prow_X = jnp.matmul(H, row_piv[:, live:], precision=precision)
+
+    # --- MULTIPLIERS from the CARRIED panel + swap fix-up (owner
+    # column's t-chunk sits at the HEAD of its live slice).
+    row_t_chunk = psum(
+        jnp.where(own_c, row_t[:, :m], 0.0), AXIS_C).astype(dtype)
+    cur_Epiv = lax.dynamic_index_in_dim(chunk_all, slot_piv, 0, False)
+    E = lax.dynamic_update_index_in_dim(
+        chunk_all, jnp.where(own_piv_r, row_t_chunk, cur_Epiv),
+        slot_piv, 0)
+    gr = jnp.arange(bpr) * pr + kr
+    E = jnp.where((gr == t)[:, None, None], jnp.asarray(0, dtype), E)
+    Ef = E.reshape(bpr * m, m)
+
+    next_probe = None
+    if t < lay.Nr - 1:
+        # --- CRITICAL PANEL: the chunk where global column t+1 lives on
+        # its owner mesh column; offset inside the live window is
+        # static.
+        u2 = (t + 1) // pc
+        offA = u2 * m - loW
+        panel = (Wloc[:, :, u2 * m:(u2 + 1) * m]
+                 - jnp.matmul(Ef, prow_A[:, offA:offA + m],
+                              precision=precision).reshape(bpr, m, m))
+        panel_cand = panel.at[slot_t].set(
+            jnp.where(own_t_r, prow_A[:, offA:offA + m], panel[slot_t]))
+        # --- CHUNK BROADCAST for step t+1, one step early.
+        own_c2 = kc == ((t + 1) % pc)
+        chunk_all_next = psum(
+            jnp.where(own_c2, panel_cand, jnp.asarray(0, dtype)), AXIS_C)
+        # --- PROBE-AHEAD for step t+1.
+        next_probe = _probe_reduce_2d(
+            chunk_all_next, t + 1, kr, lay=lay, eps=eps,
+            use_pallas=use_pallas, probe_cols=probe_cols, dtype=dtype)
+        # --- TRAILING: the remaining live chunks + all of X.
+        left = (Wloc[:, :, loW:u2 * m]
+                - jnp.matmul(Ef, prow_A[:, :offA],
+                             precision=precision).reshape(bpr, m, offA))
+        right = (Wloc[:, :, (u2 + 1) * m:]
+                 - jnp.matmul(Ef, prow_A[:, offA + m:],
+                              precision=precision).reshape(
+                                  bpr, m, live - offA - m))
+        Wloc = Wloc.at[:, :, loW:].set(
+            jnp.concatenate([left, panel, right], axis=2))
+    else:
+        upd_A = jnp.matmul(Ef, prow_A, precision=precision)
+        Wloc = Wloc.at[:, :, loW:].add(-upd_A.reshape(bpr, m, live))
+    upd_X = jnp.matmul(Ef, prow_X, precision=precision)
+    Xloc = Xloc - upd_X.reshape(bpr, m, nrhs)
+
+    # Row t becomes the normalized pivot row (owning mesh row only).
+    # int32 indices: x64 would canonicalize the static slot to int64
+    # against dynamic_slice's int32 offsets (the base-step discipline).
+    st = jnp.int32(slot_t)
+    cur_t = lax.dynamic_slice(Wloc, (st, z, jnp.int32(loW)),
+                              (1, m, live))
+    Wloc = lax.dynamic_update_slice(
+        Wloc, jnp.where(own_t_r, prow_A[None], cur_t),
+        (st, z, jnp.int32(loW)))
+    cur_tx = lax.dynamic_index_in_dim(Xloc, slot_t, 0, False)
+    Xloc = lax.dynamic_update_index_in_dim(
+        Xloc, jnp.where(own_t_r, prow_X, cur_tx), slot_t, 0)
+    return Wloc, Xloc, singular, next_probe
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "nrhs", "eps", "precision",
+                          "use_pallas", "probe_cols"))
+def _sharded_jordan_solve_2d_lookahead(W, X, mesh, lay: CyclicLayout2D,
+                                       nrhs, eps, precision, use_pallas,
+                                       probe_cols=True):
+    """The PROBE-AHEAD 2D solve engine: prologue chunk broadcast +
+    probe, panel/trailing split per step.  X bits, pivot sequence, and
+    the collective multiset match ``_sharded_jordan_solve_2d``."""
+    def worker(Wloc, Xloc):
+        kr = lax.axis_index(AXIS_R)
+        kc = lax.axis_index(AXIS_C)
+        singular = pcast(jnp.asarray(False), BOTH, to='varying')
+        chunk0 = psum(
+            jnp.where(kc == 0, Wloc[:, :, :lay.m],
+                      jnp.asarray(0, Wloc.dtype)), AXIS_C)
+        probe = _probe_reduce_2d(
+            chunk0, 0, kr, lay=lay, eps=eps, use_pallas=use_pallas,
+            probe_cols=probe_cols, dtype=Wloc.dtype)
+        for t in range(lay.Nr):
+            Wloc, Xloc, singular, probe = _solve_step_2d_lookahead(
+                t, Wloc, Xloc, singular, probe, lay=lay, nrhs=nrhs,
+                eps=eps, precision=precision, use_pallas=use_pallas,
+                probe_cols=probe_cols)
+        return Xloc, singular[None, None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=(_SPEC_W, _SPEC_X2),
+        out_specs=(_SPEC_X2, PartitionSpec(AXIS_R, AXIS_C)),
+    )(W, X)
+
+
 def scatter_rhs_2d(b: jnp.ndarray, lay: CyclicLayout2D, mesh: Mesh):
     """(n, k) RHS -> (Nr, m, k) zero-padded row blocks in cyclic row
     storage order, sharded along "pr" and replicated along "pc"."""
@@ -1166,10 +1509,13 @@ def compile_sharded_jordan_solve_2d(
     use_pallas: bool | None = None,
     unroll: bool | None = None,
     probe_layout: str = "auto",
+    lookahead: bool = False,
 ):
     """AOT-compile the 2D distributed solve.  ``run(W, X) ->
     (x_blocks, singular_grid)``; ``unroll=None`` picks the unrolled
-    trace for Nr <= MAX_UNROLL_NR and the fori engine beyond."""
+    trace for Nr <= MAX_UNROLL_NR and the fori engine beyond.
+    ``lookahead=True`` takes the probe-ahead schedule (unrolled only;
+    identical X bits and comm inventory)."""
     from .jordan2d import resolve_use_pallas_2d
 
     if eps is None:
@@ -1180,6 +1526,20 @@ def compile_sharded_jordan_solve_2d(
         unroll = lay.Nr <= MAX_UNROLL_NR
     probe_cols = resolve_probe_layout(probe_layout, mesh)
     nrhs = int(Xblocks.shape[-1])
+    if lookahead:
+        if not unroll:
+            from ..driver import UsageError
+
+            raise UsageError(
+                f"engine='solve_lookahead' is unrolled-only (the "
+                f"critical-panel split needs static chunk offsets) and "
+                f"Nr={lay.Nr} exceeds MAX_UNROLL_NR={MAX_UNROLL_NR}; "
+                f"use engine='solve_sharded' (its fori twin covers any "
+                f"Nr) or a larger block_size")
+        return _sharded_jordan_solve_2d_lookahead.lower(
+            Wblocks, Xblocks, mesh, lay, nrhs, eps, precision,
+            use_pallas, probe_cols
+        ).compile()
     engine = (_sharded_jordan_solve_2d if unroll
               else _sharded_jordan_solve_2d_fori)
     return engine.lower(
@@ -1241,6 +1601,7 @@ def compile_sharded_jordan_inplace_2d(
     group: int = 0,
     probe_layout: str = "auto",
     swapfree: bool = False,
+    lookahead: bool = False,
 ):
     """AOT-compile the 2D in-place elimination for a (Nr, m, N) 2D-cyclic
     identity-padded block tensor.  ``run(W) -> (inverse_blocks,
@@ -1250,7 +1611,9 @@ def compile_sharded_jordan_inplace_2d(
     the fori_loop engine beyond — identical results either way.
     ``group=k > 1`` takes the delayed-group-update engines (one fat
     local trailing matmul per group, fused stacked row psum per step;
-    parity with the plain engines is to rounding)."""
+    parity with the plain engines is to rounding).  ``lookahead=True``
+    takes the probe-ahead engine (unrolled only; identical bits and
+    comm inventory)."""
     from .jordan2d import resolve_use_pallas_2d
 
     if eps is None:
@@ -1260,6 +1623,24 @@ def compile_sharded_jordan_inplace_2d(
     if unroll is None:
         unroll = lay.Nr <= MAX_UNROLL_NR
     probe_cols = resolve_probe_layout(probe_layout, mesh)
+    if lookahead:
+        from ..driver import UsageError
+
+        if swapfree or (group and group > 1):
+            raise UsageError(
+                "lookahead=True composes only with the plain 2D engine "
+                "(the panel/trailing split is defined on its per-step "
+                "schedule); drop swapfree/group or drop lookahead")
+        if not unroll:
+            raise UsageError(
+                f"the lookahead engine is unrolled-only (the critical-"
+                f"panel split needs static chunk offsets) and Nr="
+                f"{lay.Nr} exceeds MAX_UNROLL_NR={MAX_UNROLL_NR}; use "
+                f"engine='inplace' (its fori twin) or a larger "
+                f"block_size")
+        return _sharded_jordan2d_inplace_lookahead.lower(
+            W, mesh, lay, eps, precision, use_pallas, probe_cols
+        ).compile()
     if swapfree:
         return _sharded_jordan2d_inplace_swapfree.lower(
             W, mesh, lay, eps, precision, use_pallas, probe_cols
@@ -1289,6 +1670,7 @@ def sharded_jordan_invert_inplace_2d(
     group: int = 0,
     probe_layout: str = "auto",
     swapfree: bool = False,
+    lookahead: bool = False,
 ):
     """Invert (n, n) ``a`` over a 2D (pr, pc) mesh with the in-place
     engine: drop-in for ``sharded_jordan_invert_2d`` at ~half the flops,
@@ -1304,6 +1686,7 @@ def sharded_jordan_invert_inplace_2d(
     W = scatter_matrix_2d(a, lay, mesh)
     run = compile_sharded_jordan_inplace_2d(W, mesh, lay, eps, precision,
                                             use_pallas, unroll, group,
-                                            probe_layout, swapfree)
+                                            probe_layout, swapfree,
+                                            lookahead)
     out, singular = run(W)
     return gather_inverse_inplace_2d(out, lay, n), singular.any()

@@ -717,6 +717,186 @@ def _sharded_jordan_inplace(W, mesh, lay: CyclicLayout, eps, precision,
     )(W)
 
 
+def _probe_reduce_1d(cands, t: int, k, *, lay: CyclicLayout, eps,
+                     use_pallas: bool, dtype):
+    """Step ``t``'s pivot probe + cross-worker reduction, factored out of
+    ``_step`` VERBATIM (same ops, same collective multiset: two scalar
+    pmins, the scalar g_piv psum, the (m, m) H psum) so the lookahead
+    engines can issue it EARLY — right after the critical panel of step
+    t−1's eliminate, before the trailing update.
+
+    ``cands`` is the (bpw − t//p, m, m) live candidate stack for step
+    ``t`` (static).  Returns the step's full pivot decision as a carry:
+    ``(H, g_piv, safe_best, i_won, step_sing)``.  Note the base engine's
+    ``i_won`` carries NO finite guard — on an all-singular window every
+    worker "wins" and the H psum sums dead-candidate inverses; the
+    lookahead panel computes those dead values with the same arithmetic,
+    so even that degenerate path stays bit-equal."""
+    p, bpw = lay.p, lay.blocks_per_worker
+    s0 = t // p
+    gidx = jnp.arange(s0, bpw) * p + k          # global block rows probed
+    invs, sing = probe_blocks(cands, eps, use_pallas)
+    valid = (gidx >= t) & ~sing
+    norms = block_inf_norms(invs)
+    key = jnp.where(valid, norms, jnp.asarray(jnp.inf, norms.dtype))
+    slot_best = jnp.argmin(key)
+    my_key = key[slot_best]
+
+    kmin = pmin(my_key, AXIS)
+    g_cand = gidx[slot_best]
+    win_g = pmin(jnp.where(my_key == kmin, g_cand, lay.Nr), AXIS)
+    step_sing = ~jnp.isfinite(kmin)
+    i_won = (my_key == kmin) & (g_cand == win_g)
+    g_piv = psum(jnp.where(i_won, g_cand, 0), AXIS)
+    H = psum(
+        jnp.where(i_won, jnp.take(invs, slot_best, axis=0), 0.0).astype(dtype),
+        AXIS,
+    )
+    safe_best = jnp.where(i_won, slot_best + s0, 0)
+    return H, g_piv, safe_best, i_won, step_sing
+
+
+def _step_lookahead(t: int, Wloc, singular, probe, *, lay: CyclicLayout,
+                    eps, precision, use_pallas: bool):
+    """One super-step of the PROBE-AHEAD 1D engine (ISSUE 16).
+
+    ``probe`` is step ``t``'s pivot decision, computed AHEAD of time (at
+    the end of step t−1, overlapping its trailing eliminate).  The
+    eliminate sweep is split: the CRITICAL PANEL (column block t+1 —
+    step t+1's candidate column) is updated first, step t+1's probe +
+    reduction launch immediately after it, and only then does the
+    TRAILING eliminate (all other columns) run.  The panel is the column
+    slice of the very matmul ``_step`` computes
+    (``matmul(Ef, prow)[:, cols] == matmul(Ef, prow[:, cols])``
+    element-for-element at HIGHEST), so pivot choices, the comm
+    multiset, and the result bits are pinned IDENTICAL to the plain
+    engine — the collectives MOVE earlier in the schedule, none are
+    added (tests/test_comm.py reconciles the inventory multiset-exact).
+
+    Returns ``(Wloc, singular, g_piv, next_probe)`` where ``next_probe``
+    is step t+1's decision carry (None at the last step)."""
+    p, m, bpw, N = lay.p, lay.m, lay.blocks_per_worker, lay.N
+    k = lax.axis_index(AXIS)
+    dtype = Wloc.dtype
+    H, g_piv, safe_best, i_won, step_sing = probe
+    singular = singular | step_sing
+
+    # --- ROW BROADCASTS (m, N): same one-hot psums as _step, from the
+    # carried decision (Wloc here equals the plain engine's state at the
+    # top of step t, by induction).
+    row_piv = psum(
+        jnp.where(i_won, lax.dynamic_index_in_dim(Wloc, safe_best, 0, False),
+                  0.0),
+        AXIS,
+    )                                           # (m, N)
+    own_t = k == (t % p)
+    slot_t = t // p
+    row_t = psum(
+        jnp.where(own_t, Wloc[slot_t], 0.0), AXIS
+    )                                           # (m, N)
+
+    # --- SWAP-BY-COPY (identical to _step).
+    own_piv = k == (g_piv % p)
+    slot_piv = jnp.where(own_piv, g_piv // p, 0)
+    cur_piv = lax.dynamic_index_in_dim(Wloc, slot_piv, 0, False)
+    Wloc = lax.dynamic_update_index_in_dim(
+        Wloc, jnp.where(own_piv, row_t, cur_piv), slot_piv, 0
+    )
+
+    # --- NORMALIZE; the t-chunk becomes H.
+    prow = jnp.matmul(H, row_piv, precision=precision)      # (m, N)
+    prow = prow.at[:, t * m:(t + 1) * m].set(H)
+
+    # --- MULTIPLIERS (identical to _step).
+    E = Wloc[:, :, t * m:(t + 1) * m]                       # (bpw, m, m)
+    loc_g = jnp.arange(bpw) * p + k
+    E = jnp.where((loc_g == t)[:, None, None], jnp.asarray(0, dtype), E)
+    Wloc = Wloc.at[:, :, t * m:(t + 1) * m].set(jnp.asarray(0, dtype))
+    Ef = E.reshape(bpw * m, m)
+
+    next_probe = None
+    if t < lay.Nr - 1:
+        # --- CRITICAL PANEL first: column block t+1's rank-m update.
+        c0 = (t + 1) * m
+        panel = (Wloc[:, :, c0:c0 + m]
+                 - jnp.matmul(Ef, prow[:, c0:c0 + m],
+                              precision=precision).reshape(bpw, m, m))
+        # The plain engine probes AFTER its slot_t prow write, and
+        # slot_t (= t//p) can still sit inside step t+1's window on the
+        # worker owning row t — apply the same overwrite to the
+        # CANDIDATE view (the panel that re-enters Wloc stays unfixed;
+        # the final slot_t write below covers it).
+        panel_cand = panel.at[slot_t].set(
+            jnp.where(own_t, prow[:, c0:c0 + m], panel[slot_t]))
+        # --- PROBE-AHEAD: step t+1's decision, issued before the
+        # trailing eliminate so the pmin/psum reduction overlaps it.
+        s1 = (t + 1) // p
+        next_probe = _probe_reduce_1d(
+            panel_cand[s1:], t + 1, k, lay=lay, eps=eps,
+            use_pallas=use_pallas, dtype=dtype)
+        # --- TRAILING ELIMINATE: the remaining columns (same sliced
+        # contractions; concat restores _step's Wloc bits).
+        left = (Wloc[:, :, :c0]
+                - jnp.matmul(Ef, prow[:, :c0],
+                             precision=precision).reshape(bpw, m, c0))
+        right = (Wloc[:, :, c0 + m:]
+                 - jnp.matmul(Ef, prow[:, c0 + m:],
+                              precision=precision).reshape(
+                                  bpw, m, N - c0 - m))
+        Wloc = jnp.concatenate([left, panel, right], axis=2)
+    else:
+        update = jnp.matmul(Ef, prow, precision=precision)
+        Wloc = Wloc - update.reshape(bpw, m, N)
+
+    # Row t becomes the normalized pivot row (owner only).
+    Wloc = Wloc.at[slot_t].set(jnp.where(own_t, prow, Wloc[slot_t]))
+    return Wloc, singular, g_piv, next_probe
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "eps", "precision", "use_pallas"))
+def _sharded_jordan_inplace_lookahead(W, mesh, lay: CyclicLayout, eps,
+                                      precision, use_pallas):
+    """The 1D in-place engine with PROBE-AHEAD scheduling (ISSUE 16):
+    step t+1's probe + pmin reduction are issued right after step t's
+    critical-panel update, BEFORE the trailing eliminate — the probe
+    collective comes off the superstep critical path and can overlap
+    the bulk rank-m GEMM under a latency-hiding scheduler.  Unrolled
+    only (the panel split needs static offsets).  Results, pivot
+    choices, and the collective MULTISET are bit-identical to
+    ``_sharded_jordan_inplace`` — the schedule moves, the traffic
+    doesn't."""
+    def worker(Wloc):
+        k = lax.axis_index(AXIS)
+        singular = pcast(jnp.asarray(False), AXIS, to='varying')
+        # --- PROLOGUE: step 0's probe on the untouched first column.
+        probe = _probe_reduce_1d(
+            lax.slice(Wloc, (0, 0, 0),
+                      (lay.blocks_per_worker, lay.m, lay.m)),
+            0, k, lay=lay, eps=eps, use_pallas=use_pallas,
+            dtype=Wloc.dtype)
+        swaps = []
+        for t in range(lay.Nr):
+            Wloc, singular, g_piv, probe = _step_lookahead(
+                t, Wloc, singular, probe, lay=lay, eps=eps,
+                precision=precision, use_pallas=use_pallas,
+            )
+            swaps.append(g_piv)
+
+        from ..ops.jordan_inplace import apply_col_perm, compose_swap_perm
+
+        Wloc = apply_col_perm(
+            Wloc, compose_swap_perm(jnp.stack(swaps), lay.Nr), lay.m)
+        return Wloc, singular[None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=PartitionSpec(AXIS, None, None),
+        out_specs=(PartitionSpec(AXIS, None, None), PartitionSpec(AXIS)),
+    )(W)
+
+
 def compile_sharded_jordan_inplace(
     blocks: jnp.ndarray,
     mesh: Mesh,
@@ -727,6 +907,7 @@ def compile_sharded_jordan_inplace(
     unroll: bool | None = None,
     group: int = 0,
     swapfree: bool = False,
+    lookahead: bool = False,
 ):
     """AOT-compile the in-place sharded elimination for a (Nr, m, N)
     identity-padded cyclic block tensor.  ``run(blocks) ->
@@ -743,7 +924,11 @@ def compile_sharded_jordan_inplace(
     half the per-step collective row bytes, one bucketed-ppermute row
     permutation at the end (residency capped at one shard — legal under
     gather=False) — the pod-scale comm design (benchmarks/comm_model.py);
-    bit-identical results on nonsingular inputs."""
+    bit-identical results on nonsingular inputs.  ``lookahead=True``
+    takes the probe-ahead engine (ISSUE 16): step t+1's probe +
+    reduction issued after step t's critical panel, before its trailing
+    eliminate — unrolled only, bit- and inventory-identical to the
+    plain engine."""
     from .sharded_jordan import resolve_use_pallas
 
     if eps is None:
@@ -752,6 +937,24 @@ def compile_sharded_jordan_inplace(
         use_pallas = resolve_use_pallas(blocks.dtype, lay.m)
     if unroll is None:
         unroll = lay.Nr <= MAX_UNROLL_NR
+    if lookahead:
+        from ..driver import UsageError
+
+        if swapfree or (group and group > 1):
+            raise UsageError(
+                "lookahead=True composes only with the plain 1D engine "
+                "(the panel/trailing split is defined on its per-step "
+                "schedule); drop swapfree/group or drop lookahead")
+        if not unroll:
+            raise UsageError(
+                f"the lookahead engine is unrolled-only (the critical-"
+                f"panel split needs static column offsets) and Nr="
+                f"{lay.Nr} exceeds MAX_UNROLL_NR={MAX_UNROLL_NR}; use "
+                f"engine='inplace' (its fori twin) or a larger "
+                f"block_size")
+        return _sharded_jordan_inplace_lookahead.lower(
+            blocks, mesh, lay, eps, precision, use_pallas
+        ).compile()
     if swapfree:
         return _sharded_jordan_inplace_swapfree.lower(
             blocks, mesh, lay, eps, precision, use_pallas
@@ -1015,6 +1218,140 @@ def _sharded_jordan_solve_fori(W, X, mesh, lay: CyclicLayout, nrhs, eps,
     )(W, X)
 
 
+def _solve_step_lookahead(t: int, Wloc, Xloc, singular, probe, *,
+                          lay: CyclicLayout, nrhs: int, eps, precision,
+                          use_pallas: bool):
+    """One PROBE-AHEAD solve super-step (ISSUE 16): ``probe`` is step
+    ``t``'s pivot decision, issued at the end of step t−1 right after
+    its critical panel.  The A-half eliminate splits into the t+1
+    candidate panel (first), step t+1's probe + reduction, then the
+    trailing A columns and the full X update — column slices of the
+    same HIGHEST-precision contractions, so X bits, pivot choices, and
+    the collective multiset pin identical to ``_solve_step``.  Unrolled
+    only (static shrinking window + static panel offsets)."""
+    p, m, bpw, N = lay.p, lay.m, lay.blocks_per_worker, lay.N
+    k = lax.axis_index(AXIS)
+    dtype = Wloc.dtype
+    z = jnp.int32(0)
+    lo = t * m
+    live = N - lo
+    H, g_piv, safe_best, i_won, step_sing = probe
+    singular = singular | step_sing
+
+    # --- STACKED ROW BROADCASTS [A_live | X] from the carried decision.
+    def rowcat(slot):
+        slot = jnp.asarray(slot, jnp.int32)
+        a_row = lax.dynamic_slice(Wloc, (slot, z, jnp.int32(lo)),
+                                  (1, m, live))[0]
+        return jnp.concatenate(
+            [a_row, lax.dynamic_index_in_dim(Xloc, slot, 0, False)],
+            axis=1)
+
+    row_piv = psum(jnp.where(i_won, rowcat(safe_best), 0.0), AXIS)
+    own_t = k == (t % p)
+    slot_t = t // p
+    row_t = psum(jnp.where(own_t, rowcat(slot_t), 0.0), AXIS)
+
+    # --- SWAP-BY-COPY (identical to _solve_step's static path).
+    own_piv = k == (g_piv % p)
+    slot_piv = jnp.asarray(jnp.where(own_piv, g_piv // p, 0), jnp.int32)
+    cur_A = lax.dynamic_slice(Wloc, (slot_piv, z, jnp.int32(lo)),
+                              (1, m, live))
+    Wloc = lax.dynamic_update_slice(
+        Wloc, jnp.where(own_piv, row_t[None, :, :live], cur_A),
+        (slot_piv, z, jnp.int32(lo)))
+    cur_X = lax.dynamic_index_in_dim(Xloc, slot_piv, 0, False)
+    Xloc = lax.dynamic_update_index_in_dim(
+        Xloc, jnp.where(own_piv, row_t[:, live:], cur_X), slot_piv, 0)
+
+    # --- NORMALIZE (A and X as separate matmuls — the bit contract).
+    prow_A = jnp.matmul(H, row_piv[:, :live], precision=precision)
+    prow_X = jnp.matmul(H, row_piv[:, live:], precision=precision)
+
+    # --- MULTIPLIERS from the post-swap t-chunk, row t excluded.
+    E = lax.slice(Wloc, (0, 0, lo), (bpw, m, lo + m))
+    loc_g = jnp.arange(bpw) * p + k
+    E = jnp.where((loc_g == t)[:, None, None], jnp.asarray(0, dtype), E)
+    Ef = E.reshape(bpw * m, m)
+
+    next_probe = None
+    if t < lay.Nr - 1:
+        # --- CRITICAL PANEL: column block t+1 sits at offset m inside
+        # the live window.
+        lo2 = (t + 1) * m
+        panel = (Wloc[:, :, lo2:lo2 + m]
+                 - jnp.matmul(Ef, prow_A[:, m:2 * m],
+                              precision=precision).reshape(bpw, m, m))
+        panel_cand = panel.at[slot_t].set(
+            jnp.where(own_t, prow_A[:, m:2 * m], panel[slot_t]))
+        # --- PROBE-AHEAD for step t+1.
+        s1 = (t + 1) // p
+        next_probe = _probe_reduce_1d(
+            panel_cand[s1:], t + 1, k, lay=lay, eps=eps,
+            use_pallas=use_pallas, dtype=dtype)
+        # --- TRAILING: pivot column, the rest of A, and all of X.
+        left = (Wloc[:, :, lo:lo2]
+                - jnp.matmul(Ef, prow_A[:, :m],
+                             precision=precision).reshape(bpw, m, m))
+        right = (Wloc[:, :, lo2 + m:]
+                 - jnp.matmul(Ef, prow_A[:, 2 * m:],
+                              precision=precision).reshape(
+                                  bpw, m, live - 2 * m))
+        Wloc = Wloc.at[:, :, lo:].set(
+            jnp.concatenate([left, panel, right], axis=2))
+    else:
+        upd_A = jnp.matmul(Ef, prow_A, precision=precision)
+        Wloc = Wloc.at[:, :, lo:].add(-upd_A.reshape(bpw, m, live))
+    upd_X = jnp.matmul(Ef, prow_X, precision=precision)
+    Xloc = Xloc - upd_X.reshape(bpw, m, nrhs)
+
+    # Row t becomes the normalized pivot row (owner only).  int32
+    # indices: x64 would canonicalize the static slot to int64 against
+    # dynamic_slice's int32 offsets (the base _solve_step discipline).
+    st = jnp.int32(slot_t)
+    cur_t = lax.dynamic_slice(Wloc, (st, z, jnp.int32(lo)),
+                              (1, m, live))
+    Wloc = lax.dynamic_update_slice(
+        Wloc, jnp.where(own_t, prow_A[None], cur_t),
+        (st, z, jnp.int32(lo)))
+    cur_tx = lax.dynamic_index_in_dim(Xloc, slot_t, 0, False)
+    Xloc = lax.dynamic_update_index_in_dim(
+        Xloc, jnp.where(own_t, prow_X, cur_tx), slot_t, 0)
+    return Wloc, Xloc, singular, next_probe
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "nrhs", "eps", "precision",
+                          "use_pallas"))
+def _sharded_jordan_solve_lookahead(W, X, mesh, lay: CyclicLayout, nrhs,
+                                    eps, precision, use_pallas):
+    """The PROBE-AHEAD 1D solve engine: same prologue-probe + panel/
+    trailing split as ``_sharded_jordan_inplace_lookahead``, on the
+    [A | B] elimination.  X bits, pivot sequence, and the collective
+    multiset match ``_sharded_jordan_solve`` exactly."""
+    def worker(Wloc, Xloc):
+        k = lax.axis_index(AXIS)
+        singular = pcast(jnp.asarray(False), AXIS, to='varying')
+        probe = _probe_reduce_1d(
+            lax.slice(Wloc, (0, 0, 0),
+                      (lay.blocks_per_worker, lay.m, lay.m)),
+            0, k, lay=lay, eps=eps, use_pallas=use_pallas,
+            dtype=Wloc.dtype)
+        for t in range(lay.Nr):
+            Wloc, Xloc, singular, probe = _solve_step_lookahead(
+                t, Wloc, Xloc, singular, probe, lay=lay, nrhs=nrhs,
+                eps=eps, precision=precision, use_pallas=use_pallas)
+        return Xloc, singular[None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=(PartitionSpec(AXIS, None, None),
+                  PartitionSpec(AXIS, None, None)),
+        out_specs=(PartitionSpec(AXIS, None, None), PartitionSpec(AXIS)),
+    )(W, X)
+
+
 def scatter_rhs_1d(b: jnp.ndarray, lay: CyclicLayout, mesh: Mesh):
     """(n, k) RHS -> (Nr, m, k) zero-padded row blocks in cyclic storage
     order, sharded over the 1D mesh (pad rows of X stay exactly zero
@@ -1048,6 +1385,7 @@ def compile_sharded_jordan_solve(
     precision=lax.Precision.HIGHEST,
     use_pallas: bool | None = None,
     unroll: bool | None = None,
+    lookahead: bool = False,
 ):
     """AOT-compile the 1D distributed solve for an identity-padded
     (Nr, m, N) A block tensor and a zero-padded (Nr, m, k) RHS tensor.
@@ -1056,7 +1394,9 @@ def compile_sharded_jordan_solve(
     ``unroll=None`` picks the unrolled trace (static shrinking
     live-column window — the FLOP-saving flavor) for Nr <=
     MAX_UNROLL_NR and the fori_loop engine beyond (identical X bits;
-    full-width updates, compile cost flat in Nr)."""
+    full-width updates, compile cost flat in Nr).  ``lookahead=True``
+    takes the probe-ahead schedule (unrolled only; identical X bits and
+    comm inventory)."""
     from .sharded_jordan import resolve_use_pallas
 
     if eps is None:
@@ -1066,6 +1406,19 @@ def compile_sharded_jordan_solve(
     if unroll is None:
         unroll = lay.Nr <= MAX_UNROLL_NR
     nrhs = int(Xblocks.shape[-1])
+    if lookahead:
+        if not unroll:
+            from ..driver import UsageError
+
+            raise UsageError(
+                f"engine='solve_lookahead' is unrolled-only (the "
+                f"critical-panel split needs static column offsets) and "
+                f"Nr={lay.Nr} exceeds MAX_UNROLL_NR={MAX_UNROLL_NR}; "
+                f"use engine='solve_sharded' (its fori twin covers any "
+                f"Nr) or a larger block_size")
+        return _sharded_jordan_solve_lookahead.lower(
+            Wblocks, Xblocks, mesh, lay, nrhs, eps, precision, use_pallas
+        ).compile()
     engine = (_sharded_jordan_solve if unroll
               else _sharded_jordan_solve_fori)
     return engine.lower(
@@ -1084,6 +1437,7 @@ def sharded_jordan_invert_inplace(
     unroll: bool | None = None,
     group: int = 0,
     swapfree: bool = False,
+    lookahead: bool = False,
 ):
     """Invert (n, n) ``a`` over the 1D mesh with the in-place engine.
 
@@ -1100,6 +1454,7 @@ def sharded_jordan_invert_inplace(
     lay = CyclicLayout.create(n, min(block_size, n), mesh.devices.size)
     blocks = _to_identity_padded_blocks(a, lay, mesh)
     run = compile_sharded_jordan_inplace(blocks, mesh, lay, eps, precision,
-                                         use_pallas, unroll, group, swapfree)
+                                         use_pallas, unroll, group, swapfree,
+                                         lookahead)
     out, singular = run(blocks)
     return gather_inverse_inplace(out, lay, n), singular.any()

@@ -397,6 +397,76 @@ def _cost_solve_sharded(pt: TunePoint) -> float:
     return 0.55 * projected_seconds(pt)
 
 
+def _lookahead_hidden_seconds(pt: TunePoint) -> float:
+    """The probe seconds the lookahead schedule can hide under the
+    trailing eliminate: per superstep the probe (candidate block
+    inverses + the pmin reduction) runs concurrently with the trailing
+    GEMM, so the hidden time is bounded by BOTH terms —
+    min(probe, elim) of the comm-model projection."""
+    pr, pc = pt.mesh_shape
+    r = comm_model().predict(pt.n, pt.block_size, pr, pc, _chip_for(pt))
+    return min(r["probe"], r["elim"])
+
+
+def probe_overlap_headroom(point: TunePoint) -> float:
+    """Projected fraction of total wall time the probe-ahead schedule
+    can hide — min(probe, elim)/total from the comm model.  Recorded by
+    bench.py's lookahead rows as an ACCOUNTING field (the `_overlap_frac`
+    suffix: context for the rate numbers, never regression-compared)
+    and attached to execute spans as scheduling evidence
+    (obs/hwcost.attach_execute_cost)."""
+    pr, pc = point.mesh_shape
+    r = comm_model().predict(
+        point.n, point.block_size, pr, pc, _chip_for(point))
+    return min(r["probe"], r["elim"]) / r["total"]
+
+
+def _legal_lookahead(pt: TunePoint) -> bool:
+    # The probe-ahead engine (ISSUE 16): pivoting flavors only (the SPD
+    # pivot-free path has no probe to move), real dtypes (in-place
+    # family contract), unrolled-reach Nr only — the critical-panel /
+    # trailing split needs static column offsets.
+    from ..parallel.sharded_inplace import MAX_UNROLL_NR
+
+    m = min(pt.block_size, pt.n)
+    Nr = -(-pt.n // m)
+    return _real_dtype(pt) and Nr <= MAX_UNROLL_NR
+
+
+def _cost_lookahead(pt: TunePoint) -> float:
+    # Distributed: the probe's candidate inverses AND its cross-worker
+    # pmin reduction come off the superstep critical path — discount
+    # the projection by the overlappable term (bounded by the trailing
+    # eliminate it hides under).  Single-device: the probe is on-chip
+    # compute with no reduction latency to hide; until a measured TPU
+    # session validates the reordered schedule it is priced just ABOVE
+    # the plain engine (the grouped_pallas discipline: a new schedule
+    # must not displace the measured champion by model fiat, but stays
+    # inside tune=True's survivor cut for evidence to promote it).
+    if pt.distributed:
+        return projected_seconds(pt) - _lookahead_hidden_seconds(pt)
+    return 1.01 * projected_seconds(pt)
+
+
+def _legal_solve_lookahead(pt: TunePoint) -> bool:
+    # The distributed probe-ahead solve: solve_sharded's legality
+    # narrowed to unrolled-reach Nr (static panel offsets).
+    from ..parallel.sharded_inplace import MAX_UNROLL_NR
+
+    m = min(pt.block_size, pt.n)
+    Nr = -(-pt.n // m)
+    return _legal_solve_sharded(pt) and Nr <= MAX_UNROLL_NR
+
+
+def _cost_solve_lookahead(pt: TunePoint) -> float:
+    # The solve_sharded n³(1+k/n) discount on the overlap-discounted
+    # projection: same supersteps, probe off the critical path —
+    # strictly below solve_sharded wherever legal, so the cost model
+    # routes unrolled-reach distributed solves through the lookahead
+    # schedule (identical X bits; the fori twin covers Nr beyond).
+    return 0.55 * (projected_seconds(pt) - _lookahead_hidden_seconds(pt))
+
+
 def _legal_update(pt: TunePoint) -> bool:
     # The SMW update (linalg/update.py): three GEMMs, a k×k capacitance
     # solve, and the in-launch verification matmul — single-device
@@ -447,6 +517,13 @@ CONFIGS: tuple[EngineConfig, ...] = (
         "the fused kernel with bf16-compute/fp32-accumulate dots "
         "(arXiv:2112.09017); auto-candidate only at sub-fp32 storage "
         "points, always guarded by the residual-gate ladder"),
+    EngineConfig(
+        "lookahead", "lookahead", 0, _legal_lookahead, _cost_lookahead,
+        "probe-ahead in-place elimination (ISSUE 16): step t+1's pivot "
+        "probe + reduction issued after step t's critical panel, before "
+        "its trailing eliminate — the probe comes off the superstep "
+        "critical path; bit-identical results and comm inventory, "
+        "unrolled-reach Nr only"),
     # ---- solve workloads (ISSUE 11, tpu_jordan/linalg/) --------------
     EngineConfig(
         "solve_aug", "solve_aug", 0, _legal_solve, _cost_solve,
@@ -475,6 +552,15 @@ CONFIGS: tuple[EngineConfig, ...] = (
         "eliminate supersteps, live-column window statically shrinking "
         "per shard (unrolled) or fori beyond MAX_UNROLL_NR; X "
         "bit-matches the single-device engine",
+        workload="solve"),
+    EngineConfig(
+        "solve_lookahead_sharded", "solve_lookahead", 0,
+        _legal_solve_lookahead, _cost_solve_lookahead,
+        "the distributed [A | B] elimination with the probe-ahead "
+        "schedule (ISSUE 16): panel-first eliminate, step t+1's probe + "
+        "reduction overlapping the trailing update; X bit-matches "
+        "solve_sharded, comm inventory multiset-identical, "
+        "unrolled-reach Nr only",
         workload="solve"),
     EngineConfig(
         "solve_fori", "solve_fori", 0, _legal_solve_fori,

@@ -99,7 +99,7 @@ def measure_config(point: TunePoint, cfg: EngineConfig,
                      (n, n), dtype)
         b = generate("crand" if point.dtype.startswith("complex")
                      else "rand", (n, 1), dtype)
-        if cfg.engine == "solve_sharded":
+        if cfg.engine in ("solve_sharded", "solve_lookahead"):
             # The distributed [A | B] elimination (ISSUE 15): measure
             # the REAL sharded executable on the point's mesh — timing
             # the single-device engine under a distributed key would be
@@ -113,7 +113,8 @@ def measure_config(point: TunePoint, cfg: EngineConfig,
                 solve_mesh_backend(point.workers, n, m)
             W = scatter_a(a, lay, mesh)
             X = scatter_b(b, lay, mesh)
-            run = compile_fn(W, X, mesh, lay)
+            run = compile_fn(W, X, mesh, lay,
+                             lookahead=cfg.engine == "solve_lookahead")
 
             def call():
                 jax.block_until_ready(run(W, X)[0])
