@@ -13,7 +13,7 @@ WORKERS  ?= 1
 REQUESTS  ?= 64
 BATCH_CAP ?= 8
 
-.PHONY: all native tpu test smoke serve-demo solve-demo chaos-demo fleet-demo update-demo capacity-demo comm-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
+.PHONY: all native tpu test smoke serve-demo solve-demo chaos-demo fleet-demo update-demo capacity-demo comm-demo lp-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
 
 REPLICAS ?= 3
 
@@ -135,6 +135,23 @@ comm-demo:
 	python -m tpu_jordan 48 8 --comm-demo --quiet \
 	  > /tmp/tpu_jordan_comm.json
 	python tools/check_comm.py /tmp/tpu_jordan_comm.json
+
+# LP/QP driver demo + validation (ISSUE 17, docs/WORKLOADS.md): four
+# seeded optimization runs (LP well/ill revised simplex, QP well/ill
+# primal active-set) stream correlated invert(resident=True) + rank-k
+# update + verification-solve traffic through a warmed replica fleet —
+# convergence judged by the solver's own eps*n*kappa gate and
+# RE-DERIVED by the checker from the report's iterate residuals — plus
+# the zero-drift-budget re_invert probe, a seeded replica_kill run that
+# must bit-match its fault-free replay, and the batched update-lane
+# amortization measurement (occupancy > 1 must beat one-per-launch;
+# exit 2 = silent divergence).  This row is the demo gate for the
+# optimization-driver workload, like update-demo/fleet-demo for theirs.
+lp-demo:
+	python -m tpu_jordan 16 8 --lp-demo --dtype float64 \
+	  --replicas $(REPLICAS) --kills 1 --batch-cap 4 --quiet \
+	  > /tmp/tpu_jordan_lp.json
+	python tools/check_lp.py /tmp/tpu_jordan_lp.json
 
 # SLO demo + validation (docs/OBSERVABILITY.md): the fleet demo with
 # the --slo-report leg — declarative per-bucket availability SLOs

@@ -499,84 +499,128 @@ def _sharded_swapfree_row(extra):
         extra["sharded_swapfree_gather_false_error"] = str(e)[:200]
 
 
-def _solve_sharded_row(extra):
-    """ISSUE 15 capture row ``solve_sharded_4096``: the distributed
-    [A | B] elimination (k=8 RHS) on a 1D p=8 mesh.  This bench host
-    exposes ONE chip, so the leg runs on a forced 8-virtual-device CPU
-    mesh in a subprocess (the __graft_entry__ dryrun recipe) — elapsed
-    is CPU-mesh wall time; the row's evidence is the per-device
-    ``cost_analysis`` FLOP share (pinned ~1/p of the single-device
-    solve's), the backward-error gate, and the communication
+def _solve_sharded_row(extra, n=4096, m=128, p=8, ks=(1, 8, 32),
+                       timeout=1800):
+    """ISSUE 15 capture row ``solve_sharded_4096`` extended into the
+    ISSUE 17 multi-RHS blocking study: the distributed [A | B]
+    elimination on a 1D p=8 mesh swept over the RHS block width
+    (``solve_sharded_4096_k{1,8,32}_*`` — the JAXMg blocking question
+    measured on the sharded solve path).  This bench host exposes ONE
+    chip, so every leg runs on a forced 8-virtual-device CPU mesh in a
+    subprocess (the __graft_entry__ dryrun recipe) — elapsed is
+    CPU-mesh wall time; each leg's evidence is the backward-error
+    gate, the executable's own ``cost_analysis`` FLOPs
+    (``*_xla_flops``, accounting-class) and the communication
     observatory's numbers: ``*_comm_bytes`` (layout-exact
     elimination-section payload, accounting-class — never compared
     cross-round) and ``*_comm_gbps`` (achieved GB/s — a RATE the
     sentinel pages on, the mesh bandwidth sentinel).  GFLOP/s uses the
-    workload-aware n³(1+k/n) convention with median-of-3 spread."""
+    workload-aware n³(1+k/n) convention with median-of-3 spread per
+    leg.  The k=8 leg keeps the historical key names
+    (``solve_sharded_4096`` / ``*_comm_bytes`` / ``*_comm_gbps``) so
+    the cross-round trajectory never diffs a renamed config against
+    itself.  One failing k-leg records its own error key and never
+    loses the siblings."""
     import subprocess
     import sys
 
     from __graft_entry__ import _REPO, _cpu_env
 
+    stem = f"solve_sharded_{n}"
     child = (
         "import jax, json\n"
         "jax.config.update('jax_platforms', 'cpu')\n"
         "from tpu_jordan.linalg import solve_system\n"
+        "from tpu_jordan.linalg.api import solve_mesh_backend\n"
         "from tpu_jordan.obs import hwcost as _hwcost\n"
         "from tpu_jordan.ops import generate\n"
         "from tpu_jordan.tuning.measure import measure_direct\n"
         "import jax.numpy as jnp\n"
-        "n, m, k, p = 4096, 128, 8, 8\n"
+        f"n, m, p, ks = {n}, {m}, {p}, {list(ks)!r}\n"
         "a = generate('rand', (n, n), jnp.float32)\n"
-        "b = generate('rand', (n, k), jnp.float32, row_offset=n)\n"
-        "r = solve_system(a, b, block_size=m, workers=p,\n"
-        "                 engine='solve_sharded')\n"
-        "assert r.engine == 'solve_sharded', r.engine\n"
-        "from tpu_jordan.linalg.api import solve_mesh_backend\n"
-        "mesh, lay, sc_a, sc_b, compile_fn, _ = "
-        "solve_mesh_backend(p, n, m)\n"
-        "W = sc_a(a, lay, mesh); X = sc_b(b, lay, mesh)\n"
-        "run = compile_fn(W, X, mesh, lay)\n"
-        "meas = measure_direct(\n"
-        "    lambda: jax.block_until_ready(run(W, X)[0]),\n"
-        "    samples=3, warmup=1)\n"
-        "flops = _hwcost.baseline_workload_flops(n, 'solve', k=k)\n"
-        "d = r.comm.drift or {}\n"
-        "print(json.dumps({'n': n, 'm': m, 'k': k, 'mesh': f'p{p}',\n"
-        "    'engine': r.engine,\n"
-        "    'elapsed_s': round(meas.seconds, 3),\n"
-        "    'gflops': round(flops / meas.seconds / 1e9, 1),\n"
-        "    'spread_pct': meas.spread_pct,\n"
-        "    'variance_flag': meas.variance_flag,\n"
-        "    'rel_backward_error': r.rel_residual,\n"
-        "    'comm_payload_bytes': int(sum(\n"
-        "        s.payload_bytes * s.executed for s in r.comm.sigs\n"
-        "        if s.section == 'engine')),\n"
-        "    'comm_gbps': d.get('achieved_gbps'),\n"
-        "    'comm_vs_projected': d.get('comm_vs_projected')}))\n"
+        "out = {}\n"
+        "for k in ks:\n"
+        "    try:\n"
+        "        b = generate('rand', (n, k), jnp.float32,\n"
+        "                     row_offset=n)\n"
+        "        r = solve_system(a, b, block_size=m, workers=p,\n"
+        "                         engine='solve_sharded')\n"
+        "        assert r.engine == 'solve_sharded', r.engine\n"
+        "        mesh, lay, sc_a, sc_b, compile_fn, _ = \\\n"
+        "            solve_mesh_backend(p, n, m)\n"
+        "        W = sc_a(a, lay, mesh); X = sc_b(b, lay, mesh)\n"
+        "        run = compile_fn(W, X, mesh, lay)\n"
+        "        meas = measure_direct(\n"
+        "            lambda: jax.block_until_ready(run(W, X)[0]),\n"
+        "            samples=3, warmup=1)\n"
+        "        flops = _hwcost.baseline_workload_flops(n, 'solve',\n"
+        "                                                k=k)\n"
+        "        d = r.comm.drift or {}\n"
+        "        leg = {'k': k,\n"
+        "               'elapsed_s': round(meas.seconds, 3),\n"
+        "               'gflops': round(flops / meas.seconds / 1e9, 1),\n"
+        "               'spread_pct': meas.spread_pct,\n"
+        "               'variance_flag': meas.variance_flag,\n"
+        "               'rel_backward_error': r.rel_residual,\n"
+        "               'comm_payload_bytes': int(sum(\n"
+        "                   s.payload_bytes * s.executed\n"
+        "                   for s in r.comm.sigs\n"
+        "                   if s.section == 'engine')),\n"
+        "               'comm_gbps': d.get('achieved_gbps'),\n"
+        "               'comm_vs_projected': d.get('comm_vs_projected')}\n"
+        "        try:\n"
+        "            c = _hwcost.executable_cost(run)\n"
+        "            if c.available and c.flops:\n"
+        "                leg['xla_flops'] = c.flops\n"
+        "        except Exception:\n"
+        "            pass\n"
+        "        out['k%d' % k] = leg\n"
+        "    except Exception as e:\n"
+        "        out['k%d' % k] = {'error': str(e)[:200]}\n"
+        "print(json.dumps({'n': n, 'm': m, 'mesh': 'p%d' % p,\n"
+        "                  'legs': out}))\n"
     )
     try:
         proc = subprocess.run(
-            [sys.executable, "-c", child], env=_cpu_env(8), cwd=_REPO,
-            capture_output=True, text=True, timeout=900, check=True)
-        row = json.loads(proc.stdout.strip().splitlines()[-1])
-        row["note"] = ("cpu-mesh distributed-solve leg, not chip "
-                       "throughput; flops convention n^3*(1+k/n)")
-        extra["solve_sharded_4096"] = row
-        extra["solve_sharded_4096_k8_gflops"] = row["gflops"]
-        extra["solve_sharded_4096_k8_spread_pct"] = row["spread_pct"]
-        if row.get("variance_flag"):
-            extra["solve_sharded_4096_k8_variance_flag"] = row[
-                "variance_flag"]
-        # Sentinel classes (tools/check_bench.py): bytes = accounting
-        # (never compared cross-round), GB/s = rate (pages on quiet
-        # shortfalls) — the ISSUE 14 convention.
-        extra["solve_sharded_4096_comm_bytes"] = row[
-            "comm_payload_bytes"]
-        if row.get("comm_gbps") is not None:
-            extra["solve_sharded_4096_comm_gbps"] = round(
-                row["comm_gbps"], 4)
+            [sys.executable, "-c", child], env=_cpu_env(p), cwd=_REPO,
+            capture_output=True, text=True, timeout=timeout, check=True)
+        doc = json.loads(proc.stdout.strip().splitlines()[-1])
     except Exception as e:                      # noqa: BLE001
-        extra["solve_sharded_4096_error"] = str(e)[:200]
+        extra[f"{stem}_error"] = str(e)[:200]
+        return
+    legs = doc.get("legs", {})
+    for k in ks:
+        leg = legs.get(f"k{k}") or {}
+        if "gflops" not in leg:
+            extra[f"{stem}_k{k}_error"] = str(
+                leg.get("error", "no capture"))[:200]
+            continue
+        extra[f"{stem}_k{k}_gflops"] = leg["gflops"]
+        extra[f"{stem}_k{k}_spread_pct"] = leg["spread_pct"]
+        if leg.get("variance_flag"):
+            extra[f"{stem}_k{k}_variance_flag"] = leg["variance_flag"]
+        extra[f"{stem}_k{k}_rel_backward_error"] = leg[
+            "rel_backward_error"]
+        # Sentinel classes (tools/check_bench.py): bytes + xla_flops =
+        # accounting (never compared cross-round), GB/s = rate (pages
+        # on quiet shortfalls) — the ISSUE 14 convention.
+        extra[f"{stem}_k{k}_comm_bytes"] = leg["comm_payload_bytes"]
+        if leg.get("xla_flops"):
+            extra[f"{stem}_k{k}_xla_flops"] = leg["xla_flops"]
+        if k != 8 and leg.get("comm_gbps") is not None:
+            extra[f"{stem}_k{k}_comm_gbps"] = round(leg["comm_gbps"], 4)
+    # The historical k=8 row + legacy sentinel keys (unchanged names —
+    # the trajectory must keep comparing like-for-like by key).
+    k8 = legs.get("k8") or {}
+    if "gflops" in k8:
+        row = dict(k8)
+        row.update(n=n, m=m, mesh=f"p{p}", engine="solve_sharded",
+                   note=("cpu-mesh distributed-solve leg, not chip "
+                         "throughput; flops convention n^3*(1+k/n)"))
+        extra[stem] = row
+        extra[f"{stem}_comm_bytes"] = k8["comm_payload_bytes"]
+        if k8.get("comm_gbps") is not None:
+            extra[f"{stem}_comm_gbps"] = round(k8["comm_gbps"], 4)
 
 
 def _lookahead_row(extra, n=4096, m=128):
@@ -1066,6 +1110,195 @@ def _update_rows(extra, n=4096, m=128, k=32, amortized_updates=8):
         extra[f"{label}_error"] = str(ge)[:200]
 
 
+def _lp_demo_row(extra, n=16, timeout=900):
+    """ISSUE 17 capture row ``lp_demo_iters``: the LP/QP optimization
+    driver's sustained correlated traffic (four seeded driver runs —
+    LP well/ill revised simplex, QP well/ill primal active-set — each
+    one ``invert(resident=True)`` plus a rank-k ``update`` +
+    verification ``solve`` stream) through a warmed 2-replica fleet in
+    an x64 subprocess.  The recorded numbers are workload-shape
+    context, deliberately NOT rate-class: iteration counts, the
+    update ledger totals, wall seconds, and iters/s are
+    fleet-overhead-dominated at this tiny n — none end in a
+    ``*_gflops``/``*_gbps`` suffix, so tools/check_bench.py never
+    pages on them (trap-pinned in tests/test_bench_check.py).  The
+    driver's PERF contract lives in the ``update_batched_amortized``
+    row next door.  Best-effort like every non-contract row."""
+    import subprocess
+    import sys
+
+    from __graft_entry__ import _REPO, _cpu_env
+
+    child = (
+        "import jax, json, time\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "jax.config.update('jax_enable_x64', True)\n"
+        "import jax.numpy as jnp\n"
+        "from tpu_jordan.fleet import JordanFleet\n"
+        "from tpu_jordan.lpqp import (lp_instance, qp_instance,\n"
+        "                             solve_lp, solve_qp)\n"
+        "from tpu_jordan.obs.metrics import REGISTRY\n"
+        f"n = {n}\n"
+        "probs = [\n"
+        "    ('lp_well', solve_lp, lp_instance(m=n, cond='well')),\n"
+        "    ('lp_ill', solve_lp, lp_instance(m=n, cond='ill')),\n"
+        "    ('qp_well', solve_qp, qp_instance(n=n, cond='well')),\n"
+        "    ('qp_ill', solve_qp, qp_instance(n=n, cond='ill'))]\n"
+        "with JordanFleet(replicas=2, engine='auto',\n"
+        "                 dtype=jnp.float64, batch_cap=1,\n"
+        "                 max_wait_ms=0.5, stable_after_s=0.2,\n"
+        "                 liveness_deadline_s=5.0) as fleet:\n"
+        "    fleet.warmup([n], update_shapes=[(n, 1), (n, 2)],\n"
+        "                 solve_shapes=[(n, 1)])\n"
+        "    c0 = REGISTRY.counter('tpu_jordan_compiles_total').total()\n"
+        "    legs = {}\n"
+        "    t0 = time.perf_counter()\n"
+        "    for name, solver, prob in probs:\n"
+        "        rep = solver(prob, fleet)\n"
+        "        legs[name] = {'iters': rep.iterations,\n"
+        "                      'updates': rep.updates,\n"
+        "                      'solves': rep.solves,\n"
+        "                      'converged': bool(rep.converged),\n"
+        "                      'kkt_rel': rep.kkt_rel_final}\n"
+        "    secs = time.perf_counter() - t0\n"
+        "    dc = (REGISTRY.counter('tpu_jordan_compiles_total')\n"
+        "          .total() - c0)\n"
+        "print(json.dumps({'n': n, 'legs': legs,\n"
+        "                  'seconds': round(secs, 3),\n"
+        "                  'compiles_after_warmup': int(dc)}))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", child], env=_cpu_env(2), cwd=_REPO,
+            capture_output=True, text=True, timeout=timeout, check=True)
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        legs = row["legs"]
+        if not all(leg["converged"] for leg in legs.values()):
+            raise RuntimeError(
+                "driver leg(s) did not converge: " + ", ".join(
+                    name for name, leg in legs.items()
+                    if not leg["converged"]))
+        iters = sum(leg["iters"] for leg in legs.values())
+        extra["lp_demo_iters"] = iters
+        extra["lp_demo_iters_by_leg"] = {name: leg["iters"]
+                                         for name, leg in legs.items()}
+        extra["lp_demo_updates"] = sum(leg["updates"]
+                                       for leg in legs.values())
+        extra["lp_demo_solves"] = sum(leg["solves"]
+                                      for leg in legs.values())
+        extra["lp_demo_seconds"] = row["seconds"]
+        if row["seconds"] > 0:
+            extra["lp_demo_iters_per_s"] = round(iters / row["seconds"],
+                                                 2)
+        extra["lp_demo_compiles_after_warmup"] = row[
+            "compiles_after_warmup"]
+    except Exception as e:                      # noqa: BLE001
+        extra["lp_demo_iters_error"] = str(e)[:200]
+
+
+def _update_batched_row(extra, n=64, cap=4, rounds=5, timeout=900):
+    """ISSUE 17 capture row ``update_batched_amortized``: the batched
+    SMW update lane's warm amortization — ``cap`` distinct resident
+    handles stream rank-1 updates through a warmed
+    :class:`~tpu_jordan.serve.service.JordanService`, first strictly
+    sequentially (the one-per-launch baseline, occupancy 1), then
+    submitted together so the batcher fuses them into one vmapped
+    launch.  Per-update amortized cost = launch ``execute_seconds`` /
+    measured ``batch_occupancy``; the headline
+    ``update_batched_amortized_gflops`` rates it in the 4n²k+2nk²
+    update convention (a RATE key the sentinel pages on, with spread
+    across the per-round medians), and ``update_batched_speedup_x``
+    records the amortization factor EVEN WHEN < 1 — a regressed lane
+    must be visible, never silently dropped.  Zero compiles across the
+    warm measurement is the pin.  Best-effort like every non-contract
+    row."""
+    import subprocess
+    import sys
+
+    from __graft_entry__ import _REPO, _cpu_env
+
+    child = (
+        "import jax, json\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from tpu_jordan.obs.metrics import REGISTRY\n"
+        "from tpu_jordan.serve.service import JordanService\n"
+        f"n, cap, rounds = {n}, {cap}, {rounds}\n"
+        "rng = np.random.default_rng(17)\n"
+        "scale = 1.0 / np.sqrt(float(n))\n"
+        "def med(s):\n"
+        "    s = sorted(s)\n"
+        "    return s[len(s) // 2]\n"
+        "seq_r, bat_r, occs = [], [], []\n"
+        "with JordanService(engine='auto', dtype=jnp.float32,\n"
+        "                   batch_cap=cap, max_wait_ms=25.0) as svc:\n"
+        "    svc.warmup(update_shapes=[(n, 1)])\n"
+        "    refs = [svc.invert((rng.standard_normal((n, n))\n"
+        "                        + n * np.eye(n)).astype(np.float32),\n"
+        "                       resident=True,\n"
+        "                       handle_id='amort-%d' % i, timeout=600)\n"
+        "            for i in range(cap)]\n"
+        "    muts = [(rng.standard_normal((n, 1)).astype(np.float32)\n"
+        "             * scale,\n"
+        "             rng.standard_normal((n, 1)).astype(np.float32)\n"
+        "             * scale) for _ in range(cap)]\n"
+        "    c0 = REGISTRY.counter('tpu_jordan_compiles_total').total()\n"
+        "    for _ in range(rounds):\n"
+        "        lat = []\n"
+        "        for ref, (u, v) in zip(refs, muts):\n"
+        "            lat.append(svc.update(ref, u, v,\n"
+        "                                  timeout=600).execute_seconds)\n"
+        "        seq_r.append(med(lat))\n"
+        "        futs = [svc.submit_update(ref, u, v)\n"
+        "                for ref, (u, v) in zip(refs, muts)]\n"
+        "        res = [f.result(600) for f in futs]\n"
+        "        occs.append(max(r.batch_occupancy for r in res))\n"
+        "        bat_r.append(med([\n"
+        "            r.execute_seconds / r.batch_occupancy\n"
+        "            for r in res]))\n"
+        "    dc = (REGISTRY.counter('tpu_jordan_compiles_total')\n"
+        "          .total() - c0)\n"
+        "seq_s, bat_s = med(seq_r), med(bat_r)\n"
+        "print(json.dumps({'n': n, 'cap': cap, 'rounds': rounds,\n"
+        "    'occupancy': int(max(occs)),\n"
+        "    'one_per_launch_ms': round(seq_s * 1e3, 4),\n"
+        "    'amortized_ms': round(bat_s * 1e3, 4),\n"
+        "    'amortized_s': bat_s,\n"
+        "    'speedup_x': round(seq_s / bat_s, 3),\n"
+        "    'spread_pct': round(\n"
+        "        100.0 * (max(bat_r) - min(bat_r)) / bat_s, 1),\n"
+        "    'compiles_delta': int(dc)}))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", child], env=_cpu_env(8), cwd=_REPO,
+            capture_output=True, text=True, timeout=timeout, check=True)
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        if row["occupancy"] <= 1:
+            raise RuntimeError(
+                f"batched lane never fused: occupancy "
+                f"{row['occupancy']}")
+        from tpu_jordan.obs import hwcost as _hwcost
+
+        flops = _hwcost.baseline_workload_flops(n, "update", k=1)
+        extra["update_batched_amortized_gflops"] = round(
+            flops / row["amortized_s"] / 1e9, 4)
+        extra["update_batched_amortized_spread_pct"] = row["spread_pct"]
+        if row["spread_pct"] >= 10.0:
+            extra["update_batched_amortized_variance_flag"] = (
+                "high_spread")
+        extra["update_batched_one_per_launch_ms"] = row[
+            "one_per_launch_ms"]
+        extra["update_batched_amortized_ms"] = row["amortized_ms"]
+        extra["update_batched_speedup_x"] = row["speedup_x"]
+        extra["update_batched_occupancy"] = row["occupancy"]
+        extra["update_batched_flops_convention"] = "4n^2k + 2nk^2"
+        extra["update_batched_compiles_delta"] = row["compiles_delta"]
+    except Exception as e:                      # noqa: BLE001
+        extra["update_batched_amortized_error"] = str(e)[:200]
+
+
 def _dip_guard(extra, candidates):
     """The r04→r05 4096² regression guard (ISSUE 6 satellite; `make
     bench-dip` reproduces just this row).  The best 4096² capture of
@@ -1222,6 +1455,14 @@ def main(argv=None):
     # (tools/check_bench.py) watches both *_gflops keys with their
     # spread stats from the round they first land.
     _update_rows(extra)
+
+    # LP/QP driver tiers (ISSUE 17): the optimization-driver workload
+    # context row (iteration/update/solve counts — never rate-compared)
+    # and the batched-update-lane amortization row (the *_gflops rate
+    # the sentinel pages on; speedup recorded even when < 1).
+    # Best-effort like every non-contract row.
+    _lp_demo_row(extra)
+    _update_batched_row(extra)
 
     # Sharded-output tier: swapfree × gather=False (bucketed ppermute),
     # best-effort — a failure records an error key, never loses the

@@ -518,3 +518,126 @@ class TestLookaheadSentinel:
             "solve_lookahead_sharded_4096_k8_gflops": 120.0,
             "solve_lookahead_sharded_4096_k8_spread_pct": 2.0}))
         assert check_bench.main(files) == 2
+
+
+class TestLpqpRows:
+    """ISSUE 17 satellites, trapped both ways: the multi-RHS blocking
+    sweep's per-k rate keys and the batched-update amortization rate
+    page on quiet shortfalls; the LP/QP driver context row (iteration
+    counts, wall seconds, speedup factor, latencies) and the sweep's
+    per-k accounting keys are never rate-compared."""
+
+    def test_k_sweep_quiet_regression_pages(self, tmp_path):
+        """A quiet shortfall on one leg of the k sweep
+        (``solve_sharded_4096_k32_gflops``) is the exit-2 class — each
+        block width is its own like-for-like key."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "solve_sharded_4096_k1_gflops": 60.0,
+                "solve_sharded_4096_k1_spread_pct": 2.0,
+                "solve_sharded_4096_k32_gflops": 140.0,
+                "solve_sharded_4096_k32_spread_pct": 2.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "solve_sharded_4096_k1_gflops": 59.0,
+                "solve_sharded_4096_k1_spread_pct": 2.0,
+                "solve_sharded_4096_k32_gflops": 90.0,
+                "solve_sharded_4096_k32_spread_pct": 2.0})),
+        ]
+        assert check_bench.main(files) == 2
+
+    def test_k_sweep_accounting_and_variance_rules(self, tmp_path):
+        """The sweep's per-k ``*_comm_bytes``/``*_xla_flops`` never
+        page (accounting / raw counts); a per-k ``*_comm_gbps`` dip is
+        explained by the leg's own spread via the fuzzy sibling
+        lookup, and pages when the session was quiet."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "solve_sharded_4096_k32_comm_bytes": 3.4e9,
+                "solve_sharded_4096_k32_xla_flops": 2.2e12,
+                "solve_sharded_4096_k1_comm_gbps": 3.5,
+                "solve_sharded_4096_k1_spread_pct": 2.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "solve_sharded_4096_k32_comm_bytes": 3.4e8,
+                "solve_sharded_4096_k32_xla_flops": 2.2e11,
+                "solve_sharded_4096_k1_comm_gbps": 2.0,
+                "solve_sharded_4096_k1_spread_pct": 30.0})),
+        ]
+        assert check_bench.main(files) == 0
+        assert check_bench.is_accounting_key(
+            "solve_sharded_4096_k32_comm_bytes")
+        keys = check_bench.comparable_keys(
+            {"metric": "m", "value": 1.0,
+             "extra": {"solve_sharded_4096_k32_comm_bytes": 3.4e9,
+                       "solve_sharded_4096_k32_xla_flops": 2.2e12,
+                       "solve_sharded_4096_k1_comm_gbps": 3.5,
+                       "solve_sharded_4096_k32_gflops": 140.0}})
+        assert "solve_sharded_4096_k32_comm_bytes" not in keys
+        assert "solve_sharded_4096_k32_xla_flops" not in keys
+        assert "solve_sharded_4096_k1_comm_gbps" in keys
+        assert "solve_sharded_4096_k32_gflops" in keys
+        files[1] = _write(tmp_path, "r2b.json", _round(10000.0, {
+            "solve_sharded_4096_k1_comm_gbps": 2.0,
+            "solve_sharded_4096_k1_spread_pct": 2.0}))
+        assert check_bench.main(files) == 2
+
+    def test_update_batched_quiet_regression_pages(self, tmp_path):
+        """ISSUE 17 satellite, trapped both ways (1/2): a quiet
+        shortfall on ``update_batched_amortized_gflops`` — the batched
+        update lane's warm amortized rate — is the exit-2 class."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "update_batched_amortized_gflops": 0.09,
+                "update_batched_amortized_spread_pct": 2.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "update_batched_amortized_gflops": 0.05,
+                "update_batched_amortized_spread_pct": 2.0})),
+        ]
+        assert check_bench.main(files) == 2
+
+    def test_update_batched_variance_explains_its_dip(self, tmp_path):
+        """The tiny-launch row IS jittery on a shared CPU host — its
+        own high spread (or variance_flag) must explain the dip."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "update_batched_amortized_gflops": 0.09,
+                "update_batched_amortized_spread_pct": 2.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "update_batched_amortized_gflops": 0.05,
+                "update_batched_amortized_spread_pct": 89.0,
+                "update_batched_amortized_variance_flag":
+                    "high_spread"})),
+        ]
+        assert check_bench.main(files) == 0
+
+    def test_lp_demo_context_rows_never_page(self, tmp_path):
+        """ISSUE 17 satellite, trapped both ways (2/2): the LP/QP
+        driver context row is counts/seconds/speedups — none are rate
+        keys, so a halved iteration count or a sub-1.0 speedup factor
+        (recorded, per the ISSUE, even when < 1) never pages."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "lp_demo_iters": 120, "lp_demo_seconds": 0.4,
+                "lp_demo_iters_per_s": 300.0,
+                "update_batched_speedup_x": 2.5,
+                "update_batched_one_per_launch_ms": 0.36,
+                "update_batched_amortized_ms": 0.14,
+                "invert_4096_spread_pct": 1.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "lp_demo_iters": 60, "lp_demo_seconds": 4.0,
+                "lp_demo_iters_per_s": 15.0,
+                "update_batched_speedup_x": 0.8,
+                "update_batched_one_per_launch_ms": 0.36,
+                "update_batched_amortized_ms": 0.45,
+                "invert_4096_spread_pct": 1.0})),
+        ]
+        assert check_bench.main(files) == 0
+        keys = check_bench.comparable_keys(
+            {"metric": "m", "value": 1.0,
+             "extra": {"lp_demo_iters": 120,
+                       "lp_demo_iters_per_s": 300.0,
+                       "lp_demo_seconds": 0.4,
+                       "update_batched_speedup_x": 2.5,
+                       "update_batched_amortized_ms": 0.14,
+                       "update_batched_amortized_gflops": 0.09}})
+        assert keys == {"m": 1.0,
+                        "update_batched_amortized_gflops": 0.09}

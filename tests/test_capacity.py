@@ -552,7 +552,7 @@ class TestServeAdmission:
             proj = svc.project_capacity(update_shapes=[(48, 8)])
         assert compiles.total() == c0
         assert set(proj) == {"invert:64:b4", "invert:64:b1",
-                             "update:64:b1:k8"}
+                             "update:64:b1:k8", "update:64:b4:k8"}
         assert all(v > 0 for v in proj.values())
         g = REGISTRY.gauge("tpu_jordan_capacity_projected_lane_bytes")
         assert g.value(lane="update:64:b1:k8") == proj["update:64:b1:k8"]

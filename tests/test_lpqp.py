@@ -1,0 +1,439 @@
+"""ISSUE 17 — the LP/QP optimization-driver subsystem (tpu_jordan/lpqp).
+
+The contract under test, per the ISSUE's coverage satellite:
+
+  * the seeded instance generators carry EXACT optimality certificates
+    (the constructed x*/y* zero the KKT residual) and are
+    deterministic like every other fixture;
+  * a tiny LP round-trips through a warmed fleet — one
+    ``invert(resident=True)`` + a rank-1 update per pivot + periodic
+    verification solves — converging under the solver's OWN eps·n·κ
+    gate with ZERO compiles after warmup (smoke tier);
+  * a zero drift budget routes EVERY update through the ``re_invert``
+    rung and the driver still converges, with the journey/recorder
+    causality pinned (each rung's recorded breadcrumb is preceded by
+    its drift-budget gate-failure event);
+  * a seeded ``replica_kill`` mid-optimization leaves the per-iterate
+    outcome stream and the final solution fingerprint BIT-IDENTICAL to
+    the fault-free replay;
+  * the batched update lane (ISSUE 17 tentpole part 3) fuses riders to
+    distinct handles into one vmapped launch (occupancy > 1, per-rider
+    verified results) and refuses mixed-bucket/dtype riders with the
+    typed ``MixedUpdateBatchError`` — batch-mates untouched;
+  * ``lp_demo``'s report validates clean through tools/check_lp.py and
+    doctored-silent variants exit 2 (the both-ways checker
+    discipline); misapplied ``--lp-demo`` CLI flags are typed
+    UsageErrors (exit 1).
+
+Heavy parametrizations are slow-marked with named fast siblings
+(``test_lp_heavy_families_slow`` ↔ ``test_lp_ill_converges``,
+``test_replica_kill_bitmatch_heavy_slow`` ↔
+``test_replica_kill_bitmatches_fault_free``) so tier-1 stays inside
+its budget.
+"""
+
+import copy
+import importlib.util
+import json
+import pathlib
+from concurrent.futures import Future
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_jordan.fleet import JordanFleet
+from tpu_jordan.lpqp import (OptimizeError, lp_instance, lp_kkt_residual,
+                             qp_instance, qp_kkt_residual, solve_lp,
+                             solve_qp)
+from tpu_jordan.obs.metrics import REGISTRY
+from tpu_jordan.resilience import FaultPlan, ResiliencePolicy
+from tpu_jordan.resilience import activate as _activate
+from tpu_jordan.resilience.policy import RetryPolicy
+
+_repo = pathlib.Path(__file__).resolve().parent.parent
+
+
+def _fleet(replicas=2, **kw):
+    """A small, fast LP/QP-shaped fleet: float64 (the drivers' pricing
+    tolerances assume it), cap-1 lanes, short stabilization."""
+    kw.setdefault("engine", "auto")
+    kw.setdefault("dtype", jnp.float64)
+    kw.setdefault("batch_cap", 1)
+    kw.setdefault("max_wait_ms", 0.5)
+    kw.setdefault("stable_after_s", 0.2)
+    kw.setdefault("liveness_deadline_s", 5.0)
+    kw.setdefault("policy", ResiliencePolicy(
+        retry=RetryPolicy(max_retries=4, backoff_s=0.0)))
+    return JordanFleet(replicas=replicas, **kw)
+
+
+def _warm(fleet, n, ks=(1,)):
+    """Warm the driver's lanes; LP needs only the rank-1 update lane,
+    QP's bound toggles ride rank-2 as well (ks=(1, 2))."""
+    fleet.warmup([n], update_shapes=[(n, k) for k in ks],
+                 solve_shapes=[(n, 1)])
+
+
+def _compiles():
+    return REGISTRY.counter("tpu_jordan_compiles_total").total()
+
+
+def _assert_accounted(rep):
+    assert sum(rep.ledger.values()) == rep.updates
+    for r in rep.iterates:
+        if "solve_rel" in r:
+            assert r["solve_pass"], r
+            assert r["agree"], r
+
+
+class TestProblemFixtures:
+    def test_lp_certificate_exact(self):
+        """The constructed vertex IS the optimum: the dual certificate
+        y recovered from the optimal (G) basis zeroes the KKT residual
+        to rounding."""
+        for cond, tol in (("well", 1e-12), ("ill", 1e-9)):
+            prob = lp_instance(m=8, seed=3, cond=cond)
+            g = prob.a[:, :prob.m]
+            y = np.linalg.solve(g.T, prob.c[:prob.m])
+            assert lp_kkt_residual(prob, prob.x_star, y) < tol
+            assert np.all(prob.b > 0)            # slack start feasible
+            assert prob.basis0 == tuple(range(prob.m, prob.n))
+
+    def test_qp_certificate_exact(self):
+        for cond in ("well", "ill"):
+            prob = qp_instance(n=10, seed=3, cond=cond)
+            assert qp_kkt_residual(prob, prob.x_star) < 1e-12
+            # SPD by construction.
+            assert np.linalg.eigvalsh(prob.q).min() > 0
+
+    def test_deterministic_and_seed_sensitive(self):
+        a = lp_instance(m=6, seed=9, cond="ill")
+        b = lp_instance(m=6, seed=9, cond="ill")
+        assert a.a.tobytes() == b.a.tobytes()
+        assert a.c.tobytes() == b.c.tobytes()
+        assert a.name == b.name
+        c = lp_instance(m=6, seed=10, cond="ill")
+        assert a.a.tobytes() != c.a.tobytes()
+        qa = qp_instance(n=6, seed=9)
+        qb = qp_instance(n=6, seed=9)
+        assert qa.q.tobytes() == qb.q.tobytes()
+
+    def test_validation_typed(self):
+        with pytest.raises(ValueError):
+            lp_instance(m=8, cond="medium")
+        with pytest.raises(ValueError):
+            lp_instance(m=1)
+        with pytest.raises(ValueError):
+            qp_instance(n=1)
+
+
+class TestLpDriver:
+    @pytest.mark.smoke   # the LP round-trip through the fleet (smoke)
+    def test_lp_round_trip_smoke(self):
+        """Tiny LP through a warmed 2-replica fleet: converges under
+        the solver's own gate, zero compiles after warmup, every
+        update accounted, objective at the constructed optimum."""
+        n = 8
+        prob = lp_instance(m=n, seed=0, cond="well")
+        with _fleet() as fleet:
+            _warm(fleet, n)
+            c0 = _compiles()
+            rep = solve_lp(prob, fleet)
+            assert _compiles() == c0          # zero compiles after warmup
+            ledger = fleet.stats()["ledger"]
+        assert rep.converged
+        assert rep.kkt_rel_final <= rep.kkt_threshold
+        assert rep.updates > 0 and rep.solves > 0
+        _assert_accounted(rep)
+        assert abs(rep.objective - prob.obj_star) <= (
+            1e-8 * (1.0 + abs(prob.obj_star)))
+        assert ledger["outstanding"] == 0
+
+    def test_lp_ill_converges(self):
+        """Fast sibling of ``test_lp_heavy_families_slow``: the
+        ill-conditioned family at m=8 converges through the same
+        fleet path."""
+        prob = lp_instance(m=8, seed=0, cond="ill")
+        with _fleet() as fleet:
+            _warm(fleet, 8)
+            rep = solve_lp(prob, fleet)
+        assert rep.converged
+        _assert_accounted(rep)
+
+    @pytest.mark.slow  # heavy parametrization; fast sibling: test_lp_ill_converges
+    @pytest.mark.parametrize("m,cond", [(24, "well"), (24, "ill")])
+    def test_lp_heavy_families_slow(self, m, cond):
+        prob = lp_instance(m=m, seed=1, cond=cond)
+        with _fleet() as fleet:
+            _warm(fleet, m)
+            rep = solve_lp(prob, fleet, solve_every=4)
+        assert rep.converged
+        _assert_accounted(rep)
+        assert abs(rep.objective - prob.obj_star) <= (
+            1e-7 * (1.0 + abs(prob.obj_star)))
+
+    def test_iteration_cap_typed_with_report(self):
+        prob = lp_instance(m=8, seed=0, cond="well")
+        with _fleet() as fleet:
+            _warm(fleet, 8)
+            with pytest.raises(OptimizeError) as ei:
+                solve_lp(prob, fleet, max_iters=1)
+        rep = ei.value.report
+        assert rep is not None and not rep.converged
+        assert rep.iterations == 1 and len(rep.iterates) == 1
+
+
+class TestQpDriver:
+    def test_qp_round_trip(self):
+        n = 8
+        prob = qp_instance(n=n, seed=0, cond="well")
+        with _fleet() as fleet:
+            _warm(fleet, n, ks=(1, 2))
+            c0 = _compiles()
+            rep = solve_qp(prob, fleet)
+            assert _compiles() == c0
+        assert rep.converged
+        assert rep.updates > 0            # rank-2 toggles rode the lane
+        _assert_accounted(rep)
+        assert np.max(np.abs(rep.x - prob.x_star)) < 1e-6
+        assert abs(rep.objective - prob.obj_star) <= (
+            1e-8 * (1.0 + abs(prob.obj_star)))
+
+    @pytest.mark.slow  # the ill QP family also runs inside the demo-checker test's lp_demo legs; fast sibling: test_qp_round_trip
+    def test_qp_ill_converges(self):
+        prob = qp_instance(n=8, seed=0, cond="ill")
+        with _fleet() as fleet:
+            _warm(fleet, 8, ks=(1, 2))
+            rep = solve_qp(prob, fleet)
+        assert rep.converged
+        _assert_accounted(rep)
+
+
+class TestDriftCausality:
+    def test_zero_budget_re_inverts_with_causality(self):
+        """Drift-budget crossing mid-loop (ISSUE 17 satellite): with a
+        ZERO budget every update trips ``re_invert``, the driver still
+        converges on the recovered inverses, and the flight recorder
+        pins the causality — each ``recovery_rung`` breadcrumb is
+        preceded (by seq) by its own drift-budget
+        ``residual_gate_failure``, and the journeys carry the
+        ``re_inverted`` outcome hop."""
+        from tpu_jordan.obs.recorder import RECORDER
+
+        n = 8
+        prob = lp_instance(m=n, seed=0, cond="well")
+        rungs = REGISTRY.counter("tpu_jordan_recovery_rungs_total")
+        with _fleet(update_drift_budget_factor=0.0) as fleet:
+            _warm(fleet, n)
+            mark = RECORDER.total
+            r0 = rungs.total()
+            rep = solve_lp(prob, fleet)
+        assert rep.converged
+        assert rep.ledger["re_inverted"] == rep.updates > 0
+        assert rep.ledger["refreshed"] == 0
+        assert rungs.total() - r0 == rep.updates
+        events = RECORDER.since(mark)
+        gate_seqs = sorted(
+            e["seq"] for e in events
+            if e["kind"] == "residual_gate_failure"
+            and e.get("workload") == "update"
+            and e.get("cause") == "drift_budget")
+        rung_seqs = sorted(
+            e["seq"] for e in events
+            if e["kind"] == "recovery_rung"
+            and e.get("rung") == "re_invert"
+            and e.get("workload") == "update")
+        assert len(rung_seqs) == rep.updates
+        assert len(gate_seqs) == rep.updates
+        # Causality: the i-th rung is preceded by the i-th crossing.
+        assert all(g < r for g, r in zip(gate_seqs, rung_seqs))
+        hops = [e for e in events if e["kind"] == "journey"
+                and e.get("event") == "update"]
+        assert sum(e.get("outcome") == "re_inverted"
+                   for e in hops) == rep.updates
+
+
+class TestChaosBitmatch:
+    def _run(self, prob, plan=None, replicas=3, kills_expected=0):
+        faults = REGISTRY.counter("tpu_jordan_faults_injected_total")
+        f0 = faults.total()
+        with _fleet(replicas=replicas,
+                    policy=ResiliencePolicy(retry=RetryPolicy(
+                        max_retries=6, backoff_s=0.0))) as fleet:
+            _warm(fleet, prob.m)
+            if plan is not None:
+                with _activate(plan):
+                    rep = solve_lp(prob, fleet)
+            else:
+                rep = solve_lp(prob, fleet)
+        assert faults.total() - f0 >= kills_expected
+        return rep
+
+    def test_replica_kill_bitmatches_fault_free(self):
+        """Fast sibling of ``test_replica_kill_bitmatch_heavy_slow``:
+        one seeded kill mid-optimization; outcome stream + final
+        fingerprint bit-match the fault-free replay."""
+        n = 8
+        prob = lp_instance(m=n, seed=0, cond="ill")
+        base = self._run(prob)
+        plan = FaultPlan.seeded(
+            0, points={"replica_kill": (1, max(3, 2 * n))})
+        chaos = self._run(prob, plan=plan, kills_expected=1)
+        assert base.converged and chaos.converged
+        tok = lambda rep: [(r.get("outcome"), r.get("version"),  # noqa: E731
+                            r["kkt_hex"]) for r in rep.iterates]
+        assert tok(base) == tok(chaos)
+        assert base.fingerprint == chaos.fingerprint != ""
+
+    @pytest.mark.slow  # heavy chaos parametrization; fast sibling: test_replica_kill_bitmatches_fault_free
+    def test_replica_kill_bitmatch_heavy_slow(self):
+        n = 16
+        prob = lp_instance(m=n, seed=2, cond="ill")
+        base = self._run(prob)
+        plan = FaultPlan.seeded(
+            2, points={"replica_kill": (2, max(3, 2 * n))})
+        chaos = self._run(prob, plan=plan, kills_expected=2)
+        assert base.fingerprint == chaos.fingerprint != ""
+        assert len(base.iterates) == len(chaos.iterates)
+
+
+class TestBatchedUpdateLane:
+    def test_fused_launch_occupancy_and_parity(self):
+        """Riders to DISTINCT handles share one vmapped launch
+        (occupancy > 1), each re-verified in-launch; results match the
+        fresh inverse of each mutated matrix; warm pin holds."""
+        from tpu_jordan.serve.service import JordanService
+
+        n, cap = 16, 3
+        rng = np.random.default_rng(5)
+        mats = [(rng.standard_normal((n, n))
+                 + n * np.eye(n)).astype(np.float32)
+                for _ in range(cap)]
+        muts = [(rng.standard_normal((n, 1)).astype(np.float32) * 0.1,
+                 rng.standard_normal((n, 1)).astype(np.float32) * 0.1)
+                for _ in range(cap)]
+        with JordanService(batch_cap=cap, max_wait_ms=25.0,
+                           dtype=jnp.float32) as svc:
+            svc.warmup(update_shapes=[(n, 1)])
+            refs = [svc.invert(a, resident=True, handle_id=f"h{i}",
+                               timeout=120)
+                    for i, a in enumerate(mats)]
+            c0 = _compiles()
+            futs = [svc.submit_update(ref, u, v)
+                    for ref, (u, v) in zip(refs, muts)]
+            res = [f.result(120) for f in futs]
+            assert _compiles() == c0
+        assert max(r.batch_occupancy for r in res) > 1
+        for r, a, (u, v) in zip(res, mats, muts):
+            assert r.update_outcome in ("refreshed", "re_inverted")
+            assert not r.singular
+            want = np.linalg.inv(a + u @ v.T)
+            assert np.abs(np.asarray(r.inverse) - want).max() < 1e-3
+
+    def test_mixed_rider_refused_typed_batchmates_untouched(self):
+        """Direct batcher misuse — a rider whose padded factors do not
+        match the lane's (bucket, k_bucket, dtype) — is refused with
+        the typed MixedUpdateBatchError; the conforming batch-mate in
+        the SAME batch still resolves."""
+        import time
+
+        from tpu_jordan.serve.batcher import (MixedUpdateBatchError,
+                                              _Request)
+        from tpu_jordan.serve.executors import bucket_for, k_bucket_for
+        from tpu_jordan.serve.service import JordanService
+
+        n = 16
+        rng = np.random.default_rng(6)
+        a = (rng.standard_normal((n, n)) + n * np.eye(n)).astype(
+            np.float32)
+        u = rng.standard_normal((n, 1)).astype(np.float32) * 0.1
+        v = rng.standard_normal((n, 1)).astype(np.float32) * 0.1
+        with JordanService(batch_cap=2, max_wait_ms=5.0,
+                           dtype=jnp.float32) as svc:
+            svc.warmup(update_shapes=[(n, 1)])
+            ref = svc.invert(a, resident=True, timeout=120)
+            bucket, kb = bucket_for(n), k_bucket_for(1)
+            pad = np.zeros((bucket, kb), np.float32)
+            pu, pv = pad.copy(), pad.copy()
+            pu[:n, :1], pv[:n, :1] = u, v
+            now = time.perf_counter()
+
+            def req(fu, fv):
+                return _Request(
+                    padded=None, n=n, bucket_n=bucket, t_enqueue=now,
+                    future=Future(), workload="update", rhs=kb, k=1,
+                    handle=ref, padded_u=fu, padded_v=fv)
+
+            bad = req(pu.astype(np.float64), pv.astype(np.float64))
+            good = req(pu, pv)
+            svc._batcher._execute_updates(("update", bucket, kb),
+                                          [bad, good], now)
+            err = bad.future.exception(timeout=120)
+            assert isinstance(err, MixedUpdateBatchError)
+            assert isinstance(err, TypeError)    # typed, catchable
+            res = good.future.result(timeout=120)
+            assert res.update_outcome in ("refreshed", "re_inverted")
+            want = np.linalg.inv(a + u @ v.T)
+            assert np.abs(np.asarray(res.inverse) - want).max() < 1e-3
+
+
+class TestLpDemoAndChecker:
+    def test_demo_report_valid_and_doctored_exits(self, tmp_path):
+        """Both-ways gate (the repo's checker discipline): a real
+        small-scale lp_demo report validates clean through
+        tools/check_lp.py, and doctored-silent variants — a residual
+        bit mismatch, an unaccounted update, a diverged chaos
+        fingerprint — each exit 2; a dead batched lane exits 1."""
+        from tpu_jordan.lpqp.demo import lp_demo
+
+        spec = importlib.util.spec_from_file_location(
+            "check_lp", _repo / "tools" / "check_lp.py")
+        check_lp = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(check_lp)
+
+        report = lp_demo(n=8, replicas=2, kills=1, batch_cap=2)
+        errs, stale = check_lp.check(report)
+        assert errs == [] and stale == [], (errs, stale)
+        assert not report["silent_divergence"]
+        assert report["batched"]["occupancy"] > 1
+        assert report["chaos"]["fingerprint_bitmatch"]
+
+        def rc(rep, name):
+            p = tmp_path / name
+            p.write_text(json.dumps(rep))
+            return check_lp.main([str(p)])
+
+        assert rc(report, "ok.json") == 0
+        d1 = copy.deepcopy(report)                 # doctored residual
+        it = d1["legs"]["lp_well"]["iterates"][-1]
+        it["kkt_hex"] = float(it["kkt_rel"] * 3.0).hex()
+        assert rc(d1, "hex.json") == 2
+        d2 = copy.deepcopy(report)                 # unaccounted update
+        d2["legs"]["qp_well"]["ledger"]["refreshed"] += 1
+        assert rc(d2, "ledger.json") == 2
+        d3 = copy.deepcopy(report)                 # silent chaos drift
+        d3["chaos"]["fingerprint_bitmatch"] = False
+        assert rc(d3, "chaos.json") == 2
+        d4 = copy.deepcopy(report)                 # lane never fused
+        d4["batched"]["occupancy"] = 1
+        assert rc(d4, "occ.json") == 1
+
+    def test_cli_misapplied_flags_typed_exit_1(self):
+        from tpu_jordan.__main__ import main
+
+        base = ["16", "8", "--lp-demo", "--dtype", "float64", "--quiet"]
+        assert main(base + ["--workers", "8"]) == 1
+        assert main(base + ["--serve-requests", "32"]) == 1
+        assert main(base + ["--batch", "4"]) == 1
+        assert main(base + ["--engine", "jordan"]) == 1
+        assert main(base + ["--workload", "solve"]) == 1
+        assert main(base + ["--numerics", "summary"]) == 1
+        assert main(base + ["--slo-report"]) == 1
+        assert main(base + ["--scaling-floor", "2.0"]) == 1
+        assert main(base + ["--replicas", "1"]) == 1
+        assert main(base + ["--kills", "0"]) == 1
+        assert main(base + ["--batch-cap", "1"]) == 1
+        # Bland pricing needs f64 reduced costs: f32 refused typed.
+        assert main(["16", "8", "--lp-demo", "--dtype", "float32",
+                     "--quiet"]) == 1

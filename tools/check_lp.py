@@ -1,0 +1,299 @@
+#!/usr/bin/env python
+"""Validate an ``--lp-demo`` report (ISSUE 17 CI satellite) — the
+LP/QP-driver analogue of ``check_update.py``.
+
+Usage: ``python tools/check_lp.py report.json [...]`` (or ``-`` for
+stdin).  No jax import — this is the ``make lp-demo`` gate and runs
+anywhere.  Exit codes: 0 = valid, 1 = bound/structure violations,
+2 = SILENT DIVERGENCE (the alarm that must never be downgraded): a
+driver that claims convergence its own iterate residuals cannot
+re-derive, an update the ledger cannot account for as
+``refreshed | re_inverted | gated`` or a typed error, a verification
+solve whose agreement with the resident inverse failed without a typed
+outcome, or a chaos run that did not bit-match the fault-free replay.
+
+What a valid lp_demo report must prove (docs/WORKLOADS.md):
+
+  * **convergence is re-derivable** — for every leg, the final
+    iterate's KKT residual is finite, bit-identical to its own hex
+    trace token, equal to the reported ``kkt_rel_final``, and at or
+    below the reported solver-gate threshold whenever the leg claims
+    ``converged`` (the checker never re-runs the solver — it re-judges
+    the report's own numbers, so a doctored residual or flag cannot
+    pass);
+  * **every update accounted** — per leg, the outcome ledger sums
+    exactly to the update count, the per-iterate outcome stream
+    agrees with the ledger tally, and the objective matches the
+    instance's constructed optimum to within the gate-scaled bound;
+  * **verification solves agree** — every iterate that carries a
+    verification solve passes the solve lane's κ-free gate AND the
+    κ-scaled agreement test against the resident inverse's answer;
+  * **the degradation ladder is real** — the zero-drift-budget probe
+    re_inverted EVERY update (>= that many rungs fired) and still
+    converged;
+  * **the warm path is free** — ZERO compiles and ZERO plan-cache
+    measurements after warmup on the driver legs, the chaos pass, and
+    the batched-lane measurement;
+  * **chaos proved durability** — >= 1 seeded ``replica_kill`` fired
+    mid-run, every per-iteration outcome tuple matched the fault-free
+    replay, and the final solution fingerprints are bit-identical;
+  * **batching amortizes** — measured occupancy > 1 on the batched
+    update lane, and the warm amortized per-update latency beats the
+    one-per-launch path (the speedup is recorded either way; the demo
+    acceptance requires > 1).
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import sys
+
+OUTCOMES = ("refreshed", "re_inverted", "gated")
+
+#: objective-vs-certificate slack: the driver's forward error scales
+#: with the same eps·n·κ model the gate encodes; 1e3x covers the
+#: constant the model hides without ever passing a wrong vertex.
+OBJ_GATE_FACTOR = 1e3
+
+
+def _ledger_total(ledger: dict) -> int:
+    return sum(int(ledger.get(k, 0)) for k in OUTCOMES + ("error",))
+
+
+def _check_leg(name: str, leg: dict, errs: list, stale: list) -> None:
+    """Re-derive one driver leg's claims from its own iterate trail."""
+    iterates = leg.get("iterates", [])
+    if not iterates:
+        stale.append(f"{name}: no iterate trail — convergence is "
+                     f"unverifiable")
+        return
+    last = iterates[-1]
+    kkt = last.get("kkt_rel")
+    thr = last.get("kkt_threshold")
+    try:
+        hex_rel = float.fromhex(last.get("kkt_hex", ""))
+    except (TypeError, ValueError):
+        hex_rel = None
+    if hex_rel is None or hex_rel != kkt:
+        stale.append(f"{name}: final kkt_rel {kkt} does not bit-match "
+                     f"its own hex trace token {last.get('kkt_hex')!r}")
+    if leg.get("kkt_rel_final") != kkt:
+        stale.append(f"{name}: reported kkt_rel_final "
+                     f"{leg.get('kkt_rel_final')} != final iterate "
+                     f"residual {kkt} — the summary drifted from its "
+                     f"own trail")
+    converged = bool(leg.get("converged", False))
+    rederived = (isinstance(kkt, float) and isinstance(thr, float)
+                 and math.isfinite(kkt) and kkt <= thr)
+    if converged and not rederived:
+        stale.append(f"{name}: claims converged but the final iterate "
+                     f"residual ({kkt}) does not pass its own gate "
+                     f"({thr}) — silent divergence")
+    if not converged:
+        errs.append(f"{name}: driver did not converge")
+
+    # Every update accounted, and the iterate stream agrees with the
+    # ledger it claims to summarize.
+    ledger = leg.get("ledger", {})
+    updates = int(leg.get("updates", -1))
+    total = _ledger_total(ledger)
+    if total != updates:
+        stale.append(f"{name}: ledger accounts {total} of {updates} "
+                     f"updates ({ledger}) — an update went silently "
+                     f"unaccounted")
+    tally = {}
+    for r in iterates:
+        if "outcome" in r:
+            tally[r["outcome"]] = tally.get(r["outcome"], 0) + 1
+    for o in OUTCOMES:
+        if tally.get(o, 0) != int(ledger.get(o, 0)):
+            stale.append(f"{name}: iterate outcome stream counts "
+                         f"{tally} but the ledger says {ledger} — the "
+                         f"ledger drifted from its own trail")
+            break
+
+    # Verification solves: the κ-free solve gate and the κ-scaled
+    # agreement both re-judged from the recorded numbers.
+    solves = 0
+    for r in iterates:
+        if "solve_rel" not in r:
+            continue
+        solves += 1
+        if not (math.isfinite(r["solve_rel"])
+                and r["solve_rel"] <= r.get("solve_threshold",
+                                            float("nan"))):
+            stale.append(f"{name} iterate {r.get('i')}: verification "
+                         f"solve failed its own gate "
+                         f"(rel {r['solve_rel']} vs "
+                         f"{r.get('solve_threshold')})")
+        if not (math.isfinite(r.get("agree_rel", float("nan")))
+                and r["agree_rel"] <= r.get("agree_threshold",
+                                            float("nan"))):
+            stale.append(f"{name} iterate {r.get('i')}: resident "
+                         f"inverse disagrees with the fresh solve "
+                         f"beyond what κ explains "
+                         f"(rel {r.get('agree_rel')} vs "
+                         f"{r.get('agree_threshold')}) — a silently "
+                         f"rotten inverse")
+    if solves != int(leg.get("solves", -1)):
+        errs.append(f"{name}: {solves} verification solves in the "
+                    f"trail but the summary claims {leg.get('solves')}")
+
+    # The certificate check: the instance carries its constructed
+    # optimum; the reached objective must match it to the gate-scaled
+    # bound (a wrong vertex/active-set converges the KKT residual too,
+    # but not the objective).
+    obj, ref = leg.get("objective"), leg.get("objective_ref")
+    if (isinstance(obj, float) and isinstance(ref, float)
+            and isinstance(thr, float) and math.isfinite(thr)):
+        rel = abs(obj - ref) / (1.0 + abs(ref))
+        bound = max(1e-8, OBJ_GATE_FACTOR * thr)
+        if converged and not rel <= bound:
+            stale.append(f"{name}: converged objective {obj} misses "
+                         f"the instance certificate {ref} (rel {rel:.3e}"
+                         f" > {bound:.3e}) — converged to the wrong "
+                         f"point")
+    else:
+        errs.append(f"{name}: objective/certificate fields missing or "
+                    f"non-numeric")
+
+
+def check(report: dict) -> tuple[list[str], list[str]]:
+    """Return (violations, divergence_violations); both empty = valid."""
+    errs: list[str] = []
+    stale: list[str] = []
+    if report.get("metric") != "lp_demo":
+        return ([f"not an lp_demo report (metric="
+                 f"{report.get('metric')!r})"], [])
+
+    legs = report.get("legs", {})
+    for required in ("lp_well", "lp_ill", "qp_well", "qp_ill"):
+        if required not in legs:
+            errs.append(f"missing driver leg {required!r}")
+    for name, leg in legs.items():
+        _check_leg(name, leg, errs, stale)
+    if "errors" not in report:
+        errs.append("missing 'errors' field")
+    for msg in report.get("errors", []):
+        stale.append(f"typed driver failure mid-demo: {msg}")
+
+    # ---- warm-path pins --------------------------------------------
+    if report.get("compiles_after_warmup", 1) != 0:
+        stale.append(f"{report.get('compiles_after_warmup')} "
+                     f"compile(s) on the warm driver path — the "
+                     f"zero-compile pin broke")
+    if report.get("measurements_after_warmup", 1) != 0:
+        errs.append(f"{report.get('measurements_after_warmup')} "
+                    f"plan-cache measurement(s) on the driver path")
+
+    # ---- the degradation ladder ------------------------------------
+    probe = report.get("drift_probe", {})
+    p_updates = int(probe.get("updates", 0))
+    if (p_updates < 1
+            or int(probe.get("ledger", {}).get("re_inverted", 0))
+            != p_updates
+            or probe.get("rungs_fired", 0) < p_updates):
+        errs.append(f"the zero-drift-budget probe did not re_invert "
+                    f"every update ({probe}) — the ladder is unproven")
+    if not probe.get("converged", False):
+        stale.append("the zero-drift-budget probe did not converge — "
+                     "the re_invert rung handed the driver a bad "
+                     "inverse")
+
+    # ---- chaos durability (the exit-2 class) ------------------------
+    chaos = report.get("chaos", {})
+    if chaos.get("kills_injected", 0) < 1:
+        errs.append("no replica_kill injected mid-run — the chaos leg "
+                    "was vacuous")
+    if chaos.get("deaths", 0) < chaos.get("kills_injected", 0):
+        errs.append(f"{chaos.get('kills_injected')} kills but only "
+                    f"{chaos.get('deaths')} deaths — a kill was "
+                    f"swallowed")
+    if not chaos.get("fingerprint_bitmatch", False):
+        stale.append("final solution fingerprint diverged from the "
+                     "fault-free replay")
+    if chaos.get("iterates_matched", -1) != chaos.get("iterates_total",
+                                                      -2):
+        stale.append(f"only {chaos.get('iterates_matched')} of "
+                     f"{chaos.get('iterates_total')} chaos iterates "
+                     f"bit-matched the fault-free replay")
+    if chaos.get("compiles_delta_after_warmup", 1) != 0:
+        stale.append(f"{chaos.get('compiles_delta_after_warmup')} "
+                     f"compile(s) during the chaos pass — warm "
+                     f"replacements were not free")
+    mism = report.get("mismatches", [{"missing": True}])
+    if mism:
+        stale.append(f"{len(mism)} chaos iterate(s) diverged from the "
+                     f"fault-free replay: {mism[:3]}")
+
+    # ---- the batched-lane amortization claim ------------------------
+    bat = report.get("batched", {})
+    if bat.get("occupancy", 0) <= 1:
+        errs.append(f"batched update lane measured occupancy "
+                    f"{bat.get('occupancy')} — the vmapped batch "
+                    f"dimension never carried > 1 rider")
+    if not bat.get("amortized_beats_one_per_launch", False):
+        errs.append(f"warm batched amortized latency "
+                    f"({bat.get('warm_batched_amortized_ms')} ms) did "
+                    f"not beat one-per-launch "
+                    f"({bat.get('warm_one_per_launch_ms')} ms), "
+                    f"speedup {bat.get('speedup_x')}x")
+    if bat.get("compiles_delta", 1) != 0:
+        stale.append(f"{bat.get('compiles_delta')} compile(s) during "
+                     f"the batched-lane measurement — the warm pin "
+                     f"broke")
+
+    if report.get("silent_divergence", True):
+        stale.append("silent_divergence flagged by the demo itself")
+    fleet_ledger = report.get("fleet_ledger", {})
+    if fleet_ledger.get("outstanding", 1) != 0:
+        stale.append(f"{fleet_ledger.get('outstanding')} request(s) "
+                     f"outstanding after the drain — lost in flight")
+    return errs, stale
+
+
+def main(argv) -> int:
+    if not argv:
+        print("usage: check_lp.py report.json [...]", file=sys.stderr)
+        return 1
+    rc = 0
+    for path in argv:
+        try:
+            if path == "-":
+                report = json.load(sys.stdin)
+            else:
+                with open(path) as f:
+                    report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"FAIL {path}: unreadable report ({e})",
+                  file=sys.stderr)
+            rc = max(rc, 1)
+            continue
+        errs, stale = check(report)
+        for e in stale:
+            print(f"SILENT-DIVERGENCE {path}: {e}", file=sys.stderr)
+        for e in errs:
+            print(f"FAIL {path}: {e}", file=sys.stderr)
+        if stale:
+            rc = 2
+        elif errs:
+            rc = max(rc, 1)
+        else:
+            legs = report["legs"]
+            bat = report["batched"]
+            iters = {k: v["iterations"] for k, v in legs.items()}
+            print(f"OK {path}: 4 driver legs converged at n="
+                  f"{report['n']} ({iters}), "
+                  f"{report['chaos']['kills_injected']} kill(s) with "
+                  f"bit-matched replay, drift probe re_inverted "
+                  f"{report['drift_probe']['updates']} update(s), "
+                  f"batched occupancy {bat['occupancy']} amortized "
+                  f"{bat['warm_batched_amortized_ms']} ms vs "
+                  f"{bat['warm_one_per_launch_ms']} ms "
+                  f"({bat['speedup_x']}x), 0 compiles after warmup")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -364,6 +364,30 @@ def _main(argv, state) -> int:
                          "validates).  n is the problem size, m the "
                          "block size; runs on a forced 8-device "
                          "virtual CPU mesh when needed")
+    ap.add_argument("--lp-demo", action="store_true",
+                    help="run the LP/QP optimization-driver acceptance "
+                         "demo (tpu_jordan.lpqp.lp_demo; ISSUE 17, "
+                         "docs/WORKLOADS.md): four seeded driver runs "
+                         "(LP well/ill via revised simplex, QP well/ill "
+                         "via primal active-set) stream correlated "
+                         "invert(resident=True) + rank-k update + "
+                         "verification-solve traffic through a warmed "
+                         "replica fleet, convergence judged by the "
+                         "solver's OWN eps*n*kappa residual gate; plus "
+                         "a zero-drift-budget probe (every update rides "
+                         "the re_invert rung), a seeded replica_kill "
+                         "chaos run that must bit-match its fault-free "
+                         "replay, and the batched update-lane "
+                         "amortization measurement (--batch-cap "
+                         "distinct handles fused into one vmapped "
+                         "launch, warm per-update latency at occupancy "
+                         "> 1 vs one-per-launch); prints ONE JSON line "
+                         "(exit 2 = silent divergence; "
+                         "tools/check_lp.py re-derives convergence "
+                         "from the iterate residuals).  n is the "
+                         "LP/QP dimension, m the block-size hint; "
+                         "--chaos-seed seeds instances and faults; "
+                         "requires --dtype float64")
     ap.add_argument("--comm-report", default=None, metavar="PATH",
                     help="write the process-wide communication "
                          "snapshot (the last distributed solve's "
@@ -535,6 +559,91 @@ def _main(argv, state) -> int:
             raise UsageError("--generator crand is complex-valued; a "
                              "real --dtype would silently discard the "
                              "imaginary part (use --dtype complex64)")
+        if args.lp_demo:
+            # LP/QP driver demo (ISSUE 17): the update-demo restriction
+            # shape (single device, deterministic seeded instances,
+            # gathered) and the same 0/1/2 taxonomy — exit 2 IS the
+            # silent-divergence alarm (a driver that claims
+            # convergence its own iterate residuals cannot re-derive,
+            # an unaccounted update, or a chaos run that did not
+            # bit-match its fault-free replay).
+            if (args.serve_demo or args.chaos_demo or args.fleet_demo
+                    or args.numerics_demo or args.update_demo
+                    or args.capacity_demo or args.comm_demo):
+                raise UsageError("--lp-demo, --comm-demo, "
+                                 "--capacity-demo, --update-demo, "
+                                 "--fleet-demo, --chaos-demo, "
+                                 "--serve-demo and --numerics-demo "
+                                 "are distinct modes; pick one")
+            if args.file is not None or args.workers != 1 or not args.gather:
+                raise UsageError(
+                    "--lp-demo runs on a single device against its "
+                    "own seeded LP/QP instances; file input, "
+                    "--workers and --no-gather do not apply")
+            if args.batch > 1 or args.tune or args.group != 0:
+                raise UsageError("--lp-demo takes no "
+                                 "--batch/--tune/--group")
+            if args.engine != "auto" or args.refine:
+                raise UsageError("--lp-demo resolves its lanes "
+                                 "through the cost-only ladder; "
+                                 "--engine/--refine do not apply")
+            if args.workload != "invert" or args.rhs != 1:
+                raise UsageError("--lp-demo streams its own resident-"
+                                 "invert + update + solve mix; "
+                                 "--workload/--rhs do not apply")
+            if args.numerics != "off":
+                raise UsageError("--lp-demo's convergence "
+                                 "re-derivation semantics are pinned; "
+                                 "--numerics does not apply")
+            if args.slo_report or args.plan_cache is not None:
+                raise UsageError("--slo-report/--plan-cache do not "
+                                 "apply to --lp-demo")
+            if args.serve_requests != 64 or args.max_wait_ms != 2.0:
+                raise UsageError("--lp-demo issues the drivers' own "
+                                 "sequential request stream; "
+                                 "--serve-requests/--max-wait-ms do "
+                                 "not apply (--batch-cap IS honored: "
+                                 "it sizes the batched update lane)")
+            if args.scaling_floor is not None:
+                raise UsageError("--scaling-floor is a --fleet-demo "
+                                 "flag (the throughput-ratio floor); "
+                                 "--lp-demo measures batched-lane "
+                                 "amortization instead")
+            if args.replicas < 2:
+                raise UsageError("--lp-demo needs --replicas >= 2")
+            if args.kills < 1:
+                raise UsageError("--lp-demo needs --kills >= 1")
+            if args.batch_cap < 2:
+                raise UsageError("--lp-demo's batched update lanes "
+                                 "measure amortization at occupancy "
+                                 "> 1; --batch-cap must be >= 2")
+            if args.dtype != "float64":
+                raise UsageError("--lp-demo iterates Bland pricing / "
+                                 "active-set multipliers on the "
+                                 "resident inverse; float32 "
+                                 "reduced-cost noise makes the "
+                                 "termination tests ill-posed — pass "
+                                 "--dtype float64")
+            import json as _json
+
+            from .lpqp.demo import lp_demo
+
+            report = lp_demo(n=args.n, block_size=args.m,
+                             seed=args.chaos_seed,
+                             replicas=args.replicas, kills=args.kills,
+                             batch_cap=args.batch_cap,
+                             dtype=jnp.dtype(args.dtype),
+                             telemetry=telemetry)
+            if args.quiet:
+                report["chaos"]["faults"].pop("log", None)
+            print(_json.dumps(report))
+            if report["silent_divergence"]:
+                print(f"silent divergence: "
+                      f"errors={report['errors']}, "
+                      f"mismatches={len(report['mismatches'])}",
+                      file=sys.stderr)
+                return 2
+            return 0
         if args.comm_demo:
             # Comm demo (ISSUE 14): the capacity-demo restriction
             # shape (fixed internal legs, deterministic fixtures) and

@@ -168,6 +168,7 @@ class JordanFleet:
         #: update-lane (n, k) pairs the fleet has warmed — replacement
         #: replicas re-warm these too (a store lookup: zero compiles).
         self._warm_updates: set[tuple[int, int]] = set()
+        self._warm_solves: set[tuple[int, int]] = set()
         # Close teardown serializes here (the Condition above must stay
         # free for grace-waiting routers): a racing second close()
         # blocks until the first has drained every replica, exactly
@@ -291,6 +292,10 @@ class JordanFleet:
         with self._lock:
             return sorted(self._warm_updates)
 
+    def warm_solve_shapes(self):
+        with self._lock:
+            return sorted(self._warm_solves)
+
     def _record_bucket(self, bucket: int) -> None:
         # Buckets only in _warm_shapes: warmup() normalizes raw request
         # sizes through bucket_for too, so the set never conflates the
@@ -413,9 +418,35 @@ class JordanFleet:
                 "resident state unchanged)")
         return res
 
+    def submit_solve(self, a, b, deadline_ms: float | None = None):
+        """Route one solve request X = A⁻¹B through the fleet
+        (ISSUE 17): same router front door as ``submit`` — bucket
+        affinity, breaker shedding, death re-queue — resolving to an
+        ``InvertResult`` with ``workload="solve"`` and ``solution`` =
+        the (n, k) X (no inverse is ever formed).  This is the lane the
+        LP/QP driver's per-iteration verification solves ride, so the
+        fleet sees the full correlated invert + update + solve mix."""
+        if deadline_ms is None:
+            deadline_ms = self._svc_kw["default_deadline_ms"]
+        return self.router.submit_solve(a, b, self._svc_kw["dtype"],
+                                        deadline_ms=deadline_ms)
+
+    def solve_system(self, a, b, timeout: float | None = None,
+                     deadline_ms: float | None = None):
+        """Synchronous ``submit_solve`` + wait; raises
+        ``SingularMatrixError`` on a singular A (typed — the solve
+        lanes' per-element flag)."""
+        res = self.submit_solve(a, b,
+                                deadline_ms=deadline_ms).result(timeout)
+        if res.singular:
+            from ..driver import SingularMatrixError
+
+            raise SingularMatrixError("singular matrix")
+        return res
+
     # ---- lifecycle ---------------------------------------------------
 
-    def warmup(self, shapes, update_shapes=()) -> dict:
+    def warmup(self, shapes, update_shapes=(), solve_shapes=()) -> dict:
         """Warm every replica against the shared store: the FIRST
         replica to reach each bucket compiles it (once, fleet-wide);
         every other replica — and every future replacement — finds it
@@ -424,27 +455,36 @@ class JordanFleet:
         ``update_shapes`` (ISSUE 12): (n, k) pairs warming the
         resident-update lanes (and each n's invert lane — handle
         creation and the re_invert rung ride it); replacements re-warm
-        these too."""
-        from ..serve.executors import bucket_for
+        these too.
 
-        from ..serve.executors import k_bucket_for
+        ``solve_shapes`` (ISSUE 17): (n, k) pairs warming the solve
+        lanes the fleet's ``solve_system`` traffic lands in — the LP/QP
+        driver's verification solves stay zero-compile warm like every
+        other lane."""
+        from ..serve.executors import (bucket_for, k_bucket_for,
+                                       rhs_bucket_for)
 
         shapes = [int(s) for s in shapes]
         update_shapes = [(int(n), int(k)) for n, k in update_shapes]
+        solve_shapes = [(int(n), int(k)) for n, k in solve_shapes]
         with self._lock:
             # Normalized to buckets — the same coordinates
             # _record_bucket stores — so stats()["warm_shapes"] reports
             # what the fleet actually serves and a replacement's warmup
             # never re-resolves duplicate sizes of one bucket.  The
-            # update set follows the same invariant with its lane
-            # coordinates: (bucket_n, k_bucket).
+            # update/solve sets follow the same invariant with their
+            # lane coordinates: (bucket_n, k_bucket) / (bucket_n, rhs).
             self._warm_shapes.update(bucket_for(s) for s in shapes)
             self._warm_updates.update(
                 (bucket_for(n), k_bucket_for(k))
                 for n, k in update_shapes)
+            self._warm_solves.update(
+                (bucket_for(n), rhs_bucket_for(k))
+                for n, k in solve_shapes)
         out = {}
         for replica in self.live_replicas():
-            out = replica.warmup(shapes, update_shapes=update_shapes)
+            out = replica.warmup(shapes, update_shapes=update_shapes,
+                                 solve_shapes=solve_shapes)
         return out
 
     def start(self) -> None:
@@ -520,6 +560,8 @@ class JordanFleet:
             "warm_shapes": self.warm_shapes(),
             "warm_update_shapes": [list(p) for p
                                    in self.warm_update_shapes()],
+            "warm_solve_shapes": [list(p) for p
+                                  in self.warm_solve_shapes()],
             "executors_compiled": len(self.store),
             "handles": self.handles.snapshot(),
             "handle_budget": self.handles.budget_snapshot(),

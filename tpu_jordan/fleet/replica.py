@@ -167,8 +167,18 @@ class Replica:
                                           deadline_ms=deadline_ms,
                                           _ctx=ctx)
 
-    def warmup(self, shapes, update_shapes=()) -> dict:
-        return self.service.warmup(shapes, update_shapes=update_shapes)
+    def submit_solve(self, a, b, deadline_ms: float | None = None,
+                     ctx=None):
+        """Route one solve request (X = A⁻¹B, ISSUE 17) into this
+        replica's service — same admission guard and kill semantics as
+        ``submit``; the service's solve lanes never form an inverse."""
+        self._admit(ctx)
+        return self.service.submit(a, b, deadline_ms=deadline_ms,
+                                   _ctx=ctx)
+
+    def warmup(self, shapes, update_shapes=(), solve_shapes=()) -> dict:
+        return self.service.warmup(shapes, update_shapes=update_shapes,
+                                   solve_shapes=solve_shapes)
 
     def breaker_allows(self, bucket_n: int) -> bool:
         """Router shedding hook: False while this replica's per-bucket

@@ -75,7 +75,13 @@ class _FleetRequest:
     instead: ``handle``/``u``/``v`` replace ``a``; the re-queue path is
     identical — the handle's committed state lives in the fleet-shared
     store, so a retried update re-reads it (exactly-once application
-    across any number of reroute hops)."""
+    across any number of reroute hops).
+
+    ``kind="solve"`` (ISSUE 17) routes X = A⁻¹B through the replicas'
+    solve lanes: ``b`` carries the RHS block, ``rhs`` its lane's
+    k-bucket — the LP/QP driver's per-iteration verification solves
+    ride this, so sustained correlated invert + update + solve traffic
+    shares one front door."""
 
     a: np.ndarray
     n: int
@@ -85,10 +91,12 @@ class _FleetRequest:
     attempts: int = 0
     t_submit: float = field(default=0.0)
     ctx: object = None                   # obs.journey.RequestContext
-    kind: str = "invert"                 # "invert" | "update"
+    kind: str = "invert"                 # "invert" | "update" | "solve"
     handle: object = None                # HandleRef (update kind)
     u: np.ndarray = None                 # (n, k) update factors
     v: np.ndarray = None
+    b: np.ndarray = None                 # (n, k) RHS block (solve kind)
+    rhs: int = 0                         # solve lane k-bucket
 
     def remaining_ms(self, now: float) -> float | None:
         if self.t_deadline is None:
@@ -105,6 +113,8 @@ class _FleetRequest:
             from ..serve.executors import k_bucket_for
 
             return f"update:{self.bucket}:k{k_bucket_for(self.u.shape[1])}"
+        if self.kind == "solve":
+            return f"solve:{self.bucket}:k{self.rhs}"
         return self.bucket
 
     @property
@@ -194,6 +204,47 @@ class Router:
             raise
         return outer
 
+    def submit_solve(self, a, b, dtype,
+                     deadline_ms: float | None = None) -> Future:
+        """Route one solve request X = A⁻¹B (ISSUE 17): the same front
+        door as ``submit`` — one fleet-level journey
+        (``workload="solve"``), bucket-affinity candidate order, typed
+        backpressure, death re-queue.  The replicas' solve lanes never
+        form an inverse (the ISSUE 11 contract)."""
+        from ..serve.executors import rhs_bucket_for
+
+        a = np.asarray(a, dtype)
+        if a.ndim != 2 or a.shape[0] != a.shape[1]:
+            raise ValueError(f"expected a square (n, n) matrix, "
+                             f"got shape {a.shape}")
+        b = np.asarray(b, dtype)
+        if b.ndim == 1:
+            b = b[:, None]
+        if b.ndim != 2 or b.shape[0] != a.shape[0]:
+            raise ValueError(f"expected a ({a.shape[0]}, k) RHS block, "
+                             f"got shape {b.shape}")
+        n = a.shape[0]
+        now = time.monotonic()
+        outer = Future()
+        outer.set_running_or_notify_cancel()
+        bucket = bucket_for(n)
+        req = _FleetRequest(
+            a=a, n=n, bucket=bucket, outer=outer,
+            t_deadline=(None if deadline_ms is None
+                        else now + float(deadline_ms) / 1e3),
+            t_submit=now,
+            ctx=self.pool.journey.new(n, bucket, workload="solve"),
+            kind="solve", b=b, rhs=rhs_bucket_for(b.shape[1]))
+        self.pool._record_bucket(req.bucket)
+        self.pool._account_submitted()
+        try:
+            self._dispatch(req)
+        except Exception as e:
+            self.pool._account_resolved(ok=False)
+            req.ctx.close("error", error=type(e).__name__)
+            raise
+        return outer
+
     # ---- dispatch / re-queue ----------------------------------------
 
     def _candidates(self, bucket: int):
@@ -250,6 +301,12 @@ class Router:
                     if req.kind == "update":
                         inner = replica.submit_update(
                             req.handle, req.u, req.v,
+                            deadline_ms=req.remaining_ms(
+                                time.monotonic()),
+                            ctx=req.ctx)
+                    elif req.kind == "solve":
+                        inner = replica.submit_solve(
+                            req.a, req.b,
                             deadline_ms=req.remaining_ms(
                                 time.monotonic()),
                             ctx=req.ctx)

@@ -167,7 +167,8 @@ class Supervisor:
             try:
                 replacement = pool._spawn_replica(slot.index)
                 replacement.warmup(pool.warm_shapes(),
-                                   update_shapes=pool.warm_update_shapes())
+                                   update_shapes=pool.warm_update_shapes(),
+                                   solve_shapes=pool.warm_solve_shapes())
             except Exception as e:      # noqa: BLE001 — counted, retried
                 _M_RESTART_FAILURES.inc(replica=str(slot.index))
                 _recorder.record("restart_failure", slot=slot.index,
@@ -221,7 +222,8 @@ class Supervisor:
         try:
             replica = pool._spawn_replica(slot.index)
             replica.warmup(pool.warm_shapes(),
-                           update_shapes=pool.warm_update_shapes())
+                           update_shapes=pool.warm_update_shapes(),
+                           solve_shapes=pool.warm_solve_shapes())
         except Exception as e:          # noqa: BLE001 — counted, retried
             _M_RESTART_FAILURES.inc(replica=str(slot.index))
             _recorder.record("restart_failure", slot=slot.index,

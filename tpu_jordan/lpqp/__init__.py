@@ -1,0 +1,18 @@
+"""LP/QP optimization driver (ISSUE 17, ROADMAP item 4): the
+downstream workload the invert → verify → update machinery was built
+for.  ``problem`` generates seeded, certificate-carrying LP/QP
+instances; ``driver`` runs the optimization inner loops through a
+:class:`~..fleet.pool.JordanFleet` as sustained correlated
+invert + update + solve traffic; ``demo`` is the ``--lp-demo`` /
+``make lp-demo`` acceptance engine."""
+
+from .driver import OptimizeError, OptimizeReport, solve_lp, solve_qp
+from .problem import (LPInstance, QPInstance, kkt_converged, kkt_gate,
+                      lp_instance, lp_kkt_residual, qp_instance,
+                      qp_kkt_residual)
+
+__all__ = [
+    "LPInstance", "QPInstance", "lp_instance", "qp_instance",
+    "lp_kkt_residual", "qp_kkt_residual", "kkt_gate", "kkt_converged",
+    "solve_lp", "solve_qp", "OptimizeReport", "OptimizeError",
+]

@@ -1,0 +1,436 @@
+"""LP/QP optimization drivers over a :class:`~..fleet.pool.JordanFleet`
+(ISSUE 17 tentpole, module 2 of the lpqp subsystem).
+
+Both drivers exercise the EXACT traffic pattern the resident-handle
+machinery was built for — one ``invert(resident=True)`` to establish
+the working inverse, then a long correlated stream where every
+iteration's KKT system differs from the last by a rank-k mutation:
+
+  * :func:`solve_lp` — revised simplex (Bland's rule, so no cycling and
+    a deterministic pivot sequence).  The slack basis starts at B = I;
+    every pivot swaps ONE basis column, i.e. a rank-1 update
+    ``B += u·e_pᵀ`` riding ``fleet.update`` — the resident inverse IS
+    the simplex's basis-inverse representation.
+  * :func:`solve_qp` — primal active-set on a box QP.  The working
+    matrix ``M = E·Q·E + (I − E)`` (E = diag of the free mask) changes
+    only in row/column *i* when coordinate *i* toggles between free and
+    active — a rank-2 update ``U = [e_i, ΔM·e_i − ΔM_ii·e_i]``,
+    ``V = [ΔMᵀ·e_i, e_i]`` riding the same lane.
+
+Every update's answer carries the serving layer's own judgment
+(``refreshed`` | ``re_inverted`` | ``gated``), folded into the report's
+ledger; a drift-budget crossing falls through the ``re_invert`` rung
+transparently and the driver keeps iterating on the recovered inverse.
+Periodic verification solves (``fleet.solve_system``, every
+``solve_every`` iterations) cross-check the updated inverse against a
+fresh sharded elimination of the SAME system, judged by the solve
+lane's κ-free backward-error gate plus a κ-scaled agreement test —
+the forward-error model the repo's own gates encode, never a looser
+twin (see :func:`~.problem.kkt_gate`).
+
+Determinism: given the same instance, fleet dtype and fault plan, the
+pivot/toggle sequence, every iterate, and the final fingerprint are
+bit-identical run to run — a mid-flight ``replica_kill`` re-queues
+through the router and the retry re-reads committed state, so the
+chaos leg of the demo can bit-compare against a fault-free replay.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..resilience.degrade import (gate_passes, gate_threshold,
+                                  solve_gate_threshold)
+from ..resilience.policy import ResidualGateError
+from .problem import (LPInstance, QPInstance, kkt_converged, kkt_gate,
+                      lp_kkt_residual, qp_kkt_residual)
+
+__all__ = ["OptimizeError", "OptimizeReport", "solve_lp", "solve_qp"]
+
+#: every fleet.update outcome the drivers account (plus "error" for
+#: typed gate exhaustion) — the checker proves the ledger sums to the
+#: update count, so nothing the fleet judged can go unreported.
+OUTCOMES = ("refreshed", "re_inverted", "gated")
+
+_RATIO_EPS = 1e-10          # simplex ratio-test / QP step denominators
+
+
+class OptimizeError(RuntimeError):
+    """Typed driver failure: an unbounded/infeasible instance, an
+    iteration cap hit, or a fleet-side typed numerics refusal
+    (``ResidualGateError`` — the re_invert rung could not recover) the
+    driver will not paper over.  ``report`` carries the iterate trail
+    up to the failure for post-mortem."""
+
+    def __init__(self, msg: str, report: "OptimizeReport" = None):
+        super().__init__(msg)
+        self.report = report
+
+
+@dataclass
+class OptimizeReport:
+    """One driver run's full account — everything the ``--lp-demo``
+    checker re-derives convergence from.  ``iterates`` holds one dict
+    per iteration (kkt residual + threshold, the update outcome the
+    fleet judged, drift, committed handle version, and — on
+    verification iterations — the solve-lane residual/threshold and
+    the κ-scaled agreement between the updated inverse and the fresh
+    solve).  ``fingerprint`` hashes the final x bytes + objective bits,
+    the chaos leg's bit-compare token."""
+
+    kind: str                 # "lp" | "qp"
+    name: str                 # instance name (seeded, self-describing)
+    converged: bool
+    iterations: int
+    objective: float
+    objective_ref: float      # the instance's constructed optimum
+    kkt_rel_final: float
+    kkt_threshold: float      # the solver-gate threshold at the end
+    kappa: float              # last verified κ of the working matrix
+    updates: int              # fleet.update calls issued
+    solves: int               # fleet.solve_system verifications issued
+    ledger: dict = field(default_factory=dict)   # outcome -> count
+    iterates: list = field(default_factory=list)
+    handle_id: str = ""
+    fingerprint: str = ""
+    x: np.ndarray = None
+
+    def to_dict(self) -> dict:
+        d = {k: getattr(self, k) for k in (
+            "kind", "name", "converged", "iterations", "objective",
+            "objective_ref", "kkt_rel_final", "kkt_threshold", "kappa",
+            "updates", "solves", "ledger", "iterates", "handle_id",
+            "fingerprint")}
+        d["obj_rel_err"] = (
+            abs(self.objective - self.objective_ref)
+            / (1.0 + abs(self.objective_ref)))
+        return d
+
+
+def _fingerprint(x: np.ndarray, objective: float) -> str:
+    h = hashlib.sha256(np.ascontiguousarray(x).tobytes())
+    h.update(float(objective).hex().encode())
+    return h.hexdigest()
+
+
+class _FleetLane:
+    """The drivers' shared fleet adapter: one resident handle, the
+    per-update outcome ledger, and the periodic verification solve —
+    so the LP and QP loops stay pure algorithm."""
+
+    def __init__(self, fleet, a0: np.ndarray, policy):
+        self.fleet = fleet
+        self.policy = (policy if policy is not None
+                       else getattr(fleet, "policy", None))
+        self.handle = fleet.invert(np.asarray(a0), resident=True)
+        self.dtype = np.dtype(self.handle.dtype)
+        self.inv = np.asarray(self.handle.result.inverse, np.float64)
+        self.kappa = max(float(self.handle.result.kappa), 1.0)
+        self.updates = 0
+        self.solves = 0
+        self.ledger = {o: 0 for o in OUTCOMES}
+        self.ledger["error"] = 0
+
+    def update(self, u: np.ndarray, v: np.ndarray, report) -> dict:
+        """One rank-k mutation through the fleet; returns the iterate
+        fields the loop folds into its record.  Typed fleet refusals
+        become :class:`OptimizeError` carrying the partial report."""
+        from ..driver import SingularMatrixError
+
+        self.updates += 1
+        try:
+            res = self.fleet.update(self.handle, np.asarray(u),
+                                    np.asarray(v))
+        except ResidualGateError as e:
+            self.ledger["error"] += 1
+            raise OptimizeError(
+                f"fleet update refused typed (re_invert rung "
+                f"exhausted): {e}", report) from e
+        except SingularMatrixError as e:
+            # The fleet's typed singularity answer — the committed
+            # resident state is untouched, but the driver's pivot
+            # choice produced a rank-destroying mutation: a driver
+            # bug or a degenerate instance, surfaced typed.
+            self.ledger["gated"] += 1
+            raise OptimizeError(
+                f"update would destroy rank (fleet gated it): {e}",
+                report) from e
+        self.ledger[res.update_outcome] += 1
+        self.inv = np.asarray(res.inverse, np.float64)
+        self.kappa = max(float(res.kappa), 1.0)
+        return {"outcome": res.update_outcome,
+                "drift": float(res.drift),
+                "version": int(res.handle_version),
+                "kappa": float(res.kappa)}
+
+    def verify(self, a: np.ndarray, rhs: np.ndarray,
+               x_inv: np.ndarray) -> dict:
+        """Cross-check the updated resident inverse against a FRESH
+        fleet solve of the same system: the solve lane's κ-free
+        backward-error gate judges the fresh solve, and a κ-scaled
+        forward-error gate (eps·n·κ — the invert gate's own model)
+        judges the agreement ‖x_solve − x_inv‖ between the two
+        routes.  Disagreement beyond what κ explains means the
+        resident inverse silently rotted — exactly what the drift
+        budget exists to prevent, so the demo checker treats a failed
+        agreement as the silent-divergence class."""
+        n = a.shape[0]
+        self.solves += 1
+        res = self.fleet.solve_system(np.asarray(a), rhs[:, None])
+        x_solve = np.asarray(res.solution, np.float64)[:, 0]
+        solve_thr = solve_gate_threshold(self.policy, n, self.dtype)
+        agree_rel = (np.max(np.abs(x_solve - x_inv))
+                     / (1.0 + np.max(np.abs(x_solve))))
+        # The agreement ceiling is the solver's own drift model: a
+        # resident inverse is ALLOWED to carry up to drift_budget
+        # gate-widths of accumulated error before re_invert fires, and
+        # the fresh solve contributes one more gate-width of its own —
+        # so the two routes may legitimately disagree by (budget + 1)
+        # κ-scaled gate-widths, and no more.
+        from ..linalg.update import drift_budget
+
+        gate_w = gate_threshold(self.policy, n, self.kappa, self.dtype)
+        agree_thr = drift_budget(gate_w) + gate_w
+        return {"solve_rel": float(res.rel_residual),
+                "solve_threshold": float(solve_thr),
+                "solve_pass": gate_passes(float(res.rel_residual),
+                                          solve_thr),
+                "agree_rel": float(agree_rel),
+                "agree_threshold": float(agree_thr),
+                "agree": gate_passes(float(agree_rel), agree_thr)}
+
+    def gate(self, n: int) -> float:
+        return kkt_gate(self.policy, n, self.kappa, self.dtype)
+
+
+def solve_lp(prob: LPInstance, fleet, policy=None,
+             max_iters: int | None = None,
+             solve_every: int = 1) -> OptimizeReport:
+    """Revised simplex over the fleet (see module docstring).  The
+    basis inverse lives in a resident fleet handle seeded from the
+    slack basis (B = I); each Bland pivot is one rank-1
+    ``fleet.update``; every ``solve_every``-th iteration cross-checks
+    x_B = B⁻¹b against a fresh ``fleet.solve_system(B, b)``.
+    Converged means: no entering column remains AND the (x, y) pair's
+    KKT residual passes the solver's own eps·n·κ gate."""
+    m, a, b, c = prob.m, np.asarray(prob.a, np.float64), prob.b, prob.c
+    b = np.asarray(b, np.float64)
+    c = np.asarray(c, np.float64)
+    if max_iters is None:
+        max_iters = 6 * m
+    basis = list(prob.basis0)
+    lane = _FleetLane(fleet, np.eye(m, dtype=a.dtype), policy)
+    report = OptimizeReport(
+        kind="lp", name=prob.name, converged=False, iterations=0,
+        objective=float("nan"), objective_ref=prob.obj_star,
+        kkt_rel_final=float("nan"), kkt_threshold=float("nan"),
+        kappa=lane.kappa, updates=0, solves=0, ledger=lane.ledger,
+        handle_id=lane.handle.handle_id)
+    # Dtype/κ-aware pricing tolerance: reduced costs computed through
+    # the fleet inverse carry ~eps·m·κ relative noise, so Bland's
+    # entering test must not chase signs below that floor.
+    eps = float(np.finfo(lane.dtype).eps)
+    c_inf = float(np.max(np.abs(c)))
+    x = np.zeros(prob.n)
+    kkt_rel, thr, optimal = float("nan"), float("nan"), False
+    for it in range(max_iters):
+        red_tol = (1.0 + c_inf) * max(1e-9, 10.0 * eps * m * lane.kappa)
+        report.iterations = it + 1
+        x_b = lane.inv @ b
+        x[:] = 0.0
+        x[basis] = x_b
+        y = lane.inv.T @ c[basis]
+        kkt_rel = lp_kkt_residual(prob, x, y)
+        thr = lane.gate(m)
+        rec = {"i": it, "kkt_rel": kkt_rel, "kkt_threshold": thr,
+               "kkt_hex": float(kkt_rel).hex()}
+        reduced = c - a.T @ y
+        reduced[basis] = 0.0
+        entering = np.flatnonzero(reduced < -red_tol)
+        if entering.size == 0:
+            report.iterates.append(rec)
+            optimal = True
+            break
+        q = int(entering[0])                      # Bland: smallest index
+        d = lane.inv @ a[:, q]
+        pos = np.flatnonzero(d > _RATIO_EPS)
+        if pos.size == 0:
+            report.iterates.append(rec)
+            _finalize(report, x, c, kkt_rel, thr, lane)
+            raise OptimizeError(
+                f"LP unbounded below at iteration {it} "
+                f"(entering column {q} has no blocking row)", report)
+        ratios = x_b[pos] / d[pos]
+        best = ratios.min()
+        ties = pos[ratios <= best * (1.0 + 1e-12) + 1e-300]
+        # Bland's leaving rule: among the minimum-ratio rows, evict
+        # the smallest basis INDEX — with the entering rule above this
+        # provably never cycles, and the pivot sequence is a pure
+        # function of the instance (the chaos bit-match relies on it).
+        p = int(ties[np.argmin(np.asarray(basis)[ties])])
+        u = a[:, q] - a[:, basis[p]]
+        v = np.zeros(m)
+        v[p] = 1.0
+        rec.update(lane.update(u[:, None], v[:, None], report))
+        basis[p] = q
+        if (it + 1) % max(1, solve_every) == 0:
+            b_mat = a[:, basis]
+            rec.update(lane.verify(b_mat, b, lane.inv @ b))
+        report.iterates.append(rec)
+    x[:] = 0.0
+    x[basis] = lane.inv @ b
+    _finalize(report, x, c, kkt_rel, thr, lane)
+    report.converged = bool(optimal
+                            and kkt_converged(kkt_rel, thr))
+    if not optimal:
+        raise OptimizeError(
+            f"LP did not reach an optimal basis in {max_iters} "
+            f"iterations", report)
+    return report
+
+
+def _finalize(report: OptimizeReport, x, c_or_none, kkt_rel, thr,
+              lane) -> None:
+    report.kkt_rel_final = float(kkt_rel)
+    report.kkt_threshold = float(thr)
+    report.kappa = lane.kappa
+    report.updates = lane.updates
+    report.solves = lane.solves
+    report.x = x.copy()
+    if c_or_none is not None:
+        report.objective = float(c_or_none @ x)
+    report.fingerprint = _fingerprint(report.x, report.objective)
+
+
+def _qp_working_matrix(q: np.ndarray, free: np.ndarray) -> np.ndarray:
+    """M = E·Q·E + (I − E): the free block is Q_FF, active rows/cols
+    are identity — so M·z = rhs solves the equality-constrained
+    subproblem AND M stays symmetric positive definite for every
+    active set (Q_FF is a principal submatrix of an SPD Q)."""
+    mat = np.where(np.outer(free, free), q, 0.0)
+    mat[~free, ~free] = 1.0
+    return mat
+
+
+def _qp_toggle_factors(m_old: np.ndarray, m_new: np.ndarray,
+                       i: int) -> tuple:
+    """ΔM = M_new − M_old is confined to row/column *i* when one
+    coordinate toggles, so it factors exactly as the rank-2
+    ``U·Vᵀ = e_i·ΔM[i,:] + (ΔM[:,i] − ΔM[i,i]·e_i)·e_iᵀ`` (the diag
+    entry assigned to the first term only, never double-counted)."""
+    n = m_old.shape[0]
+    delta = m_new - m_old
+    e_i = np.zeros(n)
+    e_i[i] = 1.0
+    row = delta[i, :].copy()
+    col = delta[:, i].copy()
+    col[i] = 0.0
+    u = np.stack([e_i, col], axis=1)
+    v = np.stack([row, e_i], axis=1)
+    return u, v
+
+
+def solve_qp(prob: QPInstance, fleet, policy=None,
+             max_iters: int | None = None,
+             solve_every: int = 2) -> OptimizeReport:
+    """Primal active-set over the fleet (see module docstring).  The
+    working-matrix inverse is a resident handle seeded from M = Q
+    (empty active set, feasible start x = lo); every bound
+    addition/release is one rank-2 ``fleet.update``; converged means
+    the projected-gradient KKT residual passes the solver's own
+    eps·n·κ gate."""
+    n = prob.n
+    q = np.asarray(prob.q, np.float64)
+    c = np.asarray(prob.c, np.float64)
+    lo = np.asarray(prob.lo, np.float64)
+    hi = np.asarray(prob.hi, np.float64)
+    if max_iters is None:
+        max_iters = 6 * n
+    free = np.ones(n, dtype=bool)
+    m_work = _qp_working_matrix(q, free)
+    lane = _FleetLane(fleet, m_work.astype(prob.q.dtype), policy)
+    report = OptimizeReport(
+        kind="qp", name=prob.name, converged=False, iterations=0,
+        objective=float("nan"), objective_ref=prob.obj_star,
+        kkt_rel_final=float("nan"), kkt_threshold=float("nan"),
+        kappa=lane.kappa, updates=0, solves=0, ledger=lane.ledger,
+        handle_id=lane.handle.handle_id)
+    x = lo.copy()
+    eps = float(np.finfo(lane.dtype).eps)
+    c_inf = float(np.max(np.abs(c)))
+    kkt_rel, thr = float("nan"), float("nan")
+
+    def toggle(i: int, now_free: bool, rec: dict) -> None:
+        nonlocal m_work
+        free[i] = now_free
+        m_new = _qp_working_matrix(q, free)
+        u, v = _qp_toggle_factors(m_work, m_new, i)
+        rec.update(lane.update(u, v, report))
+        m_work = m_new
+
+    for it in range(max_iters):
+        report.iterations = it + 1
+        mul_tol = (1.0 + c_inf) * max(1e-9,
+                                      10.0 * eps * n * lane.kappa)
+        kkt_rel = qp_kkt_residual(prob, x)
+        thr = lane.gate(n)
+        rec = {"i": it, "kkt_rel": kkt_rel, "kkt_threshold": thr,
+               "kkt_hex": float(kkt_rel).hex()}
+        # rhs of M·z = rhs: free rows ask Q_FF·z_F = −c_F − Q_FA·x_A,
+        # active rows pin z to the bound value.
+        x_bnd = np.where(free, 0.0, x)
+        rhs = np.where(free, -(c + q @ x_bnd), x)
+        z = lane.inv @ rhs
+        z[~free] = x[~free]           # active coords exact by contract
+        if (it + 1) % max(1, solve_every) == 0:
+            # Cross-check BEFORE any toggle mutates M — the fresh
+            # solve must target the same system z came from.
+            rec.update(lane.verify(m_work, rhs, z))
+        p = z - x
+        step = float(np.max(np.abs(p)))
+        if step <= 1e-12 * (1.0 + np.max(np.abs(x))):
+            # At the equality-constrained optimum for this active set:
+            # release the worst bound whose multiplier says the
+            # objective still improves by leaving it, or stop.
+            g = q @ x + c
+            lam = np.where(free, 0.0, np.where(x <= lo, g, -g))
+            viol = np.flatnonzero((~free) & (lam < -mul_tol))
+            if viol.size == 0:
+                report.iterates.append(rec)
+                break
+            j = int(viol[np.argmin(lam[viol])])   # most negative
+            rec["release"] = j
+            toggle(j, True, rec)
+        else:
+            alpha, blocker, side = 1.0, -1, 0.0
+            for i in np.flatnonzero(free):
+                if p[i] > _RATIO_EPS:
+                    r, bnd = (hi[i] - x[i]) / p[i], hi[i]
+                elif p[i] < -_RATIO_EPS:
+                    r, bnd = (lo[i] - x[i]) / p[i], lo[i]
+                else:
+                    continue
+                if r < alpha - 1e-15:
+                    alpha, blocker, side = r, i, bnd
+            x = x + max(0.0, min(1.0, alpha)) * p
+            if blocker >= 0:
+                blocker = int(blocker)
+                x[blocker] = side
+                rec["add"] = blocker
+                toggle(blocker, False, rec)
+        report.iterates.append(rec)
+    else:
+        _finalize(report, x, None, kkt_rel, thr, lane)
+        report.objective = float(0.5 * x @ q @ x + c @ x)
+        report.fingerprint = _fingerprint(x, report.objective)
+        raise OptimizeError(
+            f"QP active-set did not terminate in {max_iters} "
+            f"iterations", report)
+    _finalize(report, x, None, kkt_rel, thr, lane)
+    report.objective = float(0.5 * x @ q @ x + c @ x)
+    report.fingerprint = _fingerprint(x, report.objective)
+    report.converged = bool(kkt_converged(kkt_rel, thr))
+    return report

@@ -71,6 +71,15 @@ class ServiceClosedError(RuntimeError):
     """submit() after close() — the service no longer accepts work."""
 
 
+class MixedUpdateBatchError(TypeError):
+    """A rider in one update-lane batch does not match the lane's
+    (bucket, k_bucket, dtype) — mixed-bucket or mixed-dtype riders are
+    refused TYPED, never silently padded/cast to the lane's compiled
+    shape (ISSUE 17).  Only direct batcher misuse can produce one:
+    ``JordanService.submit_update`` pads every rider to its own lane
+    key, so lanes stay homogeneous by construction."""
+
+
 @dataclass
 class InvertResult:
     """What a request's future resolves to: the unpadded result plus
@@ -422,22 +431,24 @@ class MicroBatcher:
 
     def _execute_updates(self, lane, batch: list,
                          t_dispatch: float) -> None:
-        """Dispatch one picked update-lane batch: riders run
-        SEQUENTIALLY through the lane's unbatched SMW executable (each
-        mutates its own handle's resident state under the handle's
-        store lock — write-through, ISSUE 12).  A rider's terminal
-        failure is ITS typed error and ITS batch-failure count;
-        batch-mates are untouched — per-rider attempt chains, not one
-        shared fate, because each rider is its own launch."""
+        """Dispatch one picked update-lane batch (ISSUE 12, batched in
+        ISSUE 17).  Riders targeting DISTINCT handles share ONE vmapped
+        SMW launch through the lane's batch-cap executable (each
+        element re-verified in-launch, per-element singular/gate
+        flags); same-handle followers — whose input state depends on
+        the batch-mate ahead of them — and every rider of a cap-1 lane
+        run sequentially through the one-per-launch executable, so
+        per-handle update ordering is preserved exactly.  A rider's
+        terminal failure is ITS typed error and ITS batch-failure
+        count; batch-mates are untouched.  Mixed-bucket/dtype riders
+        are refused with the typed :class:`MixedUpdateBatchError` —
+        never silently padded to the lane's compiled shape."""
         label = _lane_label(lane)
         bucket, kb = lane[1], lane[2]
         br = self.executors.breaker(label) \
             if self.policy is not None else None
-        try:
-            _faults.fire("dispatch")
-            ex, source = self.executors.get_info(
-                bucket, 1, self.block_size, workload="update", rhs=kb)
-        except BaseException as e:                  # noqa: BLE001
+
+        def fail_batch(riders, e):
             _obs_metrics.counter(
                 "tpu_jordan_serve_batch_failures_total",
                 "dispatched batches that terminally failed (after any "
@@ -445,36 +456,93 @@ class MicroBatcher:
             ).inc(bucket=label)
             if br is not None:
                 br.record_failure()
-            for req in batch:
+            for req in riders:
                 req.hop("batch_failure", error=type(e).__name__)
                 if not req.future.done():
                     req.future.set_exception(e)
+
+        try:
+            _faults.fire("dispatch")
+            ex, source = self.executors.get_info(
+                bucket, 1, self.block_size, workload="update", rhs=kb)
+        except BaseException as e:                  # noqa: BLE001
+            fail_batch(batch, e)
             return
         queue_waits = [t_dispatch - req.t_enqueue for req in batch]
-        singular_served = 0
-        exec_total = 0.0
-        ok = True
         from ..resilience.policy import ResidualGateError
         from .handles import UnknownHandleError
 
+        # Typed-refusal contract (ISSUE 17): every rider must already
+        # match the lane's compiled (bucket, k_bucket, dtype) — the
+        # service pads to the lane key, so only direct batcher misuse
+        # can violate this, and it is refused typed, never padded.
+        dtype = np.dtype(ex.key.dtype)
+        shape = (bucket, kb)
+        conforming = []
         for i, req in enumerate(batch):
-            req.hop("executor", bucket=bucket, source=source,
-                    engine=ex.key.engine)
-            try:
-                res = self._run_one_update(req, ex, queue_waits[i],
-                                           len(batch))
-            except (UnknownHandleError, ResidualGateError) as e:
+            pu, pv = req.padded_u, req.padded_v
+            if (req.bucket_n != bucket or int(req.rhs) != kb
+                    or pu is None or pv is None
+                    or tuple(pu.shape) != shape
+                    or tuple(pv.shape) != shape
+                    or np.dtype(pu.dtype) != dtype
+                    or np.dtype(pv.dtype) != dtype):
+                e = MixedUpdateBatchError(
+                    f"update rider (bucket {req.bucket_n}, k_bucket "
+                    f"{req.rhs}, factors "
+                    f"{None if pu is None else (tuple(pu.shape), str(pu.dtype))}) "
+                    f"does not match lane {label} "
+                    f"(bucket {bucket}, k_bucket {kb}, {dtype}) — "
+                    f"mixed riders are refused, never silently padded")
+                req.hop("typed_failure", error=type(e).__name__)
+                if not req.future.done():
+                    req.future.set_exception(e)
+            else:
+                conforming.append((i, req))
+
+        # Split the batch: the FIRST rider per distinct handle can
+        # share one vmapped launch (their input states are independent
+        # committed snapshots); later same-handle riders must observe
+        # the batch-mate's committed result first.
+        group, followers, seen = [], [], set()
+        for i, req in conforming:
+            hid = req.handle.handle_id
+            if hid in seen:
+                followers.append((i, req))
+            else:
+                seen.add(hid)
+                group.append((i, req))
+        use_group = self.batch_cap > 1 and len(group) > 1
+        if not use_group:
+            followers = conforming
+            group = []
+
+        singular_served = 0
+        exec_total = 0.0
+        ok = True
+
+        def settle(req, res):
+            """Interpret one rider's outcome (InvertResult | None |
+            exception) with the lane's shared failure taxonomy."""
+            nonlocal ok, singular_served
+            if res is None:
+                # Deadline expired during execute: the rider was
+                # failed typed BEFORE the commit (the handle is
+                # untouched — a typed update failure never leaves a
+                # half-trusted mutation behind).
+                return
+            if isinstance(res, (UnknownHandleError, ResidualGateError)):
                 # Typed CALLER/NUMERICS outcomes — an evicted handle,
                 # or one handle's gate/drift failure the rung couldn't
                 # recover — are THIS rider's answer, not lane-health
                 # evidence: no breaker feedback, no batch-failure
                 # count (the invert lane never counts caller bugs or
                 # per-element numerics against its breaker either).
-                req.hop("typed_failure", error=type(e).__name__)
+                req.hop("typed_failure", error=type(res).__name__)
                 if not req.future.done():
-                    req.future.set_exception(e)
-                continue
-            except BaseException as e:              # noqa: BLE001
+                    req.future.set_exception(res)
+                return
+            if isinstance(res, BaseException):
                 ok = False
                 _obs_metrics.counter(
                     "tpu_jordan_serve_batch_failures_total",
@@ -484,29 +552,184 @@ class MicroBatcher:
                 ).inc(bucket=label)
                 if br is not None:
                     br.record_failure()
-                req.hop("batch_failure", error=type(e).__name__)
+                req.hop("batch_failure", error=type(res).__name__)
                 if not req.future.done():
-                    req.future.set_exception(e)
-                continue
-            if res is None:
-                # Deadline expired during execute: the rider was
-                # failed typed BEFORE the commit (the handle is
-                # untouched — a typed update failure never leaves a
-                # half-trusted mutation behind).
-                continue
+                    req.future.set_exception(res)
+                return
             singular_served += int(res.singular)
-            exec_total += res.execute_seconds
             req.hop("served", singular=bool(res.singular),
                     outcome=res.update_outcome,
                     version=res.handle_version,
                     seconds=round(res.execute_seconds, 6))
             req.future.set_result(res)
+
+        if group:
+            try:
+                ex_b, source_b = self.executors.get_info(
+                    bucket, self.batch_cap, self.block_size,
+                    workload="update", rhs=kb)
+            except BaseException as e:              # noqa: BLE001
+                fail_batch([r for _, r in group], e)
+                group, ex_b = [], None
+            if group:
+                for _, req in group:
+                    req.hop("executor", bucket=bucket, source=source_b,
+                            engine=ex_b.key.engine,
+                            batched=len(group))
+                try:
+                    results, exec_s = self._run_update_group(
+                        [r for _, r in group], ex_b,
+                        [queue_waits[i] for i, _ in group], len(batch))
+                except BaseException as e:          # noqa: BLE001
+                    fail_batch([r for _, r in group], e)
+                    ok = False
+                else:
+                    exec_total += exec_s
+                    for (_, req), res in zip(group, results):
+                        settle(req, res)
+
+        for i, req in followers:
+            req.hop("executor", bucket=bucket, source=source,
+                    engine=ex.key.engine)
+            try:
+                res = self._run_one_update(req, ex, queue_waits[i],
+                                           len(batch))
+            except BaseException as e:              # noqa: BLE001
+                res = e
+            else:
+                if res is not None and not isinstance(res, BaseException):
+                    exec_total += res.execute_seconds
+            settle(req, res)
         if ok and br is not None:
             br.record_success()
         self.stats.batch(label, occupancy=len(batch),
                          exec_seconds=exec_total,
                          queue_seconds=queue_waits,
                          singular=singular_served, workload="update")
+
+    def _run_update_group(self, group: list, ex, queue_waits: list,
+                          occupancy: int):
+        """One vmapped SMW launch for riders targeting DISTINCT handles
+        (ISSUE 17): read each handle's committed state under its store
+        lock (locks taken in sorted handle-id order — one global
+        acquisition order, so concurrent group launches can never
+        deadlock), stack the (A, A⁻¹, U, V, n_real) quadruples with
+        inert identity/zero fillers for empty slots, run the batch-cap
+        executable ONCE (retried + integrity-gated over the REAL
+        elements), then judge every rider's gate/drift/rung and commit
+        PER HANDLE exactly as the one-per-launch path does.
+
+        Returns ``(results, exec_seconds)`` where each result is the
+        rider's ``InvertResult``, ``None`` (deadline — failed typed,
+        handle untouched), or the rider's own typed exception; a raise
+        out of this method is a whole-launch terminal failure."""
+        import contextlib
+        import math
+
+        import jax.numpy as jnp
+
+        from ..obs import hwcost as _hwcost
+        from ..obs.spans import timed_blocking
+        from .handles import UnknownHandleError
+
+        store = self.handles
+        bucket = ex.key.bucket_n
+        cap, N, K = ex.key.batch_cap, ex.key.bucket_n, ex.key.rhs
+        dtype = np.dtype(ex.key.dtype)
+        results = [None] * len(group)
+        with contextlib.ExitStack() as stack:
+            sts = {}
+            live = []
+            for i, req in sorted(enumerate(group),
+                                 key=lambda t: t[1].handle.handle_id):
+                hid = req.handle.handle_id
+                try:
+                    sts[hid] = stack.enter_context(store.txn(hid))
+                except UnknownHandleError as e:
+                    results[i] = e
+                else:
+                    live.append(i)
+            live.sort()
+            if not live:
+                return results, 0.0
+            a = np.tile(np.eye(N, dtype=dtype), (cap, 1, 1))
+            inv = a.copy()
+            u = np.zeros((cap, N, K), dtype)
+            v = np.zeros((cap, N, K), dtype)
+            nr = np.zeros((cap, 1), np.int32)
+            for slot, i in enumerate(live):
+                req = group[i]
+                st = sts[req.handle.handle_id]
+                a[slot] = st.a
+                inv[slot] = st.inverse
+                u[slot] = req.padded_u
+                v[slot] = req.padded_v
+                nr[slot] = req.n
+            args = tuple(jnp.asarray(x) for x in (a, inv, u, v, nr))
+
+            def run_once():
+                _faults.fire("execute")
+                out, esp = timed_blocking(
+                    ex.run, *args, telemetry=self._tel, name="execute",
+                    bucket=bucket, occupancy=len(live),
+                    workload="update")
+                _hwcost.attach_execute_cost(
+                    esp, ex.cost,
+                    analytical_flops=len(live)
+                    * _hwcost.baseline_workload_flops(
+                        bucket, "update", k=ex.key.rhs))
+                a_new, inv_new, sing, kappa, rel = out
+                sing = np.asarray(sing)
+                kappa = np.asarray(kappa, float)
+                rel = np.array(np.asarray(rel), float)
+                for slot in range(len(live)):
+                    if (not bool(sing[slot])
+                            and _faults.corrupt("result_corrupt_nan")):
+                        rel[slot] = float("nan")
+                # Integrity gate per REAL element (the invert-lane
+                # discipline): a non-singular update must report a
+                # finite in-launch rel_residual — corruption is typed
+                # and retryable, and no commit has happened yet, so
+                # the whole-launch retry is mutation-safe.
+                for slot in range(len(live)):
+                    if (not bool(sing[slot])
+                            and not math.isfinite(float(rel[slot]))):
+                        raise ResultCorruptionError(
+                            f"non-finite rel_residual in batched "
+                            f"update launch (bucket {bucket}, slot "
+                            f"{slot}) — corrupted result detected by "
+                            f"the integrity gate")
+                return a_new, inv_new, sing, kappa, rel, esp.duration
+
+            def on_retry(exc, attempt):
+                for i in live:
+                    group[i].hop("retry", attempt=attempt,
+                                 error=type(exc).__name__)
+
+            a_new, inv_new, sing, kappa, rel, exec_s = (
+                self.policy.retry.call(
+                    run_once, component="serve.update",
+                    on_retry=on_retry,
+                    exemplar=(group[live[0]].ctx.request_id
+                              if group[live[0]].ctx is not None
+                              else None))
+                if self.policy is not None else run_once())
+
+            for slot, i in enumerate(live):
+                req = group[i]
+                st = sts[req.handle.handle_id]
+                try:
+                    results[i] = self._finish_update(
+                        req, st, ex, np.asarray(a_new[slot]),
+                        np.asarray(inv_new[slot]), bool(sing[slot]),
+                        float(kappa[slot]), float(rel[slot]), exec_s,
+                        queue_waits[i], occupancy)
+                except BaseException as e:          # noqa: BLE001
+                    # One rider's typed gate exhaustion must not abort
+                    # a batch-mate's commit — per-rider fates, exactly
+                    # like the sequential path.
+                    results[i] = e
+        return results, exec_s
 
     def _run_one_update(self, req, ex, queue_s: float,
                         occupancy: int):
@@ -521,10 +744,8 @@ class MicroBatcher:
         import jax.numpy as jnp
         import math
 
-        from ..linalg.update import drift_budget, drift_exceeded
         from ..obs import hwcost as _hwcost
         from ..obs.spans import timed_blocking
-        from ..resilience.degrade import gate_passes, gate_threshold
 
         bucket = req.bucket_n
         handle = req.handle
@@ -573,75 +794,97 @@ class MicroBatcher:
                               if req.ctx is not None else None))
                 if self.policy is not None else run_once())
 
-            # Deadline, judged BEFORE the commit: an update past its
-            # deadline fails typed with the handle untouched — "typed
-            # failure = no mutation" holds unconditionally (the invert
-            # lanes check after fan-out; an update has state to
-            # protect).
-            if not self._fail_expired([req], "execute"):
-                return None
+            return self._finish_update(req, st, ex, a_new, inv_new,
+                                       sing, kappa, rel, exec_s,
+                                       queue_s, occupancy)
 
-            if self.numerics == "summary" and not sing:
-                # Observed (and spiked) BEFORE the gate/rung run — the
-                # ISSUE 10 causality discipline: a recovery_rung event
-                # must be preceded by the numerics evidence (the
-                # PRE-recovery residual, judged by the policy's own
-                # gate threshold) that explains it.
-                self._observe_update_numerics(req, ex, kappa, rel)
+    def _finish_update(self, req, st, ex, a_new, inv_new, sing: bool,
+                       kappa: float, rel: float, exec_s: float,
+                       queue_s: float, occupancy: int):
+        """Judge and commit ONE update rider's launch result — shared
+        by the one-per-launch path and the batched group launch (the
+        judgment/commit discipline is identical; only the launch shape
+        differs).  Must be called with ``st``'s handle transaction
+        held.  Returns the rider's ``InvertResult``, or ``None`` when
+        the deadline expired (failed typed, handle untouched); raises
+        the typed ``ResidualGateError`` on gate exhaustion."""
+        import jax.numpy as jnp
 
-            outcome, recovery_rel = "refreshed", rel
-            if sing:
-                # Typed singularity, handle untouched: the mutation
-                # would have destroyed the matrix's rank — the rider
-                # learns it, the resident state stays consistent.
-                outcome = "gated"
-            elif self.policy is not None:
-                thr = gate_threshold(self.policy, req.n, kappa,
-                                     jnp.dtype(ex.key.dtype))
-                budget = drift_budget(thr, self._drift_factor)
-                new_drift = st.drift + max(rel, 0.0)
-                if (not gate_passes(rel, thr)
-                        or drift_exceeded(new_drift, budget)):
-                    if (self.numerics == "summary"
-                            and gate_passes(rel, thr)):
-                        # Drift-caused: the residual spike above
-                        # cannot explain this rung (rel passed), so
-                        # the budget exceedance records its own spike.
-                        from ..obs.numerics import record_drift_spike
+        from ..linalg.update import drift_budget, drift_exceeded
+        from ..resilience.degrade import gate_passes, gate_threshold
 
-                        record_drift_spike(n=req.n,
-                                           engine=ex.key.engine,
-                                           value=new_drift,
-                                           threshold=budget)
-                    outcome, kappa, recovery_rel, inv_new = (
-                        self._reinvert_rung(req, a_new, rel,
-                                            new_drift, thr, budget))
-                    new_drift = 0.0
-                    if outcome == "gated":
-                        # The rung's FRESH elimination flagged the
-                        # mutated matrix singular — the capacitance
-                        # solve's rounded determinant slipped past the
-                        # eps threshold, but the from-scratch pivot
-                        # probe cannot be fooled: typed singularity,
-                        # handle untouched.
-                        sing = True
-                if not sing:
-                    store.commit(st, a=np.asarray(a_new),
-                                 inverse=np.asarray(inv_new),
-                                 kappa=kappa,
-                                 rel_residual=recovery_rel,
-                                 drift=new_drift,
-                                 reinverted=outcome == "re_inverted")
-            else:
-                # No policy = no gate (the PR 5 contract): drift still
-                # accumulates so an attached policy later sees history.
+        bucket = req.bucket_n
+        handle = req.handle
+        store = self.handles
+        # Deadline, judged BEFORE the commit: an update past its
+        # deadline fails typed with the handle untouched — "typed
+        # failure = no mutation" holds unconditionally (the invert
+        # lanes check after fan-out; an update has state to
+        # protect).
+        if not self._fail_expired([req], "execute"):
+            return None
+
+        if self.numerics == "summary" and not sing:
+            # Observed (and spiked) BEFORE the gate/rung run — the
+            # ISSUE 10 causality discipline: a recovery_rung event
+            # must be preceded by the numerics evidence (the
+            # PRE-recovery residual, judged by the policy's own
+            # gate threshold) that explains it.
+            self._observe_update_numerics(req, ex, kappa, rel)
+
+        outcome, recovery_rel = "refreshed", rel
+        if sing:
+            # Typed singularity, handle untouched: the mutation
+            # would have destroyed the matrix's rank — the rider
+            # learns it, the resident state stays consistent.
+            outcome = "gated"
+        elif self.policy is not None:
+            thr = gate_threshold(self.policy, req.n, kappa,
+                                 jnp.dtype(ex.key.dtype))
+            budget = drift_budget(thr, self._drift_factor)
+            new_drift = st.drift + max(rel, 0.0)
+            if (not gate_passes(rel, thr)
+                    or drift_exceeded(new_drift, budget)):
+                if (self.numerics == "summary"
+                        and gate_passes(rel, thr)):
+                    # Drift-caused: the residual spike above
+                    # cannot explain this rung (rel passed), so
+                    # the budget exceedance records its own spike.
+                    from ..obs.numerics import record_drift_spike
+
+                    record_drift_spike(n=req.n,
+                                       engine=ex.key.engine,
+                                       value=new_drift,
+                                       threshold=budget)
+                outcome, kappa, recovery_rel, inv_new = (
+                    self._reinvert_rung(req, a_new, rel,
+                                        new_drift, thr, budget))
+                new_drift = 0.0
+                if outcome == "gated":
+                    # The rung's FRESH elimination flagged the
+                    # mutated matrix singular — the capacitance
+                    # solve's rounded determinant slipped past the
+                    # eps threshold, but the from-scratch pivot
+                    # probe cannot be fooled: typed singularity,
+                    # handle untouched.
+                    sing = True
+            if not sing:
                 store.commit(st, a=np.asarray(a_new),
-                             inverse=np.asarray(inv_new), kappa=kappa,
-                             rel_residual=rel,
-                             drift=st.drift + max(rel, 0.0))
-            version, drift_after = st.version, st.drift
-            req.hop("update", outcome=outcome, version=version,
-                    drift=round(drift_after, 9))
+                             inverse=np.asarray(inv_new),
+                             kappa=kappa,
+                             rel_residual=recovery_rel,
+                             drift=new_drift,
+                             reinverted=outcome == "re_inverted")
+        else:
+            # No policy = no gate (the PR 5 contract): drift still
+            # accumulates so an attached policy later sees history.
+            store.commit(st, a=np.asarray(a_new),
+                         inverse=np.asarray(inv_new), kappa=kappa,
+                         rel_residual=rel,
+                         drift=st.drift + max(rel, 0.0))
+        version, drift_after = st.version, st.drift
+        req.hop("update", outcome=outcome, version=version,
+                drift=round(drift_after, 9))
         return InvertResult(
             inverse=(None if sing
                      else np.asarray(inv_new)[:req.n, :req.n]),

@@ -132,8 +132,12 @@ def projected_lane_bytes(bucket_n: int, batch_cap: int, dtype,
         args = cap * n2 * it + cap * 4
         outs = cap * n2 * it + cap * per_elem_out
     elif workload == "update":
-        args = 2 * n2 * it + 2 * bucket_n * k * it + 4
-        outs = 2 * n2 * it + per_elem_out
+        # Scales with the batch dimension (ISSUE 17): the batched
+        # update lane stacks cap (A, A⁻¹, U, V) quadruples per launch;
+        # cap == 1 reproduces the historical unbatched projection
+        # byte-for-byte.
+        args = cap * (2 * n2 + 2 * bucket_n * k) * it + cap * 4
+        outs = cap * 2 * n2 * it + cap * per_elem_out
     else:                             # solve lanes
         args = cap * n2 * it + cap * bucket_n * k * it + cap * 4
         outs = cap * bucket_n * k * it + cap * per_elem_out
@@ -254,15 +258,22 @@ class BucketExecutor:
         ).compile()
 
     def _build_update(self):
-        """The update-lane executable (ISSUE 12): ONE Sherman–Morrison–
-        Woodbury rank-k application per launch — mutate A, update the
-        resident inverse, and re-verify against the MUTATED matrix in
-        the same compiled program (``linalg.update.
-        smw_update_with_metrics``).  Unbatched on purpose: each launch
-        mutates one handle's resident state, and the executable is
-        keyed per (bucket_n, k_bucket, dtype) so its ``cost_analysis``
-        FLOPs are pinnable strictly below the same-n fresh-invert
-        executable's (tests/test_update.py)."""
+        """The update-lane executable (ISSUE 12, batched in ISSUE 17):
+        Sherman–Morrison–Woodbury rank-k applications — mutate A, update
+        the resident inverse, and re-verify against the MUTATED matrix
+        in the same compiled program (``linalg.update.
+        smw_update_with_metrics``).
+
+        ``batch_cap == 1`` keeps the historical one-application-per-
+        launch signature (``(N,N),(N,N),(N,K),(N,K),(1,)``) unchanged —
+        same lowered program, same cost_analysis FLOPs pin below the
+        fresh-invert executable's.  ``batch_cap > 1`` vmaps the SAME
+        kernel over a leading batch axis, like the invert micro-batches:
+        each element carries its own (A, A⁻¹, U, V, n_real) and comes
+        back with per-element singular/kappa/rel flags — in-launch
+        re-verification per element, so a partial batch's inert filler
+        slots (identity A/A⁻¹, zero U/V, n_real = 0) never pollute a
+        real element's gate judgment."""
         from ..linalg.update import smw_update_with_metrics
 
         key = self.key
@@ -273,17 +284,32 @@ class BucketExecutor:
                 f"engine {key.engine!r} is not an update-lane engine "
                 f"(smw_update is the one registered update engine)")
 
-        def fn(a, inv, u, v, n_real):
-            return smw_update_with_metrics(a, inv, u, v, n_real=n_real)
-
         dtype = jnp.dtype(key.dtype)
-        N, K = key.bucket_n, key.rhs
+        cap, N, K = key.batch_cap, key.bucket_n, key.rhs
+        if cap == 1:
+            def fn(a, inv, u, v, n_real):
+                return smw_update_with_metrics(a, inv, u, v,
+                                               n_real=n_real)
+
+            return jax.jit(fn).lower(
+                jax.ShapeDtypeStruct((N, N), dtype),
+                jax.ShapeDtypeStruct((N, N), dtype),
+                jax.ShapeDtypeStruct((N, K), dtype),
+                jax.ShapeDtypeStruct((N, K), dtype),
+                jax.ShapeDtypeStruct((1,), jnp.int32),
+            ).compile()
+
+        def fn(a, inv, u, v, n_real):
+            return jax.vmap(
+                lambda aa, ii, uu, vv, nr: smw_update_with_metrics(
+                    aa, ii, uu, vv, n_real=nr))(a, inv, u, v, n_real)
+
         return jax.jit(fn).lower(
-            jax.ShapeDtypeStruct((N, N), dtype),
-            jax.ShapeDtypeStruct((N, N), dtype),
-            jax.ShapeDtypeStruct((N, K), dtype),
-            jax.ShapeDtypeStruct((N, K), dtype),
-            jax.ShapeDtypeStruct((1,), jnp.int32),
+            jax.ShapeDtypeStruct((cap, N, N), dtype),
+            jax.ShapeDtypeStruct((cap, N, N), dtype),
+            jax.ShapeDtypeStruct((cap, N, K), dtype),
+            jax.ShapeDtypeStruct((cap, N, K), dtype),
+            jax.ShapeDtypeStruct((cap, 1), jnp.int32),
         ).compile()
 
     def run(self, *args):

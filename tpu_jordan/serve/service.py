@@ -464,6 +464,10 @@ class JordanService:
             if cap != 1:
                 project("invert", b, 1)      # the re_invert cap-1 twin
             project("update", b, 1, k_bucket_for(int(k)))
+            if cap != 1:
+                # The batched update lane (ISSUE 17): distinct-handle
+                # riders share one vmapped launch at the service's cap.
+                project("update", b, cap, k_bucket_for(int(k)))
         return out
 
     def warmup(self, shapes=(), solve_shapes=(), update_shapes=()) -> dict:
@@ -517,6 +521,14 @@ class JordanService:
             ex = self.executors.get(b, 1, self._batcher.block_size,
                                     workload="update", rhs=kb)
             out[f"update:{b}:k{kb}"] = ex.key.engine
+            if self.batch_cap != 1:
+                # The batched update lane (ISSUE 17): riders targeting
+                # DISTINCT handles share one vmapped SMW launch at the
+                # service's batch cap; the cap-1 lane above stays warm
+                # for occupancy-1 batches and same-handle followers.
+                self.executors.get(b, self.batch_cap,
+                                   self._batcher.block_size,
+                                   workload="update", rhs=kb)
         return out
 
     def start(self) -> None:
