@@ -13,7 +13,7 @@ WORKERS  ?= 1
 REQUESTS  ?= 64
 BATCH_CAP ?= 8
 
-.PHONY: all native tpu test smoke serve-demo solve-demo chaos-demo fleet-demo update-demo capacity-demo comm-demo lp-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
+.PHONY: all native tpu test smoke serve-demo solve-demo chaos-demo fleet-demo autoscale-demo update-demo capacity-demo comm-demo lp-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
 
 REPLICAS ?= 3
 
@@ -87,6 +87,21 @@ fleet-demo:
 	  --serve-requests 60 --batch-cap 4 --quiet $(FLEET_ARGS) \
 	  > /tmp/tpu_jordan_fleet.json
 	python tools/check_fleet.py /tmp/tpu_jordan_fleet.json
+
+# Autoscaler demo + validation (ISSUE 18, docs/FLEET.md): one seeded
+# burst->idle->recovery trace through a floor-sized fleet under the
+# SLO-driven FleetAutoscaler — sustained deadline burn pages the
+# burn-rate monitor, which scales the pool toward the ceiling and
+# pre-sheds new submissions typed at the router; the idle phase drains
+# parked slots back to the floor; the recovery wave serves clean.  The
+# checker re-derives EVERY scale/drain/pre-shed decision from the burn
+# evidence recorded alongside it (exit 2 = a silent p99 breach or an
+# unexplained scale action).
+autoscale-demo:
+	python -m tpu_jordan 48 16 --autoscale-demo --replicas $(REPLICAS) \
+	  --serve-requests 32 --batch-cap 4 --quiet \
+	  > /tmp/tpu_jordan_autoscale.json
+	python tools/check_autoscale.py /tmp/tpu_jordan_autoscale.json
 
 # Resident-update demo + validation (ISSUE 12, docs/WORKLOADS.md):
 # a resident handle streams rank-32 Sherman-Morrison-Woodbury updates

@@ -1299,6 +1299,95 @@ def _update_batched_row(extra, n=64, cap=4, rounds=5, timeout=900):
         extra["update_batched_amortized_error"] = str(e)[:200]
 
 
+def _serve_mesh_row(extra, n=4096, m=128, p=8, rounds=2, timeout=900):
+    """ISSUE 18 capture row ``serve_mesh_4096``: the mesh-backed serve
+    lane at the headline size — a request whose single-device
+    projection exceeds the lane budget served through the warmed
+    p-device lane on the forced 8-virtual-device CPU mesh (the
+    __graft_entry__ dryrun recipe).  Context + accounting only, BY
+    DESIGN: ``*_projected_lane_bytes`` (the per-device admission
+    number) and ``*_measured_lane_bytes`` (the compiled lane's
+    capacity-ledger footprint) end in ``_bytes`` — the accounting
+    class ``tools/check_bench.py`` never compares across rounds (a
+    compiler or layout change re-prices the same lane); occupancy (1
+    by the mesh-lane contract), execute wall time, and the
+    zero-compile warm-path delta are plain context keys.  No new rate
+    key: CPU-mesh serve wall time is not chip throughput.  Best-effort
+    like every non-contract row."""
+    import subprocess
+    import sys
+
+    from __graft_entry__ import _REPO, _cpu_env
+
+    child = (
+        "import jax, json\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import jax.numpy as jnp\n"
+        "import numpy as np\n"
+        "from tpu_jordan.obs import capacity as cap\n"
+        "from tpu_jordan.obs.metrics import REGISTRY\n"
+        "from tpu_jordan.serve.executors import projected_lane_bytes\n"
+        "from tpu_jordan.serve.service import JordanService\n"
+        f"n, m, p, rounds = {n}, {m}, {p}, {rounds}\n"
+        "single = projected_lane_bytes(n, 1, jnp.float32)\n"
+        "per_dev = projected_lane_bytes(n, 1, jnp.float32, devices=p)\n"
+        "budget = (single + per_dev) // 2\n"
+        "rng = np.random.default_rng(18)\n"
+        "with JordanService(dtype=jnp.float32, batch_cap=1,\n"
+        "                   max_wait_ms=1.0, block_size=m,\n"
+        "                   mesh_shapes=(p,),\n"
+        "                   lane_budget_bytes=budget) as svc:\n"
+        "    svc.warmup(mesh_shapes=[(n, p)])\n"
+        "    measured = cap.live_bytes('executor_lanes')\n"
+        "    c0 = REGISTRY.counter('tpu_jordan_compiles_total').total()\n"
+        "    times, occs = [], []\n"
+        "    for _ in range(rounds):\n"
+        "        a = rng.standard_normal((n, n)).astype(np.float32)\n"
+        "        r = svc.submit(a).result(timeout=600)\n"
+        "        assert not r.singular and r.rel_residual < 1e-2\n"
+        "        times.append(r.execute_seconds)\n"
+        "        occs.append(r.batch_occupancy)\n"
+        "    dc = (REGISTRY.counter('tpu_jordan_compiles_total')\n"
+        "          .total() - c0)\n"
+        "times.sort()\n"
+        "print(json.dumps({'n': n, 'm': m, 'mesh': 'p%d' % p,\n"
+        "    'projected_lane_bytes': int(per_dev),\n"
+        "    'single_device_bytes': int(single),\n"
+        "    'lane_budget_bytes': int(budget),\n"
+        "    'measured_lane_bytes': int(measured),\n"
+        "    'occupancy': int(max(occs)),\n"
+        "    'execute_ms': round(times[len(times) // 2] * 1e3, 2),\n"
+        "    'compiles_delta': int(dc)}))\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", child], env=_cpu_env(8), cwd=_REPO,
+            capture_output=True, text=True, timeout=timeout, check=True)
+        row = json.loads(proc.stdout.strip().splitlines()[-1])
+        if row["compiles_delta"] != 0:
+            raise RuntimeError(
+                f"{row['compiles_delta']} compile(s) on the warm "
+                f"mesh-serve path")
+        if row["occupancy"] != 1:
+            raise RuntimeError(
+                f"mesh lane dispatched at occupancy "
+                f"{row['occupancy']}, contract is 1")
+        row["note"] = ("cpu-mesh serve-lane context leg, not chip "
+                       "throughput")
+        extra["serve_mesh_4096"] = row
+        # Top-level sentinel keys: both byte fields are accounting-
+        # class (tools/check_bench.py never rate-compares *_bytes).
+        extra["serve_mesh_4096_projected_lane_bytes"] = row[
+            "projected_lane_bytes"]
+        extra["serve_mesh_4096_measured_lane_bytes"] = row[
+            "measured_lane_bytes"]
+        extra["serve_mesh_4096_occupancy"] = row["occupancy"]
+        extra["serve_mesh_4096_execute_ms"] = row["execute_ms"]
+        extra["serve_mesh_4096_compiles_delta"] = row["compiles_delta"]
+    except Exception as e:                      # noqa: BLE001
+        extra["serve_mesh_4096_error"] = str(e)[:200]
+
+
 def _dip_guard(extra, candidates):
     """The r04→r05 4096² regression guard (ISSUE 6 satellite; `make
     bench-dip` reproduces just this row).  The best 4096² capture of
@@ -1484,6 +1573,13 @@ def main(argv=None):
     # like every non-contract row.
     _lookahead_row(extra)
     _solve_lookahead_sharded_row(extra)
+
+    # Mesh-backed serve lane (ISSUE 18): the over-budget request served
+    # through the warmed p8 lane at the headline size — projected vs
+    # measured per-device lane bytes (accounting-class, never
+    # rate-compared) with the zero-compile warm pin.  Best-effort like
+    # every non-contract row.
+    _serve_mesh_row(extra)
 
     print(json.dumps({
         "metric": "invert_4096x4096_f32_gflops",

@@ -1,0 +1,331 @@
+"""FleetAutoscaler policy coverage (ISSUE 18 tentpole part 2): the
+fake-clock control loop driven tick-by-tick against a scripted fake
+pool/registry (scale-up on sustained two-window burn, the capacity-
+ledger veto as a typed ``scale_withheld``, cooldown spacing, ceiling/
+floor bounds, drain on idle, the pre-shed flag engaging the tick risk
+appears and releasing the tick it clears — never draining into a
+burn), plus the ``--autoscale-demo`` acceptance run validated by the
+SAME checker ``make autoscale-demo`` runs (accept + doctored-reject:
+stripped burn evidence, a silent p99 breach, an uncounted pre-shed,
+and a diverged flight-recorder trail must all page)."""
+
+import copy
+import importlib.util
+import pathlib
+import types
+
+import pytest
+
+from tpu_jordan.fleet import FleetAutoscaler, autoscale_demo
+from tpu_jordan.obs.metrics import REGISTRY
+from tpu_jordan.obs.recorder import RECORDER
+from tpu_jordan.obs.slo import SLOMonitor, SLOSpec
+
+_tool = (pathlib.Path(__file__).resolve().parent.parent / "tools"
+         / "check_autoscale.py")
+_spec = importlib.util.spec_from_file_location("check_autoscale", _tool)
+check_autoscale = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_autoscale)
+
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += float(dt)
+
+
+class FakeRegistry:
+    """A scripted metrics source: the test mutates ``ok``/``err``/
+    ``p99_s`` between ticks and ``snapshot()`` renders exactly the two
+    series the burn windows and the p99 objective integrate."""
+
+    def __init__(self, bucket="64"):
+        self.bucket = bucket
+        self.ok = 0
+        self.err = 0
+        self.p99_s = None
+
+    def snapshot(self):
+        snap = {"tpu_jordan_request_outcome_total": {"series": [
+            {"labels": {"bucket": self.bucket, "outcome": "ok"},
+             "value": float(self.ok)},
+            {"labels": {"bucket": self.bucket, "outcome": "error"},
+             "value": float(self.err)},
+        ]}}
+        if self.p99_s is not None:
+            snap["tpu_jordan_request_latency_seconds"] = {"series": [
+                {"labels": {"bucket": self.bucket}, "p99": self.p99_s}]}
+        return snap
+
+
+class FakePool:
+    """The four-method harness the autoscaler docstring names: ready
+    count, grow, drain, and the router's pre-shed flag."""
+
+    def __init__(self, ready=1):
+        self._ready = int(ready)
+        self.router = types.SimpleNamespace(pre_shed=False)
+        self.grown = 0
+        self.drained = 0
+
+    def ready_count(self):
+        return self._ready
+
+    def grow(self):
+        self._ready += 1
+        self.grown += 1
+        return self._ready - 1
+
+    def drain_slot(self):
+        self._ready -= 1
+        self.drained += 1
+        return self._ready
+
+
+def _harness(ready=1, availability=0.9, p99_ms=100.0, floor=1,
+             ceiling=3, idle_after_s=5.0, cooldown=0.0, **kw):
+    """One (clock, registry, pool, scaler) with a (10s, 2s, 1x) burn
+    pair: 50% errors against a 0.1 budget burns 5x — decisively
+    paging; zero traffic burns zero — decisively quiet."""
+    clock = FakeClock()
+    reg = FakeRegistry()
+    monitor = SLOMonitor(
+        [SLOSpec(name="demo", bucket="64", availability=availability,
+                 p99_latency_ms=p99_ms)],
+        registry=reg, clock=clock, windows=((10.0, 2.0, 1.0),))
+    pool = FakePool(ready=ready)
+    scaler = FleetAutoscaler(pool, monitor, floor=floor,
+                             ceiling=ceiling,
+                             idle_after_s=idle_after_s,
+                             scale_cooldown_s=cooldown, clock=clock,
+                             **kw)
+    return clock, reg, pool, scaler
+
+
+class TestAutoscalerPolicy:
+    def test_full_cycle_scale_up_preshed_drain_to_floor(self):
+        """The whole loop on a fake clock: quiet baseline -> sustained
+        burn scales to the ceiling with pre-shed engaged -> the burn
+        clearing drains back to the floor with pre-shed released —
+        and every action's evidence re-derives under the SAME checker
+        the CI gate runs."""
+        clock, reg, pool, scaler = _harness()
+        mark = RECORDER.total
+        c = REGISTRY.counter("tpu_jordan_autoscale_actions_total")
+        up0 = c.value(action="scale_up")
+
+        t = scaler.tick()                    # quiet baseline
+        assert t["action"] is None and not t["paging"]
+        assert pool.router.pre_shed is False
+
+        reg.ok, reg.err = 5, 5               # 50% errors: burn 5x
+        clock.advance(1.0)
+        t = scaler.tick()
+        assert t["action"] == "scale_up" and t["paging"] == ["demo"]
+        assert pool.router.pre_shed is True and t["ready"] == 2
+
+        clock.advance(1.0)
+        t = scaler.tick()                    # still burning: one more
+        assert t["action"] == "scale_up" and t["ready"] == 3
+
+        clock.advance(1.0)
+        t = scaler.tick()                    # short window aged out:
+        assert t["action"] is None and t["ready"] == 3
+        # ...the multi-window AND stops paging (the blip is no longer
+        # "still happening") and pre-shed releases immediately, while
+        # the fleet holds its scaled size until the idle drain.
+        assert not t["paging"] and pool.router.pre_shed is False
+
+        clock.advance(11.0)                  # burn ages out of 10s
+        t = scaler.tick()                    # idle >= 5s: drain
+        assert t["action"] == "drain" and not t["paging"]
+        assert t["ready"] == 2
+
+        clock.advance(1.0)
+        t = scaler.tick()
+        assert t["action"] == "drain" and t["ready"] == 1
+
+        clock.advance(1.0)
+        t = scaler.tick()                    # at the floor: held
+        assert t["action"] is None and t["ready"] == 1
+
+        assert [a["action"] for a in scaler.actions] == [
+            "scale_up", "pre_shed_on", "scale_up", "pre_shed_off",
+            "drain", "drain"]
+        assert pool.grown == 2 and pool.drained == 2
+        assert c.value(action="scale_up") - up0 == 2
+        # The flight-recorder trail mirrors the in-memory one.
+        events = [e for e in RECORDER.since(mark)
+                  if e.get("kind") == "autoscale"]
+        assert ([e["action"] for e in events]
+                == [a["action"] for a in scaler.actions])
+        # Each scale_up's evidence re-derives under the CI checker:
+        # every recorded window pair actually pages by its own numbers
+        # with burn = error_rate / error_budget.
+        for a in scaler.actions:
+            if a["action"] == "scale_up":
+                assert check_autoscale._check_paging_evidence(
+                    "t", a["evidence"]["paging"]) == []
+            if a["action"] == "drain":
+                assert (a["evidence"]["idle_s"]
+                        >= a["evidence"]["idle_after_s"])
+
+    def test_cooldown_spaces_capacity_actions(self):
+        clock, reg, pool, scaler = _harness(cooldown=100.0)
+        scaler.tick()
+        reg.ok, reg.err = 5, 5
+        clock.advance(1.0)
+        assert scaler.tick()["action"] == "scale_up"
+        clock.advance(1.0)
+        t = scaler.tick()                    # paging, but in cooldown
+        assert t["action"] is None and t["paging"] == ["demo"]
+        assert t["pre_shed"] is True         # the flag has no cooldown
+        assert pool.grown == 1
+
+    def test_capacity_veto_records_scale_withheld(self, monkeypatch):
+        clock, reg, pool, scaler = _harness(scale_budget_bytes=1000)
+        monkeypatch.setattr("tpu_jordan.obs.capacity.live_bytes",
+                            lambda *a, **k: 5000)
+        scaler.tick()
+        reg.ok, reg.err = 5, 5
+        clock.advance(1.0)
+        t = scaler.tick()
+        assert t["action"] == "scale_withheld"
+        assert pool.grown == 0 and t["ready"] == 1
+        ev = scaler.actions[0]["evidence"]
+        assert ev["live_bytes"] >= ev["scale_budget_bytes"]
+        assert check_autoscale._check_paging_evidence(
+            "t", ev["paging"]) == []
+
+    def test_p99_risk_presheds_and_blocks_drain_until_clear(self):
+        """p99 at 90% of a 100ms target with a 0.8 trigger: pre-shed
+        engages WITHOUT a burn, and an otherwise-idle fleet must not
+        drain into the risk; the risk clearing releases the flag and
+        the drain lands the same tick."""
+        clock, reg, pool, scaler = _harness(ready=2, idle_after_s=0.0)
+        reg.p99_s = 0.090                    # 90ms >= 0.8 x 100ms
+        t = scaler.tick()
+        assert t["p99_risk"] == ["demo"] and not t["paging"]
+        assert t["pre_shed"] is True and t["action"] is None
+        assert pool.drained == 0
+        on = scaler.actions[0]
+        assert on["action"] == "pre_shed_on"
+        assert on["evidence"]["p99_risk"][0]["p99_ms"] >= 80.0
+
+        reg.p99_s = 0.010
+        clock.advance(1.0)
+        t = scaler.tick()
+        assert t["action"] == "drain" and t["pre_shed"] is False
+        assert [a["action"] for a in scaler.actions] == [
+            "pre_shed_on", "drain", "pre_shed_off"]
+
+    def test_drain_never_below_floor_scale_never_above_ceiling(self):
+        clock, reg, pool, scaler = _harness(ready=1, idle_after_s=0.0,
+                                            floor=1, ceiling=2)
+        scaler.tick()
+        clock.advance(1.0)
+        assert scaler.tick()["action"] is None     # idle at the floor
+        reg.ok, reg.err = 5, 5
+        clock.advance(1.0)
+        assert scaler.tick()["action"] == "scale_up"
+        clock.advance(1.0)
+        assert scaler.tick()["action"] is None     # at the ceiling
+        assert pool.ready_count() == 2
+
+    def test_ctor_validates_bounds(self):
+        clock, reg, pool, scaler = _harness()
+        with pytest.raises(ValueError, match="floor"):
+            FleetAutoscaler(pool, scaler.monitor, floor=0)
+        with pytest.raises(ValueError, match="ceiling"):
+            FleetAutoscaler(pool, scaler.monitor, floor=3, ceiling=2)
+
+
+#: One cached acceptance run (the Makefile's exact shape) shared by the
+#: pin + every doctored-reject: the checker tests doctor COPIES instead
+#: of paying for a second burst->idle->recovery trace.
+_REPORT_CACHE = {}
+
+
+def _report():
+    if "report" not in _REPORT_CACHE:
+        _REPORT_CACHE["report"] = autoscale_demo(
+            n=48, requests=32, floor=1, ceiling=3, batch_cap=4,
+            block_size=16)
+    return _REPORT_CACHE["report"]
+
+
+class TestAutoscaleDemoAcceptance:
+    def test_demo_exercises_the_loop_and_checker_accepts(self):
+        """The ISSUE 18 acceptance pin: the demo shows scale-up on
+        burn, a pre-shed engage/release cycle, drain back to the
+        floor, a clean recovery — and the CI checker re-derives every
+        decision from its recorded burn evidence with zero silent
+        breaches (the same validation ``make autoscale-demo`` runs)."""
+        report = _report()
+        assert check_autoscale.check(report) == ([], [])
+        kinds = report["actions_by_kind"]
+        assert kinds.get("scale_up", 0) >= 1
+        assert kinds.get("drain", 0) >= 1
+        assert kinds.get("pre_shed_on", 0) >= 1
+        assert kinds.get("pre_shed_off", 0) >= 1
+        assert report["silent_p99_breach"] is False
+        traj = report["ready_trajectory"]
+        assert max(traj) <= report["ceiling"]
+        assert min(traj) >= report["floor"]
+        assert traj[-1] == report["floor"]
+        assert report["pre_shed_count"] >= 1
+        assert report["ledger"]["outstanding"] == 0
+        # The burn source is typed, deterministic deadline pressure.
+        burst = report["phases"]["burst"]["waves"]
+        assert any(w["typed_errors"].get("DeadlineExceededError")
+                   for w in burst)
+        assert report["phases"]["recovery"]["ok"] >= 1
+        assert not report["phases"]["recovery"]["typed_errors"]
+
+    def test_checker_pages_on_stripped_burn_evidence(self):
+        doctored = copy.deepcopy(_report())
+        up = next(a for a in doctored["actions"]
+                  if a["action"] == "scale_up")
+        up["evidence"]["paging"] = []
+        errs, silent = check_autoscale.check(doctored)
+        assert any("unexplained" in s for s in silent)
+
+    def test_checker_pages_on_silent_p99_breach(self):
+        doctored = copy.deepcopy(_report())
+        tick = next(t for t in doctored["ticks"]
+                    if t["pre_shed"] and (t["paging"] or t["p99_risk"])
+                    and t["action"] is None)
+        tick["pre_shed"] = False
+        errs, silent = check_autoscale.check(doctored)
+        assert any("SILENT P99 BREACH" in s for s in silent)
+        # The report's own flag now disagrees with the re-derivation —
+        # a second, independent alarm.
+        assert any("disagrees" in s for s in silent)
+
+    def test_checker_pages_on_uncounted_preshed(self):
+        doctored = copy.deepcopy(_report())
+        doctored["pre_shed_count"] += 1
+        errs, silent = check_autoscale.check(doctored)
+        assert any("uncounted or unhopped" in s for s in silent)
+
+    def test_checker_pages_on_diverged_recorder_trail(self):
+        doctored = copy.deepcopy(_report())
+        events = doctored["blackbox"]["events"]
+        drop = next(e for e in events if e.get("kind") == "autoscale")
+        events.remove(drop)
+        errs, silent = check_autoscale.check(doctored)
+        assert any("diverge" in s for s in silent)
+
+    def test_checker_fails_vacuous_or_foreign_reports(self):
+        errs, _ = check_autoscale.check({"metric": "serve_demo"})
+        assert errs
+        doctored = copy.deepcopy(_report())
+        doctored["actions"] = [a for a in doctored["actions"]
+                               if a["action"] != "drain"]
+        errs, silent = check_autoscale.check(doctored)
+        assert any("no drain action" in e for e in errs)

@@ -520,6 +520,57 @@ class TestLookaheadSentinel:
         assert check_bench.main(files) == 2
 
 
+class TestServeMeshRows:
+    """ISSUE 18 satellite, trapped both ways: the mesh-serve lane's
+    ``*_lane_bytes`` capture fields are accounting-class — a 10x
+    re-pricing (a jaxlib layout change, a projection-formula change)
+    must NEVER page — and its plain context keys (occupancy, execute
+    wall time, compile delta) are never rate-compared either; the SAME
+    shortfall under a rate key still pages."""
+
+    def test_lane_bytes_accounting_never_pages(self, tmp_path):
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "serve_mesh_4096_projected_lane_bytes": 7.1e7,
+                "serve_mesh_4096_measured_lane_bytes": 9.0e7,
+                "serve_mesh_4096_occupancy": 1,
+                "serve_mesh_4096_execute_ms": 1500.0,
+                "serve_mesh_4096_compiles_delta": 0,
+                "invert_4096_spread_pct": 1.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "serve_mesh_4096_projected_lane_bytes": 7.1e8,
+                "serve_mesh_4096_measured_lane_bytes": 9.0e8,
+                "serve_mesh_4096_occupancy": 1,
+                "serve_mesh_4096_execute_ms": 15000.0,
+                "serve_mesh_4096_compiles_delta": 0,
+                "invert_4096_spread_pct": 1.0})),
+        ]
+        assert check_bench.main(files) == 0
+        assert check_bench.is_accounting_key(
+            "serve_mesh_4096_projected_lane_bytes")
+        assert check_bench.is_accounting_key(
+            "serve_mesh_4096_measured_lane_bytes")
+        keys = check_bench.comparable_keys(
+            {"metric": "m", "value": 1.0,
+             "extra": {"serve_mesh_4096_projected_lane_bytes": 1.0,
+                       "serve_mesh_4096_measured_lane_bytes": 1.0,
+                       "serve_mesh_4096_occupancy": 1,
+                       "serve_mesh_4096_execute_ms": 1500.0,
+                       "serve_mesh_4096_compiles_delta": 0}})
+        assert not any(k.startswith("serve_mesh") for k in keys)
+
+    def test_same_shortfall_under_rate_key_pages(self, tmp_path):
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "serve_mesh_4096_gbps": 30.0,
+                "serve_mesh_4096_spread_pct": 1.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "serve_mesh_4096_gbps": 3.0,
+                "serve_mesh_4096_spread_pct": 1.0})),
+        ]
+        assert check_bench.main(files) == 2
+
+
 class TestLpqpRows:
     """ISSUE 17 satellites, trapped both ways: the multi-RHS blocking
     sweep's per-k rate keys and the batched-update amortization rate

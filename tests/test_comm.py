@@ -373,6 +373,9 @@ class TestSolveReconciliation:
         rep = _reconcile_solve_1d(56, 8, 4, 3, unroll)
         assert rep.reconciled is True, rep.mismatches
 
+    @pytest.mark.slow  # tier-1 budget: the comm-demo fixture's 2D solve leg
+    # (check_comm requires solve coverage) reconciles this flavor fast-run;
+    # the fori-mesh duplicates below already run nightly
     def test_2d_solve_unrolled(self):
         rep = _reconcile_solve_2d(56, 8, 2, 2, 2, True)
         assert rep.reconciled is True, rep.mismatches
@@ -471,6 +474,8 @@ class TestLookaheadReconciliation:
     IDENTICAL by construction.  Each case compiles a unique size
     (fresh trace; the module's config-hygiene rule)."""
 
+    @pytest.mark.slow  # tier-1 budget: the sharded twin below stays fast-run
+    # and the comm-demo fixture's lookahead invert leg reconciles gathered
     def test_1d_invert_lookahead_gathered(self):
         rep = _reconcile_1d(50, 8, 4, "lookahead", lookahead=True)
         assert rep.reconciled is True, rep.mismatches
@@ -511,6 +516,9 @@ class TestLookaheadReconciliation:
                                    dtype="float32", gather=True)
         assert rep.total_bytes() == plain.total_bytes()
 
+    @pytest.mark.slow  # tier-1 budget: the comm-demo fixture's lookahead
+    # solve leg (pinned by engine name, required by check_comm) reconciles
+    # this flavor fast-run
     def test_1d_solve_lookahead(self):
         rep = _reconcile_solve_1d(44, 8, 4, 3, True, lookahead=True)
         assert rep.reconciled is True, rep.mismatches

@@ -149,6 +149,9 @@ class TestSharded2DGrouped:
         np.testing.assert_allclose(np.asarray(x_g), np.asarray(x_p),
                                    rtol=1e-9, atol=1e-9)
 
+    @pytest.mark.slow  # tier-1 budget: the plain-engine cross-mesh tied-pivot
+    # pin (TestSharded2DInplace::test_tied_pivots_swaps_cross_mesh_columns)
+    # and the fast grouped-parity params above keep tier-1 coverage
     def test_grouped_tied_pivots_cross_mesh_columns(self):
         # |i-j|: repeated candidates + zero diagonal; pc=4 puts swap
         # partners on different mesh columns within one group.
@@ -338,6 +341,8 @@ class TestSwapFree2D:
             jnp.ones((64, 64), jnp.float64), mesh, 8, swapfree=True)
         assert bool(sing)
 
+    @pytest.mark.slow  # tier-1 budget: the 1D twin in test_sharded_inplace
+    # keeps the fast-run all-singular-divergence pin
     def test_all_singular_flags_agree_but_arrays_diverge(self):
         # Bit-match is scoped to NONSINGULAR inputs (see the 1D twin's
         # test): on all-singular input both flag singular, the arrays
@@ -403,6 +408,9 @@ class TestLookahead2D:
         assert bool(s_p) == bool(s_l) is False
         assert bool(jnp.all(x_p == x_l))
 
+    @pytest.mark.slow  # tier-1 budget: the 1D driver-routing leg in
+    # test_sharded_inplace (engine="lookahead" via solve()) and the smoke
+    # 2D parity case above keep tier-1 coverage
     def test_driver_engine_string_routes_and_bitmatches(self):
         from tpu_jordan.driver import solve
 

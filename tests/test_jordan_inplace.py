@@ -133,7 +133,10 @@ class TestInplaceForiEngine:
     @pytest.mark.parametrize("n,m,k", [
         (64, 16, 2),
         pytest.param(128, 16, 4, marks=pytest.mark.slow),
-        (128, 32, 4), (96, 16, 3),
+        # tier-1 budget: (64, 16, 2) + the ragged (96, 16, 3) keep the
+        # fast-run pins; the wide-block case runs nightly.
+        pytest.param(128, 32, 4, marks=pytest.mark.slow),
+        (96, 16, 3),
         pytest.param(160, 16, 4, marks=pytest.mark.slow),
         (50, 8, 4),
         # tier-1 budget: the wide-group case runs nightly.

@@ -150,6 +150,8 @@ class TestLpDriver:
             1e-8 * (1.0 + abs(prob.obj_star)))
         assert ledger["outstanding"] == 0
 
+    @pytest.mark.slow  # tier-1 budget: the demo+checker test runs (and
+    # convergence-gates) the LP-ill leg in every fast run
     def test_lp_ill_converges(self):
         """Fast sibling of ``test_lp_heavy_families_slow``: the
         ill-conditioned family at m=8 converges through the same
@@ -185,6 +187,8 @@ class TestLpDriver:
 
 
 class TestQpDriver:
+    @pytest.mark.slow  # tier-1 budget: the demo+checker test convergence-
+    # gates the QP well/ill legs in every fast run
     def test_qp_round_trip(self):
         n = 8
         prob = qp_instance(n=n, seed=0, cond="well")
@@ -270,6 +274,9 @@ class TestChaosBitmatch:
         assert faults.total() - f0 >= kills_expected
         return rep
 
+    @pytest.mark.slow  # tier-1 budget: the fleet-level seeded replica-kill
+    # bit-match (test_fleet.py) keeps the fast-run chaos-determinism pin;
+    # the lp-demo gate replays this leg end-to-end
     def test_replica_kill_bitmatches_fault_free(self):
         """Fast sibling of ``test_replica_kill_bitmatch_heavy_slow``:
         one seeded kill mid-optimization; outcome stream + final

@@ -1,0 +1,260 @@
+"""Mesh-backed serve lanes (ISSUE 18 tentpole part 1): the topology
+vocabulary (one spelling shared with the tuner's ``TunePoint``), the
+typed-refusal contract (complex/SPD/update/resident are single-device
+promises — a mesh lane refuses them naming the legal alternative,
+never a silent single-device fallback), the byte-projected admission
+walk (single if it fits, else the smallest mesh whose PER-DEVICE share
+fits, else a typed ``CapacityExceededError`` AT SUBMIT), capacity
+projection without compiling, and the smoke-tier warm round-trip: a
+request over the single-device budget serves through the 2-device lane
+with ZERO compiles and ZERO plan-cache measurements after warmup,
+journey-hopped ``mesh_admitted`` with the projection that admitted
+it."""
+
+import types
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_jordan.driver import UsageError
+from tpu_jordan.obs.recorder import RECORDER
+from tpu_jordan.resilience.policy import CapacityExceededError
+from tpu_jordan.serve import JordanService, bucket_for
+from tpu_jordan.serve.executors import (ExecutorKey, lane_label,
+                                        projected_lane_bytes,
+                                        rhs_bucket_for)
+from tpu_jordan.serve.meshlanes import (MESH_SINGLE, MeshLaneExecutor,
+                                        mesh_devices, mesh_label,
+                                        normalize_mesh, parse_mesh)
+
+F32 = jnp.float32
+
+
+def _mesh_key(**kw):
+    """An ExecutorKey on the 2-device mesh with the refusal under test
+    overriding one coordinate (refusals fire before any compile)."""
+    base = dict(bucket_n=64, batch_cap=1, dtype="float32",
+                engine="inplace", block_size=16, workload="invert",
+                rhs=0, mesh="p2")
+    base.update(kw)
+    return ExecutorKey(**base)
+
+
+class TestMeshVocabulary:
+    def test_one_spelling_label_roundtrip(self):
+        assert mesh_label(8) == "p8"
+        assert mesh_label((2, 4)) == "2x4"
+        assert mesh_label(1) == MESH_SINGLE
+        assert parse_mesh("p8") == 8
+        assert parse_mesh("2x4") == (2, 4)
+        assert parse_mesh(MESH_SINGLE) == 1
+        assert mesh_devices((2, 4)) == 8
+
+    def test_malformed_label_is_typed(self):
+        with pytest.raises(UsageError, match="not a topology label"):
+            parse_mesh("8x")
+        with pytest.raises(UsageError, match="not a topology label"):
+            parse_mesh("fast")
+
+    def test_unformable_mesh_is_typed_at_configure_time(self):
+        # conftest pins exactly 8 host devices: 16 cannot form.
+        with pytest.raises(UsageError, match="needs 16 devices"):
+            normalize_mesh(16)
+        with pytest.raises(UsageError, match="needs 16 devices"):
+            normalize_mesh((4, 4))
+        with pytest.raises(UsageError,
+                           match="single-device lane"):
+            normalize_mesh(1)
+        with pytest.raises(UsageError, match="positive"):
+            normalize_mesh((0, 2))
+
+    def test_per_device_projection_divides_matrix_terms_only(self):
+        single = projected_lane_bytes(64, 1, F32)
+        halved = projected_lane_bytes(64, 1, F32, devices=2)
+        assert halved < single
+        # Solve lanes: the O(n·k) RHS/solution terms stay whole (X
+        # gathers), so the mesh saving is strictly the matrix share.
+        s1 = projected_lane_bytes(64, 1, F32, "solve", rhs=8)
+        s2 = projected_lane_bytes(64, 1, F32, "solve", rhs=8,
+                                  devices=2)
+        assert s1 - s2 == (projected_lane_bytes(64, 1, F32)
+                           - halved) // 2
+
+
+class TestTypedRefusals:
+    """The single-device contracts a mesh lane must refuse BY NAME —
+    never serve silently on one device (the caller asked for a
+    topology) and never crash mid-launch."""
+
+    def test_complex_dtype_refused_naming_single_lane(self):
+        with pytest.raises(UsageError, match="complex dtypes run "
+                                             "single-device"):
+            MeshLaneExecutor(_mesh_key(dtype="complex64"), None)
+
+    def test_spd_fast_path_refused_naming_alternatives(self):
+        with pytest.raises(UsageError, match="pivot-free fast\\s+path"):
+            MeshLaneExecutor(_mesh_key(workload="solve", rhs=8,
+                                       engine="solve_spd"), None)
+
+    def test_update_workload_refused_single_chip(self):
+        with pytest.raises(UsageError, match="single-chip"):
+            MeshLaneExecutor(_mesh_key(workload="update", rhs=4), None)
+
+    def test_batched_mesh_lane_refused_occupancy_one(self):
+        with pytest.raises(UsageError, match="occupancy 1"):
+            MeshLaneExecutor(_mesh_key(batch_cap=2), None)
+
+    def test_single_device_solve_engine_refused(self):
+        with pytest.raises(UsageError, match="single-device solve\\s+"
+                                             "engine"):
+            MeshLaneExecutor(_mesh_key(workload="solve", rhs=8,
+                                       engine="lookahead"), None)
+
+    def test_mesh_shapes_without_budget_is_typed(self):
+        with pytest.raises(UsageError,
+                           match="mesh_shapes without "
+                                 "lane_budget_bytes"):
+            JordanService(dtype=F32, mesh_shapes=(2,))
+
+    def test_resident_invert_refused_on_mesh_route(self):
+        # Budget under the 64-bucket's single projection: a resident
+        # invert would route to the mesh, where handles cannot live.
+        budget = projected_lane_bytes(64, 4, F32) - 1
+        with JordanService(dtype=F32, batch_cap=4, mesh_shapes=(2,),
+                           lane_budget_bytes=budget,
+                           autostart=False) as svc:
+            with pytest.raises(UsageError,
+                               match="resident=True pins"):
+                svc.invert(np.eye(64, dtype=np.float32),
+                           resident=True)
+
+
+class _Ctx:
+    """A journey-hop recorder stub for driving the admission walk."""
+
+    def __init__(self):
+        self.events = []
+
+    def event(self, name, **fields):
+        self.events.append((name, fields))
+
+
+class TestCapacityAdmission:
+    def test_admission_walk_single_then_mesh_then_refusal(self):
+        """The submit-time walk on one service: a bucket whose single
+        projection fits stays single; one that doesn't but whose
+        per-device share fits goes to the smallest mesh (with the
+        ``mesh_admitted`` hop carrying the projection); one no mesh
+        can hold is a typed refusal AT SUBMIT."""
+        cap = 4
+        budget = (projected_lane_bytes(64, cap, F32)
+                  + projected_lane_bytes(128, cap, F32)) // 2
+        assert projected_lane_bytes(128, 1, F32, devices=2) <= budget
+        with JordanService(dtype=F32, batch_cap=cap, mesh_shapes=(2,),
+                           lane_budget_bytes=budget,
+                           autostart=False) as svc:
+            ctx = _Ctx()
+            assert svc._admit_mesh(64, 64, "invert", 0,
+                                   ctx) == MESH_SINGLE
+            assert ctx.events == []
+            assert svc._admit_mesh(128, 128, "invert", 0, ctx) == "p2"
+            name, fields = ctx.events[-1]
+            assert name == "mesh_admitted" and fields["mesh"] == "p2"
+            assert fields["projected_bytes"] <= budget
+            assert fields["single_device_bytes"] > budget
+            mark = RECORDER.total
+            with pytest.raises(CapacityExceededError,
+                               match="refused at submit, never an "
+                                     "OOM mid-launch"):
+                svc._admit_mesh(2048, 2048, "invert", 0, ctx)
+            assert ctx.events[-1][0] == "reject"
+            assert ctx.events[-1][1]["reason"] == "capacity"
+            assert any(e.get("kind") == "capacity_refused"
+                       for e in RECORDER.since(mark))
+
+    def test_over_budget_without_mesh_names_the_gap(self):
+        """No mesh_shapes configured: the refusal says so (the
+        operator's fix is a config line, and the error names it)."""
+        with JordanService(dtype=F32, batch_cap=4,
+                           lane_budget_bytes=4096,
+                           autostart=False) as svc:
+            with pytest.raises(CapacityExceededError,
+                               match="no mesh_shapes configured"):
+                svc.submit(np.eye(64, dtype=np.float32))
+
+    def test_too_big_for_largest_mesh_names_it(self):
+        budget = projected_lane_bytes(64, 1, F32, devices=2) - 1
+        with JordanService(dtype=F32, batch_cap=4, mesh_shapes=(2,),
+                           lane_budget_bytes=budget,
+                           autostart=False) as svc:
+            with pytest.raises(CapacityExceededError,
+                               match="largest configured mesh "
+                                     "\\('p2'\\)"):
+                svc.submit(np.eye(64, dtype=np.float32))
+
+    def test_project_capacity_mesh_entries_without_compiling(self):
+        budget = projected_lane_bytes(512, 4, F32)
+        with JordanService(dtype=F32, batch_cap=4,
+                           mesh_shapes=(2, (2, 2)),
+                           lane_budget_bytes=budget,
+                           autostart=False) as svc:
+            out = svc.project_capacity(shapes=(64,),
+                                       mesh_shapes=[(64, 2),
+                                                    (64, 8, "2x2")])
+            inv_lane = lane_label("invert", 64, 1, mesh="p2")
+            slv_lane = lane_label("solve", 64, 1, rhs_bucket_for(8),
+                                  mesh="2x2")
+            assert out[inv_lane] == projected_lane_bytes(
+                64, 1, F32, devices=2)
+            assert out[slv_lane] == projected_lane_bytes(
+                64, 1, F32, "solve", rhs_bucket_for(8), devices=4)
+            # Projection is free: nothing compiled.
+            assert svc.stats()["totals"]["compiles"] == 0
+
+
+@pytest.mark.smoke
+def test_smoke_mesh_serve_round_trip(rng):
+    """The < 1 min smoke tier's mesh-lane leg (ISSUE 18 acceptance):
+    with the single-device budget under the 64 bucket, warm the
+    2-device lane, then serve over-budget requests through it — ZERO
+    compiles and ZERO plan-cache measurements on the request path,
+    each request journey-hopped ``mesh_admitted`` with the projection
+    that admitted it, results correct on the un-padded region, and the
+    stats mesh axis reporting the topology as its own row (never
+    aliased into the single-device bucket)."""
+    cap = 4
+    budget = (projected_lane_bytes(64, 1, F32, devices=2)
+              + projected_lane_bytes(64, cap, F32)) // 2
+    mark = RECORDER.total
+    with JordanService(dtype=F32, batch_cap=cap, max_wait_ms=1.0,
+                       block_size=16, mesh_shapes=(2,),
+                       lane_budget_bytes=budget) as svc:
+        svc.warmup(mesh_shapes=[(64, 2)])
+        warm_compiles = svc.stats()["totals"]["compiles"]
+        assert warm_compiles >= 1
+        mats = [rng.standard_normal((n, n)).astype(np.float32)
+                for n in (64, 60, 64)]
+        futs = [svc.submit(a) for a in mats]
+        results = [f.result(120) for f in futs]
+        stats = svc.stats()
+    assert stats["totals"]["compiles"] == warm_compiles
+    assert stats["measurements"] == 0
+    for a, r in zip(mats, results):
+        assert not r.singular
+        n = a.shape[0]
+        assert np.asarray(r.inverse).shape == (n, n)
+        assert r.rel_residual is not None and r.rel_residual < 1e-4
+        assert np.allclose(np.asarray(r.inverse) @ a, np.eye(n),
+                           atol=1e-3)
+    hops = [e for e in RECORDER.since(mark)
+            if e.get("kind") == "journey"
+            and e.get("event") == "mesh_admitted"]
+    assert len(hops) == len(mats)
+    assert all(e.get("mesh") == "p2" for e in hops)
+    mesh_rows = {b: s for b, s in stats["buckets"].items()
+                 if s.get("mesh", MESH_SINGLE) != MESH_SINGLE}
+    assert sum(s["requests"] for s in mesh_rows.values()) == len(mats)
+    assert "64@p2" in stats["engines"]
+    assert stats["engines"]["64@p2"]["mesh"] == "p2"

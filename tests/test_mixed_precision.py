@@ -80,6 +80,9 @@ def test_bfloat16_dtype_end_to_end(rng):
     assert rel < 0.1
 
 
+@pytest.mark.slow  # tier-1 budget: the distributed sub-fp32 upcast-policy
+# pins in test_sharded_inplace/test_jordan2d_inplace and the solver
+# storage-dtype test keep fast-run coverage
 def test_bfloat16_distributed_computes_fp32(rng):
     # Distributed sub-fp32 must follow the same fp32-compute policy as
     # the single-device kernels; result comes back bf16-rounded with an

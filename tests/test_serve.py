@@ -457,8 +457,12 @@ class TestServeDemoCLI:
         (ISSUE 3 satellite): 0 = demo ran and reported, 1 = usage."""
         from tpu_jordan.__main__ import main
 
-        # Usage errors, all pre-device: exit 1.
-        assert main(["96", "32", "--serve-demo", "--workers", "8",
+        # Usage errors, all pre-device: exit 1.  (--serve-demo
+        # --workers W is no longer one of them: ISSUE 18 made it the
+        # mesh-lane serving path — covered by tests/test_meshserve.py
+        # and the dryrun mesh-serve legs.  A non-positive workers
+        # value is still usage.)
+        assert main(["96", "32", "--serve-demo", "--workers", "0",
                      "--quiet"]) == 1
         assert main(["96", "32", "--serve-demo", "--batch", "4",
                      "--quiet"]) == 1
@@ -489,6 +493,8 @@ class TestServeDemoCLI:
         assert report["plan_cache_measurements"] == 0
 
 
+@pytest.mark.slow  # tier-1 budget: TestServeDemoCLI::test_serve_demo_runs_
+# and_reports exercises serve_demo() end-to-end (report shape incl.) fast-run
 def test_serve_demo_function_report_shape(tmp_path):
     """serve_demo() itself (the CLI engine): full report incl. nested
     stats, >= 2 buckets at n=96 (64 + 128), occupancy recorded."""

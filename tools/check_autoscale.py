@@ -1,0 +1,320 @@
+#!/usr/bin/env python
+"""Validate an ``--autoscale-demo`` report (ISSUE 18 satellite) — the
+autoscaler analogue of ``check_fleet.py``.
+
+Usage: ``python tools/check_autoscale.py report.json [...]`` (or ``-``
+for stdin).  No jax import — this is the ``make autoscale-demo`` gate
+and runs anywhere.  Exit codes: 0 = valid, 1 = bound/structure
+violations, 2 = a SILENT P99 BREACH or an UNEXPLAINED SCALE ACTION —
+the alarm class that must never be downgraded.
+
+The checker's job is re-derivation, not trust: every scale/drain/
+pre-shed decision in the report must be re-derivable from the burn
+evidence the autoscaler recorded alongside it:
+
+  * every ``scale_up`` (and every withheld one) carries >= 1 paging
+    objective whose window pairs ACTUALLY page by the recorded numbers
+    (long burn > threshold AND short burn > threshold, with each burn
+    equal to error_rate / error_budget) — an action whose evidence
+    does not re-derive is exit 2;
+  * every ``scale_withheld`` shows the ledger at/over its budget
+    (``live_bytes >= scale_budget_bytes`` — the capacity veto held);
+  * every ``drain`` shows ``idle_s >= idle_after_s``, lands at/above
+    the floor, and its tick saw NO risk signal (never drain into a
+    burn);
+  * ``pre_shed_on`` carries paging or p99-risk evidence (each p99-risk
+    entry re-derives: p99_ms >= frac x target); ``pre_shed_off``
+    carries neither;
+  * any tick that saw risk while pre-shed stayed OFF and no capacity
+    action answered it is the silent-breach class (exit 2), and the
+    report's own ``silent_p99_breach`` flag must agree with the
+    re-derivation;
+  * the in-memory action list, the flight-recorder ``autoscale``
+    events, and the ``tpu_jordan_autoscale_actions_total`` deltas must
+    all tell the same story, and the counted ``shed{reason=pre_shed}``
+    must equal the journey-hopped pre-shed rejections in the black-box
+    slice — typed, counted, journey-hopped, or it didn't happen.
+
+Vacuity guards (exit 1): the demo must actually show a scale-up, a
+drain back to the floor, a pre-shed engage/release cycle, deadline
+burn in the burst, and a clean recovery wave.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+if _HERE not in sys.path:
+    sys.path.insert(0, _HERE)
+
+#: Relative tolerance when re-deriving burn = error_rate/budget from a
+#: report rounded for JSON (the demo rounds to 6 decimals).
+REDERIVE_RTOL = 1e-3
+
+#: The capacity-action kinds that align 1:1 with non-null tick actions
+#: (pre-shed flips are flag reconciliations, not capacity steps).
+CAPACITY_ACTIONS = ("scale_up", "scale_withheld", "drain")
+
+
+def _pages(window: dict) -> bool:
+    """Re-derive one window pair's page decision from its numbers."""
+    thr = window.get("threshold", float("inf"))
+    try:
+        return (window["long"]["burn_rate"] > thr
+                and window["short"]["burn_rate"] > thr)
+    except (KeyError, TypeError):
+        return False
+
+
+def _burn_consistent(window: dict, budget: float) -> bool:
+    """Each recorded burn must equal error_rate / error_budget (the
+    definition, not a new number the report could invent)."""
+    if not budget or budget <= 0:
+        return False
+    for half in ("long", "short"):
+        w = window.get(half)
+        if not isinstance(w, dict):
+            return False
+        expect = w.get("error_rate", 0.0) / budget
+        got = w.get("burn_rate")
+        if got is None or abs(got - expect) > REDERIVE_RTOL * max(
+                1.0, abs(expect)):
+            return False
+    return True
+
+
+def _check_paging_evidence(tag: str, paging: list) -> list[str]:
+    """The exit-2 re-derivation for one action's paging evidence."""
+    bad = []
+    if not paging:
+        bad.append(f"{tag}: no paging objective in evidence — the "
+                   f"action is unexplained")
+        return bad
+    for obj in paging:
+        wins = obj.get("windows", [])
+        budget = obj.get("error_budget", 0.0)
+        if not wins:
+            bad.append(f"{tag}: objective {obj.get('name')!r} pages "
+                       f"with zero window pairs")
+        for w in wins:
+            if not _pages(w):
+                bad.append(
+                    f"{tag}: objective {obj.get('name')!r} window "
+                    f"{w.get('threshold')}x does not actually page by "
+                    f"its own numbers (long "
+                    f"{w.get('long', {}).get('burn_rate')}, short "
+                    f"{w.get('short', {}).get('burn_rate')})")
+            if not _burn_consistent(w, budget):
+                bad.append(
+                    f"{tag}: objective {obj.get('name')!r} burn rates "
+                    f"are not error_rate/error_budget "
+                    f"(budget {budget}) — doctored evidence")
+    return bad
+
+
+def check(report: dict) -> tuple[list[str], list[str]]:
+    """Return (violations, alarm_violations); both empty = valid."""
+    errs: list[str] = []
+    silent: list[str] = []
+    if report.get("metric") != "autoscale_demo":
+        return ([f"not an autoscale_demo report (metric="
+                 f"{report.get('metric')!r})"], [])
+
+    cfg = report.get("config", {})
+    floor = report.get("floor", 1)
+    ceiling = report.get("ceiling", floor)
+    idle_after_s = cfg.get("idle_after_s", float("inf"))
+    frac = cfg.get("preshed_p99_frac", 1.0)
+    actions = report.get("actions", [])
+    ticks = report.get("ticks", [])
+    phases = report.get("phases", {})
+
+    # ---- vacuity guards (the demo must demonstrate the loop) -------
+    kinds = [a.get("action") for a in actions]
+    for needed in ("scale_up", "drain", "pre_shed_on", "pre_shed_off"):
+        if needed not in kinds:
+            errs.append(f"no {needed} action — the demo never "
+                        f"exercised that leg of the control loop")
+    burst_waves = phases.get("burst", {}).get("waves", [])
+    if not any(w.get("typed_errors", {}).get("DeadlineExceededError")
+               for w in burst_waves):
+        errs.append("no DeadlineExceededError in the burst — the burn "
+                    "source never fired, the paging was not this "
+                    "demo's doing")
+    recovery = phases.get("recovery", {})
+    if recovery.get("ok", 0) < 1 or recovery.get("typed_errors"):
+        errs.append(f"recovery wave did not serve cleanly: {recovery}")
+    traj = report.get("ready_trajectory", [])
+    if traj and (max(traj) > ceiling or min(traj) < floor):
+        errs.append(f"ready trajectory {traj} escaped "
+                    f"[{floor}, {ceiling}]")
+    if traj and traj[-1] != floor:
+        errs.append(f"fleet ended at {traj[-1]} replicas, not the "
+                    f"floor {floor} — the drain never completed")
+
+    # ---- per-action re-derivation (the exit-2 class) ---------------
+    for i, a in enumerate(actions):
+        kind = a.get("action")
+        tag = f"action[{i}] {kind}"
+        ev = a.get("evidence", {})
+        before, after = a.get("ready_before"), a.get("ready_after")
+        if kind == "scale_up":
+            silent += _check_paging_evidence(tag, ev.get("paging", []))
+            if after != before + 1 or after > ceiling:
+                silent.append(f"{tag}: ready {before} -> {after} is "
+                              f"not one step up within ceiling "
+                              f"{ceiling}")
+        elif kind == "scale_withheld":
+            silent += _check_paging_evidence(tag, ev.get("paging", []))
+            budget = ev.get("scale_budget_bytes")
+            if budget is None or ev.get("live_bytes", -1) < budget:
+                silent.append(f"{tag}: withheld without the ledger at "
+                              f"its budget (live {ev.get('live_bytes')}"
+                              f" vs budget {budget})")
+            if after != before:
+                silent.append(f"{tag}: a WITHHELD action changed ready "
+                              f"{before} -> {after}")
+        elif kind == "drain":
+            if ev.get("idle_s", -1.0) < idle_after_s:
+                silent.append(f"{tag}: drained at idle_s="
+                              f"{ev.get('idle_s')} < idle_after_s="
+                              f"{idle_after_s} — unexplained drain")
+            if after != before - 1 or after < floor:
+                silent.append(f"{tag}: ready {before} -> {after} is "
+                              f"not one step down at/above floor "
+                              f"{floor}")
+        elif kind == "pre_shed_on":
+            p99 = ev.get("p99_risk", [])
+            if not ev.get("paging") and not p99:
+                silent.append(f"{tag}: pre-shed engaged with neither "
+                              f"paging nor p99 risk in evidence")
+            for r in p99:
+                if r.get("p99_ms", -1) < frac * r.get(
+                        "p99_target_ms", float("inf")):
+                    silent.append(f"{tag}: p99 risk entry does not "
+                                  f"re-derive ({r})")
+        elif kind == "pre_shed_off":
+            if ev.get("paging") or ev.get("p99_risk"):
+                silent.append(f"{tag}: pre-shed released while "
+                              f"evidence still shows risk: {ev}")
+        else:
+            silent.append(f"{tag}: unknown action kind")
+
+    # ---- tick/action alignment: drains must not answer a burn ------
+    tick_actions = [t for t in ticks if t.get("action")]
+    cap_actions = [a for a in actions
+                   if a.get("action") in CAPACITY_ACTIONS]
+    if [t["action"] for t in tick_actions] != [a["action"]
+                                               for a in cap_actions]:
+        silent.append(
+            f"tick action trail {[t['action'] for t in tick_actions]} "
+            f"!= recorded capacity actions "
+            f"{[a['action'] for a in cap_actions]}")
+    else:
+        for t in tick_actions:
+            if t["action"] == "drain" and (t.get("paging")
+                                           or t.get("p99_risk")):
+                silent.append(f"drain at t={t.get('t')} while the "
+                              f"tick itself saw risk signals "
+                              f"(paging={t.get('paging')}, "
+                              f"p99_risk={t.get('p99_risk')})")
+
+    # ---- the silent-breach re-derivation (the namesake alarm) ------
+    rederived = any(
+        (t.get("paging") or t.get("p99_risk"))
+        and not t.get("pre_shed")
+        and t.get("action") not in ("scale_up", "scale_withheld")
+        for t in ticks)
+    if rederived:
+        silent.append("a tick saw risk signals with pre-shed OFF and "
+                      "no capacity action — SILENT P99 BREACH")
+    if bool(report.get("silent_p99_breach", True)) != rederived:
+        silent.append(f"report's silent_p99_breach="
+                      f"{report.get('silent_p99_breach')} disagrees "
+                      f"with the tick re-derivation ({rederived})")
+
+    # ---- black-box / counter reconciliation ------------------------
+    bb = report.get("blackbox")
+    if not isinstance(bb, dict) or "events" not in bb:
+        silent.append("no black-box slice embedded — the decisions "
+                      "are unreconstructible")
+    else:
+        events = bb["events"]
+        bb_actions = [e for e in events if e.get("kind") == "autoscale"]
+        if [e.get("action") for e in bb_actions] != kinds:
+            silent.append(
+                f"flight-recorder autoscale trail "
+                f"{[e.get('action') for e in bb_actions]} != report "
+                f"actions {kinds} — the two stories diverge")
+        preshed_hops = sum(
+            1 for e in events
+            if e.get("kind") == "journey" and e.get("event") == "shed"
+            and e.get("reason") == "pre_shed")
+        counted = report.get("pre_shed_count", -1)
+        if counted != preshed_hops:
+            silent.append(f"shed{{reason=pre_shed}} counted {counted} "
+                          f"but the black box journey-hopped "
+                          f"{preshed_hops} — a shed went uncounted "
+                          f"or unhopped")
+        if kinds.count("pre_shed_on") > 0 and preshed_hops == 0:
+            errs.append("pre-shed engaged but shed zero requests — "
+                        "the front door never exercised the flag")
+
+    # ---- the fleet ledger must still add up ------------------------
+    ledger = report.get("ledger", {})
+    if ledger.get("outstanding", 1) != 0:
+        silent.append(f"{ledger.get('outstanding')} request(s) "
+                      f"outstanding after the drain — lost in flight")
+    if (ledger.get("resolved_ok", -1) + ledger.get("resolved_error", -1)
+            != ledger.get("submitted", 0)):
+        silent.append(f"fleet ledger does not add up: {ledger}")
+    return errs, silent
+
+
+def main(argv) -> int:
+    if not argv:
+        print("usage: check_autoscale.py report.json [...]",
+              file=sys.stderr)
+        return 1
+    rc = 0
+    for path in argv:
+        try:
+            if path == "-":
+                report = json.load(sys.stdin)
+            else:
+                with open(path) as f:
+                    report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"FAIL {path}: unreadable report ({e})",
+                  file=sys.stderr)
+            rc = max(rc, 1)
+            continue
+        errs, silent = check(report)
+        for e in silent:
+            print(f"ALARM {path}: {e}", file=sys.stderr)
+        for e in errs:
+            print(f"FAIL {path}: {e}", file=sys.stderr)
+        if silent:
+            rc = 2
+        elif errs:
+            rc = max(rc, 1)
+        else:
+            kinds = report.get("actions_by_kind", {})
+            print(f"OK {path}: {len(report.get('ticks', []))} ticks, "
+                  f"{kinds.get('scale_up', 0)} scale-up(s) + "
+                  f"{kinds.get('drain', 0)} drain(s) + "
+                  f"{kinds.get('scale_withheld', 0)} withheld, "
+                  f"pre-shed cycle "
+                  f"{kinds.get('pre_shed_on', 0)}/"
+                  f"{kinds.get('pre_shed_off', 0)}, "
+                  f"{report.get('pre_shed_count', 0)} typed pre-sheds, "
+                  f"every action re-derived from its burn evidence, "
+                  f"0 silent breaches")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

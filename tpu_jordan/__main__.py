@@ -307,6 +307,21 @@ def _main(argv, state) -> int:
                          "fault-free replay or carried a typed error "
                          "(exit 2 on any silent loss; "
                          "tools/check_fleet.py validates the report)")
+    ap.add_argument("--autoscale-demo", action="store_true",
+                    help="run the SLO-driven autoscaler acceptance "
+                         "demo (tpu_jordan.fleet.FleetAutoscaler; "
+                         "ISSUE 18, docs/FLEET.md): one seeded "
+                         "burst->idle->recovery trace through a "
+                         "floor-sized fleet — sustained deadline burn "
+                         "pages the burn-rate monitor, which scales "
+                         "the pool toward --replicas (the ceiling) "
+                         "and pre-sheds new submissions typed at the "
+                         "router; the idle phase drains back to the "
+                         "floor; prints ONE JSON line carrying every "
+                         "decision WITH the burn evidence it was "
+                         "derived from (exit 2 on a silent p99 "
+                         "breach; tools/check_autoscale.py re-derives "
+                         "every action)")
     ap.add_argument("--update-demo", action="store_true",
                     help="run the resident-inverse update acceptance "
                          "demo (tpu_jordan.serve.update_demo; ISSUE 12, "
@@ -559,6 +574,70 @@ def _main(argv, state) -> int:
             raise UsageError("--generator crand is complex-valued; a "
                              "real --dtype would silently discard the "
                              "imaginary part (use --dtype complex64)")
+        if args.autoscale_demo:
+            # Autoscaler demo (ISSUE 18): the fleet-demo restriction
+            # shape (single device per replica, deterministic seeded
+            # traffic, gathered) and the same 0/1/2 taxonomy — exit 2
+            # IS the silent-p99-breach alarm (a tick that saw risk
+            # signals while pre-shed stayed off and no capacity action
+            # answered it).
+            if (args.serve_demo or args.chaos_demo or args.fleet_demo
+                    or args.numerics_demo or args.update_demo
+                    or args.capacity_demo or args.comm_demo
+                    or args.lp_demo):
+                raise UsageError("--autoscale-demo is a distinct mode; "
+                                 "pick one demo")
+            if args.file is not None or args.workers != 1 or not args.gather:
+                raise UsageError(
+                    "--autoscale-demo runs single-device replicas "
+                    "against its own seeded burst trace; file input, "
+                    "--workers and --no-gather do not apply")
+            if args.batch > 1 or args.tune or args.group != 0:
+                raise UsageError("--autoscale-demo takes no "
+                                 "--batch/--tune/--group")
+            if args.engine != "auto" or args.refine:
+                raise UsageError("--autoscale-demo resolves engines "
+                                 "through the cost-only ladder; "
+                                 "--engine/--refine do not apply")
+            if args.workload != "invert" or args.rhs != 1:
+                raise UsageError("--autoscale-demo streams invert "
+                                 "requests; --workload/--rhs do not "
+                                 "apply")
+            if args.numerics != "off":
+                raise UsageError("--autoscale-demo's burn-evidence "
+                                 "semantics are pinned; --numerics "
+                                 "does not apply")
+            if args.slo_report or args.plan_cache is not None:
+                raise UsageError("--slo-report/--plan-cache do not "
+                                 "apply to --autoscale-demo (it builds "
+                                 "its own demo-scaled monitor)")
+            if args.replicas < 2:
+                raise UsageError("--autoscale-demo needs --replicas "
+                                 ">= 2 (the scale-up ceiling; the "
+                                 "floor is 1)")
+            if args.kills != 2 or args.scaling_floor is not None:
+                raise UsageError("--kills/--scaling-floor are "
+                                 "--fleet-demo flags; the autoscaler "
+                                 "demo injects no faults")
+            import json as _json
+
+            from .fleet.autoscaler import autoscale_demo
+
+            report = autoscale_demo(
+                n=args.n, requests=args.serve_requests, floor=1,
+                ceiling=args.replicas, batch_cap=args.batch_cap,
+                max_wait_ms=args.max_wait_ms, seed=args.chaos_seed,
+                block_size=args.m, dtype=jnp.dtype(args.dtype),
+                telemetry=telemetry)
+            if args.quiet:
+                report.pop("slo_final", None)
+            print(_json.dumps(report))
+            if report["silent_p99_breach"]:
+                print("silent p99 breach: a tick saw risk signals "
+                      "with pre-shed off and no capacity action",
+                      file=sys.stderr)
+                return 2
+            return 0
         if args.lp_demo:
             # LP/QP driver demo (ISSUE 17): the update-demo restriction
             # shape (single device, deterministic seeded instances,
@@ -1000,10 +1079,11 @@ def _main(argv, state) -> int:
             # The serving demo: single-device, generator input,
             # gathered output — same shape of restrictions as --batch
             # (exit 1 on bad combos, main.cpp:77-85 taxonomy).
-            if args.file is not None or args.workers != 1 or not args.gather:
+            if args.file is not None or not args.gather:
                 raise UsageError(
-                    "--serve-demo requires generator input on a single "
-                    "device (gathered output)")
+                    "--serve-demo requires generator input (gathered "
+                    "output); --workers W serves the LARGEST size "
+                    "through a W-device mesh lane (ISSUE 18)")
             if args.batch > 1:
                 raise UsageError("--serve-demo and --batch are distinct "
                                  "modes; pick one")
@@ -1029,7 +1109,8 @@ def _main(argv, state) -> int:
                 max_wait_ms=args.max_wait_ms, engine=args.engine,
                 plan_cache=args.plan_cache,
                 dtype=jnp.dtype(args.dtype), generator=args.generator,
-                telemetry=telemetry, numerics=args.numerics)
+                telemetry=telemetry, numerics=args.numerics,
+                workers=args.workers)
             if args.quiet:
                 report.pop("stats", None)
             print(_json.dumps(report))

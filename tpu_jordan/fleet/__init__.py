@@ -24,8 +24,17 @@ Five parts:
   * ``demo`` — ``fleet_demo``: the ``--fleet-demo`` CLI engine; its
     report is validated by ``tools/check_fleet.py`` (exit 2 = silent
     loss).
+  * ``autoscaler`` — :class:`FleetAutoscaler` (ISSUE 18): the
+    burn-rate :class:`~..obs.slo.SLOMonitor` DRIVES the pool — scale
+    up on sustained two-window burn (capacity-ledger veto), typed
+    pre-shed at the router before a p99 breach, drain parked slots to
+    the floor when idle; every action a flight-recorder event carrying
+    its burn evidence.  ``autoscale_demo`` is the ``--autoscale-demo``
+    CLI engine (``tools/check_autoscale.py`` re-derives every
+    decision; exit 2 = silent p99 breach).
 """
 
+from .autoscaler import FleetAutoscaler, autoscale_demo
 from .demo import fleet_demo
 from .pool import JordanFleet
 from .replica import Replica, ReplicaKilledError
@@ -33,6 +42,6 @@ from .router import Router
 from .supervisor import Supervisor
 
 __all__ = [
-    "JordanFleet", "Replica", "ReplicaKilledError", "Router",
-    "Supervisor", "fleet_demo",
+    "FleetAutoscaler", "JordanFleet", "Replica", "ReplicaKilledError",
+    "Router", "Supervisor", "autoscale_demo", "fleet_demo",
 ]

@@ -1,0 +1,429 @@
+""":class:`FleetAutoscaler` — the SLO-driven replica control loop
+(ISSUE 18 tentpole part 2).
+
+PR 8 built the burn-rate :class:`~..obs.slo.SLOMonitor` as a REPORT;
+this module closes the loop: the monitor DRIVES the supervisor-side
+capacity of the pool.  One ``tick()`` is the whole policy (inline-
+drivable, fake-clock deterministic in tests; the optional background
+thread just runs it on an interval):
+
+  * **Scale up on sustained burn** — any objective paging (the
+    multi-window AND: long window proves material, short window proves
+    ongoing) grows the pool by one replica per cooldown, up to
+    ``ceiling``.  The replacement warms every fleet-served lane against
+    the shared store BEFORE entering the slot table (zero compiles —
+    the supervisor's rolling-restart discipline).
+  * **Capacity veto** — the process-wide byte ledger
+    (``obs/capacity.py``) is the what-fits check on every scale-up:
+    with ``scale_budget_bytes`` set, a grow that would run over it is
+    WITHHELD — a typed non-action, recorded with the same evidence as
+    an action (``autoscale{action="scale_withheld"}``).
+  * **Pre-shed before breach** — when burn or the p99 trend says the
+    objective is at risk (p99 ≥ ``preshed_p99_frac`` × target, or any
+    paging pair), the router's ``pre_shed`` flag sheds NEW submissions
+    typed at the front door (``shed{reason="pre_shed"}``,
+    journey-hopped ``ServiceOverloadedError``) — load is turned away
+    while the pool scales, instead of queueing into a p99 breach.
+  * **Drain to the floor when idle** — ``idle_after_s`` with zero new
+    request outcomes (and no risk signals) parks one replica per
+    cooldown down to ``floor``; parked slots drain their queues first
+    (nothing dropped) and the supervisor skips them (designed
+    reduction, not a death).
+
+Every action AND withheld action is a flight-recorder ``autoscale``
+event carrying the burn evidence it was derived from (paging
+objectives with their window burn rates, p99 vs target, idle seconds,
+ledger bytes) — ``tools/check_autoscale.py`` re-derives every decision
+from that evidence and exits 2 on a silent p99 breach or an
+unexplained scale action.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+from ..obs import capacity as _capacity
+from ..obs import metrics as _obs_metrics
+from ..obs import recorder as _recorder
+from ..obs.slo import _outcome_counts
+
+_M_ACTIONS = _obs_metrics.counter(
+    "tpu_jordan_autoscale_actions_total",
+    "autoscaler decisions, labeled by action (scale_up|drain|"
+    "pre_shed_on|pre_shed_off|scale_withheld)")
+
+
+class FleetAutoscaler:
+    """The control loop over one :class:`~.pool.JordanFleet` and one
+    :class:`~..obs.slo.SLOMonitor`.
+
+    Args:
+      pool: the fleet (needs ``ready_count``/``grow``/``drain_slot``
+        and ``router.pre_shed`` — a test fake implementing those four
+        is a full harness).
+      monitor: the burn-rate monitor; ``tick()`` samples it and
+        evaluates, so the caller never manages sampling separately.
+      floor / ceiling: replica bounds.  Drain never goes below
+        ``floor``; scale-up never above ``ceiling``.
+      idle_after_s: zero new request outcomes for this long (with no
+        risk signals) triggers a drain step.
+      scale_cooldown_s: minimum spacing between capacity actions (both
+        directions) — one step per window, never a thundering resize.
+      preshed_p99_frac: the pre-breach trigger — pre-shed turns on
+        when any objective's observed p99 reaches this fraction of its
+        target (or any pair pages), and off when neither holds.
+      scale_budget_bytes: optional ledger ceiling for the capacity
+        veto; None = no veto.
+      clock: injectable monotonic clock (defaults to the pool's —
+        fake-clock tests drive both from one source).
+    """
+
+    def __init__(self, pool, monitor, floor: int = 1, ceiling: int = 4,
+                 idle_after_s: float = 30.0,
+                 scale_cooldown_s: float = 5.0,
+                 preshed_p99_frac: float = 0.8,
+                 scale_budget_bytes: int | None = None, clock=None):
+        if floor < 1:
+            raise ValueError("floor must be >= 1")
+        if ceiling < floor:
+            raise ValueError("ceiling must be >= floor")
+        self.pool = pool
+        self.monitor = monitor
+        self.floor = int(floor)
+        self.ceiling = int(ceiling)
+        self.idle_after_s = float(idle_after_s)
+        self.scale_cooldown_s = float(scale_cooldown_s)
+        self.preshed_p99_frac = float(preshed_p99_frac)
+        self.scale_budget_bytes = (None if scale_budget_bytes is None
+                                   else int(scale_budget_bytes))
+        self.clock = (clock if clock is not None
+                      else getattr(pool, "clock", time.monotonic))
+        self._last_action_t: float | None = None
+        self._last_activity_t = self.clock()
+        self._last_outcome_total: int | None = None
+        #: In-memory mirror of every recorded ``autoscale`` event, in
+        #: order — the demo report embeds it next to the recorder
+        #: slice so the checker can cross-validate the two.
+        self.actions: list[dict] = []
+        self.ticks = 0
+        self._stop = False
+        self._thread: threading.Thread | None = None
+
+    # ---- the control policy -----------------------------------------
+
+    def _record(self, action: str, ready_before: int,
+                evidence: dict) -> dict:
+        ev = {"action": action, "ready_before": ready_before,
+              "ready_after": self.pool.ready_count(),
+              "floor": self.floor, "ceiling": self.ceiling,
+              "evidence": evidence}
+        _M_ACTIONS.inc(action=action)
+        _recorder.record("autoscale", **ev)
+        self.actions.append(ev)
+        return ev
+
+    def _cooldown_ok(self, now: float) -> bool:
+        return (self._last_action_t is None
+                or now - self._last_action_t >= self.scale_cooldown_s)
+
+    @staticmethod
+    def _paging_evidence(report: dict) -> list[dict]:
+        """The burn evidence of every paging objective — the window
+        pairs whose long AND short burn exceeded the threshold, copied
+        verbatim from the monitor's report (the checker re-derives the
+        page decision from exactly these numbers)."""
+        out = []
+        for obj in report["objectives"]:
+            if not obj["paging"]:
+                continue
+            out.append({"name": obj["name"], "bucket": obj["bucket"],
+                        "error_budget": obj["error_budget"],
+                        "windows": [w for w in obj["windows"]
+                                    if w["page"]]})
+        return out
+
+    def _p99_risk(self, report: dict) -> list[dict]:
+        """Objectives whose observed p99 reached the pre-breach
+        fraction of their target."""
+        out = []
+        for obj in report["objectives"]:
+            target, p99 = obj["p99_target_ms"], obj["p99_ms"]
+            if (target is not None and p99 is not None
+                    and p99 >= self.preshed_p99_frac * target):
+                out.append({"name": obj["name"], "p99_ms": p99,
+                            "p99_target_ms": target,
+                            "frac": self.preshed_p99_frac})
+        return out
+
+    def tick(self) -> dict:
+        """One control pass: sample + evaluate the monitor, then apply
+        at most ONE capacity action (scale/drain, cooldown-spaced) and
+        reconcile the pre-shed flag.  Returns the tick summary the
+        demo report embeds."""
+        now = self.clock()
+        self.ticks += 1
+        self.monitor.sample()
+        report = self.monitor.evaluate()
+        paging = self._paging_evidence(report)
+        p99_risk = self._p99_risk(report)
+        ready = self.pool.ready_count()
+
+        # Activity tracking: any movement of the fleet-wide outcome
+        # total (the journey-terminal series — the same numbers the
+        # burn windows integrate) resets the idle clock.
+        snap = self.monitor.registry.snapshot()
+        ok, err = _outcome_counts(snap, None)
+        total = ok + err
+        if self._last_outcome_total is None \
+                or total != self._last_outcome_total:
+            self._last_activity_t = now
+        self._last_outcome_total = total
+        idle_s = now - self._last_activity_t
+
+        action = None
+        if paging and ready < self.ceiling and self._cooldown_ok(now):
+            live = _capacity.live_bytes()
+            if (self.scale_budget_bytes is not None
+                    and live >= self.scale_budget_bytes):
+                # The capacity veto: a withheld action leaves the same
+                # evidence trail as a taken one.
+                action = self._record("scale_withheld", ready, {
+                    "paging": paging, "live_bytes": live,
+                    "scale_budget_bytes": self.scale_budget_bytes})
+                self._last_action_t = now
+            else:
+                slot = self.pool.grow()
+                if slot is not None:
+                    action = self._record("scale_up", ready, {
+                        "paging": paging, "slot": slot,
+                        "live_bytes": live,
+                        "scale_budget_bytes": self.scale_budget_bytes})
+                    self._last_action_t = now
+        elif (not paging and not p99_risk and ready > self.floor
+                and idle_s >= self.idle_after_s
+                and self._cooldown_ok(now)):
+            slot = self.pool.drain_slot()
+            if slot is not None:
+                action = self._record("drain", ready, {
+                    "idle_s": round(idle_s, 6),
+                    "idle_after_s": self.idle_after_s, "slot": slot})
+                self._last_action_t = now
+
+        # Pre-shed reconciliation (flag, not a step — no cooldown:
+        # shedding must engage the tick the risk appears and release
+        # the tick it clears).
+        want_shed = bool(paging or p99_risk)
+        if want_shed != self.pool.router.pre_shed:
+            self.pool.router.pre_shed = want_shed
+            self._record("pre_shed_on" if want_shed else "pre_shed_off",
+                         ready, {"paging": paging, "p99_risk": p99_risk})
+
+        return {
+            "t": round(now, 6),
+            "ready": self.pool.ready_count(),
+            "paging": [p["name"] for p in paging],
+            "p99_risk": [p["name"] for p in p99_risk],
+            "pre_shed": self.pool.router.pre_shed,
+            "idle_s": round(idle_s, 6),
+            "action": None if action is None else action["action"],
+            "healthy": report["healthy"],
+        }
+
+    # ---- optional background loop -----------------------------------
+
+    def start(self, interval_s: float = 1.0) -> None:
+        """Run ``tick()`` on a daemon thread every ``interval_s`` (the
+        production wiring; tests and the demo drive ``tick()``
+        inline)."""
+        if self._thread is not None:
+            return
+        self._stop = False
+
+        def loop():
+            while not self._stop:
+                time.sleep(interval_s)
+                if self._stop:
+                    return
+                self.tick()
+
+        self._thread = threading.Thread(
+            target=loop, name="tpu-jordan-fleet-autoscaler", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop = True
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+
+
+def autoscale_demo(n: int = 64, requests: int = 48, floor: int = 1,
+                   ceiling: int = 3, batch_cap: int = 4,
+                   max_wait_ms: float = 1.0, seed: int = 0,
+                   block_size: int | None = None, dtype=None,
+                   telemetry=None) -> dict:
+    """The ``--autoscale-demo`` CLI mode's engine (ISSUE 18
+    acceptance): one seeded burst→idle→recovery traffic trace through
+    a floor-sized fleet under the :class:`FleetAutoscaler`, showing
+    scale-up on sustained burn, typed pre-shed before breach, drain on
+    idle, and a healthy recovery — every decision carried in the
+    report with the burn evidence it was derived from
+    (``tools/check_autoscale.py`` re-derives each one; exit 2 = a
+    silent p99 breach or an unexplained scale action).
+
+    The burn source is deterministic by construction: the burst waves
+    mix clean requests with requests whose ``deadline_ms`` is already
+    unpayable (sub-millisecond) — each resolves with the typed
+    ``DeadlineExceededError``, an error outcome on the journey series
+    the burn windows integrate.  No fault injection, no flaky timing
+    assertions: the SLO math sees a sustained error rate, and the
+    control loop must answer it."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..obs.journey import outcome_ledger
+    from ..obs.metrics import REGISTRY
+    from ..obs.recorder import RECORDER
+    from ..obs.slo import SLOMonitor, bucket_specs
+    from ..serve.executors import bucket_for
+    from .pool import JordanFleet
+
+    dtype = jnp.dtype(jnp.float32 if dtype is None else dtype)
+    t0 = time.monotonic()
+    bucket = bucket_for(n)
+    # Demo-scaled SLO: availability 0.7 (budget 0.3) with one
+    # (2s, 0.4s, 1.2x) window pair — a ~50%-error burst burns ~1.67x,
+    # decisively over threshold in BOTH windows within one wave, and a
+    # quiet fleet decisively under (zero traffic burns zero).  The p99
+    # objective is a generous runaway bound; the demo's pre-shed
+    # trigger is the burn signal.
+    windows = ((2.0, 0.4, 1.2),)
+    availability, p99_target_ms = 0.7, 60000.0
+    idle_after_s, preshed_frac = 0.6, 0.8
+    monitor = SLOMonitor(
+        bucket_specs([bucket], availability=availability,
+                     p99_latency_ms=p99_target_ms),
+        windows=windows)
+
+    def shed_pre() -> int:
+        return int(REGISTRY.counter("tpu_jordan_fleet_shed_total")
+                   .value(reason="pre_shed"))
+
+    waves, per_wave = 4, max(4, requests // 4)
+    rng = np.random.default_rng(seed)
+    bb_mark = RECORDER.total
+    shed0 = shed_pre()
+    ticks, trajectory = [], []
+    phase_stats = {}
+
+    with JordanFleet(replicas=floor, dtype=dtype, batch_cap=batch_cap,
+                     max_wait_ms=max_wait_ms,
+                     max_queue=max(requests * 2, 64),
+                     block_size=block_size, telemetry=telemetry,
+                     stable_after_s=0.05) as fleet:
+        scaler = FleetAutoscaler(fleet, monitor, floor=floor,
+                                 ceiling=ceiling,
+                                 idle_after_s=idle_after_s,
+                                 scale_cooldown_s=0.0,
+                                 preshed_p99_frac=preshed_frac)
+        fleet.warmup([n])
+        monitor.sample()                     # the pre-burst baseline
+
+        def run_wave(n_ok: int, n_bad: int) -> dict:
+            futs = []
+            for i in range(n_ok + n_bad):
+                a = rng.standard_normal((n, n)).astype(dtype)
+                # The bad half's deadline is unpayable by construction
+                # (queue wait alone exceeds it): a deterministic typed
+                # DeadlineExceededError, the demo's burn source.
+                dl = None if i < n_ok else 0.01
+                try:
+                    futs.append(fleet.submit(a, deadline_ms=dl))
+                except Exception as e:       # noqa: BLE001 — typed shed
+                    futs.append(e)
+            out = {"ok": 0, "typed_errors": {}}
+            for f in futs:
+                try:
+                    if isinstance(f, Exception):
+                        raise f
+                    f.result(120)
+                    out["ok"] += 1
+                except Exception as e:       # noqa: BLE001 — typed
+                    name = type(e).__name__
+                    out["typed_errors"][name] = (
+                        out["typed_errors"].get(name, 0) + 1)
+            return out
+
+        # ---- phase 1: burst (sustained two-window burn) -------------
+        burst = []
+        for _ in range(waves):
+            burst.append(run_wave(per_wave // 2,
+                                  per_wave - per_wave // 2))
+            ticks.append(scaler.tick())
+            trajectory.append(ticks[-1]["ready"])
+            time.sleep(0.15)
+        phase_stats["burst"] = {"waves": burst,
+                                "ready_after": fleet.ready_count(),
+                                "pre_shed": fleet.router.pre_shed}
+
+        # ---- phase 2: idle (burn clears, fleet drains to floor) -----
+        for _ in range(24):
+            time.sleep(0.3)
+            ticks.append(scaler.tick())
+            trajectory.append(ticks[-1]["ready"])
+            if (fleet.ready_count() <= floor
+                    and not fleet.router.pre_shed):
+                break
+        phase_stats["idle"] = {"ready_after": fleet.ready_count(),
+                               "pre_shed": fleet.router.pre_shed,
+                               "ticks": len(ticks)}
+
+        # ---- phase 3: recovery (clean traffic serves again) ---------
+        recovery = run_wave(max(4, per_wave // 2), 0)
+        ticks.append(scaler.tick())
+        trajectory.append(ticks[-1]["ready"])
+        phase_stats["recovery"] = recovery
+
+        final_slo = monitor.evaluate()
+        actions = list(scaler.actions)
+        fleet_stats = fleet.stats()
+
+    blackbox = RECORDER.dump(events=RECORDER.since(bb_mark))
+    journey_ledger = outcome_ledger(blackbox["events"])
+    by_action: dict[str, int] = {}
+    for a in actions:
+        by_action[a["action"]] = by_action.get(a["action"], 0) + 1
+    # A tick that saw risk (paging or p99) and left pre-shed OFF with
+    # no capacity action is the silent-breach class — the breach the
+    # checker pages on.
+    silent_p99_breach = any(
+        (t["paging"] or t["p99_risk"]) and not t["pre_shed"]
+        and t["action"] not in ("scale_up", "scale_withheld")
+        for t in ticks)
+    return {
+        "metric": "autoscale_demo",
+        "n": n, "seed": seed,
+        "floor": floor, "ceiling": ceiling,
+        "requests_per_wave": per_wave, "waves": waves,
+        "config": {
+            "windows": [list(w) for w in windows],
+            "availability": availability,
+            "p99_target_ms": p99_target_ms,
+            "idle_after_s": idle_after_s,
+            "scale_cooldown_s": 0.0,
+            "preshed_p99_frac": preshed_frac,
+        },
+        "phases": phase_stats,
+        "ticks": ticks,
+        "actions": actions,
+        "actions_by_kind": by_action,
+        "ready_trajectory": trajectory,
+        "pre_shed_count": shed_pre() - shed0,
+        "slo_final": final_slo,
+        "ledger": fleet_stats["ledger"],
+        "journey_ledger": journey_ledger,
+        "blackbox": blackbox,
+        "silent_p99_breach": silent_p99_breach,
+        "elapsed_s": round(time.monotonic() - t0, 3),
+    }

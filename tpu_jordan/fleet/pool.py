@@ -70,6 +70,11 @@ class _Slot:
     installed_at: float = 0.0
     credited: bool = False
     lineage: tuple = field(default=())
+    #: Parked = deliberately emptied by the autoscaler's drain
+    #: (ISSUE 18): the supervisor skips it (no restart — an empty
+    #: parked slot is DESIGNED capacity reduction, not a death) until
+    #: ``grow()`` un-parks it.
+    parked: bool = False
 
 
 class JordanFleet:
@@ -180,10 +185,10 @@ class JordanFleet:
         self._resolved_ok = 0
         self._resolved_error = 0
         self.closing = False
+        self._restart_failures = int(restart_failures)
+        self._restart_cooldown_s = float(restart_cooldown_s)
         self._slots = [
-            _Slot(index=i, breaker=CircuitBreaker(
-                failures=restart_failures, cooldown_s=restart_cooldown_s,
-                clock=self.clock, name=f"fleet_slot_{i}"))
+            _Slot(index=i, breaker=self._slot_breaker(i))
             for i in range(self.slots)
         ]
         # Fleet-level journey log (ISSUE 8): the router mints ONE
@@ -212,6 +217,12 @@ class JordanFleet:
             self.supervisor.start()
 
     # ---- replica lifecycle plumbing ---------------------------------
+
+    def _slot_breaker(self, index: int) -> CircuitBreaker:
+        return CircuitBreaker(
+            failures=self._restart_failures,
+            cooldown_s=self._restart_cooldown_s,
+            clock=self.clock, name=f"fleet_slot_{index}")
 
     def _spawn_replica(self, slot_index: int) -> Replica:
         with self._lock:
@@ -253,6 +264,63 @@ class JordanFleet:
         _M_READY.set(float(sum(
             1 for s in self._slots
             if s.replica is not None and s.replica.state == READY)))
+
+    # ---- autoscaling (ISSUE 18) -------------------------------------
+
+    def ready_count(self) -> int:
+        """Replicas currently READY (the autoscaler's capacity view)."""
+        return len(self.live_replicas())
+
+    def grow(self) -> int | None:
+        """Add one replica (autoscaler scale-up): un-park the
+        lowest-index parked slot, or append a brand-new slot.  The
+        replacement warms every lane the fleet has served BEFORE
+        entering the slot table (shared store — zero compiles, the
+        supervisor's rolling-restart discipline), so scaled-up capacity
+        never serves cold.  Returns the slot index, or None while the
+        fleet is closing."""
+        with self._lock:
+            if self.closing:
+                return None
+            parked = [s for s in self._slots if s.parked]
+            if parked:
+                slot = parked[0]
+                slot.parked = False
+            else:
+                slot = _Slot(index=len(self._slots),
+                             breaker=self._slot_breaker(len(self._slots)))
+                self._slots.append(slot)
+                self.slots += 1
+        replica = self._spawn_replica(slot.index)
+        replica.warmup(self.warm_shapes(),
+                       update_shapes=self.warm_update_shapes(),
+                       solve_shapes=self.warm_solve_shapes())
+        self._install(slot, replica)
+        return slot.index
+
+    def drain_slot(self) -> int | None:
+        """Remove one replica (autoscaler drain): the highest-index
+        live slot drains its queue (every in-flight/queued request
+        completes — a drain never drops work), then parks empty.  The
+        supervisor skips parked slots; ``grow()`` un-parks them first.
+        Refuses (returns None) rather than drain the last live
+        replica — the FLOOR is the autoscaler's policy, but a
+        zero-replica pool is never this method's outcome."""
+        with self._lock:
+            live = [s for s in self._slots
+                    if not s.parked and s.replica is not None]
+            if len(live) <= 1 or self.closing:
+                return None
+            slot = live[-1]
+            slot.parked = True
+            replica = slot.replica
+        if replica is not None:
+            replica.close(drain=True)
+            with self._lock:
+                slot.replica = None
+                self._lock.notify_all()
+        self._export_ready_gauge()
+        return slot.index
 
     # ---- router plumbing --------------------------------------------
 
@@ -540,6 +608,7 @@ class JordanFleet:
             entry = {"slot": s.index,
                      "restart_breaker": s.breaker.state,
                      "lineage": list(s.lineage),
+                     "parked": s.parked,
                      "replica": None}
             if s.replica is not None:
                 entry["replica"] = s.replica.snapshot()

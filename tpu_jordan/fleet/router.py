@@ -59,8 +59,8 @@ _M_REROUTES = _obs_metrics.counter(
     "replica's slot")
 _M_SHED = _obs_metrics.counter(
     "tpu_jordan_fleet_shed_total",
-    "routing decisions that skipped a replica, labeled by reason "
-    "(breaker|overload|dead)")
+    "routing decisions that skipped a replica or shed a request, "
+    "labeled by reason (breaker|overload|dead|pre_shed)")
 
 
 @dataclass
@@ -134,6 +134,30 @@ class Router:
     def __init__(self, pool, max_reroutes: int = 2):
         self.pool = pool
         self.max_reroutes = max(1, int(max_reroutes))
+        #: Pre-shed flag (ISSUE 18): set by the
+        #: :class:`~.autoscaler.FleetAutoscaler` when the SLO burn/p99
+        #: evidence says the fleet is approaching its objective — NEW
+        #: submissions are shed typed at the front door (counted
+        #: ``shed{reason="pre_shed"}``, journey-hopped) while in-flight
+        #: work and death re-queues finish untouched.
+        self.pre_shed = False
+
+    def _check_pre_shed(self, req: "_FleetRequest") -> None:
+        """Typed pre-shed at the front door: a shed request is an
+        ANSWER (``ServiceOverloadedError`` — retry after backoff), with
+        the shed counted and the journey explaining why; never a
+        silent drop.  Applied to NEW submissions only — re-queue hops
+        dispatch directly, so pre-shed can't drop accepted work."""
+        if not self.pre_shed:
+            return
+        _M_SHED.inc(reason="pre_shed", exemplar=req.rid)
+        req.hop("shed", reason="pre_shed")
+        req.hop("reject", reason="pre_shed")
+        raise ServiceOverloadedError(
+            f"pre-shedding bucket {req.bucket}: the autoscaler flagged "
+            f"the fleet as approaching its SLO objective (sustained "
+            f"burn / p99 risk) — retry after backoff (typed "
+            f"backpressure, nothing dropped)")
 
     # ---- caller side -------------------------------------------------
 
@@ -167,6 +191,7 @@ class Router:
         self.pool._record_bucket(req.bucket)
         self.pool._account_submitted()
         try:
+            self._check_pre_shed(req)
             self._dispatch(req)
         except Exception as e:
             self.pool._account_resolved(ok=False)
@@ -197,6 +222,7 @@ class Router:
             kind="update", handle=handle, u=u, v=v)
         self.pool._account_submitted()
         try:
+            self._check_pre_shed(req)
             self._dispatch(req)
         except Exception as e:
             self.pool._account_resolved(ok=False)
@@ -238,6 +264,7 @@ class Router:
         self.pool._record_bucket(req.bucket)
         self.pool._account_submitted()
         try:
+            self._check_pre_shed(req)
             self._dispatch(req)
         except Exception as e:
             self.pool._account_resolved(ok=False)

@@ -130,6 +130,11 @@ class Supervisor:
         pool = self.pool
         now = pool.clock()
         for slot in pool.slot_table():
+            if slot.parked:
+                # Autoscaler-drained capacity (ISSUE 18): an empty
+                # parked slot is DESIGNED reduction, not a death —
+                # refilling it would fight the control loop.
+                continue
             replica = slot.replica
             if replica is not None and replica.state == READY:
                 # Wedge: READY but the heartbeat went stale.

@@ -27,6 +27,9 @@ hop of its life appends a timestamped journey event:
   fault               a request-scoped injected fault (replica_kill)
   served              the replica-level result fan-out (singular, secs)
   result              TERMINAL — outcome ok|error, written by close()
+  mesh_admitted       the mesh-lane admission walk (ISSUE 18): this
+                      request routed to a distributed lane (mesh +
+                      the per-device projection that admitted it)
   ==================  =================================================
 
 Every event is mirrored into the always-on flight recorder

@@ -127,6 +127,7 @@ class _Request:
     handle: object = None     # update lane: the HandleRef to mutate
     padded_u: np.ndarray = None       # (bucket_n, k_bucket) zero-padded
     padded_v: np.ndarray = None       # (bucket_n, k_bucket) zero-padded
+    mesh: str = "single"      # topology of the lane (ISSUE 18)
 
     def hop(self, event: str, **attrs) -> None:
         """One journey event for this rider (no-op without a context —
@@ -135,25 +136,41 @@ class _Request:
             self.ctx.event(event, **attrs)
 
 
-def _lane(workload: str, bucket_n: int, rhs: int = 0):
+def _lane(workload: str, bucket_n: int, rhs: int = 0,
+          mesh: str = "single"):
     """The queue/breaker key for a request class: invert lanes keep the
     historical bare int (every pre-ISSUE-11 key, stat label, and
     breaker name is byte-identical); solve lanes are
-    ("solve", bucket_n, rhs) tuples."""
+    ("solve", bucket_n, rhs) tuples.  Mesh lanes (ISSUE 18) are
+    4-tuples carrying the topology — distinct meshes of one bucket are
+    distinct queues, breakers, and stats rows."""
+    if mesh != "single":
+        return (workload, bucket_n, int(rhs), mesh)
     return bucket_n if workload == "invert" else (workload, bucket_n,
                                                   int(rhs))
 
 
 def _lane_label(lane):
     """The stats/metrics label of a lane: the bare bucket int for
-    invert, ``"solve:<bucket>:k<rhs>"`` for solve lanes."""
+    invert, ``"solve:<bucket>:k<rhs>"`` for solve lanes, and the same
+    with an ``@mesh`` suffix for mesh lanes (matching
+    ``executors.get_info``'s label, so the two can never drift)."""
     if isinstance(lane, int):
         return lane
+    if len(lane) == 4:
+        wl, b, rhs, mesh = lane
+        base = b if wl == "invert" else f"{wl}:{b}:k{rhs}"
+        return f"{base}@{mesh}"
     return f"{lane[0]}:{lane[1]}:k{lane[2]}"
 
 
 def _lane_workload(lane) -> str:
     return "invert" if isinstance(lane, int) else lane[0]
+
+
+def _lane_mesh(lane) -> str:
+    return lane[3] if isinstance(lane, tuple) and len(lane) == 4 \
+        else "single"
 
 
 class MicroBatcher:
@@ -231,8 +248,9 @@ class MicroBatcher:
                workload: str = "invert", padded_b: np.ndarray = None,
                rhs: int = 0, k: int = 0, handle=None,
                padded_u: np.ndarray = None,
-               padded_v: np.ndarray = None) -> Future:
-        lane = _lane(workload, bucket_n, rhs)
+               padded_v: np.ndarray = None,
+               mesh: str = "single") -> Future:
+        lane = _lane(workload, bucket_n, rhs, mesh)
         label = _lane_label(lane)
         br = self.executors.breaker(label) \
             if self.policy is not None else None
@@ -252,7 +270,8 @@ class MicroBatcher:
                                    else now + float(deadline_s)),
                        ctx=ctx, workload=workload, padded_b=padded_b,
                        rhs=int(rhs), k=int(k), handle=handle,
-                       padded_u=padded_u, padded_v=padded_v)
+                       padded_u=padded_u, padded_v=padded_v,
+                       mesh=str(mesh))
         with self._cv:
             if self._closing:
                 req.hop("reject", reason="closed")
@@ -374,7 +393,7 @@ class MicroBatcher:
             if not q:
                 continue
             age = now - q[0].t_enqueue
-            if len(q) >= self.batch_cap:
+            if len(q) >= self._lane_cap(b):
                 cause = "full"
             elif age >= self.max_wait:
                 cause = "deadline"
@@ -385,6 +404,13 @@ class MicroBatcher:
             if best is None or age > best[1]:
                 best = (b, age, cause)
         return None if best is None else (best[0], best[2])
+
+    def _lane_cap(self, lane) -> int:
+        """A lane's dispatch capacity: ``batch_cap`` everywhere except
+        mesh lanes, which go at occupancy 1 — one sharded program owns
+        the whole mesh per launch (ISSUE 18), so a "full batch" there
+        is one request."""
+        return 1 if _lane_mesh(lane) != "single" else self.batch_cap
 
     def _next_deadline(self, now: float) -> float | None:
         waits = [self.max_wait - (now - q[0].t_enqueue)
@@ -402,7 +428,7 @@ class MicroBatcher:
                     if picked is not None:
                         bucket, cause = picked
                         q = self._queues[bucket]
-                        take = min(len(q), self.batch_cap)
+                        take = min(len(q), self._lane_cap(bucket))
                         batch = [q.popleft() for _ in range(take)]
                         self._queued -= take
                         # Claim each future (the stdlib executor
@@ -1046,6 +1072,123 @@ class MicroBatcher:
             _numerics.observe(rep)
             _numerics.record_spikes(rep, thresholds)
 
+    def _execute_mesh(self, lane, batch: list, t_dispatch: float) -> None:
+        """Dispatch one mesh-lane request (ISSUE 18): the distributed
+        AOT executable (``serve/meshlanes.MeshLaneExecutor``) at
+        occupancy 1 — scatter, the sharded elimination, gather — with
+        the full serve discipline inherited: journeys, breaker
+        feedback, deadlines, retry + integrity gate, numerics summary,
+        and the comm observatory's per-execute analytical inventory
+        (observed records attached at compile time, drift judged per
+        execute) exactly like ``solve_system(workers=...)``."""
+        import math
+
+        import jax.numpy as jnp
+
+        workload, bucket, rhs, mesh = lane
+        label = _lane_label(lane)
+        br = self.executors.breaker(label) \
+            if self.policy is not None else None
+        req = batch[0]
+        try:
+            _faults.fire("dispatch")
+            ex, source = self.executors.get_info(
+                bucket, 1, self.block_size, workload=workload, rhs=rhs,
+                mesh=mesh)
+            req.hop("executor", bucket=bucket, source=source,
+                    engine=ex.key.engine, mesh=mesh)
+            from ..obs import comm as _comm
+            from ..obs import hwcost as _hwcost
+            from ..obs.spans import timed_blocking
+
+            a = jnp.asarray(req.padded)
+            run_args = (a,) if workload == "invert" \
+                else (a, jnp.asarray(req.padded_b))
+
+            def run_once():
+                _faults.fire("execute")
+                comm_rep = ex.comm_report()
+                out, esp = timed_blocking(
+                    ex.run, *run_args, telemetry=self._tel,
+                    name="execute", bucket=bucket, occupancy=1,
+                    workload=workload, mesh=mesh)
+                res, sing_flags = out
+                _hwcost.attach_execute_cost(
+                    esp, ex.cost,
+                    analytical_flops=_hwcost.baseline_workload_flops(
+                        bucket, workload, k=rhs))
+                comm_rep.observe_metrics()
+                comm_rep.attach_span(esp)
+                _comm.observe_drift(comm_rep, esp.duration, esp)
+                _comm.set_last_report(comm_rep)
+                sing = bool(np.asarray(sing_flags).any())
+                kappa = rel = 0.0
+                if not sing:
+                    kappa, rel = ex.metrics(
+                        req.padded, res,
+                        req.padded_b if workload != "invert" else None)
+                    if _faults.corrupt("result_corrupt_nan"):
+                        rel = float("nan")
+                    # Integrity gate (the single-device lanes'
+                    # discipline, host-verified here): corruption is
+                    # typed and retryable, never a wrong answer served.
+                    if not math.isfinite(rel):
+                        raise ResultCorruptionError(
+                            f"non-finite rel_residual on mesh lane "
+                            f"{label} — corrupted result detected by "
+                            f"the integrity gate")
+                return res, sing, kappa, rel, esp.duration
+
+            def on_retry(exc, attempt):
+                req.hop("retry", attempt=attempt,
+                        error=type(exc).__name__)
+
+            res, sing, kappa, rel, exec_s = (
+                self.policy.retry.call(
+                    run_once, component="serve.execute",
+                    on_retry=on_retry,
+                    exemplar=(req.ctx.request_id
+                              if req.ctx is not None else None))
+                if self.policy is not None else run_once())
+        except BaseException as e:                  # noqa: BLE001
+            _obs_metrics.counter(
+                "tpu_jordan_serve_batch_failures_total",
+                "dispatched batches that terminally failed (after any "
+                "retries) and fanned a typed error to their riders",
+            ).inc(bucket=label)
+            if br is not None:
+                br.record_failure()
+            for r in batch:
+                r.hop("batch_failure", error=type(e).__name__)
+                if not r.future.done():
+                    r.future.set_exception(e)
+            return
+        if br is not None:
+            br.record_success()
+
+        queue_waits = [t_dispatch - req.t_enqueue]
+        self.stats.batch(label, occupancy=1, exec_seconds=exec_s,
+                         queue_seconds=queue_waits, singular=int(sing),
+                         workload=workload)
+        if self.numerics == "summary":
+            self._observe_numerics(batch, ex, np.asarray([sing]),
+                                   np.asarray([kappa]),
+                                   np.asarray([rel]))
+        if not self._fail_expired(batch, "execute"):
+            return
+        req.hop("served", singular=sing, seconds=round(exec_s, 6),
+                mesh=mesh)
+        out = np.asarray(res)
+        req.future.set_result(InvertResult(
+            inverse=(out[:req.n, :req.n]
+                     if workload == "invert" else None),
+            n=req.n, bucket_n=bucket, singular=sing,
+            kappa=float(kappa), rel_residual=float(rel),
+            queue_seconds=queue_waits[0], execute_seconds=exec_s,
+            batch_occupancy=1, workload=workload,
+            solution=(out[:req.n, :req.k]
+                      if workload != "invert" else None)))
+
     def _execute(self, lane, batch: list, t_dispatch: float) -> None:
         import jax.numpy as jnp
 
@@ -1053,6 +1196,8 @@ class MicroBatcher:
         workload = _lane_workload(lane)
         if workload == "update":
             return self._execute_updates(lane, batch, t_dispatch)
+        if _lane_mesh(lane) != "single":
+            return self._execute_mesh(lane, batch, t_dispatch)
         label = _lane_label(lane)
         br = self.executors.breaker(label) \
             if self.policy is not None else None

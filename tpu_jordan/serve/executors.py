@@ -95,7 +95,15 @@ class ExecutorKey:
     ``workload``/``rhs`` (ISSUE 11): solve lanes compile their own
     executables per (workload, bucket_n, dtype, rhs-bucket) — an invert
     key keeps the historical defaults, so every pre-existing key (and
-    the fleet's shared-store sharing semantics) is unchanged."""
+    the fleet's shared-store sharing semantics) is unchanged.
+
+    ``mesh`` (ISSUE 18): the lane's topology axis — ``"single"`` for
+    every single-device lane (the default, so every pre-existing key is
+    byte-identical), or a ``TunePoint.topology`` label (``"p8"``,
+    ``"2x4"``) selecting a distributed mesh-backed lane
+    (``serve/meshlanes.py``).  Distinct topologies of the same bucket
+    are distinct executables, distinct stats rows, and distinct
+    capacity entries — never aliased."""
 
     bucket_n: int
     batch_cap: int
@@ -104,18 +112,25 @@ class ExecutorKey:
     block_size: int
     workload: str = "invert"
     rhs: int = 0                  # RHS-width bucket (solve lanes only)
+    mesh: str = "single"          # topology label (mesh lanes only)
 
 
 def lane_label(workload: str, bucket_n: int, batch_cap: int,
-               rhs: int = 0) -> str:
+               rhs: int = 0, mesh: str = "single") -> str:
     """The capacity-ledger detail label of one lane — workload, bucket,
-    batch capacity, and (solve/update) the k-bucket."""
+    batch capacity, (solve/update) the k-bucket, and (mesh lanes) the
+    topology.  The ``@mesh`` segment appears only off the single-device
+    default, so every pre-existing label is byte-identical while
+    distinct topologies of one bucket stop aliasing (ISSUE 18)."""
     base = f"{workload}:{bucket_n}:b{batch_cap}"
-    return base if workload == "invert" else f"{base}:k{rhs}"
+    if workload != "invert":
+        base = f"{base}:k{rhs}"
+    return base if mesh == "single" else f"{base}@{mesh}"
 
 
 def projected_lane_bytes(bucket_n: int, batch_cap: int, dtype,
-                         workload: str = "invert", rhs: int = 0) -> int:
+                         workload: str = "invert", rhs: int = 0,
+                         devices: int = 1) -> int:
     """Projected argument + output bytes of a lane's AOT signature —
     computable BEFORE compiling (ISSUE 13: ``warmup``/
     ``project_capacity`` record this so operators see what a bucket
@@ -123,9 +138,15 @@ def projected_lane_bytes(bucket_n: int, batch_cap: int, dtype,
     compiler-known only: the post-compile ``memory_analysis`` footprint
     in the ``executor_lanes`` capacity ledger is the full number; this
     projection is its arg/out floor (exact on backends whose temp
-    residency is zero, e.g. the CPU lanes the tests pin)."""
+    residency is zero, e.g. the CPU lanes the tests pin).
+
+    ``devices`` (ISSUE 18) is the lane's mesh size: the O(n²) matrix
+    terms divide by it (A and the inverse stay sharded — per-DEVICE
+    residency is the admission signal), while the O(n·k) RHS/solution
+    terms stay whole (X gathers; conservative).  ``devices=1`` is the
+    historical projection byte-for-byte."""
     it = jnp.dtype(dtype).itemsize
-    n2 = bucket_n * bucket_n
+    n2 = -(-bucket_n * bucket_n // max(1, int(devices)))
     cap, k = int(batch_cap), int(rhs)
     per_elem_out = 1 + 2 * it         # singular flag + kappa + rel
     if workload == "invert":
@@ -378,12 +399,17 @@ class ExecutorStore:
         nbytes = ex.cost.hbm_bytes if ex.cost.available else None
         source = "memory_analysis"
         if nbytes is None:
+            devices = 1
+            if key.mesh != "single":
+                from .meshlanes import mesh_devices, parse_mesh
+
+                devices = mesh_devices(parse_mesh(key.mesh))
             nbytes = projected_lane_bytes(key.bucket_n, key.batch_cap,
                                           key.dtype, key.workload,
-                                          key.rhs)
+                                          key.rhs, devices=devices)
             source = "projected"
         label = lane_label(key.workload, key.bucket_n, key.batch_cap,
-                           key.rhs)
+                           key.rhs, key.mesh)
         _capacity.register("executor_lanes", (id(self), key), nbytes,
                            detail=f"{label}:{source}")
 
@@ -493,13 +519,28 @@ class ExecutorCache:
         return self.tuner.measurements
 
     def _resolve(self, bucket_n: int, batch_cap: int, block_size: int,
-                 workload: str = "invert"):
+                 workload: str = "invert", mesh: str = "single"):
         """(engine, plan) for one bucket: the tuner ladder for "auto"
         (batched, workload-scoped plan-cache key — zero measurements on
         the cost-only ladder, counter-pinned), the explicit engine
         otherwise.  A service built with an explicit INVERT engine
         still resolves its solve lanes through the ladder — the invert
-        zoo is not a solve vocabulary (tuning/registry.py)."""
+        zoo is not a solve vocabulary (tuning/registry.py).
+
+        Mesh lanes (ISSUE 18) ALWAYS resolve through the ladder at a
+        distributed point — the plan-cache key's topology segment keys
+        them apart from the single-device lanes for free — because an
+        explicit single-device engine is not a distributed vocabulary
+        either."""
+        if mesh != "single":
+            from .meshlanes import normalize_mesh
+
+            point = TunePoint.create(bucket_n, block_size, self.dtype,
+                                     workers=normalize_mesh(mesh),
+                                     gather=True, batch=1,
+                                     workload=workload)
+            plan = self.tuner.select(point)
+            return plan.engine, plan
         if self.engine != "auto" and workload == "invert":
             return self.engine, None
         point = TunePoint.create(bucket_n, block_size, self.dtype,
@@ -519,31 +560,40 @@ class ExecutorCache:
 
     def get_info(self, bucket_n: int, batch_cap: int,
                  block_size: int | None = None,
-                 workload: str = "invert", rhs: int = 0
+                 workload: str = "invert", rhs: int = 0,
+                 mesh: str = "single"
                  ) -> tuple[BucketExecutor, str]:
         """``get`` plus HOW the executor was obtained — ``"cached"``
         (this cache's own view), ``"shared_store"`` (another replica
         compiled it), or ``"compiled"`` (this call built it).  The
         dispatcher stamps the source on each rider's journey (ISSUE 8:
         compile-vs-cache-hit is a per-request fact, not just a
-        counter).  ``workload``/``rhs`` select a solve lane (ISSUE 11)."""
+        counter).  ``workload``/``rhs`` select a solve lane (ISSUE 11);
+        ``mesh`` a distributed mesh-backed lane (ISSUE 18 — always
+        ``batch_cap=1``, one sharded program per launch)."""
+        if mesh != "single":
+            batch_cap = 1
         m = min(block_size if block_size is not None
                 else default_block_size(bucket_n), bucket_n)
         with self._lock:
-            rkey = (bucket_n, batch_cap, m, workload)
+            rkey = (bucket_n, batch_cap, m, workload, mesh)
             if rkey not in self._resolved:
                 self._resolved[rkey] = self._resolve(bucket_n, batch_cap,
-                                                     m, workload)
+                                                     m, workload, mesh)
             engine, plan = self._resolved[rkey]
             key = ExecutorKey(bucket_n, batch_cap, self.dtype, engine, m,
-                              workload, rhs)
+                              workload, rhs, mesh)
             ex = self._executors.get(key)
         # Stats are keyed by the LANE label (ISSUE 11): invert lanes
         # keep the historical bare bucket int; solve lanes get their
         # own "solve:<bucket>:k<rhs>" row so a solve compile can never
-        # masquerade as an invert bucket's.
+        # masquerade as an invert bucket's.  Mesh lanes append the
+        # topology (ISSUE 18) so distinct meshes of one bucket never
+        # alias onto one row.
         label = (bucket_n if workload == "invert"
                  else f"{workload}:{bucket_n}:k{rhs}")
+        if mesh != "single":
+            label = f"{label}@{mesh}"
         if ex is not None:
             if self.stats is not None:
                 self.stats.cache_hit(label, workload=workload)
@@ -559,8 +609,13 @@ class ExecutorCache:
             # propagates to the caller (the dispatcher fans it to
             # the batch's riders).
             with self._tel.span("compile", bucket=bucket_n,
-                                engine=engine, batch_cap=batch_cap):
+                                engine=engine, batch_cap=batch_cap,
+                                mesh=mesh):
                 def one():
+                    if mesh != "single":
+                        from .meshlanes import MeshLaneExecutor
+
+                        return MeshLaneExecutor(key, plan)
                     return BucketExecutor(key, plan)
                 return (self.policy.retry.call(
                             one, component="serve.compile")

@@ -1,0 +1,314 @@
+"""Mesh-backed serve lanes (ISSUE 18 tentpole part 1).
+
+Every serve lane before this PR was a single-device vmapped executable,
+so the fleet's n ceiling was one chip's HBM.  A :class:`MeshLaneExecutor`
+is the distributed counterpart of ``executors.BucketExecutor``: ONE
+AOT-compiled sharded program per ``(workload, bucket, dtype, mesh)``
+built from the SAME engine front ends the library path ships —
+``linalg.api.solve_mesh_backend`` for the [A | B] solve elimination,
+``driver.make_distributed_backend`` for the sharded invert — resolved
+through the SAME tuner ladder (the plan-cache key already carries the
+topology segment, ``tuning/plan_cache.plan_key``), so a warm mesh lane
+performs ZERO compiles and ZERO measurements exactly like the
+single-device lanes (counter-pinned in tests/test_meshlanes.py).
+
+Contract differences from the single-device lanes, all deliberate:
+
+  * **batch_cap is 1.**  A mesh program owns the whole mesh for its
+    launch — there is no second device set to vmap a batch over.  The
+    batcher dispatches mesh lanes at occupancy 1.
+  * **Admission is byte-projected.**  ``projected_lane_bytes(...,
+    devices=p)`` divides the O(n²) matrix terms by the mesh size — the
+    per-device residency — and the service admits a request to the
+    smallest mesh whose per-device projection fits the lane budget.  A
+    request no mesh can hold is a typed ``CapacityExceededError`` at
+    submit, never an OOM mid-launch.
+  * **Comm accounting is inherited day one.**  The compile is traced
+    under ``obs.comm.record_collectives`` when recording is active, and
+    every execute builds the layout-derived analytical
+    :class:`~..obs.comm.CommReport` (multiset-reconciled against the
+    observed records) exactly like ``solve_system(workers=...)``.
+  * **Typed refusals, never silent fallback.**  Complex dtypes, the
+    SPD fast path, and ``resident=True`` handles are single-device
+    contracts; a mesh lane refuses them with the library's own
+    vocabulary (``linalg/api.py``) naming the legal alternatives.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..resilience import faults as _faults
+
+#: The non-mesh topology label — the value of ``ExecutorKey.mesh`` for
+#: every single-device lane (and the default, so every pre-existing key
+#: is byte-identical).
+MESH_SINGLE = "single"
+
+
+def mesh_label(workers) -> str:
+    """The topology label of a workers spec — the SAME vocabulary as
+    ``TunePoint.topology`` ('p8' for 1D, '2x4' for 2D), so plan-cache
+    keys and ``ExecutorKey.mesh`` can never use two spellings."""
+    if isinstance(workers, tuple):
+        return f"{int(workers[0])}x{int(workers[1])}"
+    w = int(workers)
+    return MESH_SINGLE if w == 1 else f"p{w}"
+
+
+def parse_mesh(label: str):
+    """The inverse of :func:`mesh_label`: 'p8' -> 8, '2x4' -> (2, 4).
+    A malformed label is a typed ``UsageError`` (the serve surface
+    never guesses a topology)."""
+    from ..driver import UsageError
+
+    s = str(label)
+    if s == MESH_SINGLE:
+        return 1
+    if "x" in s:
+        pr, _, pc = s.partition("x")
+        if pr.isdigit() and pc.isdigit() and int(pr) > 0 and int(pc) > 0:
+            return (int(pr), int(pc))
+    elif s.startswith("p") and s[1:].isdigit() and int(s[1:]) > 0:
+        return int(s[1:])
+    raise UsageError(
+        f"mesh spec {label!r} is not a topology label: use 'pN' (1D "
+        f"row-cyclic over N devices), 'PRxPC' (2D block-cyclic), an "
+        f"int, or a (pr, pc) tuple")
+
+
+def mesh_devices(workers) -> int:
+    """Device count of a workers spec (1D p -> p, (pr, pc) -> pr*pc)."""
+    if isinstance(workers, tuple):
+        return int(workers[0]) * int(workers[1])
+    return int(workers)
+
+
+def normalize_mesh(spec):
+    """Canonicalize a mesh spec (int, (pr, pc) tuple, or topology
+    label) to the driver's workers spec, validated against the devices
+    this process can actually form a mesh from.  An unformable mesh is
+    a typed ``UsageError`` naming the device count — the serve surface
+    refuses at configure/submit time, never a mesh-construction crash
+    mid-launch."""
+    from ..driver import UsageError
+
+    workers = parse_mesh(spec) if isinstance(spec, str) else spec
+    if isinstance(workers, tuple):
+        workers = (int(workers[0]), int(workers[1]))
+        if workers[0] < 1 or workers[1] < 1:
+            raise UsageError(
+                f"mesh shape {workers} is not a topology: both mesh "
+                f"axes must be positive")
+    else:
+        workers = int(workers)
+        if workers < 1:
+            raise UsageError(
+                f"mesh size {workers} is not a topology: workers must "
+                f"be positive")
+    need = mesh_devices(workers)
+    have = jax.device_count()
+    if need < 2:
+        raise UsageError(
+            "a 1-device mesh is the single-device lane (mesh="
+            "'single'); mesh lanes need workers > 1 or a (pr, pc) "
+            "tuple")
+    if need > have:
+        raise UsageError(
+            f"mesh {mesh_label(workers)!r} needs {need} devices; this "
+            f"process has {have} (jax.device_count()) — serve this "
+            f"topology on a host that can form it, or configure a "
+            f"smaller mesh_shapes entry")
+    return workers
+
+
+class MeshLaneExecutor:
+    """One AOT-compiled distributed executable for one mesh lane.
+
+    ``key`` is an ``executors.ExecutorKey`` with ``mesh != 'single'``
+    and ``batch_cap == 1``; ``plan`` the tuner's resolved plan (cost
+    ranked through the topology-keyed plan cache — zero measurements).
+    The compile runs ONCE here; ``run()`` is scatter -> the sharded
+    elimination -> gather, and ``comm_report()`` hands the dispatcher
+    the per-execute analytical inventory with the compile-time observed
+    records attached (recording permitting)."""
+
+    def __init__(self, key, plan):
+        from ..driver import UsageError
+
+        self.key = key
+        self.plan = plan
+        self.block_size = key.block_size
+        if key.batch_cap != 1:
+            raise UsageError(
+                "mesh lanes dispatch at occupancy 1 (one sharded "
+                "program owns the whole mesh per launch); batch_cap "
+                "must be 1")
+        in_dtype = jnp.dtype(key.dtype)
+        if in_dtype.kind == "c":
+            raise UsageError(
+                "complex dtypes run single-device (the distributed "
+                "scatter/collective paths are real-dtype, the invert "
+                "engines' contract); serve complex requests on the "
+                "single-device lanes (mesh='single')")
+        if key.engine == "solve_spd":
+            raise UsageError(
+                "assume='spd' is the single-device pivot-free fast "
+                "path; the distributed [A | B] elimination pivots — "
+                "serve SPD requests on the single-device lanes "
+                "(mesh='single'), or drop the spd promise")
+        if key.workload == "update":
+            raise UsageError(
+                "the SMW update lanes are single-chip (resident "
+                "handles live on one device); mesh lanes serve "
+                "workload='invert' and 'solve'")
+        self.workers = normalize_mesh(key.mesh)
+        self.devices = mesh_devices(self.workers)
+        self.in_dtype = in_dtype
+        # The distributed core's working-dtype promotion (linalg/api.py
+        # / driver.py): sub-fp32 storage computes in fp32.
+        self.work_dtype = (jnp.dtype(jnp.float32)
+                          if in_dtype.itemsize < 4 else in_dtype)
+        #: compile-time traced collective records (obs/comm.py), or
+        #: None when recording was off — attached to every execute's
+        #: analytical report so the serve path reconciles multiset-
+        #: exact like the library path.
+        self._observed = None
+        self._compiled = (self._build_solve() if key.workload == "solve"
+                          else self._build_invert())
+        from ..obs import hwcost as _hwcost
+
+        self.cost = _hwcost.executable_cost(self._compiled)
+
+    # ---- builds ------------------------------------------------------
+
+    def _traced_compile(self, compile_once):
+        from ..obs import comm as _comm
+
+        _faults.fire("compile")
+        if _comm.recording_active():
+            with _comm.record_collectives() as rec:
+                run = compile_once()
+            self._observed = rec.records
+            return run
+        return compile_once()
+
+    def _build_solve(self):
+        from ..driver import UsageError
+        from ..linalg.api import solve_mesh_backend
+        from ..parallel.sharded_inplace import MAX_UNROLL_NR
+
+        key = self.key
+        if key.engine not in ("solve_sharded", "solve_lookahead"):
+            raise UsageError(
+                f"engine={key.engine!r} is a single-device solve "
+                f"engine; mesh solve lanes run engine='solve_sharded' "
+                f"or 'solve_lookahead' (or 'auto', which resolves "
+                f"there)")
+        N, m, K = key.bucket_n, self.block_size, key.rhs
+        (mesh, lay, scatter_a, scatter_b, compile_fn,
+         gather_x) = solve_mesh_backend(self.workers, N, m)
+        self.lay, self.mesh = lay, mesh
+        self._scatter_a, self._scatter_b = scatter_a, scatter_b
+        self._gather_x = gather_x
+        self._unroll = lay.Nr <= MAX_UNROLL_NR
+        la = key.engine == "solve_lookahead"
+        # Shape/dtype templates only — nothing executes at build.
+        W = scatter_a(jnp.eye(N, dtype=self.work_dtype), lay, mesh)
+        Xb = scatter_b(jnp.zeros((N, K), self.work_dtype), lay, mesh)
+        return self._traced_compile(
+            lambda: compile_fn(W, Xb, mesh, lay, lookahead=la))
+
+    def _build_invert(self):
+        from ..driver import make_distributed_backend
+
+        key = self.key
+        N, m = key.bucket_n, self.block_size
+        group = getattr(self.plan, "group", 0) or 0
+        engine = "inplace" if key.engine in ("inplace", "auto") else key.engine
+        be = make_distributed_backend(self.workers, N, m, engine, group)
+        self._be = be
+        self.lay, self.mesh = be.lay, be.mesh
+        from ..parallel.sharded_inplace import MAX_UNROLL_NR
+
+        self._unroll = be.lay.Nr <= MAX_UNROLL_NR
+        # The comm inventory's engine name (driver.py's derivation).
+        self._eng_name = ("swapfree" if be.swapfree
+                          else "lookahead" if getattr(be, "lookahead", False)
+                          else "grouped" if be.group > 1
+                          else "inplace" if be.inplace else "augmented")
+        W = be.scatter_W(jnp.eye(N, dtype=self.work_dtype))
+        return self._traced_compile(lambda: be.compile(W))
+
+    # ---- the per-request path ---------------------------------------
+
+    def run(self, a, b=None):
+        """One request through the mesh: scatter the identity-padded A
+        (and zero-padded B on solve lanes), execute the compiled
+        sharded program, gather the result — returns ``(result,
+        singular_flags)`` in the request dtype.  The dispatcher wraps
+        this whole call in its ``timed_blocking`` bracket (scatter and
+        gather ARE the request's latency on a mesh lane)."""
+        a = jnp.asarray(a, self.work_dtype)
+        N = self.key.bucket_n
+        if self.key.workload == "solve":
+            W = self._scatter_a(a, self.lay, self.mesh)
+            Xb = self._scatter_b(jnp.asarray(b, self.work_dtype),
+                                 self.lay, self.mesh)
+            out, sing = self._compiled(W, Xb)
+            res = self._gather_x(out, self.lay, N)
+        else:
+            W = self._be.scatter_W(a)
+            out, sing = self._compiled(W)
+            res = self._be.gather(out, N)
+        if res.dtype != self.in_dtype:
+            res = res.astype(self.in_dtype)
+        return res, sing
+
+    def metrics(self, a, result, b=None):
+        """Host-side dense verification against the CALLER's padded A
+        (and B) — ``(kappa_est, rel_residual)``, the same backward-error
+        semantics as the batched lanes' in-launch assembly.  Dense is
+        deliberate: a mesh request's O(n²) verify is noise next to its
+        O(n³/p) elimination, and the gathered result is already in
+        hand."""
+        from jax import lax as _lax
+
+        a = jnp.asarray(a)
+        x = jnp.asarray(result)
+        rhs = (jnp.asarray(b) if b is not None
+               else jnp.eye(a.shape[0], dtype=a.dtype))
+        r = jnp.matmul(a, x, precision=_lax.Precision.HIGHEST) - rhs
+        residual = float(jnp.max(jnp.sum(jnp.abs(r), axis=-1)))
+        norm = jnp.max(jnp.sum(jnp.abs(a), axis=-1))
+        norm_a = float(norm)
+        norm_x = float(jnp.max(jnp.sum(jnp.abs(x), axis=-1)))
+        norm_b = float(jnp.max(jnp.sum(jnp.abs(rhs), axis=-1)))
+        denom = norm_a * norm_x + norm_b
+        rel = residual / denom if denom else residual
+        kappa = (norm_a * norm_x / norm_b) if norm_b else 0.0
+        return kappa, rel
+
+    def comm_report(self):
+        """The layout-derived analytical collective inventory for one
+        execute (obs/comm.py), with the compile-time observed records
+        attached when they were captured.  Invert lanes pass
+        ``refine=1``: the serve path verifies densely on the gathered
+        result (like the solve flavors), so the ring-GEMM residual
+        section is honestly absent from the model."""
+        from ..obs import comm as _comm
+
+        key = self.key
+        if key.workload == "solve":
+            rep = _comm.engine_report(
+                engine=key.engine, lay=self.lay, dtype=self.work_dtype,
+                gather=True, unroll=self._unroll, rhs=key.rhs)
+        else:
+            rep = _comm.engine_report(
+                engine=self._eng_name, lay=self.lay,
+                dtype=self.work_dtype, gather=True, refine=1,
+                group=getattr(self._be, "group", 0))
+        if self._observed is not None:
+            rep.attach_observed("engine", self._observed)
+        return rep

@@ -118,6 +118,23 @@ class JordanService:
         admission (the ledger still accounts every byte).  Mutually
         exclusive with ``shared_handles`` (a shared store carries its
         own budget).
+      mesh_shapes: topologies this service may open mesh-backed lanes
+        on (ISSUE 18, ``serve/meshlanes.py``): an iterable of workers
+        specs — ints ('p8'), (pr, pc) tuples ('2x4'), or topology
+        labels — validated against ``jax.device_count()`` at
+        construction (an unformable mesh is a typed ``UsageError``
+        here, never a crash mid-launch).  Requires
+        ``lane_budget_bytes``: the projected per-device arg+out bytes
+        (``executors.projected_lane_bytes``) are the admission signal
+        — a request that fits the single-device budget stays on the
+        historical lanes; one that doesn't routes to the SMALLEST
+        configured mesh whose per-device share fits (a
+        ``mesh_admitted`` journey hop carries the projection); one no
+        mesh can hold is a typed ``CapacityExceededError`` at submit.
+      lane_budget_bytes: the per-device byte budget the admission walk
+        compares projections against (docs/SERVING.md).  None (the
+        default) disables mesh routing entirely — every request serves
+        on the single-device lanes, exactly the pre-mesh behavior.
     """
 
     def __init__(self, engine: str = "auto", plan_cache: str | None = None,
@@ -132,7 +149,8 @@ class JordanService:
                  numerics: str = "off",
                  shared_handles=None,
                  update_drift_budget_factor: float | None = None,
-                 handle_budget_bytes: int | None = None):
+                 handle_budget_bytes: int | None = None,
+                 mesh_shapes=(), lane_budget_bytes: int | None = None):
         self.dtype = jnp.dtype(dtype)
         self.batch_cap = int(batch_cap)
         self.telemetry = telemetry
@@ -193,8 +211,80 @@ class JordanService:
         # process-wide flight recorder.  A fleet replica does NOT mint
         # ids — the router passes the fleet-level context through.
         self.journey = JourneyLog(prefix="req")
+        # Mesh-backed lanes (ISSUE 18): each configured topology is
+        # validated NOW (typed UsageError on an unformable mesh) and
+        # held sorted by device count — the admission walk always
+        # routes to the SMALLEST mesh that fits, so capacity scales
+        # with n instead of every big request grabbing the whole host.
+        from .meshlanes import mesh_devices, mesh_label, normalize_mesh
+
+        lanes = {}
+        for spec in mesh_shapes:
+            workers = normalize_mesh(spec)
+            lanes[mesh_label(workers)] = mesh_devices(workers)
+        self._mesh_lanes = sorted(lanes.items(), key=lambda t: (t[1], t[0]))
+        self.lane_budget_bytes = (None if lane_budget_bytes is None
+                                  else int(lane_budget_bytes))
+        if self._mesh_lanes and self.lane_budget_bytes is None:
+            from ..driver import UsageError
+
+            raise UsageError(
+                "mesh_shapes without lane_budget_bytes: the per-device "
+                "byte budget IS the admission signal deciding which "
+                "requests leave the single-device lane — pass "
+                "lane_budget_bytes (docs/SERVING.md)")
         self._closed = False
         self._close_lock = threading.Lock()
+
+    # ---- mesh admission (ISSUE 18) -----------------------------------
+
+    def _admit_mesh(self, n: int, bucket: int, workload: str, rhs: int,
+                    ctx) -> str:
+        """The submit-time admission walk: single-device lane if the
+        projection fits the budget, else the smallest configured mesh
+        whose PER-DEVICE share fits, else a typed
+        ``CapacityExceededError`` — refused here, at submit, with a
+        ``reject`` journey hop and a ``capacity_refused`` recorder
+        event; the launch that would have OOMed never happens."""
+        from .executors import projected_lane_bytes
+        from .meshlanes import MESH_SINGLE
+
+        budget = self.lane_budget_bytes
+        if budget is None:
+            return MESH_SINGLE
+        single = projected_lane_bytes(bucket, self.batch_cap, self.dtype,
+                                      workload, rhs)
+        if single <= budget:
+            return MESH_SINGLE
+        best = single
+        for label, devices in self._mesh_lanes:
+            proj = projected_lane_bytes(bucket, 1, self.dtype, workload,
+                                        rhs, devices=devices)
+            best = min(best, proj)
+            if proj <= budget:
+                ctx.event("mesh_admitted", mesh=label,
+                          projected_bytes=proj, budget_bytes=budget,
+                          single_device_bytes=single)
+                return label
+        from ..obs import capacity as _capacity
+        from ..resilience.policy import CapacityExceededError
+
+        _capacity.record_refusal(
+            requested=best,
+            live_bytes=_capacity.live_bytes("executor_lanes"),
+            budget_bytes=budget, pinned=0)
+        ctx.event("reject", reason="capacity", projected_bytes=best,
+                  budget_bytes=budget)
+        largest = (f"the largest configured mesh "
+                   f"({self._mesh_lanes[-1][0]!r})"
+                   if self._mesh_lanes else
+                   "the single-device lane (no mesh_shapes configured)")
+        raise CapacityExceededError(
+            f"n={n} (bucket {bucket}, workload {workload!r}) projects "
+            f"{best} bytes/device on {largest}; lane_budget_bytes is "
+            f"{budget} — configure a larger mesh_shapes entry or raise "
+            f"the budget (the request is refused at submit, never an "
+            f"OOM mid-launch)")
 
     # ---- request path ------------------------------------------------
 
@@ -255,12 +345,13 @@ class JordanService:
         ctx = (self.journey.new(n, bucket, workload=workload)
                if own_ctx else _ctx)
         try:
+            mesh = self._admit_mesh(n, bucket, workload, rhs, ctx)
             fut = self._batcher.submit(
                 padded, n, bucket,
                 deadline_s=(None if deadline_ms is None
                             else float(deadline_ms) / 1e3),
                 ctx=ctx, workload=workload, padded_b=padded_b,
-                rhs=rhs, k=k)
+                rhs=rhs, k=k, mesh=mesh)
         except Exception as e:
             if own_ctx:
                 ctx.close("error", error=type(e).__name__)
@@ -321,6 +412,21 @@ class JordanService:
                              f"got shape {arr.shape}")
         n = arr.shape[0]
         bucket = bucket_for(n)
+        if self.lane_budget_bytes is not None:
+            from .executors import projected_lane_bytes
+
+            if (projected_lane_bytes(bucket, self.batch_cap, self.dtype)
+                    > self.lane_budget_bytes):
+                from ..driver import UsageError
+
+                raise UsageError(
+                    f"resident=True pins the (A, A⁻¹) pair on ONE "
+                    f"device (the SMW update lanes are single-chip); "
+                    f"bucket {bucket} exceeds lane_budget_bytes="
+                    f"{self.lane_budget_bytes} on the single-device "
+                    f"lane, so this invert would route to a mesh lane "
+                    f"— invert without resident=True (the mesh lanes "
+                    f"serve it), or raise lane_budget_bytes")
         ctx = self.journey.new(n, bucket, workload="invert")
         try:
             self.handles.ensure_capacity(
@@ -431,7 +537,7 @@ class JordanService:
     # ---- lifecycle ---------------------------------------------------
 
     def project_capacity(self, shapes=(), solve_shapes=(),
-                         update_shapes=()) -> dict:
+                         update_shapes=(), mesh_shapes=()) -> dict:
         """Projected arg+out bytes per lane the given request mix would
         open — WITHOUT compiling anything (ISSUE 13: what a bucket
         costs to open, visible before paying for it).  Same lane
@@ -447,10 +553,12 @@ class JordanService:
         cap = self.batch_cap
         out = {}
 
-        def project(workload, bucket, batch_cap, rhs=0):
-            label = lane_label(workload, bucket, batch_cap, rhs)
+        def project(workload, bucket, batch_cap, rhs=0, mesh="single",
+                    devices=1):
+            label = lane_label(workload, bucket, batch_cap, rhs, mesh)
             out[label] = projected_lane_bytes(bucket, batch_cap,
-                                              self.dtype, workload, rhs)
+                                              self.dtype, workload, rhs,
+                                              devices=devices)
             _capacity.record_projection(label, out[label])
 
         for n in shapes:
@@ -468,9 +576,33 @@ class JordanService:
                 # The batched update lane (ISSUE 17): distinct-handle
                 # riders share one vmapped launch at the service's cap.
                 project("update", b, cap, k_bucket_for(int(k)))
+        for entry in mesh_shapes:
+            workload, b, rhs, label, devices = self._mesh_entry(entry)
+            # Per-DEVICE share (ISSUE 18): the mesh lane's projection
+            # divides the O(n²) terms over the mesh — the number the
+            # admission walk compares against lane_budget_bytes.
+            project(workload, b, 1, rhs, mesh=label, devices=devices)
         return out
 
-    def warmup(self, shapes=(), solve_shapes=(), update_shapes=()) -> dict:
+    def _mesh_entry(self, entry):
+        """Decode one warmup/projection mesh-lane entry — ``(n, mesh)``
+        (an invert lane) or ``(n, k, mesh)`` (a solve lane) — into
+        ``(workload, bucket, rhs, mesh_label, devices)``.  The mesh
+        spec takes anything :func:`~.meshlanes.normalize_mesh` does."""
+        from .meshlanes import mesh_devices, mesh_label, normalize_mesh
+
+        if len(entry) == 2:
+            n, spec = entry
+            workload, rhs = "invert", 0
+        else:
+            n, k, spec = entry
+            workload, rhs = "solve", rhs_bucket_for(int(k))
+        workers = normalize_mesh(spec)
+        return (workload, bucket_for(int(n)), rhs, mesh_label(workers),
+                mesh_devices(workers))
+
+    def warmup(self, shapes=(), solve_shapes=(), update_shapes=(),
+               mesh_shapes=()) -> dict:
         """Pre-compile the executables for every bucket the given
         request sizes land in; returns {lane: resolved engine}.
         After a warmup covering the live shape mix, the serve path
@@ -492,9 +624,15 @@ class JordanService:
         Every lane's projected arg+out bytes are recorded BEFORE its
         compile (ISSUE 13, :meth:`project_capacity`) — the
         ``tpu_jordan_capacity_projected_lane_bytes`` gauge tells an
-        operator what the warmup is about to pin before it pins it."""
+        operator what the warmup is about to pin before it pins it.
+
+        ``mesh_shapes`` (ISSUE 18): ``(n, mesh)`` / ``(n, k, mesh)``
+        entries pre-compile the distributed mesh-backed lanes those
+        requests route to — the zero-compile warm-path contract covers
+        the topologies too."""
         self.project_capacity(shapes=shapes, solve_shapes=solve_shapes,
-                              update_shapes=update_shapes)
+                              update_shapes=update_shapes,
+                              mesh_shapes=mesh_shapes)
         out = {}
         for n in shapes:
             b = bucket_for(int(n))
@@ -529,6 +667,14 @@ class JordanService:
                 self.executors.get(b, self.batch_cap,
                                    self._batcher.block_size,
                                    workload="update", rhs=kb)
+        for entry in mesh_shapes:
+            workload, b, rhs, label, _ = self._mesh_entry(entry)
+            ex, _src = self.executors.get_info(
+                b, 1, self._batcher.block_size, workload=workload,
+                rhs=rhs, mesh=label)
+            lane = (f"{b}" if workload == "invert"
+                    else f"{workload}:{b}:k{rhs}")
+            out[f"{lane}@{label}"] = ex.key.engine
         return out
 
     def start(self) -> None:
@@ -572,15 +718,20 @@ class JordanService:
         warm-server pin)."""
         snap = self._stats.snapshot()
         snap["engines"] = {
-            (f"{k.bucket_n}" if k.workload == "invert"
-             else f"{k.workload}:{k.bucket_n}:k{k.rhs}"):
+            ((f"{k.bucket_n}" if k.workload == "invert"
+              else f"{k.workload}:{k.bucket_n}:k{k.rhs}")
+             + (f"@{k.mesh}" if k.mesh != "single" else "")):
             {"engine": k.engine,
              "batch_cap": k.batch_cap,
              "workload": k.workload,
+             "mesh": k.mesh,
              "plan_source": (ex.plan.source
                              if ex.plan else None)}
             for k, ex in self.executors.entries()
         }
+        snap["mesh_lanes"] = {label: devices
+                              for label, devices in self._mesh_lanes}
+        snap["lane_budget_bytes"] = self.lane_budget_bytes
         snap["measurements"] = self.executors.measurements
         snap["batch_cap"] = self.batch_cap
         snap["queued"] = self._batcher.queued
@@ -595,7 +746,8 @@ def serve_demo(n: int, block_size: int | None = None, requests: int = 64,
                batch_cap: int = 8, max_wait_ms: float = 2.0,
                engine: str = "auto", plan_cache: str | None = None,
                dtype=jnp.float32, generator: str = "rand",
-               telemetry=None, numerics: str = "off") -> dict:
+               telemetry=None, numerics: str = "off",
+               workers=1) -> dict:
     """The ``--serve-demo`` CLI mode's engine: a self-contained
     sustained-throughput demonstration on whatever backend is live.
 
@@ -607,20 +759,43 @@ def serve_demo(n: int, block_size: int | None = None, requests: int = 64,
     stats with mean occupancy and latency percentiles, the compile and
     plan-cache measurement counters (a warm server pins both at zero on
     the request path), worst rel_residual, and wall time.
+
+    ``--workers W`` (ISSUE 18): configure ONE mesh lane on a W-device
+    mesh (``'8'`` → 1D, ``'2x4'`` → 2D) with ``lane_budget_bytes`` set
+    just under the LARGEST bucket's single-device projection — so the
+    big size provably routes through the distributed lane (the
+    ``mesh_admitted`` journey hop) while the smaller sizes stay
+    single-device, all in one warm run.
     """
     import time
 
     from ..ops import generate
+    from .executors import bucket_for, projected_lane_bytes
+    from .meshlanes import mesh_label, normalize_mesh
 
     sizes = sorted({max(1, n), max(1, n // 2), max(1, n // 4)},
                    reverse=True)
+    mesh_kw, label = {}, None
+    if workers not in (1, None):
+        # The admission signal is the demo's plot device: a budget one
+        # byte under the big bucket's single-device projection forces
+        # exactly that bucket onto the mesh lane.
+        label = mesh_label(normalize_mesh(workers))
+        budget = projected_lane_bytes(bucket_for(sizes[0]), batch_cap,
+                                      dtype) - 1
+        mesh_kw = {"mesh_shapes": (workers,),
+                   "lane_budget_bytes": budget}
     elapsed0 = time.perf_counter()
     with JordanService(engine=engine, plan_cache=plan_cache, dtype=dtype,
                        batch_cap=batch_cap, max_wait_ms=max_wait_ms,
                        max_queue=max(requests, 1),
                        block_size=block_size, telemetry=telemetry,
-                       numerics=numerics) as svc:
-        svc.warmup(shapes=sizes)
+                       numerics=numerics, **mesh_kw) as svc:
+        if label is None:
+            svc.warmup(shapes=sizes)
+        else:
+            svc.warmup(shapes=sizes[1:],
+                       mesh_shapes=[(sizes[0], label)])
         compiles_after_warmup = svc.stats()["totals"]["compiles"]
         futures = []
         for i in range(requests):
@@ -635,13 +810,23 @@ def serve_demo(n: int, block_size: int | None = None, requests: int = 64,
     elapsed = time.perf_counter() - elapsed0
     singular = sum(r.singular for r in results)
     worst_rel = max((r.rel_residual for r in results
-                     if not r.singular), default=None)
+                     if not r.singular and r.rel_residual is not None),
+                    default=None)
+    mesh_doc = {}
+    if label is not None:
+        mesh_requests = sum(
+            s["requests"] for b, s in stats["buckets"].items()
+            if s.get("mesh", "single") != "single")
+        mesh_doc = {"mesh": label,
+                    "lane_budget_bytes": mesh_kw["lane_budget_bytes"],
+                    "mesh_requests": mesh_requests}
     return {
         "metric": "serve_demo",
         "requests": requests,
         "request_sizes": sizes,
         "buckets": len(stats["buckets"]),
         "batch_cap": batch_cap,
+        **mesh_doc,
         "singular": singular,
         "worst_rel_residual": (None if worst_rel is None
                                else f"{worst_rel:.1e}"),

@@ -76,8 +76,10 @@ class _BucketStats:
     """Counters for one shape bucket (all mutation under the owner's
     lock — this class itself is not thread-safe on purpose)."""
 
-    def __init__(self, workload: str = "invert"):
+    def __init__(self, workload: str = "invert",
+                 mesh: str = "single"):
         self.workload = workload
+        self.mesh = mesh
         self.requests = 0
         self.rejected = 0
         self.batches = 0
@@ -93,6 +95,7 @@ class _BucketStats:
         occ = (self.elements / self.batches) if self.batches else 0.0
         doc = {
             "workload": self.workload,
+            "mesh": self.mesh,
             "requests": self.requests,
             "rejected": self.rejected,
             "batches": self.batches,
@@ -127,7 +130,7 @@ class ServeStats:
     #: bind to the parameter instead of becoming a label series — deep
     #: in the request path, so refuse up front typed.
     RESERVED_LABELS = frozenset({"bucket", "component", "value",
-                                 "exemplar"})
+                                 "exemplar", "mesh"})
 
     def __init__(self, labels: dict | None = None):
         self._lock = threading.Lock()
@@ -140,8 +143,24 @@ class ServeStats:
                 f"stamped by ServeStats itself; pick different names")
         self._buckets: dict[int, _BucketStats] = {}
 
+    @staticmethod
+    def _split_mesh(bucket) -> tuple:
+        """Split a lane key's mesh axis (ISSUE 18): ``"4096@2x4"`` →
+        ``("4096", "2x4")``; every single-device lane (bare int bucket
+        or ``solve:<n>:k<k>`` string) → ``(key, "single")``.  The mesh
+        becomes its OWN Prometheus label so distinct topologies of one
+        bucket never alias onto one ``bucket=...`` series — and the
+        single-device series stay byte-identical (no new label)."""
+        s = str(bucket)
+        if "@" in s:
+            base, _, mesh = s.rpartition("@")
+            return base, mesh
+        return bucket, "single"
+
     def _b(self, bucket, workload: str = "invert") -> _BucketStats:
-        return self._buckets.setdefault(bucket, _BucketStats(workload))
+        _, mesh = self._split_mesh(bucket)
+        return self._buckets.setdefault(bucket,
+                                        _BucketStats(workload, mesh))
 
     def _wl(self, workload: str) -> dict:
         """Mirror labels for a mutation: invert lanes keep their
@@ -152,25 +171,36 @@ class ServeStats:
             return self._labels
         return dict(self._labels, workload=workload)
 
+    def _mirror(self, bucket, workload: str | None = None) -> dict:
+        """The full mirror label set for one mutation: the instance
+        labels, the de-aliased ``bucket``, ``workload`` off the invert
+        default, and ``mesh`` off the single-device default."""
+        base, mesh = self._split_mesh(bucket)
+        labels = (self._labels if workload in (None, "invert")
+                  else dict(self._labels, workload=workload))
+        if mesh != "single":
+            labels = dict(labels, mesh=mesh)
+        return dict(labels, bucket=base)
+
     def request(self, bucket, workload: str = "invert") -> None:
         with self._lock:
             self._b(bucket, workload).requests += 1
-        _M_REQUESTS.inc(bucket=bucket, **self._wl(workload))
+        _M_REQUESTS.inc(**self._mirror(bucket, workload))
 
     def rejected(self, bucket, workload: str = "invert") -> None:
         with self._lock:
             self._b(bucket, workload).rejected += 1
-        _M_REJECTED.inc(bucket=bucket, **self._wl(workload))
+        _M_REJECTED.inc(**self._mirror(bucket, workload))
 
     def compile(self, bucket, workload: str = "invert") -> None:
         with self._lock:
             self._b(bucket, workload).compiles += 1
-        _M_COMPILES.inc(component="serve", bucket=bucket, **self._labels)
+        _M_COMPILES.inc(component="serve", **self._mirror(bucket))
 
     def cache_hit(self, bucket, workload: str = "invert") -> None:
         with self._lock:
             self._b(bucket, workload).cache_hits += 1
-        _M_CACHE_HITS.inc(bucket=bucket, **self._labels)
+        _M_CACHE_HITS.inc(**self._mirror(bucket))
 
     def executable_cost(self, bucket, cost) -> None:
         """Record a bucket executable's XLA accounting (ISSUE 10
@@ -182,7 +212,10 @@ class ServeStats:
             return
         with self._lock:
             self._b(bucket).executable = cost.to_json()
-        _hwcost.observe_cost(cost, bucket=bucket, **self._labels)
+        base, mesh = self._split_mesh(bucket)
+        labels = (self._labels if mesh == "single"
+                  else dict(self._labels, mesh=mesh))
+        _hwcost.observe_cost(cost, bucket=base, **labels)
 
     def batch(self, bucket, occupancy: int, exec_seconds: float,
               queue_seconds, singular: int = 0,
@@ -197,16 +230,14 @@ class ServeStats:
             b.singular += singular
             b.exec_s.add(float(exec_seconds))
             b.queue_s.extend(queue_seconds)
-        wl = self._wl(workload)
-        _M_BATCHES.inc(bucket=bucket, **wl)
-        _M_OCCUPANCY.observe(occupancy, bucket=bucket, **self._labels)
-        _M_EXEC_S.observe(float(exec_seconds), bucket=bucket,
-                          **self._labels)
+        lab = self._mirror(bucket)
+        _M_BATCHES.inc(**self._mirror(bucket, workload))
+        _M_OCCUPANCY.observe(occupancy, **lab)
+        _M_EXEC_S.observe(float(exec_seconds), **lab)
         for q in queue_seconds:
-            _M_QUEUE_S.observe(q, bucket=bucket, **self._labels)
+            _M_QUEUE_S.observe(q, **lab)
         if singular:
-            _M_SINGULAR.inc(singular, component="serve", bucket=bucket,
-                            **self._labels)
+            _M_SINGULAR.inc(singular, component="serve", **lab)
         # Live-bytes device watermark (ISSUE 10, re-based by ISSUE 13):
         # the process-wide sticky probe — a backend whose FIRST probe
         # reported no allocator stats (CPU) stays disabled forever (the

@@ -74,7 +74,13 @@ def plan_key(point: TunePoint) -> str:
     appears only when ``point.workload != "invert"`` (e.g.
     ``tpu-v5e|single|n4096|float32|gathered|wsolve``), so every
     pre-existing invert key — batched or not — is byte-identical and
-    existing caches stay valid."""
+    existing caches stay valid.
+
+    The topology segment is also what makes the mesh-backed serve
+    lanes (ISSUE 18, ``serve/meshlanes.py``) warm-cacheable with NO
+    key change: a ``p8``/``2x4`` lane's plan resolves under the same
+    key a direct ``solve(workers=...)`` tuned — one plans.json serves
+    both the library path and the serving topology lanes."""
     backend = (f"{point.backend}-{point.chip}" if point.chip
                else point.backend)
     mem = "gathered" if point.gather else "sharded"
