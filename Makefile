@@ -13,7 +13,7 @@ WORKERS  ?= 1
 REQUESTS  ?= 64
 BATCH_CAP ?= 8
 
-.PHONY: all native tpu test smoke serve-demo solve-demo chaos-demo fleet-demo autoscale-demo update-demo capacity-demo comm-demo lp-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
+.PHONY: all native tpu test smoke serve-demo solve-demo chaos-demo fleet-demo autoscale-demo update-demo capacity-demo comm-demo work-demo lp-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
 
 REPLICAS ?= 3
 
@@ -150,6 +150,23 @@ comm-demo:
 	python -m tpu_jordan 48 8 --comm-demo --quiet \
 	  > /tmp/tpu_jordan_comm.json
 	python tools/check_comm.py /tmp/tpu_jordan_comm.json
+
+# Work-observatory demo + validation (ISSUE 19,
+# docs/OBSERVABILITY.md): six tiny distributed solves (1D + 2D meshes,
+# invert + solve workloads, a ragged size whose padded tail skews the
+# shares and an aligned size whose penalty must pin to exactly 0) —
+# each leg's per-worker analytical FLOP shares summing EXACTLY to the
+# engine's convention total, re-derived by the checker from the layout
+# math alone, and each executable judged against cost_analysis — plus
+# the fleet-skew legs: a synthetic straggler that MUST be a recorded
+# straggler_suspected event, a layout-attributed spread that must stay
+# clean, and the recovery transition (exit 2 = unaccounted work or an
+# unsupported straggler verdict).  This row is the work observatory's
+# demo gate, like comm-demo for the communication observatory.
+work-demo:
+	python -m tpu_jordan 48 8 --work-demo --quiet \
+	  > /tmp/tpu_jordan_work.json
+	python tools/check_work.py /tmp/tpu_jordan_work.json
 
 # LP/QP driver demo + validation (ISSUE 17, docs/WORKLOADS.md): four
 # seeded optimization runs (LP well/ill revised simplex, QP well/ill

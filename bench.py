@@ -467,6 +467,7 @@ def _sharded_swapfree_row(extra):
         "shard = max(s.data.nbytes for s in b.addressable_shards)\n"
         "assert r.inverse is None and shard * 8 == b.nbytes\n"
         "d = r.comm.drift or {}\n"
+        "wt = r.work.to_json()['totals']\n"
         "print(json.dumps({'n': n, 'm': m, 'mesh': '2x4',\n"
         "                  'engine': 'swapfree', 'gather': False,\n"
         "                  'elapsed_s': round(r.elapsed, 3),\n"
@@ -478,7 +479,10 @@ def _sharded_swapfree_row(extra):
         "                      if s.section == 'engine')),\n"
         "                  'comm_gbps': d.get('achieved_gbps'),\n"
         "                  'comm_vs_projected':\n"
-        "                      d.get('comm_vs_projected')}))\n"
+        "                      d.get('comm_vs_projected'),\n"
+        "                  'work_skew': wt['skew'],\n"
+        "                  'work_ragged_penalty':\n"
+        "                      wt['ragged_penalty']}))\n"
     )
     try:
         proc = subprocess.run(
@@ -495,6 +499,12 @@ def _sharded_swapfree_row(extra):
         if row.get("comm_gbps") is not None:
             extra["sharded_swapfree_2048_comm_gbps"] = round(
                 row["comm_gbps"], 4)
+        # ISSUE 19: work-observatory accounting fields (layout-exact
+        # imbalance factor + padding penalty — never compared
+        # cross-round: a layout change re-prices the same solve).
+        extra["sharded_swapfree_2048_work_skew"] = row["work_skew"]
+        extra["sharded_swapfree_2048_ragged_penalty"] = row[
+            "work_ragged_penalty"]
     except Exception as e:                      # noqa: BLE001
         extra["sharded_swapfree_gather_false_error"] = str(e)[:200]
 
@@ -567,7 +577,11 @@ def _solve_sharded_row(extra, n=4096, m=128, p=8, ks=(1, 8, 32),
         "                   for s in r.comm.sigs\n"
         "                   if s.section == 'engine')),\n"
         "               'comm_gbps': d.get('achieved_gbps'),\n"
-        "               'comm_vs_projected': d.get('comm_vs_projected')}\n"
+        "               'comm_vs_projected': d.get('comm_vs_projected'),\n"
+        "               'work_skew': r.work.to_json()['totals'][\n"
+        "                   'skew'],\n"
+        "               'work_ragged_penalty': r.work.to_json()[\n"
+        "                   'totals']['ragged_penalty']}\n"
         "        try:\n"
         "            c = _hwcost.executable_cost(run)\n"
         "            if c.available and c.flops:\n"
@@ -607,6 +621,10 @@ def _solve_sharded_row(extra, n=4096, m=128, p=8, ks=(1, 8, 32),
         extra[f"{stem}_k{k}_comm_bytes"] = leg["comm_payload_bytes"]
         if leg.get("xla_flops"):
             extra[f"{stem}_k{k}_xla_flops"] = leg["xla_flops"]
+        # ISSUE 19: work-observatory accounting fields (layout-exact,
+        # never compared cross-round).
+        extra[f"{stem}_k{k}_work_skew"] = leg["work_skew"]
+        extra[f"{stem}_k{k}_ragged_penalty"] = leg["work_ragged_penalty"]
         if k != 8 and leg.get("comm_gbps") is not None:
             extra[f"{stem}_k{k}_comm_gbps"] = round(leg["comm_gbps"], 4)
     # The historical k=8 row + legacy sentinel keys (unchanged names —

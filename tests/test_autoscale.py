@@ -224,6 +224,49 @@ class TestAutoscalerPolicy:
         assert [a["action"] for a in scaler.actions] == [
             "pre_shed_on", "drain", "pre_shed_off"]
 
+    def test_skew_judge_vetoes_p99_preshed_not_paging(self):
+        """ISSUE 19: with a suspected straggler on the fleet-skew
+        judge, p99-risk-driven pre-shed is withheld (the veto evidence
+        rides in the tick and a transition-only pre_shed_vetoed
+        action), while paging-driven pre-shed engages regardless —
+        burn is fleet-wide evidence."""
+        from tpu_jordan.obs.work import FleetSkewJudge
+
+        judge = FleetSkewJudge()
+        judge.assess({"0": 10.0, "1": 10.0, "2": 55.0})
+        assert judge.veto() is not None
+        clock, reg, pool, scaler = _harness(ready=2, idle_after_s=0.0,
+                                            skew_judge=judge)
+        reg.p99_s = 0.090                    # p99 risk, no burn
+        t = scaler.tick()
+        assert t["p99_risk"] == ["demo"] and not t["paging"]
+        assert t["pre_shed"] is False        # vetoed, not engaged
+        assert t["skew_veto"]["replica"] == "2"
+        assert t["skew_veto"]["spread"] > t["skew_veto"]["threshold"]
+        assert [a["action"] for a in scaler.actions] == [
+            "pre_shed_vetoed"]               # transition-only
+        clock.advance(1.0)
+        t = scaler.tick()                    # still vetoed: no repeat
+        assert t["pre_shed"] is False
+        assert [a["action"] for a in scaler.actions] == [
+            "pre_shed_vetoed"]
+
+        reg.ok, reg.err = 5, 5               # now a real burn pages
+        clock.advance(1.0)
+        t = scaler.tick()
+        assert t["paging"] == ["demo"] and t["pre_shed"] is True
+        assert "skew_veto" not in t
+
+        # The straggler clearing re-arms p99-driven shedding.
+        judge.assess({"0": 10.0, "1": 10.0, "2": 11.0})
+        assert judge.veto() is None
+        reg.ok, reg.err = 10, 0
+        clock.advance(20.0)
+        scaler.tick()                        # burn window clears
+        clock.advance(1.0)
+        t = scaler.tick()
+        assert t["p99_risk"] == ["demo"] and t["pre_shed"] is True
+
     def test_drain_never_below_floor_scale_never_above_ceiling(self):
         clock, reg, pool, scaler = _harness(ready=1, idle_after_s=0.0,
                                             floor=1, ceiling=2)
@@ -306,6 +349,33 @@ class TestAutoscaleDemoAcceptance:
         # The report's own flag now disagrees with the re-derivation —
         # a second, independent alarm.
         assert any("disagrees" in s for s in silent)
+
+    def test_checker_honors_skew_vetoed_tick(self):
+        """ISSUE 19, trapped both ways: a risk tick with pre-shed OFF
+        is the breach class — unless it carries supported skew-veto
+        evidence; a veto whose evidence does not re-derive (spread
+        under threshold) still pages."""
+        doctored = copy.deepcopy(_report())
+        tick = next(t for t in doctored["ticks"]
+                    if t["pre_shed"] and (t["paging"] or t["p99_risk"])
+                    and t["action"] is None)
+        tick["pre_shed"] = False
+        tick["skew_veto"] = {"replica": "2", "spread": 5.5,
+                             "threshold": 2.0}
+        doctored["silent_p99_breach"] = False
+        errs, silent = check_autoscale.check(doctored)
+        assert not any("SILENT P99 BREACH" in s for s in silent)
+        # The other way: a pre_shed_vetoed action whose evidence does
+        # not support the veto is itself the exit-2 class.
+        doctored["actions"].append({
+            "action": "pre_shed_vetoed", "ready_before": 2,
+            "ready_after": 2,
+            "evidence": {"p99_risk": [{"name": "demo"}],
+                         "skew_veto": {"replica": "2", "spread": 1.2,
+                                       "threshold": 2.0}}})
+        errs2, silent2 = check_autoscale.check(doctored)
+        assert any("veto evidence does not re-derive" in s.lower()
+                   for s in silent2)
 
     def test_checker_pages_on_uncounted_preshed(self):
         doctored = copy.deepcopy(_report())

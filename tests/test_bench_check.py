@@ -520,6 +520,64 @@ class TestLookaheadSentinel:
         assert check_bench.main(files) == 2
 
 
+class TestWorkSentinel:
+    """ISSUE 19 satellite, trapped both ways: the sharded rows'
+    ``*_work_skew`` / ``*_ragged_penalty`` work-accounting fields are
+    never compared cross-round (a layout/block-size change re-prices
+    the same solve), while the same rows' rate keys still page on
+    quiet shortfalls."""
+
+    def test_work_accounting_never_pages(self, tmp_path):
+        # A 10x skew/penalty change (different layout, same solve)
+        # with flat rates: exit 0.
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "sharded_swapfree_2048_work_skew": 1.0,
+                "sharded_swapfree_2048_ragged_penalty": 0.0,
+                "solve_sharded_4096_k8_work_skew": 1.05,
+                "solve_sharded_4096_k8_ragged_penalty": 0.02,
+                "solve_sharded_4096_k8_gflops": 120.0,
+                "solve_sharded_4096_k8_spread_pct": 2.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "sharded_swapfree_2048_work_skew": 1.46,
+                "sharded_swapfree_2048_ragged_penalty": 2.08,
+                "solve_sharded_4096_k8_work_skew": 1.45,
+                "solve_sharded_4096_k8_ragged_penalty": 1.93,
+                "solve_sharded_4096_k8_gflops": 119.0,
+                "solve_sharded_4096_k8_spread_pct": 2.0})),
+        ]
+        assert check_bench.main(files) == 0
+        assert check_bench.is_accounting_key(
+            "sharded_swapfree_2048_work_skew")
+        assert check_bench.is_accounting_key(
+            "sharded_swapfree_2048_ragged_penalty")
+        assert check_bench.is_accounting_key(
+            "solve_sharded_4096_k8_work_skew")
+        keys = check_bench.comparable_keys(
+            {"metric": "m", "value": 1.0,
+             "extra": {"solve_sharded_4096_k8_work_skew": 1.45,
+                       "solve_sharded_4096_k8_ragged_penalty": 1.93,
+                       "solve_sharded_4096_k8_gflops": 120.0}})
+        assert "solve_sharded_4096_k8_work_skew" not in keys
+        assert "solve_sharded_4096_k8_ragged_penalty" not in keys
+        assert "solve_sharded_4096_k8_gflops" in keys
+
+    def test_rates_still_page_beside_work_accounting(self, tmp_path):
+        # The other way: flat accounting fields must not mask a quiet
+        # rate shortfall on the same rows.
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "solve_sharded_4096_k8_work_skew": 1.45,
+                "solve_sharded_4096_k8_gflops": 120.0,
+                "solve_sharded_4096_k8_spread_pct": 2.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "solve_sharded_4096_k8_work_skew": 1.45,
+                "solve_sharded_4096_k8_gflops": 80.0,
+                "solve_sharded_4096_k8_spread_pct": 2.0})),
+        ]
+        assert check_bench.main(files) == 2
+
+
 class TestServeMeshRows:
     """ISSUE 18 satellite, trapped both ways: the mesh-serve lane's
     ``*_lane_bytes`` capture fields are accounting-class — a 10x

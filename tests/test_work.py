@@ -1,0 +1,526 @@
+"""ISSUE 19 — the work observatory.
+
+The reconciliation invariant is the heart: for every distributed
+engine configuration, the per-(worker, superstep, phase) analytical
+FLOP inventory (``obs/work.engine_report`` — cyclic ownership ×
+live-column window × workload) must sum EXACTLY to the engine's
+headline convention (invert ``2n³``, solve ``n³ + n²k`` — integer
+arithmetic, no tolerance), with the ragged tail's reduced-height last
+block threaded through every share (satellite 3: non-block-aligned n
+on 1D and 2D meshes).  Plus: the driver/linalg/solver integration
+(``SolveResult.work`` / ``SolveSystemResult.work`` /
+``JordanSolver.work``, execute-span attrs, the ``tpu_jordan_work_*``
+gauges), the hwcost pin (devices × cost_analysis vs the traced model),
+the measured fleet-skew layer (ServeStats cross-replica rollup →
+``FleetSkewJudge`` → transition-only recorder events → the autoscaler
+veto), and the ``tools/check_work.py`` both-ways gate.
+"""
+
+import importlib.util
+import json
+import pathlib
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_jordan.obs import work
+from tpu_jordan.obs.metrics import REGISTRY
+from tpu_jordan.obs.recorder import RECORDER
+from tpu_jordan.parallel.layout import (
+    CyclicLayout,
+    CyclicLayout2D,
+    last_block_height,
+    num_block_rows,
+)
+
+_repo = pathlib.Path(__file__).resolve().parent.parent
+_spec = importlib.util.spec_from_file_location(
+    "check_work", _repo / "tools" / "check_work.py")
+check_work = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_work)
+
+
+# ---------------------------------------------------------------------
+# Analytical inventories: pure host-side layout math.
+# ---------------------------------------------------------------------
+
+
+class TestInventoryExactness:
+    """Satellite 3: the ragged-tail edge (``last_block_height`` /
+    ``padded_num_blocks``) through the work inventories at
+    non-block-aligned n — shares summing exactly to the convention
+    total, on 1D and 2D meshes, both workloads."""
+
+    @pytest.mark.parametrize("n,m,p", [(44, 8, 4), (7, 3, 2),
+                                       (26, 8, 4), (100, 16, 8),
+                                       (64, 8, 4)])
+    def test_1d_invert_exact(self, n, m, p):
+        rep = work.engine_report(engine="inplace",
+                                 lay=CyclicLayout.create(n, m, p))
+        assert rep.exact
+        assert rep.accounted_flops() == 2 * n ** 3
+        assert sum(rep.per_superstep) == 2 * n ** 3
+        assert len(rep.per_worker) == p
+        assert rep.supersteps == num_block_rows(n, m)
+        assert rep.last_height == last_block_height(n, m)
+        assert abs(sum(rep.shares().values()) - 1.0) < 1e-12
+
+    @pytest.mark.parametrize("n,m,p,k", [(44, 8, 4, 3), (26, 8, 4, 1),
+                                         (37, 8, 2, 5)])
+    def test_1d_solve_exact(self, n, m, p, k):
+        rep = work.engine_report(engine="solve_sharded",
+                                 lay=CyclicLayout.create(n, m, p), k=k)
+        assert rep.workload == "solve"
+        assert rep.exact
+        assert rep.accounted_flops() == n ** 3 + n ** 2 * k
+        assert sum(rep.per_superstep) == n ** 3 + n ** 2 * k
+
+    @pytest.mark.parametrize("n,m,pr,pc", [(44, 8, 2, 2), (60, 8, 2, 4),
+                                           (37, 8, 4, 2),
+                                           (100, 16, 2, 4)])
+    def test_2d_invert_exact(self, n, m, pr, pc):
+        rep = work.engine_report(
+            engine="inplace", lay=CyclicLayout2D.create(n, m, pr, pc))
+        assert rep.exact
+        assert rep.accounted_flops() == 2 * n ** 3
+        assert len(rep.per_worker) == pr * pc
+        assert set(rep.per_worker) == {f"{r},{c}" for r in range(pr)
+                                       for c in range(pc)}
+
+    @pytest.mark.parametrize("n,m,pr,pc,k", [(44, 8, 2, 2, 3),
+                                             (60, 8, 2, 4, 7),
+                                             (37, 8, 4, 2, 1)])
+    def test_2d_solve_exact_with_cyclic_k_split(self, n, m, pr, pc, k):
+        """The k RHS columns split cyclically over the column workers —
+        including k not divisible by pc — and the total stays an exact
+        integer identity."""
+        rep = work.engine_report(
+            engine="solve_sharded",
+            lay=CyclicLayout2D.create(n, m, pr, pc), k=k)
+        assert rep.exact
+        assert rep.accounted_flops() == n ** 3 + n ** 2 * k
+
+    def test_ragged_tail_changes_shares_aligned_does_not(self):
+        """The reduced-height tail block gives its cyclic owner less
+        work: ragged n skews the shares, block-aligned p | Nr n pins
+        skew to exactly 1 and the penalty to exactly 0."""
+        ragged = work.engine_report(engine="inplace",
+                                    lay=CyclicLayout.create(44, 8, 4))
+        assert ragged.last_height == 4
+        assert ragged.skew() > 1.0
+        assert ragged.ragged_penalty > 0.0
+        aligned = work.engine_report(engine="inplace",
+                                     lay=CyclicLayout.create(64, 8, 4))
+        assert aligned.last_height == 8
+        assert aligned.skew() == 1.0
+        assert aligned.ragged_penalty == 0.0
+
+    def test_phase_split_pivot_only_on_owner(self):
+        """The pivot phase belongs to the superstep's owning row
+        worker; everyone eliminates.  Total pivot work is
+        Σ f_t · h_t — strictly positive and strictly smaller than the
+        eliminate bulk on any p > 1 mesh."""
+        rep = work.engine_report(engine="inplace",
+                                 lay=CyclicLayout.create(26, 8, 4))
+        piv = sum(d["pivot"] for d in rep.per_worker.values())
+        elim = sum(d["eliminate"] for d in rep.per_worker.values())
+        assert piv > 0 and elim > piv
+        assert piv + elim == rep.convention
+
+    def test_unknown_engine_refused(self):
+        with pytest.raises(ValueError, match="work inventory"):
+            work.engine_report(engine="mystery",
+                               lay=CyclicLayout.create(26, 8, 4))
+
+    def test_unknown_workload_refused(self):
+        with pytest.raises(ValueError, match="convention"):
+            work.convention_flops(8, "lstsq")
+
+
+class TestExecutedModel:
+    def test_augmented_strip_doubles_invert_width(self):
+        base = work.executed_model_flops("inplace", "invert", N=64, m=8)
+        aug = work.executed_model_flops("augmented", "invert", N=64,
+                                        m=8)
+        assert aug == 2 * base == 4.0 * 64 ** 3
+
+    def test_solve_unrolled_shrinks_fori_does_not(self):
+        fori = work.executed_model_flops("solve_sharded", "solve",
+                                         N=64, m=8, k=2, unroll=False)
+        unrolled = work.executed_model_flops("solve_sharded", "solve",
+                                             N=64, m=8, k=2,
+                                             unroll=True)
+        assert fori == 2.0 * 64 * 64 * (64 + 2)
+        assert unrolled < fori
+
+    def test_xla_pin_fori_judges_traced_body_once(self):
+        """cost_analysis is a STATIC HLO count — a fori body is counted
+        once, never × trip count — so the fori flavors judge devices ×
+        per-device against executed/Nr."""
+        rep = work.engine_report(engine="swapfree",
+                                 lay=CyclicLayout.create(64, 8, 4))
+        assert rep.unroll is False
+        traced = rep.executed_model / rep.padded_supersteps
+        cost = SimpleNamespace(available=True,
+                               flops=2.0 * traced / rep.n_devices)
+        x = rep.attach_xla(cost)
+        assert x["available"] and x["within"]
+        assert x["xla_vs_model"] == pytest.approx(2.0, rel=1e-3)
+        assert x["model_traced_flops"] == pytest.approx(traced)
+
+    def test_xla_pin_honest_when_cost_unavailable(self):
+        rep = work.engine_report(engine="inplace",
+                                 lay=CyclicLayout.create(26, 8, 4))
+        assert rep.attach_xla(None) == {"available": False}
+        assert rep.attach_xla(
+            SimpleNamespace(available=False, flops=None)) == {
+                "available": False}
+
+    def test_xla_pin_flags_out_of_band(self):
+        rep = work.engine_report(engine="inplace",
+                                 lay=CyclicLayout.create(26, 8, 4))
+        cost = SimpleNamespace(
+            available=True,
+            flops=100.0 * rep.executed_model / rep.n_devices)
+        assert rep.attach_xla(cost)["within"] is False
+
+
+# ---------------------------------------------------------------------
+# Export: metrics, span attrs, snapshot.
+# ---------------------------------------------------------------------
+
+
+class TestExport:
+    def test_metrics_and_span_attrs(self):
+        rep = work.engine_report(engine="inplace",
+                                 lay=CyclicLayout.create(44, 8, 4))
+        rep.observe_metrics()
+        snap = REGISTRY.snapshot()
+        skew_series = snap["tpu_jordan_work_skew"]["series"]
+        got = {tuple(sorted(s["labels"].items())): s["value"]
+               for s in skew_series}
+        assert got[(("engine", "inplace"),)] == pytest.approx(
+            rep.skew())
+        shares = snap["tpu_jordan_work_share"]["series"]
+        mine = [s for s in shares
+                if s["labels"].get("engine") == "inplace"]
+        assert len(mine) >= 4
+        span = SimpleNamespace(attrs={})
+        rep.attach_span(span)
+        assert span.attrs["work_skew"] == pytest.approx(rep.skew(),
+                                                        rel=1e-3)
+        assert span.attrs["work_ragged_penalty"] > 0
+
+    def test_snapshot_carries_last_report(self):
+        rep = work.engine_report(engine="inplace",
+                                 lay=CyclicLayout.create(44, 8, 4))
+        work.set_last_report(rep)
+        snap = work.snapshot()
+        assert snap["metric"] == "work_report"
+        assert snap["last_solve"]["engine"] == "inplace"
+        assert snap["last_solve"]["totals"]["exact"] is True
+
+
+# ---------------------------------------------------------------------
+# Layer two: measured fleet skew.
+# ---------------------------------------------------------------------
+
+
+class TestServeStatsSpread:
+    def test_snapshot_has_labels_and_exec_ms(self):
+        from tpu_jordan.serve.stats import ServeStats
+
+        st = ServeStats(labels={"replica": "7"})
+        st.batch("64", occupancy=1, exec_seconds=0.010,
+                 queue_seconds=())
+        snap = st.snapshot()
+        assert snap["labels"] == {"replica": "7"}
+        assert snap["exec_ms"]["p99"] == pytest.approx(10.0)
+
+    def test_cross_replica_spread(self):
+        from tpu_jordan.serve.stats import (ServeStats,
+                                            cross_replica_spread)
+
+        snaps = []
+        for slot, base in (("0", 0.010), ("1", 0.030)):
+            st = ServeStats(labels={"replica": slot})
+            for _ in range(4):
+                st.batch("64", occupancy=1, exec_seconds=base,
+                         queue_seconds=())
+            snaps.append(st.snapshot())
+        sp = cross_replica_spread(snaps)
+        assert sp["judged"] is True
+        assert sp["p99_spread"] == pytest.approx(3.0)
+        assert sp["max_replica"] == "1" and sp["min_replica"] == "0"
+
+    def test_single_replica_not_judged(self):
+        from tpu_jordan.serve.stats import (ServeStats,
+                                            cross_replica_spread)
+
+        st = ServeStats(labels={"replica": "0"})
+        st.batch("64", occupancy=1, exec_seconds=0.01,
+                 queue_seconds=())
+        assert cross_replica_spread([st.snapshot()])["judged"] is False
+
+
+class TestFleetSkewJudge:
+    def test_straggler_lifecycle_transition_only(self):
+        """Suspect → still-suspected (no duplicate event) → cleared:
+        exactly one straggler_suspected and one straggler_cleared
+        recorder event, and the counter moves once."""
+        mark = RECORDER.total
+        c = REGISTRY.counter("tpu_jordan_straggler_suspected_total")
+        before = c.value(replica="2")
+        judge = work.FleetSkewJudge()
+        v = judge.assess({"0": 10.0, "1": 10.0, "2": 55.0})
+        assert v["judged"] and v["suspected"] and v["replica"] == "2"
+        assert judge.veto() is not None
+        judge.assess({"0": 10.0, "1": 10.0, "2": 60.0})  # still sick
+        v3 = judge.assess({"0": 10.0, "1": 10.0, "2": 11.0})
+        assert not v3["suspected"]
+        assert judge.veto() is None
+        kinds = [e["kind"] for e in RECORDER.since(mark)
+                 if e["kind"].startswith("straggler")]
+        assert kinds == ["straggler_suspected", "straggler_cleared"]
+        assert c.value(replica="2") == before + 1
+
+    def test_layout_attributed_spread_stays_clean(self):
+        """A replica slower exactly in proportion to its analytical
+        critical path (a smaller mesh) must NOT be suspected — the
+        'was it the layout or the replica?' disambiguation."""
+        big = work.engine_report(engine="inplace",
+                                 lay=CyclicLayout.create(44, 8, 8))
+        small = work.engine_report(engine="inplace",
+                                   lay=CyclicLayout.create(44, 8, 2))
+        expected = {"0": work.expected_latency_factor(big),
+                    "1": work.expected_latency_factor(small)}
+        ratio = expected["1"] / expected["0"]
+        assert ratio > work.STRAGGLER_SPREAD   # raw spread WOULD page
+        v = work.FleetSkewJudge().assess(
+            {"0": 10.0, "1": 10.0 * ratio}, expected=expected)
+        assert v["judged"] is True
+        assert v["spread"] == pytest.approx(1.0)
+        assert v["suspected"] is False
+
+    def test_single_replica_honestly_unjudged(self):
+        v = work.FleetSkewJudge().assess({"0": 10.0})
+        assert v["judged"] is False and v["suspected"] is False
+        v2 = work.FleetSkewJudge().assess({"0": 10.0, "1": None,
+                                           "2": 0.0})
+        assert v2["judged"] is False
+
+
+# ---------------------------------------------------------------------
+# Driver / linalg / solver integration (real sharded executables).
+# ---------------------------------------------------------------------
+
+
+class TestIntegration:
+    def test_driver_attaches_exact_report_with_xla(self):
+        from tpu_jordan.driver import solve
+
+        r = solve(28, 8, workers=4, engine="inplace")
+        assert r.work is not None and r.work.exact
+        assert r.work.engine == "inplace"
+        assert len(r.work.per_worker) == 4
+        assert r.work.ragged_penalty > 0          # 28 % 8 != 0
+        assert r.work.xla["available"] and r.work.xla["within"]
+        assert work.LAST_REPORT is r.work
+
+    def test_solve_system_attaches_solve_report(self):
+        from tpu_jordan.linalg import solve_system
+        from tpu_jordan.ops import generate
+
+        a = generate("absdiff", (28, 28), jnp.float32)
+        b = generate("rand", (28, 2), jnp.float32, row_offset=28)
+        r = solve_system(a, b, block_size=8, workers=2,
+                         engine="solve_sharded")
+        assert r.work is not None and r.work.exact
+        assert r.work.workload == "solve" and r.work.rhs == 2
+        assert r.work.accounted_flops() == 28 ** 3 + 28 ** 2 * 2
+
+    @pytest.mark.slow  # tier-1 budget: the driver legs above pin the path
+    def test_jordan_solver_warm_execute_keeps_work_accounting(self):
+        """The warm-path pin with work accounting on: the report is
+        built at compile, executes only set gauges/span attrs — no
+        recompiles, no measurements."""
+        from tpu_jordan.models import JordanSolver
+
+        rng = np.random.default_rng(5)
+        a = (2.0 * np.eye(36) + 0.1 * rng.standard_normal(
+            (36, 36))).astype(np.float32)
+
+        def counter(name):
+            reg = REGISTRY.snapshot()
+            return sum(s["value"] for s in
+                       reg.get(name, {}).get("series", []))
+
+        s = JordanSolver(36, block_size=8, workers=2, engine="inplace")
+        s.invert(jnp.asarray(a))                   # compile + attach
+        assert s.work is not None and s.work.exact
+        assert s.work.xla is not None
+        compiles = counter("tpu_jordan_compiles_total")
+        s.invert(jnp.asarray(a))
+        s.invert(jnp.asarray(a))
+        assert counter("tpu_jordan_compiles_total") == compiles
+
+
+# ---------------------------------------------------------------------
+# The demo + checker, both ways.
+# ---------------------------------------------------------------------
+
+
+def _fake_cost(rep, factor=2.0):
+    """A cost_analysis stand-in whose devices × per-device lands at
+    ``factor`` × the traced model (in band for factor in [0.5, 4])."""
+    model = rep.executed_model
+    if not rep.unroll and rep.padded_supersteps:
+        traced = (min(rep.group, rep.padded_supersteps)
+                  if rep.group > 1 else 1)
+        model = model * traced / rep.padded_supersteps
+    return SimpleNamespace(available=True,
+                           flops=factor * model / rep.n_devices)
+
+
+@pytest.fixture(scope="module")
+def demo_report():
+    """A synthetic-but-honest work_demo report: the same leg shapes and
+    flag derivation as ``work_demo`` with the solves' analytical
+    reports built directly from layout math and the hwcost pin fed a
+    modeled cost — everything the CHECKER judges is real (inventories,
+    verdicts, recorder events); only the executables are elided, so
+    the fixture costs milliseconds instead of six compiles.  The slow
+    acceptance test below runs the real thing."""
+    mark = RECORDER.total
+    legs = []
+    for name, engine, lay, k in [
+            ("1d_p4_inplace_gathered", "inplace",
+             CyclicLayout.create(44, 8, 4), 0),
+            ("1d_p4_swapfree_sharded", "swapfree",
+             CyclicLayout.create(44, 8, 4), 0),
+            ("1d_p4_inplace_aligned", "inplace",
+             CyclicLayout.create(64, 8, 4), 0),
+            ("2d_2x2_inplace_gathered", "inplace",
+             CyclicLayout2D.create(44, 8, 2, 2), 0),
+            ("1d_p4_solve_gathered", "solve_sharded",
+             CyclicLayout.create(44, 8, 4), 3),
+            ("2d_2x2_solve_sharded", "solve_sharded",
+             CyclicLayout2D.create(44, 8, 2, 2), 2)]:
+        rep = work.engine_report(engine=engine, lay=lay, k=k,
+                                 dtype=jnp.float32)
+        rep.attach_xla(_fake_cost(rep))
+        legs.append({"name": name, "n": lay.n, "block_size": lay.m,
+                     "work": rep.to_json()})
+    fleet_legs, fleet = work._fleet_skew_legs()
+    blackbox = RECORDER.dump(events=RECORDER.since(mark))
+    straggler_events = [e for e in blackbox["events"]
+                        if e["kind"] == "straggler_suspected"]
+    cleared = [e for e in blackbox["events"]
+               if e["kind"] == "straggler_cleared"]
+    unaccounted = [leg["name"] for leg in legs
+                   if not leg["work"]["totals"]["exact"]]
+    xla_unreconciled = [leg["name"] for leg in legs
+                        if not leg["work"]["xla"]["within"]]
+    aligned = next(leg for leg in legs
+                   if leg["name"] == "1d_p4_inplace_aligned")
+    penalty_bad = aligned["work"]["totals"]["ragged_penalty"] != 0.0
+    verdict_wrong = [
+        leg["name"] for leg in fleet_legs
+        if bool(leg["verdict"]["suspected"]) != leg["expect_suspected"]]
+    return json.loads(json.dumps({
+        "metric": "work_demo", "n": 44, "aligned_n": 64,
+        "block_size": 8, "dtype": "float32", "generator": "absdiff",
+        "ragged": True, "legs": legs, "fleet_legs": fleet_legs,
+        "fleet": fleet, "straggler_events": len(straggler_events),
+        "cleared_events": len(cleared), "unaccounted": unaccounted,
+        "xla_unreconciled": xla_unreconciled,
+        "penalty_nonzero_aligned": penalty_bad,
+        "verdict_wrong": verdict_wrong,
+        "silent_work": bool(unaccounted or xla_unreconciled
+                            or penalty_bad or verdict_wrong
+                            or not straggler_events),
+        "blackbox": blackbox,
+    }))
+
+
+class TestDemoAndChecker:
+    def test_checker_accepts_clean_report(self, demo_report, tmp_path):
+        errs, silent = check_work.check(demo_report)
+        assert errs == [] and silent == []
+        p = tmp_path / "work.json"
+        p.write_text(json.dumps(demo_report))
+        assert check_work.main([str(p)]) == 0
+
+    def test_checker_rejects_silent_share_shift(self, demo_report):
+        """Doctored: work shifted between workers with the totals still
+        summing — the checker re-derives every share from layout math
+        and exit-2s, never trusting the exact flag."""
+        doc = json.loads(json.dumps(demo_report))
+        pw = doc["legs"][0]["work"]["per_worker"]
+        pw["0"]["eliminate"] += 4096
+        pw["1"]["eliminate"] -= 4096
+        errs, silent = check_work.check(doc)
+        assert any("layout-derived" in s for s in silent)
+
+    def test_checker_rejects_hidden_xla_overrun(self, demo_report):
+        doc = json.loads(json.dumps(demo_report))
+        x = doc["legs"][0]["work"]["xla"]
+        x["per_device_flops"] *= 10
+        x["total_flops"] *= 10
+        x["xla_vs_model"] *= 10
+        errs, silent = check_work.check(doc)
+        assert any("UNACCOUNTED work" in s for s in silent)
+
+    def test_checker_rejects_unsupported_verdict(self, demo_report):
+        doc = json.loads(json.dumps(demo_report))
+        for leg in doc["fleet_legs"]:
+            if leg["name"] == "fleet_skew_layout_attributed":
+                leg["verdict"]["suspected"] = True
+        errs, silent = check_work.check(doc)
+        assert any("UNSUPPORTED VERDICT" in s for s in silent)
+
+    def test_checker_rejects_stripped_straggler_event(self,
+                                                      demo_report):
+        doc = json.loads(json.dumps(demo_report))
+        doc["blackbox"]["events"] = [
+            e for e in doc["blackbox"]["events"]
+            if e["kind"] != "straggler_suspected"]
+        doc["straggler_events"] = 0
+        errs, silent = check_work.check(doc)
+        assert any("SILENT STRAGGLER" in s for s in silent)
+
+    def test_checker_rejects_nonzero_aligned_penalty(self, demo_report):
+        doc = json.loads(json.dumps(demo_report))
+        leg = next(l for l in doc["legs"]
+                   if l["name"] == "1d_p4_inplace_aligned")
+        leg["work"]["totals"]["ragged_penalty"] = 0.05
+        errs, silent = check_work.check(doc)
+        assert silent or errs
+
+    def test_checker_exit_taxonomy(self, demo_report, tmp_path):
+        foreign = tmp_path / "foreign.json"
+        foreign.write_text(json.dumps({"metric": "comm_demo"}))
+        assert check_work.main([str(foreign)]) == 1
+        doc = json.loads(json.dumps(demo_report))
+        doc["legs"][0]["work"]["per_worker"]["0"]["pivot"] += 1
+        bad = tmp_path / "bad.json"
+        bad.write_text(json.dumps(doc))
+        assert check_work.main([str(bad)]) == 2
+        assert check_work.main([str(tmp_path / "missing.json")]) == 1
+
+    @pytest.mark.slow  # tier-1 budget: six compiles; the synthetic
+    def test_real_demo_is_clean(self):   # fixture pins the checker fast
+        report = work.work_demo(n=28, block_size=8)
+        assert report["silent_work"] is False
+        assert report["ragged"] is True
+        errs, silent = check_work.check(report)
+        assert errs == [] and silent == []
+
+    def test_demo_refuses_complex_dtype(self):
+        from tpu_jordan.driver import UsageError
+
+        with pytest.raises(UsageError):
+            work.work_demo(n=28, block_size=8, dtype="complex64")

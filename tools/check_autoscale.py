@@ -200,6 +200,25 @@ def check(report: dict) -> tuple[list[str], list[str]]:
             if ev.get("paging") or ev.get("p99_risk"):
                 silent.append(f"{tag}: pre-shed released while "
                               f"evidence still shows risk: {ev}")
+        elif kind == "pre_shed_vetoed":
+            # ISSUE 19: a withheld p99-driven shed must carry BOTH the
+            # p99 risk it answered and the skew-judge verdict that
+            # vetoed it (a suspected straggler replica with a spread
+            # above threshold).
+            veto = ev.get("skew_veto") or {}
+            if not ev.get("p99_risk"):
+                silent.append(f"{tag}: vetoed with no p99 risk in "
+                              f"evidence — nothing was withheld")
+            if veto.get("replica") is None or not isinstance(
+                    veto.get("spread"), (int, float)) \
+                    or veto.get("spread", 0) <= veto.get(
+                        "threshold", float("inf")):
+                silent.append(f"{tag}: veto evidence does not "
+                              f"re-derive (needs a suspected replica "
+                              f"and spread > threshold): {veto}")
+            if after != before:
+                silent.append(f"{tag}: a VETOED shed changed ready "
+                              f"{before} -> {after}")
         else:
             silent.append(f"{tag}: unknown action kind")
 
@@ -223,10 +242,15 @@ def check(report: dict) -> tuple[list[str], list[str]]:
                               f"p99_risk={t.get('p99_risk')})")
 
     # ---- the silent-breach re-derivation (the namesake alarm) ------
+    # A skew-vetoed tick (ISSUE 19) is the one sanctioned exception:
+    # the fleet-skew judge attributed the p99 risk to one suspected
+    # straggler replica, and the tick carries the veto evidence —
+    # shedding the whole fleet would have been the wrong actuator.
     rederived = any(
         (t.get("paging") or t.get("p99_risk"))
         and not t.get("pre_shed")
         and t.get("action") not in ("scale_up", "scale_withheld")
+        and not t.get("skew_veto", False)
         for t in ticks)
     if rederived:
         silent.append("a tick saw risk signals with pre-shed OFF and "

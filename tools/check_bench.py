@@ -86,9 +86,13 @@ def load_round(path: str) -> dict | None:
 #: a collective-inventory change re-prices the same execution), plus
 #: ``*_overlap_frac`` (ISSUE 16: the probe-ahead rows' modeled
 #: probe-overlap headroom — a cost-model re-weighting re-prices the
-#: same schedule).  Never compared across rounds — the first-call
+#: same schedule), plus the ISSUE 19 work-observatory fields
+#: ``*_work_skew`` / ``*_ragged_penalty`` (layout-exact imbalance
+#: factor and padding penalty — a layout/block-size change re-prices
+#: the same solve).  Never compared across rounds — the first-call
 #: separation principle applied to accounting.
-ACCOUNTING_SUFFIXES = ("_xla_gflops", "_bytes", "_overlap_frac")
+ACCOUNTING_SUFFIXES = ("_xla_gflops", "_bytes", "_overlap_frac",
+                       "_work_skew", "_ragged_penalty")
 
 #: Rate-class suffixes: slope-derived achieved rates on the cached
 #: executable — the keys the sentinel compares and pages on.

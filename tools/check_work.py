@@ -1,0 +1,502 @@
+#!/usr/bin/env python
+"""Validate a ``--work-demo`` report (ISSUE 19).
+
+Usage: ``python tools/check_work.py report.json`` (or ``-`` for
+stdin).  No jax import — this is the ``make work-demo`` gate and runs
+anywhere.
+
+What a valid work-observatory report must prove
+(docs/OBSERVABILITY.md):
+
+  * **the reconciliation invariant** — on every solve leg (1D and 2D
+    meshes, invert and solve workloads, a RAGGED size and an ALIGNED
+    size) the per-(worker, phase) analytical FLOP inventory re-derives
+    EXACTLY from the layout math in this file (cyclic ownership ×
+    live-column window × workload convention) and sums EXACTLY to the
+    engine's convention total (invert ``2n³``, solve ``n³ + n²k`` —
+    integer arithmetic, no tolerance).  The checker never trusts the
+    ``exact`` flag: a worker share the layout math does not predict is
+    UNACCOUNTED work — the exit-2 class.
+  * **the hwcost pin** — each leg's ``devices × cost_analysis
+    per-device`` FLOPs sit inside the stated band around the TRACED
+    executed-work model, and the model itself re-derives from the
+    leg's (engine, N, m, k, unroll, group, pc).  An out-of-band ratio
+    the report stamps ``within: true`` is unaccounted work.
+  * **penalty honesty** — the aligned leg's ragged penalty is exactly
+    ``0.0``; every ragged penalty re-derives from the padded/ideal
+    executed-model quotient.
+  * **supported straggler verdicts** — each fleet leg's verdict
+    re-derives from its own evidence (normalized p99 spread vs the
+    stated threshold); a ``suspected`` verdict MUST have a
+    ``straggler_suspected`` event naming the same replica in the
+    embedded flight-recorder slice, and a layout-attributed spread
+    must stay clean.  A verdict the evidence can't support is the
+    exit-2 class.
+  * the embedded black-box slice is gap-free (``dropped == 0``) and
+    ``silent_work`` agrees with the re-derivation.
+
+Exit taxonomy (the check_comm/check_fleet convention): 0 = valid,
+1 = unreadable/structurally invalid, 2 = unaccounted work or an
+unsupported straggler verdict.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+#: Engines with a registered inventory (obs/work.INVENTORY_ENGINES —
+#: mirrored here so the gate needs no tpu_jordan import).
+KNOWN_ENGINES = {
+    "inplace", "grouped", "swapfree", "augmented", "solve_sharded",
+    "lookahead", "solve_lookahead",
+}
+
+
+def _sig(v: float) -> float:
+    return float(f"{float(v):.4g}")
+
+
+def _close(a, b, tol: float = 1e-6) -> bool:
+    if a is None or b is None:
+        return a is b
+    a, b = float(a), float(b)
+    return abs(a - b) <= tol * max(1.0, abs(a), abs(b))
+
+
+# ---------------------------------------------------------------------
+# The analytical model, re-derived from scratch (obs/work.py's math,
+# independently restated — the whole point of the gate).
+# ---------------------------------------------------------------------
+
+
+def _heights(n: int, m: int) -> list[int]:
+    tu = -(-n // m)
+    return [m] * (tu - 1) + [n - (tu - 1) * m]
+
+
+def _cyclic_sums(h: list[int], p: int) -> list[int]:
+    out = [0] * p
+    for r, hr in enumerate(h):
+        out[r % p] += hr
+    return out
+
+
+def _convention(n: int, workload: str, k: int) -> int:
+    if workload == "invert":
+        return 2 * n ** 3
+    if workload == "solve":
+        return n ** 3 + n ** 2 * k
+    raise ValueError(f"unknown workload {workload!r}")
+
+
+def _inventory_1d(n: int, m: int, p: int, workload: str, k: int):
+    h = _heights(n, m)
+    r_sum = _cyclic_sums(h, p)
+    per = {str(w): {"pivot": 0, "eliminate": 0} for w in range(p)}
+    steps = []
+    cum = 0
+    for t, ht in enumerate(h):
+        if workload == "invert":
+            f = 2 * ht * n
+        else:
+            w_prev = n - cum
+            cum += ht
+            f = ht * (w_prev + (n - cum) + k)
+        owner = t % p
+        tot = 0
+        for w in range(p):
+            piv = f * ht if w == owner else 0
+            elim = f * (r_sum[w] - (ht if w == owner else 0))
+            per[str(w)]["pivot"] += piv
+            per[str(w)]["eliminate"] += elim
+            tot += piv + elim
+        steps.append(tot)
+    return per, steps
+
+
+def _inventory_2d(n: int, m: int, pr: int, pc: int, workload: str,
+                  k: int):
+    h = _heights(n, m)
+    r_sum = _cyclic_sums(h, pr)
+    s_sum = _cyclic_sums(h, pc)
+    kc = [len(range(c, k, pc)) for c in range(pc)]
+    per = {f"{wr},{wc}": {"pivot": 0, "eliminate": 0}
+           for wr in range(pr) for wc in range(pc)}
+    steps = []
+    pref = [0] * pc
+    for t, ht in enumerate(h):
+        tc = t % pc
+        pref[tc] += ht
+        tot = 0
+        for wc in range(pc):
+            if workload == "invert":
+                f = 2 * ht * s_sum[wc]
+            else:
+                colw = 2 * (s_sum[wc] - pref[wc])
+                colw += (ht if wc == tc else 0) + kc[wc]
+                f = ht * colw
+            owner = t % pr
+            for wr in range(pr):
+                piv = f * ht if wr == owner else 0
+                elim = f * (r_sum[wr] - (ht if wr == owner else 0))
+                cell = per[f"{wr},{wc}"]
+                cell["pivot"] += piv
+                cell["eliminate"] += elim
+                tot += piv + elim
+        steps.append(tot)
+    return per, steps
+
+
+def _executed_model(engine: str, workload: str, *, N: int, m: int,
+                    k: int, unroll: bool, pc: int) -> float:
+    nr = N // m
+    if workload == "invert":
+        width = 2 * N if engine == "augmented" else N
+        return 2.0 * N * N * width
+    if not unroll:
+        return 2.0 * N * N * (N + k * pc)
+    total = 0.0
+    for t in range(nr):
+        if pc > 1:
+            bc1 = nr // pc
+            live = pc * (bc1 - t // pc) * m
+        else:
+            live = N - t * m
+        total += 2.0 * m * N * (live + k * pc)
+    return total
+
+
+# ---------------------------------------------------------------------
+# Per-leg re-derivation.
+# ---------------------------------------------------------------------
+
+
+def _check_solve_leg(name: str, work: dict, errs: list,
+                     silent: list) -> None:
+    engine = work.get("engine")
+    if engine not in KNOWN_ENGINES:
+        errs.append(f"{name}: unknown engine {engine!r} (no registered "
+                    f"inventory)")
+        return
+    n, m = int(work["n"]), int(work["block_size"])
+    workload, k = work["workload"], int(work.get("rhs") or 0)
+    workers = work.get("workers")
+    two_d = isinstance(workers, list)
+    if two_d:
+        pr, pc = int(workers[0]), int(workers[1])
+        per, steps = _inventory_2d(n, m, pr, pc, workload, k)
+    else:
+        pc = 1
+        per, steps = _inventory_1d(n, m, int(workers), workload, k)
+
+    convention = _convention(n, workload, k)
+    tot = work.get("totals") or {}
+    if tot.get("convention_flops") != convention:
+        errs.append(f"{name}: convention_flops "
+                    f"{tot.get('convention_flops')} != {workload} "
+                    f"convention {convention}")
+
+    # -- the reconciliation invariant, re-derived ---------------------
+    got = work.get("per_worker") or {}
+    for w in sorted(set(per) | set(got)):
+        mine, theirs = per.get(w), got.get(w)
+        if mine is None:
+            silent.append(f"{name}: UNACCOUNTED worker {w!r}: the "
+                          f"layout owns no such worker")
+            continue
+        if theirs is None:
+            silent.append(f"{name}: worker {w!r} missing from the "
+                          f"report (layout says {sum(mine.values())} "
+                          f"FLOPs)")
+            continue
+        for phase in ("pivot", "eliminate"):
+            if int(theirs.get(phase, -1)) != mine[phase]:
+                silent.append(
+                    f"{name}: worker {w} {phase} FLOPs "
+                    f"{theirs.get(phase)} != layout-derived "
+                    f"{mine[phase]}")
+        flops = mine["pivot"] + mine["eliminate"]
+        if theirs.get("flops") != flops:
+            silent.append(f"{name}: worker {w} flops "
+                          f"{theirs.get('flops')} != pivot+eliminate "
+                          f"{flops}")
+        share = _sig(flops / float(convention))
+        if not _close(theirs.get("share"), share):
+            silent.append(f"{name}: worker {w} share "
+                          f"{theirs.get('share')} != {share}")
+    accounted = sum(d["pivot"] + d["eliminate"] for d in per.values())
+    if accounted != convention:
+        silent.append(f"{name}: layout inventory sums to {accounted} "
+                      f"!= convention {convention} (checker model "
+                      f"violation — file a bug)")
+    if tot.get("accounted_flops") != accounted:
+        silent.append(f"{name}: accounted_flops "
+                      f"{tot.get('accounted_flops')} != inventory sum "
+                      f"{accounted}")
+    if tot.get("exact") is not True:
+        silent.append(f"{name}: exact={tot.get('exact')!r} — the "
+                      f"shares do not sum to the convention total")
+    if list(work.get("per_superstep") or []) != steps:
+        silent.append(f"{name}: per_superstep series diverges from "
+                      f"the layout-derived schedule")
+
+    # -- skew / penalty re-derivation ---------------------------------
+    worker_flops = [d["pivot"] + d["eliminate"] for d in per.values()]
+    mean = sum(worker_flops) / len(worker_flops)
+    skew = _sig(max(worker_flops) / mean) if mean else 1.0
+    if not _close(tot.get("skew"), skew):
+        errs.append(f"{name}: skew {tot.get('skew')} != re-derived "
+                    f"{skew}")
+    N = int(work["padded_n"])
+    unroll = bool(work.get("unroll"))
+    executed = _executed_model(engine, workload, N=N, m=m, k=k,
+                               unroll=unroll, pc=pc)
+    ideal = _executed_model(engine, workload, N=n, m=m, k=k,
+                            unroll=unroll, pc=pc)
+    if not _close(tot.get("executed_model_flops"), executed):
+        errs.append(f"{name}: executed_model_flops "
+                    f"{tot.get('executed_model_flops')} != re-derived "
+                    f"{executed}")
+    penalty = _sig(executed / ideal - 1.0) if ideal else 0.0
+    if not _close(tot.get("ragged_penalty"), penalty):
+        errs.append(f"{name}: ragged_penalty {tot.get('ragged_penalty')}"
+                    f" != re-derived {penalty}")
+
+    # -- the hwcost pin ------------------------------------------------
+    xla = work.get("xla") or {}
+    if not xla.get("available"):
+        errs.append(f"{name}: no cost_analysis attribution (the demo "
+                    f"legs run real sharded executables — the pin must "
+                    f"be judged)")
+        return
+    total_fl = float(xla.get("per_device_flops", 0)) \
+        * int(xla.get("devices", 0))
+    if not _close(xla.get("total_flops"), total_fl):
+        errs.append(f"{name}: xla.total_flops {xla.get('total_flops')} "
+                    f"!= per_device × devices {total_fl}")
+    nr = int(work.get("padded_supersteps") or 0)
+    model = executed
+    if not unroll and nr:
+        group = int(work.get("group") or 0)
+        traced = min(group, nr) if group > 1 else 1
+        model = model * traced / nr
+    if not _close(xla.get("model_traced_flops"), model):
+        errs.append(f"{name}: xla.model_traced_flops "
+                    f"{xla.get('model_traced_flops')} != re-derived "
+                    f"{model}")
+    band = xla.get("band") or [0, 0]
+    ratio = total_fl / model if model > 0 else None
+    if not _close(xla.get("xla_vs_model"), None if ratio is None
+                  else _sig(ratio), tol=1e-3):
+        errs.append(f"{name}: xla_vs_model {xla.get('xla_vs_model')} "
+                    f"!= re-derived {ratio}")
+    within = ratio is not None and band[0] <= ratio <= band[1]
+    if bool(xla.get("within")) != within:
+        silent.append(f"{name}: UNACCOUNTED work — xla ratio {ratio} "
+                      f"vs band {band} says within={within} but the "
+                      f"report stamps {xla.get('within')}")
+    elif not within:
+        silent.append(f"{name}: UNACCOUNTED work — devices × "
+                      f"cost_analysis {total_fl} is outside the band "
+                      f"{band} around the traced model {model}")
+
+
+def _check_fleet_leg(leg: dict, bb_events: list, errs: list,
+                     silent: list) -> None:
+    name = leg.get("name", "fleet?")
+    verdict = leg.get("verdict") or {}
+    thr = verdict.get("threshold")
+    if not isinstance(thr, (int, float)) or thr <= 1:
+        errs.append(f"{name}: verdict has no usable threshold "
+                    f"({thr!r})")
+        return
+    p99 = verdict.get("p99_ms") or {}
+    expected = verdict.get("expected")
+    norm = {}
+    for rep, v in p99.items():
+        if v is None or v <= 0:
+            continue
+        e = float(expected.get(rep, 1.0)) if expected else 1.0
+        norm[rep] = float(v) / (e if e > 0 else 1.0)
+    for rep, v in norm.items():
+        if not _close(verdict.get("normalized", {}).get(rep), _sig(v),
+                      tol=1e-3):
+            silent.append(f"{name}: normalized p99 for replica {rep} "
+                          f"{verdict.get('normalized', {}).get(rep)} "
+                          f"!= evidence-derived {_sig(v)}")
+    if len(norm) < 2:
+        judged, suspected, spread = False, False, None
+    else:
+        judged = True
+        worst = max(norm, key=lambda r: norm[r])
+        spread = norm[worst] / min(norm.values())
+        suspected = spread > thr
+        if suspected and verdict.get("replica") != worst:
+            silent.append(f"{name}: verdict blames replica "
+                          f"{verdict.get('replica')!r} but the "
+                          f"evidence's worst replica is {worst!r}")
+    if bool(verdict.get("judged")) != judged:
+        errs.append(f"{name}: judged={verdict.get('judged')} but the "
+                    f"evidence has {len(norm)} usable replicas")
+    if spread is not None and not _close(verdict.get("spread"),
+                                         _sig(spread), tol=1e-3):
+        silent.append(f"{name}: spread {verdict.get('spread')} != "
+                      f"evidence-derived {_sig(spread)}")
+    if bool(verdict.get("suspected")) != suspected:
+        silent.append(
+            f"{name}: UNSUPPORTED VERDICT — suspected="
+            f"{verdict.get('suspected')} but the normalized spread "
+            f"{spread} vs threshold {thr} says {suspected}")
+    if "expect_suspected" in leg and \
+            bool(leg["expect_suspected"]) != suspected:
+        silent.append(f"{name}: the leg's contract expects suspected="
+                      f"{leg['expect_suspected']} and the evidence "
+                      f"says {suspected}")
+    if suspected:
+        hits = [e for e in bb_events
+                if e.get("kind") == "straggler_suspected"
+                and e.get("replica") == verdict.get("replica")]
+        if not hits:
+            silent.append(
+                f"{name}: SILENT STRAGGLER — the verdict suspects "
+                f"replica {verdict.get('replica')!r} but no "
+                f"straggler_suspected event for it exists in the "
+                f"flight-recorder slice")
+
+
+# ---------------------------------------------------------------------
+# The report-level contract.
+# ---------------------------------------------------------------------
+
+#: Solve-leg coverage the demo must ship (mesh kind × workload) plus
+#: the aligned penalty pin.
+_REQUIRED_LEGS = {
+    ("1d", "invert"), ("2d", "invert"), ("1d", "solve"),
+    ("2d", "solve"),
+}
+
+
+def check(report: dict) -> tuple[list[str], list[str]]:
+    """Returns ``(errs, silent)``: structural violations (exit 1) and
+    the exit-2 unaccounted-work / unsupported-verdict class."""
+    errs: list[str] = []
+    silent: list[str] = []
+    if report.get("metric") != "work_demo":
+        return ([f"not a work_demo report "
+                 f"(metric={report.get('metric')!r})"], [])
+    if not report.get("ragged"):
+        errs.append("demo problem size is not ragged (n % m == 0): the "
+                    "padded-tail shares were never exercised")
+
+    legs = report.get("legs") or []
+    seen = set()
+    aligned = None
+    for leg in legs:
+        work = leg.get("work") or {}
+        two_d = isinstance(work.get("workers"), list)
+        seen.add(("2d" if two_d else "1d", work.get("workload")))
+        if work.get("n") == work.get("block_size", 0) * 8 and not two_d:
+            aligned = leg
+        _check_solve_leg(leg.get("name", "?"), work, errs, silent)
+    for want in sorted(_REQUIRED_LEGS):
+        if want not in seen:
+            errs.append(f"missing reconciliation coverage: {want[0]} "
+                        f"{want[1]} leg")
+    if aligned is None:
+        errs.append("missing the aligned leg (n % m == 0, p | Nr): the "
+                    "penalty==0 pin was never exercised")
+    else:
+        pen = (aligned.get("work", {}).get("totals") or {}).get(
+            "ragged_penalty")
+        if pen != 0.0:
+            silent.append(
+                f"{aligned.get('name')}: aligned ragged_penalty {pen} "
+                f"!= 0.0 — phantom padding work on an aligned shape")
+    if bool(report.get("penalty_nonzero_aligned")) != \
+            bool(aligned is not None and (aligned.get("work", {})
+                 .get("totals") or {}).get("ragged_penalty") != 0.0):
+        errs.append("penalty_nonzero_aligned disagrees with the "
+                    "aligned leg's own totals")
+
+    # -- fleet legs ----------------------------------------------------
+    bb = report.get("blackbox") or {}
+    bb_events = bb.get("events") or []
+    fleet_legs = report.get("fleet_legs") or []
+    names = {leg.get("name") for leg in fleet_legs}
+    for want in ("fleet_straggler_suspected",
+                 "fleet_skew_layout_attributed",
+                 "fleet_straggler_recovered"):
+        if want not in names:
+            errs.append(f"missing fleet leg: {want}")
+    for leg in fleet_legs:
+        _check_fleet_leg(leg, bb_events, errs, silent)
+    n_susp = sum(1 for e in bb_events
+                 if e.get("kind") == "straggler_suspected")
+    n_clear = sum(1 for e in bb_events
+                  if e.get("kind") == "straggler_cleared")
+    if report.get("straggler_events") != n_susp:
+        errs.append(f"report straggler_events="
+                    f"{report.get('straggler_events')} != {n_susp} in "
+                    f"the slice")
+    if report.get("cleared_events") != n_clear:
+        errs.append(f"report cleared_events="
+                    f"{report.get('cleared_events')} != {n_clear} in "
+                    f"the slice")
+    if "fleet_straggler_recovered" in names and n_clear < 1:
+        silent.append("the recovery leg ran but no straggler_cleared "
+                      "event exists — the clear transition was never "
+                      "recorded")
+    fleet = report.get("fleet") or {}
+    if fleet.get("veto_after_recovery") is not None:
+        errs.append("veto_after_recovery is still set — a recovered "
+                    "fleet must not keep vetoing the autoscaler")
+
+    if bb.get("dropped", 1) != 0:
+        errs.append(f"flight-recorder slice dropped {bb.get('dropped')} "
+                    f"events — reconstruction has gaps")
+    if bool(report.get("silent_work")) != bool(silent):
+        errs.append(f"report silent_work={report.get('silent_work')} "
+                    f"disagrees with the re-derived verdict "
+                    f"({len(silent)} violations)")
+    return errs, silent
+
+
+def main(argv) -> int:
+    if not argv:
+        print("usage: check_work.py report.json [...]", file=sys.stderr)
+        return 1
+    rc = 0
+    for path in argv:
+        try:
+            if path == "-":
+                report = json.load(sys.stdin)
+            else:
+                with open(path) as f:
+                    report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"FAIL {path}: unreadable report ({e})",
+                  file=sys.stderr)
+            return 1
+        errs, silent = check(report)
+        for msg in errs:
+            print(f"FAIL {path}: {msg}", file=sys.stderr)
+        for msg in silent:
+            print(f"SILENT {path}: {msg}", file=sys.stderr)
+        if silent:
+            rc = max(rc, 2)
+        elif errs:
+            rc = max(rc, 1)
+        else:
+            legs = report.get("legs") or []
+            print(f"OK {path}: {len(legs)} solve legs reconciled "
+                  f"(shares == layout math == convention total, "
+                  f"hwcost pin in band), "
+                  f"{report.get('straggler_events')} straggler "
+                  f"event(s) supported by evidence, "
+                  f"{report.get('cleared_events')} cleared")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

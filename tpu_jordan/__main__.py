@@ -81,6 +81,23 @@ def _write_comm(path) -> None:
         print(f"warning: comm report failed: {e}", file=sys.stderr)
 
 
+def _write_work(path) -> None:
+    """``--work-report`` emission — the process-wide work snapshot
+    (obs/work.py: the last distributed solve's per-worker analytical
+    FLOP shares, skew and ragged-penalty record plus the
+    tpu_jordan_work_* gauges and straggler counter), written on every
+    exit path with the same never-mask-the-exit-code discipline as
+    ``_write_telemetry``."""
+    if not path:
+        return
+    try:
+        from .obs.work import write_report
+
+        write_report(path)
+    except OSError as e:
+        print(f"warning: work report failed: {e}", file=sys.stderr)
+
+
 def _write_blackbox(path) -> None:
     """Dump the always-on flight recorder (ISSUE 8): on demand via
     ``--blackbox-out``, and AUTOMATICALLY on every exit-2 path — the
@@ -379,6 +396,34 @@ def _main(argv, state) -> int:
                          "validates).  n is the problem size, m the "
                          "block size; runs on a forced 8-device "
                          "virtual CPU mesh when needed")
+    ap.add_argument("--work-demo", action="store_true",
+                    help="run the work-observatory acceptance demo "
+                         "(tpu_jordan.obs.work.work_demo; ISSUE 19, "
+                         "docs/OBSERVABILITY.md): six tiny distributed "
+                         "solves — 1D and 2D meshes, invert and solve "
+                         "workloads, a ragged size and an aligned size "
+                         "— each leg's per-worker analytical FLOP "
+                         "shares summing EXACTLY to the engine's "
+                         "convention total and its executable judged "
+                         "against cost_analysis, plus the fleet-skew "
+                         "legs (a synthetic straggler that must become "
+                         "a recorded straggler_suspected event, a "
+                         "layout-attributed spread that must stay "
+                         "clean, and the recovery transition); prints "
+                         "ONE JSON line (exit 2 = unaccounted work or "
+                         "an unsupported straggler verdict; "
+                         "tools/check_work.py re-derives every share "
+                         "from the layout math).  n is the problem "
+                         "size, m the block size; runs on a forced "
+                         "8-device virtual CPU mesh when needed")
+    ap.add_argument("--work-report", default=None, metavar="PATH",
+                    help="write the process-wide work snapshot (the "
+                         "last distributed solve's per-worker "
+                         "analytical FLOP shares, skew and "
+                         "ragged-penalty record plus the "
+                         "tpu_jordan_work_* gauges and the straggler "
+                         "counter) as one JSON document on exit "
+                         "(docs/OBSERVABILITY.md)")
     ap.add_argument("--lp-demo", action="store_true",
                     help="run the LP/QP optimization-driver acceptance "
                          "demo (tpu_jordan.lpqp.lp_demo; ISSUE 17, "
@@ -584,7 +629,7 @@ def _main(argv, state) -> int:
             if (args.serve_demo or args.chaos_demo or args.fleet_demo
                     or args.numerics_demo or args.update_demo
                     or args.capacity_demo or args.comm_demo
-                    or args.lp_demo):
+                    or args.lp_demo or args.work_demo):
                 raise UsageError("--autoscale-demo is a distinct mode; "
                                  "pick one demo")
             if args.file is not None or args.workers != 1 or not args.gather:
@@ -648,8 +693,9 @@ def _main(argv, state) -> int:
             # bit-match its fault-free replay).
             if (args.serve_demo or args.chaos_demo or args.fleet_demo
                     or args.numerics_demo or args.update_demo
-                    or args.capacity_demo or args.comm_demo):
-                raise UsageError("--lp-demo, --comm-demo, "
+                    or args.capacity_demo or args.comm_demo
+                    or args.work_demo):
+                raise UsageError("--lp-demo, --comm-demo, --work-demo, "
                                  "--capacity-demo, --update-demo, "
                                  "--fleet-demo, --chaos-demo, "
                                  "--serve-demo and --numerics-demo "
@@ -730,8 +776,9 @@ def _main(argv, state) -> int:
             # unaccounted-collective / silent-drift alarm.
             if (args.serve_demo or args.chaos_demo or args.fleet_demo
                     or args.numerics_demo or args.update_demo
-                    or args.capacity_demo):
-                raise UsageError("--comm-demo, --capacity-demo, "
+                    or args.capacity_demo or args.work_demo):
+                raise UsageError("--comm-demo, --work-demo, "
+                                 "--capacity-demo, "
                                  "--update-demo, --fleet-demo, "
                                  "--chaos-demo, --serve-demo and "
                                  "--numerics-demo are distinct modes; "
@@ -790,6 +837,75 @@ def _main(argv, state) -> int:
                       f"unreconciled={report['unreconciled']}, "
                       f"mismatches={len(report['mismatches'])}, "
                       f"drift_events={report['drift_events']}",
+                      file=sys.stderr)
+                return 2
+            return 0
+        if args.work_demo:
+            # Work demo (ISSUE 19): the comm-demo restriction shape
+            # (fixed internal legs, deterministic fixtures) and the
+            # same 0/1/2 taxonomy — exit 2 IS the unaccounted-work /
+            # unsupported-straggler-verdict alarm.
+            if (args.serve_demo or args.chaos_demo or args.fleet_demo
+                    or args.numerics_demo or args.update_demo
+                    or args.capacity_demo):
+                raise UsageError("--work-demo, --capacity-demo, "
+                                 "--update-demo, --fleet-demo, "
+                                 "--chaos-demo, --serve-demo and "
+                                 "--numerics-demo are distinct modes; "
+                                 "pick one")
+            if args.file is not None or args.workers != 1 or not args.gather:
+                raise UsageError(
+                    "--work-demo builds its own 1D/2D meshes (forced "
+                    "virtual CPU devices when needed); file input, "
+                    "--workers and --no-gather do not apply")
+            if args.batch > 1 or args.tune or args.group != 0:
+                raise UsageError("--work-demo takes no "
+                                 "--batch/--tune/--group")
+            if args.engine != "auto" or args.refine:
+                raise UsageError("--work-demo runs a fixed engine-leg "
+                                 "set (inplace/swapfree/solve_sharded, "
+                                 "both layouts); --engine/--refine do "
+                                 "not apply")
+            if args.workload != "invert" or args.rhs != 1:
+                raise UsageError("--work-demo accounts both workloads "
+                                 "on its own legs; --workload/--rhs do "
+                                 "not apply")
+            if args.numerics != "off":
+                raise UsageError("--work-demo's reconciliation "
+                                 "semantics are pinned; --numerics "
+                                 "does not apply")
+            if args.slo_report or args.plan_cache is not None:
+                raise UsageError("--slo-report/--plan-cache do not "
+                                 "apply to --work-demo")
+            if (args.serve_requests != 64 or args.batch_cap != 8
+                    or args.max_wait_ms != 2.0):
+                raise UsageError("--work-demo runs driver solves and "
+                                 "synthetic fleet stats, not the "
+                                 "service; --serve-requests/"
+                                 "--batch-cap/--max-wait-ms do not "
+                                 "apply")
+            if (args.replicas != 3 or args.kills != 2
+                    or args.scaling_floor is not None):
+                raise UsageError("--replicas/--kills/--scaling-floor "
+                                 "are --fleet-demo/--update-demo "
+                                 "flags; --work-demo runs one process")
+            import json as _json
+
+            from .obs.work import work_demo
+
+            # --dtype / --generator are honored, not dropped (complex
+            # is a typed refusal inside work_demo — the distributed
+            # engines are real-dtype).
+            report = work_demo(n=args.n, block_size=args.m,
+                               seed=args.chaos_seed,
+                               dtype=jnp.dtype(args.dtype),
+                               generator=args.generator)
+            print(_json.dumps(report))
+            if report["silent_work"]:
+                print(f"silent work accounting violation: "
+                      f"unaccounted={report['unaccounted']}, "
+                      f"xla_unreconciled={report['xla_unreconciled']}, "
+                      f"verdict_wrong={report['verdict_wrong']}",
                       file=sys.stderr)
                 return 2
             return 0
@@ -1276,6 +1392,7 @@ def _main(argv, state) -> int:
         _write_telemetry(args.metrics_out, args.trace_json, telemetry)
         _write_capacity(args.capacity_report)
         _write_comm(args.comm_report)
+        _write_work(args.work_report)
     if args.quiet:
         print(f"glob_time: {result.elapsed:.2f}")
         print(f"residual: {result.residual:e}")

@@ -91,6 +91,12 @@ class SolveResult:
     #   reconciliation when obs.comm.recording() was active around the
     #   solve, and the measured-vs-projected drift record.  None on
     #   single-device solves (no collectives to account).
+    work: object | None = None  # obs.work.WorkReport on every
+    #   DISTRIBUTED solve (ISSUE 19): per-worker useful-FLOP shares
+    #   summing EXACTLY to the 2n³ convention, the max/mean skew and
+    #   ragged-tail penalty, and the cost_analysis reconciliation
+    #   (devices × per-device vs the padded executed model).  None on
+    #   single-device solves (one worker has no skew to account).
 
     @property
     def rel_residual(self) -> float | None:
@@ -1249,6 +1255,7 @@ def _solve_distributed_core(
     (and, for file input, one full host read).
     """
     from .obs import comm as _comm
+    from .obs import work as _work
     from .ops import newton_schulz
 
     if refine and not gather:
@@ -1292,6 +1299,11 @@ def _solve_distributed_core(
     comm_rep = _comm.engine_report(
         engine=eng_name, lay=be.lay, dtype=dtype, gather=gather,
         refine=refine, group=be.group)
+    # The work observatory (ISSUE 19): the same layout math, pointed at
+    # compute — per-worker useful-FLOP shares (integer-exact against
+    # the 2n³ convention), skew gauges, and the ragged-tail penalty.
+    work_rep = _work.engine_report(engine=eng_name, lay=be.lay,
+                                   dtype=dtype, group=be.group)
 
     with tel.span("compile", engine=engine, n=n) as csp:
         def _compile():
@@ -1332,6 +1344,13 @@ def _solve_distributed_core(
     comm_rep.attach_span(esp)
     _comm.observe_drift(comm_rep, elapsed, esp)
     _comm.set_last_report(comm_rep)
+    # Work accounting on the same span: the share/skew gauges, and the
+    # hwcost pin (devices × per-device cost_analysis judged against the
+    # padded executed-work model — SPMD cost is uniform per device).
+    work_rep.attach_xla(exe_cost, span=esp)
+    work_rep.observe_metrics()
+    work_rep.attach_span(esp)
+    _work.set_last_report(work_rep)
     singular_flag = bool(singular.any())
     _solve_metrics(n, elapsed, esp, singular=singular_flag)
     if singular_flag:
@@ -1411,4 +1430,5 @@ def _solve_distributed_core(
         kappa=kappa,
         _norm_a=norm_a,
         comm=comm_rep,
+        work=work_rep,
     )

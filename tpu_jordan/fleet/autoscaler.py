@@ -75,6 +75,12 @@ class FleetAutoscaler:
         target (or any pair pages), and off when neither holds.
       scale_budget_bytes: optional ledger ceiling for the capacity
         veto; None = no veto.
+      skew_judge: optional :class:`~..obs.work.FleetSkewJudge` — when
+        its live verdict suspects a straggler, p99-risk-driven
+        pre-shed is VETOED (one sick replica explains the p99 risk;
+        shedding the whole fleet's front door is the wrong actuator —
+        route/drain that replica instead).  Paging-driven pre-shed is
+        never vetoed: burn is fleet-wide evidence.  None = no veto.
       clock: injectable monotonic clock (defaults to the pool's —
         fake-clock tests drive both from one source).
     """
@@ -83,7 +89,8 @@ class FleetAutoscaler:
                  idle_after_s: float = 30.0,
                  scale_cooldown_s: float = 5.0,
                  preshed_p99_frac: float = 0.8,
-                 scale_budget_bytes: int | None = None, clock=None):
+                 scale_budget_bytes: int | None = None,
+                 skew_judge=None, clock=None):
         if floor < 1:
             raise ValueError("floor must be >= 1")
         if ceiling < floor:
@@ -97,6 +104,8 @@ class FleetAutoscaler:
         self.preshed_p99_frac = float(preshed_p99_frac)
         self.scale_budget_bytes = (None if scale_budget_bytes is None
                                    else int(scale_budget_bytes))
+        self.skew_judge = skew_judge
+        self._last_vetoed = False
         self.clock = (clock if clock is not None
                       else getattr(pool, "clock", time.monotonic))
         self._last_action_t: float | None = None
@@ -212,14 +221,32 @@ class FleetAutoscaler:
 
         # Pre-shed reconciliation (flag, not a step — no cooldown:
         # shedding must engage the tick the risk appears and release
-        # the tick it clears).
+        # the tick it clears).  The skew-judge veto (ISSUE 19) applies
+        # ONLY to p99-risk-driven shedding: when the judge's live
+        # verdict attributes the p99 spread to one suspected straggler
+        # replica, shedding the whole fleet is the wrong actuator —
+        # the evidence rides in the tick (and, transition-only, the
+        # action trail) so a withheld shed is as reconstructible as a
+        # taken one.  Paging (fleet-wide burn) is never vetoed.
         want_shed = bool(paging or p99_risk)
+        skew_veto = None
+        if p99_risk and not paging and self.skew_judge is not None:
+            v = self.skew_judge.veto()
+            if v is not None:
+                skew_veto = {"replica": v.get("replica"),
+                             "spread": v.get("spread"),
+                             "threshold": v.get("threshold")}
+                want_shed = False
+        if skew_veto is not None and not self._last_vetoed:
+            self._record("pre_shed_vetoed", ready, {
+                "p99_risk": p99_risk, "skew_veto": skew_veto})
+        self._last_vetoed = skew_veto is not None
         if want_shed != self.pool.router.pre_shed:
             self.pool.router.pre_shed = want_shed
             self._record("pre_shed_on" if want_shed else "pre_shed_off",
                          ready, {"paging": paging, "p99_risk": p99_risk})
 
-        return {
+        tick = {
             "t": round(now, 6),
             "ready": self.pool.ready_count(),
             "paging": [p["name"] for p in paging],
@@ -229,6 +256,9 @@ class FleetAutoscaler:
             "action": None if action is None else action["action"],
             "healthy": report["healthy"],
         }
+        if skew_veto is not None:
+            tick["skew_veto"] = skew_veto
+        return tick
 
     # ---- optional background loop -----------------------------------
 
@@ -396,10 +426,14 @@ def autoscale_demo(n: int = 64, requests: int = 48, floor: int = 1,
         by_action[a["action"]] = by_action.get(a["action"], 0) + 1
     # A tick that saw risk (paging or p99) and left pre-shed OFF with
     # no capacity action is the silent-breach class — the breach the
-    # checker pages on.
+    # checker pages on.  A skew-vetoed tick is the one sanctioned
+    # exception (ISSUE 19): the judge attributed the p99 risk to a
+    # suspected straggler replica, and the veto evidence rides in the
+    # tick itself.
     silent_p99_breach = any(
         (t["paging"] or t["p99_risk"]) and not t["pre_shed"]
         and t["action"] not in ("scale_up", "scale_withheld")
+        and not t.get("skew_veto")
         for t in ticks)
     return {
         "metric": "autoscale_demo",

@@ -44,6 +44,7 @@ from ..resilience.policy import DEFAULT_POLICY, CircuitBreaker
 from ..serve.executors import ExecutorStore
 from ..serve.handles import HandleStore
 from ..serve.service import JordanService
+from ..serve.stats import cross_replica_spread as _cross_replica_spread
 from ..tuning.plan_cache import PlanCache
 from .replica import READY, Replica
 from .router import Router
@@ -641,5 +642,12 @@ class JordanFleet:
             # it) — with high-water marks and the created == live +
             # evicted reconciliation per metered class.
             "capacity": _obs_capacity.snapshot(),
+            # Cross-replica execute-latency spread (ISSUE 19): the
+            # measured-skew rollup over the READY replicas' own
+            # ServeStats — the FleetSkewJudge's evidence input
+            # (docs/OBSERVABILITY.md "was it the layout or the
+            # replica?").
+            "exec_spread": _cross_replica_spread(
+                [e["service"] for e in per_slot if e.get("service")]),
             "slots": per_slot,
         }

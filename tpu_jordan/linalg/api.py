@@ -81,6 +81,11 @@ class SolveSystemResult:
     #   solve engines): per-phase collective bytes/messages, the
     #   observed == analytical reconciliation under
     #   obs.comm.recording(), and the drift record.  None single-device.
+    work: object | None = None    # obs.work.WorkReport on every
+    #   DISTRIBUTED solve (ISSUE 19): per-worker useful-FLOP shares of
+    #   the shrinking [A|B] window summing EXACTLY to n³+n²k, skew and
+    #   ragged-tail penalty, and the cost_analysis reconciliation.
+    #   None single-device.
     _norm_a: float | None = None
     _norm_x: float | None = None
     _norm_b: float | None = None
@@ -556,6 +561,7 @@ def _solve_system_dist_impl(a, b2, n, k, m, dtype, workers, gather,
     from ..driver import (SingularMatrixError, _attach_overlap_evidence,
                           _record_compile)
     from ..obs import comm as _comm
+    from ..obs import work as _obswork
     from ..parallel.sharded_inplace import MAX_UNROLL_NR
 
     in_dtype = jnp.dtype(dtype)
@@ -575,6 +581,10 @@ def _solve_system_dist_impl(a, b2, n, k, m, dtype, workers, gather,
     comm_rep = _comm.engine_report(
         engine=engine, lay=lay, dtype=work, gather=gather,
         unroll=unroll, rhs=k)
+    # The work observatory (ISSUE 19): per-worker shares of the
+    # shrinking [A|B] live window, integer-exact against n³+n²k.
+    work_rep = _obswork.engine_report(engine=engine, lay=lay,
+                                      dtype=work, k=k, unroll=unroll)
 
     with tel.span("compile", engine=engine, n=n, k=k) as csp:
         def _compile():
@@ -610,6 +620,10 @@ def _solve_system_dist_impl(a, b2, n, k, m, dtype, workers, gather,
     comm_rep.attach_span(esp)
     _comm.observe_drift(comm_rep, elapsed, esp)
     _comm.set_last_report(comm_rep)
+    work_rep.attach_xla(exe_cost, span=esp)
+    work_rep.observe_metrics()
+    work_rep.attach_span(esp)
+    _obswork.set_last_report(work_rep)
     _obs_metrics.histogram(
         "tpu_jordan_solve_seconds",
         "timed elimination wall seconds (the glob_time analog)",
@@ -626,7 +640,7 @@ def _solve_system_dist_impl(a, b2, n, k, m, dtype, workers, gather,
             x=None, elapsed=elapsed, residual=float("inf"), n=n, k=k,
             block_size=m, gflops=0.0, engine=engine,
             workload=workload, singular=True, plan=plan,
-            workers=workers, comm=comm_rep)
+            workers=workers, comm=comm_rep, work=work_rep)
 
     with tel.span("gather", gathered=gather):
         # X is O(n·k): assembled in EITHER mode (the verification needs
@@ -682,6 +696,7 @@ def _solve_system_dist_impl(a, b2, n, k, m, dtype, workers, gather,
         numerics=nreport, workers=workers,
         x_blocks=None if gather else xb,
         layout=None if gather else lay, comm=comm_rep,
+        work=work_rep,
         _norm_a=norm_a, _norm_x=norm_x, _norm_b=norm_b)
 
 

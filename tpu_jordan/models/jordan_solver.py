@@ -89,6 +89,9 @@ class JordanSolver:
     cost: Any = field(default=None, repr=False)  # hwcost.ExecutableCost
     comm: Any = field(default=None, repr=False)  # obs.comm.CommReport
     #   (distributed solvers only, built at compile; ISSUE 14)
+    work: Any = field(default=None, repr=False)  # obs.work.WorkReport
+    #   (distributed solvers only, built at compile; ISSUE 19):
+    #   per-worker useful-FLOP shares, skew, ragged-tail penalty
     _run: Any = field(default=None, repr=False)
     _be: Any = field(default=None, repr=False)
 
@@ -177,6 +180,14 @@ class JordanSolver:
                 engine=self.engine, lay=self._be.lay,
                 dtype=self._work_dtype, gather=self.gather,
                 refine=self.refine, group=self.group)
+            # The work observatory (ISSUE 19): the per-worker share
+            # inventory for the cached executable — built once at
+            # compile (host math); launches only stamp span attrs.
+            from ..obs import work as _obswork
+
+            self.work = _obswork.engine_report(
+                engine=self.engine, lay=self._be.lay,
+                dtype=self._work_dtype, group=self.group)
 
         with self._tel.span("compile", engine=self.engine, n=self.n) as csp:
             def compile_once():
@@ -211,6 +222,11 @@ class JordanSolver:
         from ..obs import hwcost as _hwcost
 
         self.cost = _hwcost.executable_cost(self._run)
+        if self.work is not None:
+            # The hwcost pin (ISSUE 19): devices × per-device
+            # cost_analysis judged against the padded executed model,
+            # once per compile.
+            self.work.attach_xla(self.cost)
 
     def _execute(self, arg):
         """One executable launch: with telemetry, an honest blocking
@@ -244,6 +260,11 @@ class JordanSolver:
                 self.comm.observe_metrics(sections=("engine", "gather"))
                 self.comm.attach_span(esp)
                 _comm.observe_drift(self.comm, esp.duration, esp)
+            if self.work is not None:
+                # Per-launch work attrs + gauges (ISSUE 19) — host
+                # math only, the zero-compile warm pins stay intact.
+                self.work.observe_metrics()
+                self.work.attach_span(esp)
             return out
 
         return (self.policy.retry.call(run_once, component="solver.execute")

@@ -1,0 +1,868 @@
+"""The work observatory (ISSUE 19 tentpole).
+
+The paper's whole reason for the 1D row-block-cyclic decomposition
+(``local_to_global``, main.cpp:118-123; the ragged last block,
+main.cpp:95-116) is LOAD BALANCE as the elimination's live window
+shrinks — yet until this module the observability stack (spans,
+journeys, comm, capacity, numerics, hwcost) never measured whether that
+balance is actually achieved.  The comm observatory (obs/comm.py,
+ISSUE 14) answered "which bytes moved"; this module answers "which
+worker did the work, and was the straggler the layout or the replica".
+Two layers:
+
+1. **Analytical per-worker work inventories** — for every distributed
+   engine configuration, the per-(worker, superstep, phase) useful-FLOP
+   inventory is derived EXACTLY from the layout math
+   (``parallel/layout.py`` ownership × live-column window × workload),
+   in INTEGER arithmetic, so the per-worker shares sum EXACTLY to the
+   engine's headline convention total (``obs/hwcost.py``):
+
+     * invert — the in-place engines hold a constant-width window (the
+       eliminated A columns become inverse columns in place), so
+       ``w(t, r, j) = 2·h_t·h_r·h_j`` over useful block heights ``h``
+       (``Σh = n`` per axis) sums to ``2·n³``
+       (``baseline_invert_flops``), the factor 2 being the [A|I] pair.
+     * solve — the [A|B] elimination's live window SHRINKS: per
+       column block the weight is ``2·[j>t] + [j==t]`` (live columns
+       are touched by the row scale and the rank-m update; the pivot
+       column once), and ``Σ_t h_t·(W_{t-1}+W_t) = n²`` makes
+       ``w(t, r) = h_t·h_r·(W_{t-1}+W_t+k)`` sum to ``n³ + n²·k``
+       (``baseline_workload_flops(n, "solve", k)``).
+
+   The ragged last block (height ``l = last_block_height(n, m)``) and
+   the identity padding to ``Nr`` blocks ride through the heights:
+   pad blocks carry ZERO useful work, which is exactly the layout's
+   tail imbalance.  Exposed as :class:`WorkReport` on every
+   distributed ``SolveResult`` / ``SolveSystemResult`` /
+   ``JordanSolver``, with ``tpu_jordan_work_share`` / ``work_skew``
+   gauges and execute-span attrs, and pinned against hwcost's
+   cost_analysis per-device FLOPs on the real sharded executables
+   (:meth:`WorkReport.attach_xla` — SPMD programs report uniform
+   per-device cost, so ``devices × per-device`` is judged against the
+   PADDED executed-work model, not the useful convention).
+
+2. **Measured fleet skew** — per-replica execute-latency spread
+   (``serve/stats.cross_replica_spread`` over the per-replica
+   ServeStats rollup) judged by :class:`FleetSkewJudge`: measured p99s
+   are NORMALIZED by each replica's analytical expected-latency factor
+   (its layout critical path — :func:`expected_latency_factor`) before
+   the spread is compared to the threshold, so layout-inherent
+   imbalance is never misread as a sick replica.  A suspected
+   straggler is a transition-only ``straggler_suspected``
+   flight-recorder event with the evidence attached, and the judge's
+   live verdict is a pre-shed VETO input for the autoscaler
+   (``fleet/autoscaler.py`` — a single sick replica must not shed the
+   whole fleet's p99-risk traffic), never a new actuator.
+
+Operator guide: docs/OBSERVABILITY.md (work/skew taxonomy + the
+"was it the layout or the replica?" post-mortem).  Gate:
+``make work-demo`` → ``tools/check_work.py`` (exit 2 = unaccounted
+work or a straggler verdict the evidence can't support).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+from . import metrics as _metrics
+from . import recorder as _recorder
+
+#: Phase vocabulary (docs/OBSERVABILITY.md): ``pivot`` = the work on
+#: the pivot block row itself (r == t: the H application scaling the
+#: pivot row); ``eliminate`` = every other owned row's rank-m update.
+PHASES = ("pivot", "eliminate")
+
+_M_SHARE = _metrics.gauge(
+    "tpu_jordan_work_share",
+    "analytical useful-FLOP share of the last distributed solve, per "
+    "worker (layout-derived; docs/OBSERVABILITY.md)")
+_M_SKEW = _metrics.gauge(
+    "tpu_jordan_work_skew",
+    "max-over-mean per-worker imbalance factor of the last distributed "
+    "solve per engine (1.0 = perfectly balanced)")
+_M_STRAGGLER = _metrics.counter(
+    "tpu_jordan_straggler_suspected_total",
+    "fleet replicas whose normalized execute-latency spread exceeded "
+    "the straggler threshold (transition-only, evidence in the flight "
+    "recorder)")
+
+
+def _sig(v: float) -> float:
+    return float(f"{float(v):.4g}")
+
+
+# ---------------------------------------------------------------------
+# Layout math: useful block heights and convention totals.
+# ---------------------------------------------------------------------
+
+
+def useful_heights(n: int, m: int) -> list[int]:
+    """Heights of the USEFUL block rows (and, by symmetry, block
+    columns): ``m`` for every full block, ``last_block_height(n, m)``
+    for the ragged tail, nothing for pad blocks.  ``Σ = n`` exactly —
+    the invariant every inventory below rests on."""
+    from ..parallel.layout import last_block_height, num_block_rows
+
+    Tu = num_block_rows(n, m)
+    return [m] * (Tu - 1) + [last_block_height(n, m)]
+
+
+def convention_flops(n: int, workload: str, k: int = 0) -> int:
+    """The engine's headline useful-FLOP convention (obs/hwcost.py):
+    invert ``2·n³`` (baseline_invert_flops), solve ``n³ + n²·k``
+    (baseline_workload_flops) — as an exact integer."""
+    if workload == "invert":
+        return 2 * n ** 3
+    if workload == "solve":
+        return n ** 3 + n ** 2 * int(k)
+    raise ValueError(f"no work convention for workload {workload!r}")
+
+
+def _cyclic_sums(h: list[int], p: int) -> list[int]:
+    """``Σ h_r`` over the blocks each of ``p`` cyclic workers owns."""
+    out = [0] * p
+    for r, hr in enumerate(h):
+        out[r % p] += hr
+    return out
+
+
+# ---------------------------------------------------------------------
+# The analytical inventories (integer-exact by construction).
+# ---------------------------------------------------------------------
+
+
+def _inventory_1d(lay, workload: str, k: int):
+    """Per-(worker, superstep, phase) useful FLOPs on the 1D row-cyclic
+    layout: block row r → worker r % p.  Columns are unsharded, so the
+    column factor collapses (invert: the constant n-wide window; solve:
+    the shrinking ``W_{t-1}+W_t+k`` live width)."""
+    n, m, p = lay.n, lay.m, lay.p
+    h = useful_heights(n, m)
+    R = _cyclic_sums(h, p)
+    per_worker = {str(w): {"pivot": 0, "eliminate": 0} for w in range(p)}
+    per_superstep = []
+    C = 0
+    for t, ht in enumerate(h):
+        if workload == "invert":
+            f = 2 * ht * n
+        else:
+            w_prev = n - C
+            C += ht
+            f = ht * (w_prev + (n - C) + k)
+        owner = t % p
+        tot_t = 0
+        for w in range(p):
+            piv = f * ht if w == owner else 0
+            elim = f * (R[w] - (ht if w == owner else 0))
+            per_worker[str(w)]["pivot"] += piv
+            per_worker[str(w)]["eliminate"] += elim
+            tot_t += piv + elim
+        per_superstep.append(tot_t)
+    return per_worker, per_superstep
+
+
+def _inventory_2d(lay, workload: str, k: int):
+    """Per-(worker, superstep, phase) useful FLOPs on the 2D
+    block-cyclic layout: block (r, j) → worker (r % pr, j % pc).  The
+    invert window is constant width (in-place), so a column class's
+    share is just its owned heights; the solve window shrinks per the
+    ``2·[j>t] + [j==t]`` weight, and the k RHS columns (replicated
+    along pc in the engine) are SPLIT cyclically over the column
+    workers so the useful total stays exact."""
+    n, m, pr, pc = lay.n, lay.m, lay.pr, lay.pc
+    h = useful_heights(n, m)
+    Rr = _cyclic_sums(h, pr)
+    S = _cyclic_sums(h, pc)
+    kc = [len(range(c, int(k), pc)) for c in range(pc)]
+    per_worker = {f"{wr},{wc}": {"pivot": 0, "eliminate": 0}
+                  for wr in range(pr) for wc in range(pc)}
+    per_superstep = []
+    P = [0] * pc        # Σ h_j over j <= t per column class
+    for t, ht in enumerate(h):
+        tc = t % pc
+        P[tc] += ht
+        tot_t = 0
+        for wc in range(pc):
+            if workload == "invert":
+                colw = S[wc]
+            else:
+                colw = 2 * (S[wc] - P[wc]) + (ht if wc == tc else 0)
+                colw += kc[wc]
+            f = 2 * ht * colw if workload == "invert" else ht * colw
+            owner = t % pr
+            for wr in range(pr):
+                piv = f * ht if wr == owner else 0
+                elim = f * (Rr[wr] - (ht if wr == owner else 0))
+                cell = per_worker[f"{wr},{wc}"]
+                cell["pivot"] += piv
+                cell["eliminate"] += elim
+                tot_t += piv + elim
+        per_superstep.append(tot_t)
+    return per_worker, per_superstep
+
+
+# ---------------------------------------------------------------------
+# The padded executed-work model (the hwcost reconciliation unit).
+# ---------------------------------------------------------------------
+
+
+def executed_model_flops(engine: str, workload: str, *, N: int, m: int,
+                         k: int = 0, unroll: bool = False,
+                         pc: int = 1) -> float:
+    """Modeled FLOPs the sharded executables actually LAUNCH, summed
+    over the mesh — padded dimensions, full-width supersteps: the unit
+    ``devices × cost_analysis-per-device`` is judged against (SPMD
+    programs report uniform per-device cost, hwcost honesty contract).
+
+    * invert: every engine updates the constant padded window each of
+      the ``Nr`` supersteps — ``2·N³`` (``2·N²·2N = 4·N³`` for the
+      augmented engine's explicit [A|I] strip).
+    * solve: the fori flavors keep the full ``N + k`` width
+      (``2·N²·(N + k·pc)`` — X is replicated along pc, so the 2D mesh
+      really repeats the RHS update pc times); the unrolled flavors
+      shrink the live width statically per superstep.
+    """
+    Nr = N // m
+    if workload == "invert":
+        width = 2 * N if engine == "augmented" else N
+        return 2.0 * N * N * width
+    if not unroll:
+        return 2.0 * N * N * (N + k * pc)
+    total = 0.0
+    for t in range(Nr):
+        if pc > 1:
+            bc1 = Nr // pc
+            live = pc * (bc1 - t // pc) * m
+        else:
+            live = N - t * m
+        total += 2.0 * m * N * (live + k * pc)
+    return total
+
+
+#: Engines with a registered work inventory — the same discipline as
+#: obs/comm.INVENTORY_ENGINES: :func:`engine_report` refuses unknown
+#: names, so a new distributed engine without work accounting fails
+#: loudly at its first report.
+INVENTORY_ENGINES = frozenset(
+    {"inplace", "grouped", "swapfree", "augmented", "solve_sharded",
+     "lookahead", "solve_lookahead"})
+
+#: Acceptance band for devices × cost_analysis-per-device against the
+#: TRACED model (cost_analysis is a STATIC HLO count: a fori_loop body
+#: is counted once, never × its trip count, so fori flavors judge
+#: against executed/Nr).  XLA additionally counts the per-superstep
+#: pivot inversions, scaling, masking, and candidate passes the
+#: leading GEMM-order model deliberately omits — measured 1.5-2.8× the
+#: model across the engine zoo on CPU XLA; within the band is a
+#: reconciled executable, outside is unaccounted work.
+XLA_BAND = (0.5, 4.0)
+
+
+def engine_report(*, engine: str, lay, dtype=None, k: int = 0,
+                  group: int = 0, unroll: bool | None = None
+                  ) -> "WorkReport":
+    """Build the analytical :class:`WorkReport` for one distributed
+    engine configuration.  ``lay`` is the solve's ``CyclicLayout`` /
+    ``CyclicLayout2D``; ``k`` the solve workload's RHS column count;
+    ``unroll=None`` resolves exactly like the compile front ends (it
+    only affects the padded executed model — the useful inventory is
+    schedule-independent).  An engine name outside
+    :data:`INVENTORY_ENGINES` is a hard ``ValueError``: work
+    accounting is part of shipping an engine."""
+    from ..parallel.layout import last_block_height, num_block_rows
+    from ..parallel.sharded_inplace import MAX_UNROLL_NR
+
+    if engine not in INVENTORY_ENGINES:
+        raise ValueError(
+            f"no work inventory registered for engine {engine!r} "
+            f"(obs/work.INVENTORY_ENGINES); a distributed engine ships "
+            f"WITH its analytical work accounting — add its inventory "
+            f"before wiring it anywhere")
+    if engine in ("swapfree", "augmented"):
+        unroll = False
+    elif unroll is None:
+        unroll = lay.Nr <= MAX_UNROLL_NR
+    workload = ("solve" if engine in ("solve_sharded", "solve_lookahead")
+                else "invert")
+    dt = None
+    if dtype is not None:
+        import numpy as np
+
+        dt = str(np.dtype(dtype))
+    two_d = hasattr(lay, "pc")
+    if two_d:
+        per_worker, per_superstep = _inventory_2d(lay, workload, int(k))
+        mesh = f"{lay.pr}x{lay.pc}"
+        workers: object = (lay.pr, lay.pc)
+        n_devices = lay.pr * lay.pc
+        pc = lay.pc
+    else:
+        per_worker, per_superstep = _inventory_1d(lay, workload, int(k))
+        mesh = f"1D p={lay.p}"
+        workers = lay.p
+        n_devices = lay.p
+        pc = 1
+    n, m = lay.n, lay.m
+    executed = executed_model_flops(engine, workload, N=lay.N, m=m,
+                                    k=int(k), unroll=bool(unroll), pc=pc)
+    ideal = executed_model_flops(engine, workload, N=n, m=m, k=int(k),
+                                 unroll=bool(unroll), pc=pc)
+    return WorkReport(
+        engine=engine, mesh=mesh, workers=workers, n=n, block_size=m,
+        workload=workload, rhs=int(k), dtype=dt, group=int(group),
+        unroll=bool(unroll), n_devices=n_devices,
+        supersteps=num_block_rows(n, m), padded_supersteps=lay.Nr,
+        padded_n=lay.N, last_height=last_block_height(n, m),
+        per_worker=per_worker, per_superstep=per_superstep,
+        convention=convention_flops(n, workload, int(k)),
+        executed_model=float(executed),
+        ragged_penalty=(float(executed) / float(ideal) - 1.0
+                        if ideal else 0.0))
+
+
+# ---------------------------------------------------------------------
+# The report: shares, skew, hwcost pin, metrics, span attrs.
+# ---------------------------------------------------------------------
+
+
+@dataclass
+class WorkReport:
+    """One distributed solve's work record (``SolveResult.work``)."""
+
+    engine: str
+    mesh: str
+    workers: object
+    n: int
+    block_size: int
+    workload: str           # invert | solve
+    rhs: int = 0            # solve-workload RHS columns (0 = invert)
+    dtype: str | None = None
+    group: int = 0
+    unroll: bool = False
+    n_devices: int = 1
+    supersteps: int = 0     # useful block rows (num_block_rows)
+    padded_supersteps: int = 0
+    padded_n: int = 0
+    last_height: int = 0    # the ragged tail's reduced height
+    #: {worker: {"pivot": int, "eliminate": int}} — integer-exact.
+    per_worker: dict = field(default_factory=dict)
+    #: useful FLOPs per superstep (summed over the mesh) — length
+    #: ``supersteps``; pad supersteps carry zero and are omitted.
+    per_superstep: list = field(default_factory=list)
+    convention: int = 0     # the headline useful total
+    executed_model: float = 0.0   # padded launched-work model
+    ragged_penalty: float = 0.0   # executed(padded)/executed(exact) − 1
+    #: devices × cost_analysis-per-device vs the executed model
+    #: (:meth:`attach_xla`); None until a real executable was costed.
+    xla: dict | None = None
+
+    # ---- shares ------------------------------------------------------
+
+    def worker_flops(self) -> dict:
+        return {w: d["pivot"] + d["eliminate"]
+                for w, d in self.per_worker.items()}
+
+    def accounted_flops(self) -> int:
+        return sum(self.worker_flops().values())
+
+    @property
+    def exact(self) -> bool:
+        """The reconciliation invariant: per-worker shares sum EXACTLY
+        to the convention total (integer arithmetic, no tolerance)."""
+        return self.accounted_flops() == self.convention
+
+    def shares(self) -> dict:
+        tot = float(self.convention) or 1.0
+        return {w: f / tot for w, f in self.worker_flops().items()}
+
+    def max_worker_flops(self) -> int:
+        """The layout's critical path: the most loaded worker's useful
+        FLOPs (what a perfectly overlapped superstep schedule waits
+        on — the fleet judge's expected-latency unit)."""
+        return max(self.worker_flops().values(), default=0)
+
+    def skew(self) -> float:
+        """Max-over-mean per-worker imbalance factor (1.0 = balanced;
+        the ragged tail and pad blocks push it above 1)."""
+        f = list(self.worker_flops().values())
+        mean = sum(f) / len(f) if f else 0.0
+        return (max(f) / mean) if mean else 1.0
+
+    # ---- the hwcost pin ---------------------------------------------
+
+    def attach_xla(self, cost, span=None) -> dict:
+        """Judge ``devices × cost_analysis-per-device`` FLOPs against
+        the TRACED work model (:data:`XLA_BAND`).  SPMD executables
+        report UNIFORM per-device cost, so ``devices × per-device`` is
+        the whole-program static count; cost_analysis counts a
+        fori_loop body ONCE (never × trip count), so the fori flavors
+        judge against ``executed / Nr``.  The useful convention lives
+        in the shares.  An unavailable or flop-less cost_analysis
+        stays honest: ``available: False``, never a modeled stand-in
+        (obs/hwcost.py's contract)."""
+        if cost is None or not getattr(cost, "available", False) \
+                or cost.flops is None:
+            self.xla = {"available": False}
+            return self.xla
+        per_dev = float(cost.flops)
+        total = per_dev * self.n_devices
+        model = float(self.executed_model)
+        if not self.unroll and self.padded_supersteps:
+            # One traced loop body: a plain fori traces one superstep,
+            # the grouped fori traces one full-size group of them.
+            traced = (min(self.group, self.padded_supersteps)
+                      if self.group > 1 else 1)
+            model = model * traced / self.padded_supersteps
+        ratio = (total / model) if model > 0 else None
+        within = (ratio is not None
+                  and XLA_BAND[0] <= ratio <= XLA_BAND[1])
+        self.xla = {
+            "available": True,
+            "per_device_flops": per_dev,
+            "devices": self.n_devices,
+            "total_flops": total,
+            "model_traced_flops": model,
+            "model_executed_flops": float(self.executed_model),
+            "xla_vs_model": None if ratio is None else _sig(ratio),
+            "band": [XLA_BAND[0], XLA_BAND[1]],
+            "within": within,
+        }
+        if span is not None and ratio is not None:
+            span.attrs["work_xla_vs_model"] = _sig(ratio)
+        return self.xla
+
+    # ---- export ------------------------------------------------------
+
+    def observe_metrics(self) -> None:
+        """Set the per-solve work gauges (analytical — exact layout
+        math, host-side only: the warm-path zero-compile pins run with
+        this on)."""
+        for w, s in self.shares().items():
+            _M_SHARE.set(s, engine=self.engine, worker=w)
+        _M_SKEW.set(self.skew(), engine=self.engine)
+
+    def attach_span(self, span) -> None:
+        """Work attrs on a distributed ``execute`` span: the imbalance
+        factor, the most loaded worker's share, and the ragged-tail
+        penalty the padding costs this shape."""
+        span.attrs["work_skew"] = _sig(self.skew())
+        span.attrs["work_max_share"] = _sig(
+            max(self.shares().values(), default=0.0))
+        span.attrs["work_ragged_penalty"] = _sig(self.ragged_penalty)
+
+    def to_json(self) -> dict:
+        shares = self.shares()
+        return {
+            "engine": self.engine, "mesh": self.mesh,
+            "workers": (list(self.workers)
+                        if isinstance(self.workers, tuple)
+                        else self.workers),
+            "n": self.n, "block_size": self.block_size,
+            "workload": self.workload, "rhs": self.rhs,
+            "dtype": self.dtype, "group": self.group,
+            "unroll": self.unroll, "n_devices": self.n_devices,
+            "supersteps": self.supersteps,
+            "padded_supersteps": self.padded_supersteps,
+            "padded_n": self.padded_n, "last_height": self.last_height,
+            "per_worker": {
+                w: {"pivot": d["pivot"], "eliminate": d["eliminate"],
+                    "flops": d["pivot"] + d["eliminate"],
+                    "share": _sig(shares[w])}
+                for w, d in self.per_worker.items()},
+            "per_superstep": list(self.per_superstep),
+            "totals": {
+                "convention_flops": self.convention,
+                "accounted_flops": self.accounted_flops(),
+                "exact": self.exact,
+                "executed_model_flops": self.executed_model,
+                "skew": _sig(self.skew()),
+                "ragged_penalty": _sig(self.ragged_penalty),
+            },
+            "xla": self.xla,
+        }
+
+
+#: The last distributed solve's report (the ``--work-report`` CLI
+#: snapshot source; process-level, like comm.LAST_REPORT).
+_LAST_LOCK = threading.Lock()
+LAST_REPORT: WorkReport | None = None
+
+
+def set_last_report(report: WorkReport) -> None:
+    """Record the most recent distributed solve's report (the
+    ``--work-report`` snapshot source; called by the driver)."""
+    global LAST_REPORT
+    with _LAST_LOCK:
+        LAST_REPORT = report
+
+
+def snapshot() -> dict:
+    """The process-wide work snapshot (``--work-report``): the last
+    distributed solve's full report plus the work metric families."""
+    reg = _metrics.REGISTRY.snapshot()
+    with _LAST_LOCK:
+        last = LAST_REPORT
+    return {
+        "metric": "work_report",
+        "last_solve": None if last is None else last.to_json(),
+        "gauges": {name: reg[name] for name in (
+            "tpu_jordan_work_share",
+            "tpu_jordan_work_skew") if name in reg},
+        "counters": {name: reg[name] for name in (
+            "tpu_jordan_straggler_suspected_total",) if name in reg},
+    }
+
+
+def write_report(path: str) -> None:
+    import json
+
+    with open(path, "w") as f:
+        json.dump(snapshot(), f)
+
+
+# ---------------------------------------------------------------------
+# Layer two: measured fleet skew, reconciled against the layout.
+# ---------------------------------------------------------------------
+
+#: A replica whose NORMALIZED p99 exceeds the fleet's best by this
+#: factor is a suspected straggler.  Normalization divides by the
+#: replica's analytical expected-latency factor first, so a replica
+#: that is slower because its layout GIVES it more work never trips
+#: the threshold (the "layout or replica?" disambiguation).
+STRAGGLER_SPREAD = 2.0
+
+
+def expected_latency_factor(report: WorkReport) -> float:
+    """A replica's analytical expected-latency unit: its layout's
+    critical path (the most loaded worker's useful FLOPs).  Relative
+    across replicas — a homogeneous fleet normalizes to 1, a replica
+    on a smaller mesh honestly expects a proportionally larger
+    critical path."""
+    return float(report.max_worker_flops())
+
+
+class FleetSkewJudge:
+    """The measured-vs-analytical skew reconciler.  ``assess`` takes
+    per-replica execute p99s (milliseconds, from the ServeStats
+    cross-replica rollup) and optional per-replica analytical
+    expected-latency factors; it returns a verdict dict and records a
+    TRANSITION-ONLY ``straggler_suspected`` / ``straggler_cleared``
+    flight-recorder event pair — a wedged replica must not spam the
+    ring every tick.  The live verdict doubles as the autoscaler's
+    pre-shed veto input (:meth:`veto`)."""
+
+    def __init__(self, threshold: float = STRAGGLER_SPREAD):
+        self.threshold = float(threshold)
+        self._lock = threading.Lock()
+        self._last: dict | None = None
+        self._suspected = False
+
+    def assess(self, p99_ms: dict, expected: dict | None = None) -> dict:
+        """Judge one observation of the fleet.  ``p99_ms`` maps replica
+        → measured execute p99 (ms); ``expected`` maps replica → its
+        analytical expected-latency factor (omitted or equal values =
+        homogeneous fleet, raw spread).  Fewer than two replicas with
+        data is an honest ``judged: False`` — a one-replica fleet has
+        no spread to measure."""
+        norm = {}
+        for rep, v in p99_ms.items():
+            if v is None or v <= 0:
+                continue
+            e = float(expected.get(rep, 1.0)) if expected else 1.0
+            if e <= 0:
+                e = 1.0
+            norm[str(rep)] = float(v) / e
+        verdict: dict = {
+            "threshold": self.threshold,
+            "p99_ms": {str(r): (None if v is None else float(v))
+                       for r, v in p99_ms.items()},
+            "expected": ({str(r): float(v) for r, v in expected.items()}
+                         if expected else None),
+            "normalized": {r: _sig(v) for r, v in norm.items()},
+        }
+        if len(norm) < 2:
+            verdict.update({"judged": False, "suspected": False,
+                            "spread": None, "replica": None})
+        else:
+            worst = max(norm, key=lambda r: norm[r])
+            best = min(norm.values())
+            spread = norm[worst] / best
+            verdict.update({
+                "judged": True,
+                "spread": _sig(spread),
+                "replica": worst,
+                "suspected": spread > self.threshold,
+            })
+        with self._lock:
+            was = self._suspected
+            now = bool(verdict["suspected"])
+            self._suspected = now
+            self._last = verdict
+        if now and not was:
+            _M_STRAGGLER.inc(replica=verdict["replica"])
+            _recorder.record(
+                "straggler_suspected", replica=verdict["replica"],
+                spread=verdict["spread"], threshold=self.threshold,
+                p99_ms=verdict["p99_ms"],
+                normalized=verdict["normalized"])
+        elif was and not now:
+            _recorder.record(
+                "straggler_cleared", spread=verdict["spread"],
+                threshold=self.threshold)
+        return verdict
+
+    def veto(self) -> dict | None:
+        """The pre-shed veto input: the last verdict IF it currently
+        suspects a straggler (one sick replica explains the p99 risk —
+        shedding the whole fleet is the wrong actuator; route/drain
+        that replica instead), else None."""
+        with self._lock:
+            if self._suspected and self._last is not None:
+                return dict(self._last)
+            return None
+
+    @property
+    def last_verdict(self) -> dict | None:
+        with self._lock:
+            return None if self._last is None else dict(self._last)
+
+
+# ---------------------------------------------------------------------
+# The acceptance demo (`make work-demo`, CLI --work-demo).
+# ---------------------------------------------------------------------
+
+
+def _work_leg(name: str, *, n: int, m: int, workers, engine: str,
+              gather: bool, group: int = 0, dtype=None,
+              generator: str = "absdiff") -> dict:
+    import jax.numpy as jnp
+
+    from ..driver import solve
+
+    res = solve(n, m, workers=workers, engine=engine, group=group,
+                gather=gather, generator=generator,
+                dtype=dtype if dtype is not None else jnp.float32)
+    return {"name": name, "n": n, "block_size": m,
+            "elapsed_s": res.elapsed,
+            "rel_residual": res.rel_residual,
+            "work": res.work.to_json()}
+
+
+def _solve_work_leg(name: str, *, n: int, m: int, workers, gather: bool,
+                    k: int, dtype, generator: str,
+                    engine: str = "solve_sharded") -> dict:
+    import jax.numpy as jnp
+
+    from ..linalg import solve_system
+    from ..ops import generate
+
+    dt = jnp.dtype(dtype if dtype is not None else jnp.float32)
+    a = generate(generator, (n, n), dt)
+    bmat = generate("rand", (n, k), dt, row_offset=n)
+    res = solve_system(a, bmat, block_size=m, workers=workers,
+                       gather=gather, engine=engine)
+    return {"name": name, "n": n, "block_size": m,
+            "elapsed_s": res.elapsed,
+            "rel_residual": res.rel_residual,
+            "work": res.work.to_json()}
+
+
+def _fleet_skew_legs() -> tuple[list, dict]:
+    """The measured-skew legs: synthetic per-replica latencies pushed
+    through the REAL rollup + judge path (ServeStats.batch →
+    cross_replica_spread → FleetSkewJudge), the work-observatory twin
+    of the comm demo's deliberate drift leg.  Three cases: a genuinely
+    sick replica (must be a recorded ``straggler_suspected`` event), a
+    layout-attributed spread (a replica on a smaller mesh is slower
+    exactly in proportion to its analytical critical path — must stay
+    CLEAN), and the recovery transition (``straggler_cleared``)."""
+    from ..serve.stats import ServeStats, cross_replica_spread
+
+    def replica_stats(slot: int, exec_s: list) -> "ServeStats":
+        st = ServeStats(labels={"replica": str(slot)})
+        for e in exec_s:
+            st.batch("demo", occupancy=1, exec_seconds=e,
+                     queue_seconds=())
+        return st
+
+    legs = []
+    judge = FleetSkewJudge()
+
+    # Leg A: replica 2 is 5x slower than its homogeneous peers — an
+    # environmental straggler the judge MUST suspect.
+    snaps = [replica_stats(i, [0.010 + 0.001 * j for j in range(8)])
+             for i in range(2)]
+    snaps.append(replica_stats(2, [0.050 + 0.005 * j for j in range(8)]))
+    spread = cross_replica_spread([s.snapshot() for s in snaps])
+    p99 = {r: d["exec_ms"]["p99"]
+           for r, d in spread["replicas"].items()}
+    verdict = judge.assess(p99)
+    legs.append({"name": "fleet_straggler_suspected", "synthetic": True,
+                 "spread": spread, "verdict": verdict,
+                 "expect_suspected": True})
+
+    # Leg B: a heterogeneous fleet — replica 1's layout critical path
+    # is ~4x replica 0's, and its measured p99 is slower by the SAME
+    # factor: layout-inherent, must NOT be misread as a sick replica.
+    from ..parallel.layout import CyclicLayout
+
+    rep_big = engine_report(engine="inplace",
+                            lay=CyclicLayout.create(44, 8, 8))
+    rep_small = engine_report(engine="inplace",
+                              lay=CyclicLayout.create(44, 8, 2))
+    expected = {"0": expected_latency_factor(rep_big),
+                "1": expected_latency_factor(rep_small)}
+    ratio = expected["1"] / expected["0"]
+    snaps = [replica_stats(0, [0.010] * 8),
+             replica_stats(1, [0.010 * ratio] * 8)]
+    spread_b = cross_replica_spread([s.snapshot() for s in snaps])
+    p99_b = {r: d["exec_ms"]["p99"]
+             for r, d in spread_b["replicas"].items()}
+    judge_b = FleetSkewJudge()
+    verdict_b = judge_b.assess(p99_b, expected=expected)
+    legs.append({"name": "fleet_skew_layout_attributed",
+                 "synthetic": True, "spread": spread_b,
+                 "expected": expected, "verdict": verdict_b,
+                 "expect_suspected": False})
+
+    # Leg C: the first judge sees the straggler recover — the verdict
+    # clears and the transition records ``straggler_cleared`` (never a
+    # second ``straggler_suspected`` while already suspected).
+    p99_rec = {r: 11.0 for r in p99}
+    verdict_c = judge.assess(p99_rec)
+    legs.append({"name": "fleet_straggler_recovered", "synthetic": True,
+                 "verdict": verdict_c, "expect_suspected": False})
+
+    fleet = {"threshold": STRAGGLER_SPREAD,
+             "veto_after_recovery": judge.veto()}
+    return legs, fleet
+
+
+def work_demo(n: int = 48, block_size: int = 8, seed: int = 0,
+              dtype=None, generator: str = "absdiff") -> dict:
+    """The ISSUE 19 acceptance run: distributed solves on 1D and 2D
+    meshes — invert and solve workloads, a RAGGED size (the padded
+    tail's zero-work blocks skew the shares) and an ALIGNED size (the
+    penalty pins to exactly 0) — each leg's per-worker analytical
+    shares summing EXACTLY to the convention total and its executable
+    judged against cost_analysis (devices × per-device vs the padded
+    executed model); then the fleet-skew legs: a synthetic straggler
+    that MUST become a recorded ``straggler_suspected`` event, a
+    layout-attributed spread that must stay clean, and the recovery
+    transition.
+
+    Returns the one-line-JSON report ``tools/check_work.py`` validates
+    (exit 2 = unaccounted work or a straggler verdict the evidence
+    can't support).  Needs an 8-device mesh: re-execs itself on a
+    forced virtual CPU platform when the current process cannot host
+    one (the dryrun recipe)."""
+    import json
+    import subprocess
+    import sys
+
+    import jax
+    import jax.numpy as jnp
+
+    from .comm import _cpu_env, _repo_root
+
+    del seed  # the demo fixtures are deterministic generators
+    dt = jnp.dtype(dtype if dtype is not None else jnp.float32)
+    if dt.kind == "c":
+        from ..driver import UsageError
+
+        raise UsageError(
+            "--work-demo accounts the DISTRIBUTED engines and complex "
+            "dtypes run single-device (driver.solve's contract); use "
+            "a real dtype")
+    try:
+        can_inline = len(jax.devices()) >= 8
+    except RuntimeError:
+        can_inline = False
+    if not can_inline:
+        x64 = ("jax.config.update('jax_enable_x64', True)\n"
+               if dt.itemsize == 8 else "")
+        code = (
+            "import jax; jax.config.update('jax_platforms', 'cpu')\n"
+            + x64 +
+            "import json\n"
+            "from tpu_jordan.obs.work import work_demo\n"
+            f"print(json.dumps(work_demo(n={int(n)}, "
+            f"block_size={int(block_size)}, dtype={dt.name!r}, "
+            f"generator={generator!r})))\n")
+        proc = subprocess.run(
+            [sys.executable, "-c", code], env=_cpu_env(8),
+            cwd=_repo_root(), capture_output=True, text=True,
+            timeout=900)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"work_demo subprocess failed (rc={proc.returncode}): "
+                f"{proc.stderr[-2000:]}")
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    m = block_size
+    # A ragged point: n chosen so n % m != 0 (the padded identity tail
+    # and its zero useful work ride through every share below).
+    n_rag = n - m // 2 if n % m == 0 else n
+    # An aligned point: n % m == 0 AND p | Nr on the p=4 mesh — the
+    # ragged penalty must pin to exactly 0.0.
+    n_ali = 8 * m
+    mark = _recorder.RECORDER.total
+    kw = {"dtype": dt, "generator": generator}
+    legs = [
+        _work_leg("1d_p4_inplace_gathered", n=n_rag, m=m, workers=4,
+                  engine="inplace", gather=True, **kw),
+        _work_leg("1d_p4_swapfree_sharded", n=n_rag, m=m, workers=4,
+                  engine="swapfree", gather=False, **kw),
+        _work_leg("1d_p4_inplace_aligned", n=n_ali, m=m, workers=4,
+                  engine="inplace", gather=True, **kw),
+        _work_leg("2d_2x2_inplace_gathered", n=n_rag, m=m,
+                  workers=(2, 2), engine="inplace", gather=True, **kw),
+        _solve_work_leg("1d_p4_solve_gathered", n=n_rag, m=m,
+                        workers=4, gather=True, k=3, dtype=dt,
+                        generator=generator),
+        _solve_work_leg("2d_2x2_solve_sharded", n=n_rag, m=m,
+                        workers=(2, 2), gather=False, k=2, dtype=dt,
+                        generator=generator),
+    ]
+    fleet_legs, fleet = _fleet_skew_legs()
+    blackbox = _recorder.RECORDER.dump(
+        events=_recorder.RECORDER.since(mark))
+    straggler_events = [e for e in blackbox["events"]
+                        if e["kind"] == "straggler_suspected"]
+    cleared_events = [e for e in blackbox["events"]
+                      if e["kind"] == "straggler_cleared"]
+    unaccounted = [leg["name"] for leg in legs
+                   if not leg["work"]["totals"]["exact"]]
+    xla_unreconciled = [
+        leg["name"] for leg in legs
+        if (leg["work"]["xla"] or {}).get("available")
+        and not leg["work"]["xla"]["within"]]
+    aligned = next(leg for leg in legs
+                   if leg["name"] == "1d_p4_inplace_aligned")
+    penalty_bad = aligned["work"]["totals"]["ragged_penalty"] != 0.0
+    verdict_wrong = [
+        leg["name"] for leg in fleet_legs
+        if bool(leg["verdict"]["suspected"]) != leg["expect_suspected"]]
+    silent_straggler = (
+        any(leg["expect_suspected"] for leg in fleet_legs)
+        and not straggler_events)
+    return {
+        "metric": "work_demo",
+        "n": n_rag, "aligned_n": n_ali, "block_size": m,
+        "dtype": dt.name, "generator": generator,
+        "ragged": n_rag % m != 0,
+        "legs": legs,
+        "fleet_legs": fleet_legs,
+        "fleet": fleet,
+        "straggler_events": len(straggler_events),
+        "cleared_events": len(cleared_events),
+        "unaccounted": unaccounted,
+        "xla_unreconciled": xla_unreconciled,
+        "penalty_nonzero_aligned": penalty_bad,
+        "verdict_wrong": verdict_wrong,
+        "silent_work": bool(unaccounted or xla_unreconciled
+                            or penalty_bad or verdict_wrong
+                            or silent_straggler),
+        "blackbox": blackbox,
+    }

@@ -253,6 +253,13 @@ class ServeStats:
             buckets = {str(k): v.to_json()
                        for k, v in sorted(self._buckets.items(),
                                           key=lambda kv: str(kv[0]))}
+            # Instance-level execute-latency rollup (ISSUE 19): the
+            # per-replica half of the cross-replica spread — all lanes'
+            # recent execute samples pooled, so a fleet can compare
+            # replicas without scraping Prometheus.
+            exec_samples: list = []
+            for v in self._buckets.values():
+                exec_samples.extend(v.exec_s.samples)
         totals = {
             "requests": sum(b["requests"] for b in buckets.values()),
             "rejected": sum(b["rejected"] for b in buckets.values()),
@@ -271,4 +278,38 @@ class ServeStats:
             w["batches"] += b["batches"]
             w["singular"] += b["singular"]
         return {"buckets": buckets, "totals": totals,
-                "workloads": workloads}
+                "workloads": workloads,
+                "labels": dict(self._labels),
+                "exec_ms": _percentiles(exec_samples)}
+
+
+def cross_replica_spread(snapshots) -> dict:
+    """Cross-replica execute-latency spread (ISSUE 19): given
+    per-replica :meth:`ServeStats.snapshot` dicts, the max-over-min
+    ratio of their pooled execute p99s — the fleet's MEASURED skew
+    signal, readable straight off ``JordanFleet.stats()`` without
+    scraping Prometheus.  Replica identity comes from each snapshot's
+    ``labels["replica"]`` (the fleet stamps it at spawn), falling back
+    to list position.  Fewer than two replicas with samples is an
+    honest ``judged: False`` — never a fabricated spread.  Whether a
+    high spread means a SICK replica is the work observatory's call
+    (``obs/work.FleetSkewJudge`` normalizes by the analytical layout
+    share first — docs/OBSERVABILITY.md)."""
+    replicas = {}
+    for i, snap in enumerate(snapshots):
+        rep = str((snap.get("labels") or {}).get("replica", i))
+        replicas[rep] = {
+            "exec_ms": snap.get("exec_ms") or _percentiles(()),
+            "batches": (snap.get("totals") or {}).get("batches", 0),
+        }
+    p99 = {r: d["exec_ms"].get("p99") for r, d in replicas.items()}
+    live = {r: v for r, v in p99.items() if v}
+    out: dict = {"replicas": replicas, "judged": len(live) >= 2,
+                 "p99_spread": None, "max_replica": None,
+                 "min_replica": None}
+    if out["judged"]:
+        mx = max(live, key=lambda r: live[r])
+        mn = min(live, key=lambda r: live[r])
+        out.update({"p99_spread": round(live[mx] / live[mn], 4),
+                    "max_replica": mx, "min_replica": mn})
+    return out
