@@ -13,7 +13,7 @@ WORKERS  ?= 1
 REQUESTS  ?= 64
 BATCH_CAP ?= 8
 
-.PHONY: all native tpu test smoke serve-demo solve-demo chaos-demo fleet-demo autoscale-demo update-demo capacity-demo comm-demo work-demo lp-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
+.PHONY: all native tpu test smoke serve-demo solve-demo chaos-demo fleet-demo autoscale-demo update-demo capacity-demo comm-demo work-demo lp-demo ckpt-demo metrics-demo slo-demo blackbox numerics-demo bench bench-dip bench-check clean
 
 REPLICAS ?= 3
 
@@ -184,6 +184,19 @@ lp-demo:
 	  --replicas $(REPLICAS) --kills 1 --batch-cap 4 --quiet \
 	  > /tmp/tpu_jordan_lp.json
 	python tools/check_lp.py /tmp/tpu_jordan_lp.json
+
+# Checkpoint/resume demo + validation (ISSUE 20, docs/RESILIENCE.md):
+# four preempt-and-resume legs (single-device invert, 1D distributed
+# solve, a resumable LP stream, and a fleet replica killed mid-sweep)
+# each recover from the last durable superstep checkpoint and must
+# bit-match the uninterrupted baseline with zero segment compiles on
+# the warm resume.  check_ckpt exit 2 is the silent-loss alarm: a
+# divergent resume, a durable checkpoint silently ignored, or a
+# checkpoint ledger that does not add up.
+ckpt-demo:
+	python -m tpu_jordan 96 16 --ckpt-demo --quiet \
+	  > /tmp/tpu_jordan_ckpt.json
+	python tools/check_ckpt.py /tmp/tpu_jordan_ckpt.json
 
 # SLO demo + validation (docs/OBSERVABILITY.md): the fleet demo with
 # the --slo-report leg — declarative per-bucket availability SLOs

@@ -863,6 +863,72 @@ def _solve_fori_row(extra):
         extra["solve_fori_8192_error"] = str(e)[:200]
 
 
+def _ckpt_overhead_row(extra, n=4096, m=128, cadence=8):
+    """ISSUE 20 capture row ``ckpt_overhead_4096``: the superstep
+    checkpoint tax.  The fori invert engine at the headline size runs
+    twice through tpu_jordan.resilience.checkpoint — once as a single
+    monolithic segment (cadence = Nr: zero checkpoint writes) and once
+    at cadence 8 (a host round-trip, a sha256 content checksum and an
+    atomic write at every superstep boundary) — both WARM, so the
+    delta is pure checkpoint tax.  The checkpointed GFLOP/s and the
+    overhead pct are measured; ``*_bytes`` (snapshot size) and
+    ``*_cadence`` (the interval knob that bought the durability) are
+    accounting class (tools/check_bench.py ACCOUNTING_SUFFIXES): a
+    dtype or cadence retune re-prices the same sweep and must never
+    page — the overhead RATE still does."""
+    import shutil
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_jordan.obs import hwcost as _hwcost
+    from tpu_jordan.ops import generate
+    from tpu_jordan.resilience.checkpoint import (CheckpointStore,
+                                                  checkpointed_invert)
+    from tpu_jordan.tuning.measure import measure_direct
+
+    tmp = tempfile.mkdtemp(prefix="tpu_jordan_bench_ckpt_")
+    try:
+        store = CheckpointStore(tmp)
+        a = generate("rand", (n, n), jnp.float32)
+        nr = -(-n // m)
+
+        def run(cad, rid):
+            inv, sing, info = checkpointed_invert(
+                a, m, store=store, run_id=rid, cadence=cad,
+                engine="fori")
+            jax.block_until_ready(inv)
+            if bool(sing):
+                raise _Singular("ckpt_overhead_4096: fixture singular")
+            return info
+
+        run(nr, "bench:mono:warm")   # compile the monolithic segment
+        info = run(cadence, "bench:ckpt:warm")   # ...and the cadenced
+        mono = _retry_transient(lambda: measure_direct(
+            lambda: run(nr, "bench:mono"), samples=3, warmup=1))
+        ckpt = _retry_transient(lambda: measure_direct(
+            lambda: run(cadence, "bench:ckpt"), samples=3, warmup=1))
+        flops = _hwcost.baseline_workload_flops(n, "invert")
+        extra["ckpt_overhead_4096_gflops"] = round(
+            flops / ckpt.seconds / 1e9, 1)
+        extra["ckpt_overhead_4096_spread_pct"] = ckpt.spread_pct
+        if ckpt.variance_flag:
+            extra["ckpt_overhead_4096_variance_flag"] = \
+                ckpt.variance_flag
+        extra["ckpt_overhead_4096_pct"] = round(
+            (ckpt.seconds - mono.seconds) / mono.seconds * 100.0, 1)
+        extra["ckpt_overhead_4096_bytes"] = int(
+            info["ckpt_bytes_last"])
+        extra["ckpt_overhead_4096_cadence"] = cadence
+        extra["ckpt_overhead_4096_writes_per_run"] = int(
+            info["ckpt_written"])
+    except Exception as e:                      # noqa: BLE001
+        extra["ckpt_overhead_4096_error"] = str(e)[:200]
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 #: BENCH_r04.json's 4096² number of record — the high-water mark the
 #: r04→r05 dip fell from (diagnosed as single-sample session-lottery
 #: noise, BASELINE.md "The r04→r05 4096² dip"); the dip guard row
@@ -1598,6 +1664,13 @@ def main(argv=None):
     # rate-compared) with the zero-compile warm pin.  Best-effort like
     # every non-contract row.
     _serve_mesh_row(extra)
+
+    # Checkpoint-overhead tier (ISSUE 20): the superstep checkpoint
+    # tax at the headline size — warm monolithic vs warm cadence-8
+    # checkpointed sweep through the same segmented machinery, with
+    # the snapshot bytes and the cadence knob as accounting fields.
+    # Best-effort like every non-contract row.
+    _ckpt_overhead_row(extra)
 
     print(json.dumps({
         "metric": "invert_4096x4096_f32_gflops",

@@ -236,6 +236,48 @@ class TestRegressionRules:
         assert "update_4096_k32_gflops" in keys
         assert "update_4096_k32_peak_hbm_bytes" not in keys
 
+    def test_ckpt_cadence_accounting_class_never_compared(
+            self, tmp_path):
+        """ISSUE 20 satellite, trapped both ways: the checkpoint
+        row's ``*_cadence`` knob (and its ``*_bytes`` snapshot size)
+        are accounting-class — a cadence retune or a snapshot-layout
+        change re-prices the SAME sweep and must NEVER page — while
+        the same shortfall in the row's ``*_gflops`` overhead rate
+        still does."""
+        files = [
+            _write(tmp_path, "r1.json", _round(10000.0, {
+                "ckpt_overhead_4096_cadence": 8,
+                "ckpt_overhead_4096_bytes": 6.7e7,
+                "invert_4096_spread_pct": 1.0})),
+            _write(tmp_path, "r2.json", _round(10000.0, {
+                "ckpt_overhead_4096_cadence": 1,
+                "ckpt_overhead_4096_bytes": 6.7e8,
+                "invert_4096_spread_pct": 1.0})),
+        ]
+        assert check_bench.main(files) == 0
+        # The other way: the same shortfall under the rate key pages.
+        files = [
+            _write(tmp_path, "r3.json", _round(10000.0, {
+                "ckpt_overhead_4096_gflops": 9000.0,
+                "ckpt_overhead_4096_spread_pct": 1.0})),
+            _write(tmp_path, "r4.json", _round(10000.0, {
+                "ckpt_overhead_4096_gflops": 900.0,
+                "ckpt_overhead_4096_spread_pct": 1.0})),
+        ]
+        assert check_bench.main(files) == 2
+        assert check_bench.is_accounting_key(
+            "ckpt_overhead_4096_cadence")
+        assert check_bench.is_accounting_key(
+            "ckpt_overhead_4096_bytes")
+        assert not check_bench.is_accounting_key(
+            "ckpt_overhead_4096_gflops")
+        keys = check_bench.comparable_keys(
+            {"metric": "m", "value": 1.0,
+             "extra": {"ckpt_overhead_4096_cadence": 8.0,
+                       "ckpt_overhead_4096_gflops": 9000.0}})
+        assert "ckpt_overhead_4096_gflops" in keys
+        assert "ckpt_overhead_4096_cadence" not in keys
+
     def test_update_rows_trap_quiet_regression(self, tmp_path):
         """ISSUE 12 satellite: the new resident-update keys
         (update_4096_k32_gflops / update_resident_amortized_gflops)

@@ -1,0 +1,786 @@
+"""Preemption-safe execution (ISSUE 20): superstep checkpoint/resume.
+
+The acceptance pins, in test form:
+
+  * **bit-match** — a checkpointed run (any cadence) returns the SAME
+    bytes as the monolithic engine of the same flavor, and a
+    preempted-then-resumed run returns the same bytes as the
+    uninterrupted one (single-device, 1D p=8 mid-sweep, grouped);
+  * **typed refusals** — missing / corrupt / mismatched / unsupported
+    checkpoints and misapplied CLI flags each raise their own type with
+    a message that names the refusal; a resume NEVER silently degrades
+    to a from-scratch run;
+  * **cadence edges** — cadence > Nr writes nothing (and a resume on
+    that store is a typed CheckpointNotFoundError), cadence 1 works,
+    ragged last blocks round-trip, grouped cadence snaps to group
+    boundaries;
+  * **warm resumes are free** — zero segment compiles when the segment
+    grid was already compiled (the n=64 smoke pin);
+  * **the ledger adds up** — written == resumed + discarded + live,
+    persisted across store reopen, corruption quarantined and counted;
+  * **the fleet kill path resumes** — a replica killed mid-ckpt_solve
+    re-queues with ``resume_from`` (the ``ckpt_resume`` journey hop)
+    and the result bit-matches;
+  * **LP streams replay** — ``solve_lp(resume=True)`` re-enters at the
+    stored iteration and reproduces the identical ``kkt_hex`` trail;
+  * **reaped dispatchers** (satellite): a dispatcher thread the
+    bounded kill-path close abandoned is joined by a later ``reap()``
+    and counted in ``tpu_jordan_serve_dispatcher_reaped_total``.
+"""
+
+import importlib.util
+import json
+import os
+import pathlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_jordan.obs.metrics import REGISTRY
+from tpu_jordan.obs.recorder import RECORDER
+from tpu_jordan.resilience import FaultPlan, FaultSpec, activate
+from tpu_jordan.resilience.checkpoint import (
+    CheckpointCorruptError, CheckpointKey, CheckpointMismatchError,
+    CheckpointNotFoundError, CheckpointStore,
+    CheckpointUnsupportedError, PreemptedError, checkpointed_invert,
+    checkpointed_solve, fingerprint)
+
+_repo = pathlib.Path(__file__).resolve().parent.parent
+_spec = importlib.util.spec_from_file_location(
+    "check_ckpt", _repo / "tools" / "check_ckpt.py")
+check_ckpt = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(check_ckpt)
+
+
+def _mat(n, seed=0, dtype=np.float32):
+    rng = np.random.default_rng(seed)
+    return (rng.standard_normal((n, n)) + n * np.eye(n)).astype(dtype)
+
+
+def _rhs(n, k=3, seed=1, dtype=np.float32):
+    return np.random.default_rng(seed).standard_normal(
+        (n, k)).astype(dtype)
+
+
+def _key(run_id="t:key", **kw):
+    base = dict(run_id=run_id, workload="invert", engine="fori",
+                topology="single", n=32, m=8, Nr=4, dtype="float32",
+                nrhs=0, cadence=2)
+    base.update(kw)
+    return CheckpointKey(**base)
+
+
+def _state(seed=0):
+    rng = np.random.default_rng(seed)
+    return {"V": rng.standard_normal((4, 8, 8)).astype(np.float32),
+            "swaps": np.arange(8, dtype=np.int32)}
+
+
+def _preempt_plan(call):
+    return FaultPlan([FaultSpec("preempt", (call,), "permanent")])
+
+
+# ---------------------------------------------------------------------
+# The store: tokens, checksums, quarantine, ledger persistence
+# ---------------------------------------------------------------------
+
+
+class TestStore:
+    def test_write_peek_resume_roundtrip_bit_exact(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        key = _key()
+        st = _state()
+        nbytes = store.write(key, 2, st)
+        assert nbytes > 0
+        assert store.has_live("t:key")
+        step, arrays = store.resume(key)
+        assert step == 2
+        for name in st:
+            assert arrays[name].dtype == st[name].dtype
+            np.testing.assert_array_equal(arrays[name], st[name])
+        led = store.ledger()
+        assert led["written"] == 1 and led["resumed"] == 1
+        assert led["invariant_holds"]
+        # A resume consumes the token: a second one is a typed miss.
+        assert not store.has_live("t:key")
+        with pytest.raises(CheckpointNotFoundError):
+            store.resume(key)
+
+    def test_supersede_discards_previous_token(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        key = _key()
+        store.write(key, 1, _state(1))
+        store.write(key, 2, _state(2))
+        led = store.ledger()
+        assert led["written"] == 2 and led["discarded"] == 1
+        assert led["live"] == 1 and led["invariant_holds"]
+        step, arrays = store.resume(key)   # only the LATEST survives
+        assert step == 2
+        np.testing.assert_array_equal(arrays["V"], _state(2)["V"])
+
+    def test_corrupt_entry_quarantined_typed_and_counted(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        key = _key()
+        store.write(key, 2, _state())
+        path = [p for p in os.listdir(tmp_path)
+                if p != "ledger.json" and not p.endswith(".corrupt")]
+        assert len(path) == 1
+        full = tmp_path / path[0]
+        raw = bytearray(full.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF          # flip a payload byte
+        full.write_bytes(bytes(raw))
+        before = REGISTRY.counter("tpu_jordan_ckpt_corrupt_total").total()
+        with pytest.raises(CheckpointCorruptError):
+            store.resume(key)
+        assert REGISTRY.counter(
+            "tpu_jordan_ckpt_corrupt_total").total() == before + 1
+        assert any(p.endswith(".corrupt") for p in os.listdir(tmp_path))
+        led = store.ledger()
+        assert led["corrupt"] == 1
+        assert led["invariant_holds"]       # corrupt token => discarded
+        assert not store.has_live("t:key")
+
+    def test_mismatched_key_typed_refusal_names_fields(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        store.write(_key(), 2, _state())
+        with pytest.raises(CheckpointMismatchError,
+                           match="dtype.*silent corruption"):
+            store.resume(_key(dtype="float64"))
+        # cadence is the ONE legitimately tunable field.
+        store.write(_key(), 2, _state())
+        step, _ = store.resume(_key(cadence=4))
+        assert step == 2
+
+    def test_ledger_persists_across_reopen(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        key = _key()
+        store.write(key, 1, _state())
+        store.resume(key)
+        led0 = store.ledger()
+        again = CheckpointStore(str(tmp_path))
+        led1 = again.ledger()
+        for k in ("written", "resumed", "discarded", "corrupt", "live"):
+            assert led1[k] == led0[k], k
+        assert led1["invariant_holds"]
+
+    def test_resume_unknown_run_typed(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        with pytest.raises(CheckpointNotFoundError,
+                           match="never silently"):
+            store.resume(_key(run_id="t:nobody"))
+
+
+# ---------------------------------------------------------------------
+# Single-device runners: bit-match, preempt/resume, cadence edges
+# ---------------------------------------------------------------------
+
+
+class TestSingleDevice:
+    @pytest.mark.smoke
+    def test_invert_bitmatches_monolithic_and_warm_resume_free(
+            self, tmp_path):
+        """The n=64 smoke pin: segmented == monolithic bytes, and the
+        preempt/resume round trip re-enters at the durable superstep
+        with ZERO segment compiles (everything warm)."""
+        import jax
+
+        from tpu_jordan.ops.jordan_inplace import \
+            block_jordan_invert_inplace_fori
+
+        a = _mat(64, seed=3)
+        ref, sing = jax.jit(
+            lambda x: block_jordan_invert_inplace_fori(x, 16))(a)
+        assert not bool(sing)
+        store = CheckpointStore(str(tmp_path))
+        inv, sing2, info = checkpointed_invert(
+            a, 16, store=store, run_id="t:s64", cadence=2,
+            engine="fori")
+        assert not bool(sing2)
+        assert fingerprint(inv) == fingerprint(ref)
+        assert info["ckpt_written"] == 1          # boundary at 2, Nr=4
+        # Preempt before the second segment: durable step 2.
+        with activate(_preempt_plan(2)):
+            with pytest.raises(PreemptedError) as ei:
+                checkpointed_invert(a, 16, store=store, run_id="t:s64p",
+                                    cadence=2, engine="fori")
+        assert ei.value.step == 2
+        assert store.has_live("t:s64p")
+        mark = RECORDER.total
+        inv2, _, info2 = checkpointed_invert(
+            a, 16, store=store, run_id="t:s64p", cadence=2,
+            engine="fori", resume_from="t:s64p")
+        assert fingerprint(inv2) == fingerprint(ref)
+        assert info2["resumed"] and info2["start_step"] == 2
+        assert info2["segments_run"] == [(2, 4)]
+        assert info2["segment_compiles"] == 0     # the zero-compile pin
+        evs = [e["kind"] for e in RECORDER.since(mark)
+               if str(e.get("kind", "")).startswith("ckpt_")]
+        # The resume consumed the token; no writes remained past it
+        # (the next boundary IS completion), so no discard event.
+        assert evs == ["ckpt_resumed"]
+        assert store.ledger()["invariant_holds"]
+
+    def test_solve_bitmatches_monolithic(self, tmp_path):
+        import jax
+
+        from tpu_jordan.linalg.engine import block_jordan_solve_fori
+
+        a, b = _mat(48, seed=5), _rhs(48, k=2, seed=6)
+        ref, sing = jax.jit(
+            lambda aa, bb: block_jordan_solve_fori(aa, bb, 8))(a, b)
+        assert not bool(sing)
+        store = CheckpointStore(str(tmp_path))
+        x, sing2, info = checkpointed_solve(
+            a, b, 8, store=store, run_id="t:sv", cadence=2,
+            engine="fori")
+        assert not bool(sing2)
+        assert fingerprint(x) == fingerprint(ref)
+        assert info["Nr"] == 6 and info["ckpt_written"] == 2
+
+    def test_cadence_over_nr_writes_nothing_resume_typed(self, tmp_path):
+        """Cadence > Nr: one monolithic segment, ZERO checkpoints —
+        and asking to resume from that store is a typed miss, never a
+        silent from-scratch run."""
+        store = CheckpointStore(str(tmp_path))
+        a = _mat(32, seed=7)
+        inv, _, info = checkpointed_invert(
+            a, 8, store=store, run_id="t:wide", cadence=99,
+            engine="fori")
+        assert info["ckpt_written"] == 0
+        assert info["segments_run"] == [(0, 4)]
+        assert store.ledger()["written"] == 0
+        with pytest.raises(CheckpointNotFoundError):
+            checkpointed_invert(a, 8, store=store, run_id="t:wide",
+                                cadence=99, engine="fori",
+                                resume_from="t:wide")
+
+    def test_cadence_one_and_ragged_tail_bitmatch(self, tmp_path):
+        """Cadence 1 (a checkpoint at EVERY superstep) on a ragged n
+        (70 = 4*16 + 6: the last block is partial) still bit-matches;
+        preempt/resume crosses the ragged boundary."""
+        import jax
+
+        from tpu_jordan.ops.jordan_inplace import \
+            block_jordan_invert_inplace_fori
+
+        a = _mat(70, seed=9)
+        ref, sing = jax.jit(
+            lambda x: block_jordan_invert_inplace_fori(x, 16))(a)
+        assert not bool(sing)
+        store = CheckpointStore(str(tmp_path))
+        inv, _, info = checkpointed_invert(
+            a, 16, store=store, run_id="t:rag", cadence=1,
+            engine="fori")
+        assert fingerprint(inv) == fingerprint(ref)
+        assert info["Nr"] == 5 and info["ckpt_written"] == 4
+        with activate(_preempt_plan(5)):          # durable step 4
+            with pytest.raises(PreemptedError) as ei:
+                checkpointed_invert(a, 16, store=store, run_id="t:ragp",
+                                    cadence=1, engine="fori")
+        assert ei.value.step == 4
+        inv2, _, info2 = checkpointed_invert(
+            a, 16, store=store, run_id="t:ragp", cadence=1,
+            engine="fori", resume_from="t:ragp")
+        assert fingerprint(inv2) == fingerprint(ref)
+        assert info2["segments_run"] == [(4, 5)]  # the ragged tail
+
+    def test_grouped_cadence_snaps_to_group_boundary(self, tmp_path):
+        """The grouped engine closes its (V, swaps, t) state only at
+        group boundaries: cadence 2 with group 4 rounds UP to 4, and
+        the resume re-enters exactly on the group grid."""
+        import jax
+
+        from tpu_jordan.ops.jordan_inplace import \
+            block_jordan_invert_inplace_grouped
+
+        a = _mat(64, seed=11)
+        ref, sing = jax.jit(
+            lambda x: block_jordan_invert_inplace_grouped(
+                x, 8, group=4))(a)
+        assert not bool(sing)
+        store = CheckpointStore(str(tmp_path))
+        inv, _, info = checkpointed_invert(
+            a, 8, store=store, run_id="t:grp", cadence=2,
+            engine="grouped", group=4)
+        assert fingerprint(inv) == fingerprint(ref)
+        assert info["cadence"] == 4               # snapped up
+        assert info["ckpt_written"] == 1          # Nr=8: boundary at 4
+        with activate(_preempt_plan(2)):          # durable step 4
+            with pytest.raises(PreemptedError) as ei:
+                checkpointed_invert(a, 8, store=store, run_id="t:grpp",
+                                    cadence=2, engine="grouped", group=4)
+        assert ei.value.step == 4
+        inv2, _, info2 = checkpointed_invert(
+            a, 8, store=store, run_id="t:grpp", cadence=2,
+            engine="grouped", group=4, resume_from="t:grpp")
+        assert fingerprint(inv2) == fingerprint(ref)
+        assert info2["start_step"] == 4
+
+    def test_preempt_before_first_boundary_carries_step_none(
+            self, tmp_path):
+        """Preempted before anything durable: the typed error says so
+        (step None) — the CORRECT recovery is from scratch, and that
+        is the caller's explicit choice, not the runner's."""
+        store = CheckpointStore(str(tmp_path))
+        with activate(_preempt_plan(1)):
+            with pytest.raises(PreemptedError) as ei:
+                checkpointed_invert(_mat(32), 8, store=store,
+                                    run_id="t:early", cadence=2,
+                                    engine="fori")
+        assert ei.value.step is None
+        assert not store.has_live("t:early")
+
+
+# ---------------------------------------------------------------------
+# Typed refusal sweep (satellite 2)
+# ---------------------------------------------------------------------
+
+
+class TestRefusals:
+    def test_resume_key_must_name_this_run(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        with pytest.raises(CheckpointMismatchError,
+                           match="exactly its own run"):
+            checkpointed_invert(_mat(32), 8, store=store, run_id="t:a",
+                                cadence=2, engine="fori",
+                                resume_from="t:b")
+
+    def test_mismatched_layout_refused_on_resume(self, tmp_path):
+        """A checkpoint written at one (n, m, Nr) must not feed a call
+        with another: block_size 16 vs 8 changes Nr and is refused by
+        type, naming the mismatched fields."""
+        store = CheckpointStore(str(tmp_path))
+        a = _mat(64, seed=13)
+        with activate(_preempt_plan(2)):
+            with pytest.raises(PreemptedError):
+                checkpointed_invert(a, 16, store=store, run_id="t:mm",
+                                    cadence=2, engine="fori")
+        with pytest.raises(CheckpointMismatchError,
+                           match="does not describe"):
+            checkpointed_invert(a, 8, store=store, run_id="t:mm",
+                                cadence=2, engine="fori",
+                                resume_from="t:mm")
+
+    def test_spd_fast_path_unsupported(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        with pytest.raises(CheckpointUnsupportedError,
+                           match="SPD fast path"):
+            checkpointed_solve(_mat(32), _rhs(32), 8, store=store,
+                               run_id="t:spd", cadence=2,
+                               engine="fori", spd=True)
+
+    def test_complex_distributed_unsupported(self, tmp_path):
+        from tpu_jordan.parallel.mesh import make_mesh
+
+        store = CheckpointStore(str(tmp_path))
+        a = _mat(32, dtype=np.complex64)
+        with pytest.raises(CheckpointUnsupportedError,
+                           match="complex distributed"):
+            checkpointed_invert(a, 8, store=store, run_id="t:cplx",
+                                cadence=2, engine="fori",
+                                mesh=make_mesh(2))
+
+    def test_pipeline_engines_unsupported(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        with pytest.raises(CheckpointUnsupportedError,
+                           match="not checkpointable"):
+            checkpointed_invert(_mat(32), 8, store=store,
+                                run_id="t:look", cadence=2,
+                                engine="lookahead")
+
+    def test_cadence_below_one_refused(self, tmp_path):
+        store = CheckpointStore(str(tmp_path))
+        with pytest.raises(ValueError, match="cadence must be >= 1"):
+            checkpointed_invert(_mat(32), 8, store=store, run_id="t:c0",
+                                cadence=0, engine="fori")
+
+    def test_cli_misapplied_flags_typed(self, capsys):
+        """--ckpt-dir without --ckpt-demo, and --ckpt-demo combined
+        with flags it cannot honor, are UsageError (exit 1) with
+        messages that name the contract — checked BEFORE any device
+        work, so these are cheap."""
+        from tpu_jordan.__main__ import main
+
+        cases = [
+            (["96", "16", "--ckpt-dir", "/tmp/x"],
+             "--ckpt-dir applies to --ckpt-demo"),
+            (["96", "16", "--ckpt-demo", "--workload", "solve"],
+             "checkpoints both workloads"),
+            (["96", "16", "--ckpt-demo", "--engine", "inplace"],
+             "fixed engine-leg set"),
+            (["96", "16", "--ckpt-demo", "--replicas", "5"],
+             "kill leg is fixed"),
+            (["96", "16", "--ckpt-demo", "--serve-demo"],
+             "distinct modes"),
+            (["96", "16", "--ckpt-demo", "--dtype", "complex64"],
+             "use a real dtype"),
+        ]
+        for argv, fragment in cases:
+            assert main(argv) == 1, argv
+            assert fragment in capsys.readouterr().err, argv
+
+
+# ---------------------------------------------------------------------
+# Distributed: the 8-device dryrun leg (1D p=8 mid-sweep resume) + 2D
+# ---------------------------------------------------------------------
+
+
+class TestDistributed:
+    def test_1d_p8_solve_resumes_mid_sweep_bit_exact(self, tmp_path):
+        """The 8-device dryrun leg: a 1D p=8 sharded solve preempted
+        mid-sweep resumes at the durable superstep and bit-matches the
+        uninterrupted checkpointed run — with zero compiles on the
+        warm resume."""
+        from tpu_jordan.parallel.mesh import make_mesh
+
+        mesh = make_mesh(8)
+        a, b = _mat(64, seed=17), _rhs(64, k=2, seed=18)
+        store = CheckpointStore(str(tmp_path))
+        x0, sing, info0 = checkpointed_solve(
+            a, b, 8, store=store, run_id="t:p8", cadence=2,
+            engine="fori", mesh=mesh)
+        assert not bool(sing)
+        assert info0["topology"] == "1d:8" and info0["Nr"] == 8
+        ref = fingerprint(x0)
+        with activate(_preempt_plan(3)):          # durable step 4
+            with pytest.raises(PreemptedError) as ei:
+                checkpointed_solve(a, b, 8, store=store, run_id="t:p8p",
+                                   cadence=2, engine="fori", mesh=mesh)
+        assert ei.value.step == 4
+        x1, _, info1 = checkpointed_solve(
+            a, b, 8, store=store, run_id="t:p8p", cadence=2,
+            engine="fori", mesh=mesh, resume_from="t:p8p")
+        assert fingerprint(x1) == ref
+        assert info1["resumed"] and info1["start_step"] == 4
+        assert info1["segments_run"] == [(4, 6), (6, 8)]
+        assert info1["segment_compiles"] == 0
+        assert store.ledger()["invariant_holds"]
+
+    @pytest.mark.slow
+    def test_2d_invert_resumes_bit_exact(self, tmp_path):
+        from tpu_jordan.parallel.mesh import make_mesh_2d
+
+        mesh = make_mesh_2d(2, 2)
+        a = _mat(48, seed=19)
+        store = CheckpointStore(str(tmp_path))
+        inv0, sing, _ = checkpointed_invert(
+            a, 8, store=store, run_id="t:2d", cadence=2,
+            engine="fori", mesh=mesh)
+        assert not bool(sing)
+        with activate(_preempt_plan(2)):
+            with pytest.raises(PreemptedError) as ei:
+                checkpointed_invert(a, 8, store=store, run_id="t:2dp",
+                                    cadence=2, engine="fori", mesh=mesh)
+        assert ei.value.step == 2
+        inv1, _, info1 = checkpointed_invert(
+            a, 8, store=store, run_id="t:2dp", cadence=2,
+            engine="fori", mesh=mesh, resume_from="t:2dp")
+        assert fingerprint(inv1) == fingerprint(inv0)
+        assert info1["start_step"] == 2
+
+
+# ---------------------------------------------------------------------
+# The fleet kill path and the resumable LP stream
+# ---------------------------------------------------------------------
+
+
+class TestFleetAndLP:
+    def test_killed_replica_resumes_on_survivor_bit_exact(self):
+        """The ISSUE 20 fleet wire-through: a replica killed while
+        serving a ckpt_solve dies at the next segment boundary; the
+        router re-queues, probes the store, dispatches with
+        ``resume_from`` (the ``ckpt_resume`` journey hop) and the
+        result bit-matches the uninterrupted run — lost work bounded
+        by the cadence."""
+        import tempfile
+
+        from tpu_jordan.fleet.pool import JordanFleet
+        from tpu_jordan.parallel.mesh import make_mesh
+        from tpu_jordan.resilience import ResiliencePolicy, RetryPolicy
+
+        a, b = _mat(96, seed=21, dtype=np.float64), \
+            _rhs(96, k=4, seed=22, dtype=np.float64)
+        mesh = make_mesh(4)
+        store = CheckpointStore(tempfile.mkdtemp(prefix="t_ckpt_fleet_"))
+        spec = {"store": store, "cadence": 2, "engine": "fori",
+                "mesh": mesh, "block_size": 16}
+        mark = RECORDER.total
+        with JordanFleet(replicas=2, engine="auto", dtype="float64",
+                         batch_cap=1, max_wait_ms=0.5,
+                         stable_after_s=0.2, liveness_deadline_s=30.0,
+                         policy=ResiliencePolicy(retry=RetryPolicy(
+                             max_retries=4, backoff_s=0.0))) as fleet:
+            res0 = fleet.solve_system(
+                a, b, timeout=300.0,
+                ckpt=dict(spec, run_id="t:fleet:base"))
+            ref = fingerprint(res0.solution)
+            run_id = "t:fleet:killed"
+            fut = fleet.submit_solve(a, b,
+                                     ckpt=dict(spec, run_id=run_id))
+            deadline = time.monotonic() + 120
+            while not store.has_live(run_id):
+                assert time.monotonic() < deadline, \
+                    "no checkpoint written in time"
+                time.sleep(0.005)
+            serving = {t.name.split("tpu-jordan-ckpt-")[1]
+                       for t in threading.enumerate()
+                       if t.name.startswith("tpu-jordan-ckpt-")}
+            killed = [r.name for r in fleet.live_replicas()
+                      if r.name in serving and r.kill(reason="chaos")]
+            assert killed, "no serving replica found to kill"
+            res1 = fut.result(timeout=300.0)
+        assert fingerprint(res1.solution) == ref
+        assert res1.ckpt_info["resumed"]
+        evs = [e for e in RECORDER.since(mark)
+               if e.get("run_id") == run_id]
+        kinds = [e["kind"] for e in evs]
+        assert "ckpt_preempted" in kinds and "ckpt_resumed" in kinds
+        # The journey explains the recovery: the re-dispatch carries
+        # the ckpt_resume hop (mirrored into the flight recorder).
+        assert any(e["kind"] == "journey"
+                   and e.get("event") == "ckpt_resume" for e in evs)
+        assert store.ledger()["invariant_holds"]
+
+    def test_lp_stream_resumes_to_identical_kkt_trail(self):
+        """``solve_lp(resume=True)`` replays the remaining iterations
+        from the persisted iterate audit to the IDENTICAL ``kkt_hex``
+        trail the uninterrupted stream produced."""
+        import tempfile
+
+        from tpu_jordan.fleet.pool import JordanFleet
+        from tpu_jordan.lpqp.driver import solve_lp
+        from tpu_jordan.lpqp.problem import lp_instance
+        from tpu_jordan.resilience import ResiliencePolicy, RetryPolicy
+
+        prob = lp_instance(m=8, seed=23, cond="well")
+        store = CheckpointStore(tempfile.mkdtemp(prefix="t_ckpt_lp_"))
+        with JordanFleet(replicas=2, engine="auto", dtype="float64",
+                         batch_cap=1, max_wait_ms=0.5,
+                         stable_after_s=0.2, liveness_deadline_s=30.0,
+                         policy=ResiliencePolicy(retry=RetryPolicy(
+                             max_retries=4, backoff_s=0.0))) as fleet:
+            ref = solve_lp(prob, fleet)
+            trail = [it["kkt_hex"] for it in ref.iterates]
+            assert len(trail) >= 4, "fixture converged too fast"
+            with activate(_preempt_plan(len(trail) - 1)):
+                with pytest.raises(PreemptedError) as ei:
+                    solve_lp(prob, fleet, ckpt_store=store,
+                             ckpt_every=2, run_id="t:lp")
+            assert ei.value.step is not None
+            resumed = solve_lp(prob, fleet, ckpt_store=store,
+                               ckpt_every=2, run_id="t:lp",
+                               resume=True)
+        assert [it["kkt_hex"] for it in resumed.iterates] == trail
+        assert resumed.fingerprint == ref.fingerprint
+        assert resumed.converged == ref.converged
+        assert store.ledger()["invariant_holds"]
+
+
+# ---------------------------------------------------------------------
+# Dispatcher reap (satellite 1)
+# ---------------------------------------------------------------------
+
+
+class TestDispatcherReap:
+    def test_reap_joins_abandoned_dispatcher_and_counts(self):
+        """The abandoned-dispatcher epilogue: after a bounded kill-path
+        close abandons a wedged dispatcher, ``reap()`` returns False
+        while the wedge holds, then joins the unstuck thread, clears
+        the reference, and counts the recovery exactly once."""
+        from tpu_jordan.serve.batcher import MicroBatcher
+        from tpu_jordan.serve.stats import ServeStats
+
+        gate = threading.Event()
+
+        class StuckExecutors:
+            def breaker(self, bucket):
+                return None
+
+            def get_info(self, bucket, batch_cap, block_size, **kw):
+                gate.wait(30)
+                raise RuntimeError("released")
+
+        mb = MicroBatcher(StuckExecutors(), ServeStats(),
+                          batch_cap=1, max_wait_ms=0.1)
+        fut = mb.submit(np.eye(4, dtype=np.float32), 4, 64)
+        deadline = time.monotonic() + 10
+        while not mb.progress()[1] and time.monotonic() < deadline:
+            time.sleep(0.005)
+        assert mb.progress()[1]
+        mb.close(drain=False, join_timeout_s=0.2)   # abandons (counted)
+        reaped = REGISTRY.counter(
+            "tpu_jordan_serve_dispatcher_reaped_total")
+        before = reaped.total()
+        assert mb.reap() is False                   # still wedged
+        assert reaped.total() == before
+        gate.set()                                  # wedge clears
+        with pytest.raises(RuntimeError, match="released"):
+            fut.result(30)
+        deadline = time.monotonic() + 10
+        while not mb.reap() and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert mb.reap() is True                    # idempotent
+        assert reaped.total() == before + 1         # counted ONCE
+        assert mb._thread is None
+
+    def test_reap_never_touches_a_live_dispatcher(self):
+        from tpu_jordan.serve.batcher import MicroBatcher
+        from tpu_jordan.serve.stats import ServeStats
+
+        class IdleExecutors:
+            def breaker(self, bucket):
+                return None
+
+        mb = MicroBatcher(IdleExecutors(), ServeStats(), batch_cap=1)
+        try:
+            assert mb.reap() is False   # serving: nothing abandoned
+        finally:
+            mb.close()
+        assert mb.reap() is True        # clean close left no thread
+
+    def test_second_service_close_reaps(self):
+        """JordanService.close() is the reap retry point: a second
+        close on an already-closed service joins any abandoned
+        dispatcher instead of silently no-opping."""
+        from tpu_jordan.serve.service import JordanService
+
+        svc = JordanService(batch_cap=1, autostart=False)
+        svc.close()
+        svc.close()                     # must not raise; reaps inline
+        assert svc._batcher.reap() is True
+
+
+# ---------------------------------------------------------------------
+# check_ckpt: the doctored-report traps (no jax in the checker)
+# ---------------------------------------------------------------------
+
+
+def _leg(name, **kw):
+    base = {"run_id": f"demo:{name}", "workload": "invert",
+            "topology": "single", "engine": "fori", "n": 96,
+            "block_size": 16, "Nr": 6, "cadence": 2,
+            "preempt_step": 4, "baseline_fp": "aa", "resume_fp": "aa",
+            "bit_match": True, "resume_start_step": 4, "resumed": True,
+            "resume_segments": [[4, 6]], "resume_compiles": 0}
+    base.update(kw)
+    return base
+
+
+def _report():
+    legs = {
+        "single_invert": _leg("single_invert"),
+        "dist_solve": _leg("dist_solve", workload="solve",
+                           topology="1d:4", Nr=8, preempt_step=4,
+                           resume_start_step=4,
+                           resume_segments=[[4, 6], [6, 8]]),
+        "lp_stream": _leg("lp_stream", workload="lp", topology="fleet",
+                          preempt_step=6, resume_start_step=6,
+                          resume_segments=[], kkt_trail_match=True),
+        "fleet_kill": _leg("fleet_kill", workload="solve",
+                           topology="1d:4", Nr=8, preempt_step=4,
+                           resume_start_step=4,
+                           resume_segments=[[4, 6], [6, 8]],
+                           killed_replicas=["r0g1"],
+                           kill_attempts=1),
+    }
+    events = []
+    for name, leg in legs.items():
+        rid = leg["run_id"]
+        events += [
+            {"kind": "ckpt_written", "run_id": rid, "step": 2},
+            {"kind": "ckpt_written", "run_id": rid,
+             "step": leg["preempt_step"]},
+            {"kind": "ckpt_preempted", "run_id": rid,
+             "step": leg["preempt_step"]},
+            {"kind": "ckpt_resumed", "run_id": rid,
+             "step": leg["preempt_step"]},
+            {"kind": "ckpt_discarded", "run_id": rid,
+             "reason": "complete"},
+        ]
+    return {
+        "metric": "ckpt_demo", "n": 96, "block_size": 16, "cadence": 2,
+        "seed": 0, "workers": 4, "dtype": "float64", "legs": legs,
+        "ledger": {"written": 8, "resumed": 4, "discarded": 4,
+                   "corrupt": 0, "live": 0, "invariant_holds": True},
+        "counters": {}, "silent_loss": False,
+        "blackbox": {"events": events},
+    }
+
+
+class TestCheckCkpt:
+    def test_accepts_clean_report(self, tmp_path):
+        errs, loss = check_ckpt.check(_report())
+        assert errs == [] and loss == []
+        p = tmp_path / "ckpt.json"
+        p.write_text(json.dumps(_report()))
+        assert check_ckpt.main([str(p)]) == 0
+
+    def _loss(self, report, fragment):
+        errs, loss = check_ckpt.check(report)
+        assert any(fragment in m for m in loss), (fragment, loss, errs)
+
+    def test_rejects_divergent_resume(self):
+        r = _report()
+        r["legs"]["dist_solve"]["bit_match"] = False
+        r["legs"]["dist_solve"]["resume_fp"] = "bb"
+        self._loss(r, "diverged from the uninterrupted baseline")
+
+    def test_rejects_silent_from_scratch(self):
+        r = _report()
+        r["legs"]["single_invert"]["resumed"] = False
+        self._loss(r, "silent recompute-from-scratch")
+
+    def test_rejects_resume_at_wrong_step(self):
+        r = _report()
+        r["legs"]["single_invert"]["resume_start_step"] = 0
+        self._loss(r, "work silently lost")
+
+    def test_rejects_segment_over_cadence(self):
+        r = _report()
+        r["legs"]["dist_solve"]["resume_segments"] = [[4, 8]]
+        self._loss(r, "lost-work bound is broken")
+
+    def test_rejects_recompiled_resume(self):
+        r = _report()
+        r["legs"]["fleet_kill"]["resume_compiles"] = 2
+        self._loss(r, "zero-compile pin broke")
+
+    def test_rejects_diverged_lp_trail(self):
+        r = _report()
+        r["legs"]["lp_stream"]["kkt_trail_match"] = False
+        self._loss(r, "silently diverged")
+
+    def test_rejects_stripped_resume_events(self):
+        r = _report()
+        r["blackbox"]["events"] = [
+            e for e in r["blackbox"]["events"]
+            if not (e["kind"] == "ckpt_resumed"
+                    and e["run_id"] == "demo:fleet_kill")]
+        self._loss(r, "no matching ckpt_resumed")
+
+    def test_rejects_ledger_event_drift(self):
+        r = _report()
+        r["ledger"]["written"] = 9
+        r["ledger"]["discarded"] = 5      # still adds up internally...
+        self._loss(r, "drifted from its own event stream")
+
+    def test_rejects_broken_invariant(self):
+        r = _report()
+        r["ledger"]["discarded"] = 3
+        self._loss(r, "does not add up")
+
+    def test_rejects_demo_self_flag(self):
+        r = _report()
+        r["silent_loss"] = True
+        self._loss(r, "flagged by the demo itself")
+
+    def test_structure_violations_exit_1_not_0(self, tmp_path):
+        r = _report()
+        del r["legs"]["fleet_kill"]
+        errs, loss = check_ckpt.check(r)
+        assert any("missing leg" in m for m in errs)
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps(r))
+        assert check_ckpt.main([str(p)]) == 1

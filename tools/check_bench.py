@@ -89,10 +89,14 @@ def load_round(path: str) -> dict | None:
 #: same schedule), plus the ISSUE 19 work-observatory fields
 #: ``*_work_skew`` / ``*_ragged_penalty`` (layout-exact imbalance
 #: factor and padding penalty — a layout/block-size change re-prices
-#: the same solve).  Never compared across rounds — the first-call
-#: separation principle applied to accounting.
+#: the same solve), plus the ISSUE 20 checkpoint field ``*_cadence``
+#: (the superstep checkpoint interval the ``ckpt_overhead`` row ran
+#: at: a cadence retune re-prices the same sweep — the overhead RATE
+#: still pages, the knob that produced it never does).  Never compared
+#: across rounds — the first-call separation principle applied to
+#: accounting.
 ACCOUNTING_SUFFIXES = ("_xla_gflops", "_bytes", "_overlap_frac",
-                       "_work_skew", "_ragged_penalty")
+                       "_work_skew", "_ragged_penalty", "_cadence")
 
 #: Rate-class suffixes: slope-derived achieved rates on the cached
 #: executable — the keys the sentinel compares and pages on.

@@ -1,0 +1,212 @@
+#!/usr/bin/env python
+"""Validate a ``--ckpt-demo`` report (ISSUE 20 CI satellite).
+
+Usage: ``python tools/check_ckpt.py report.json [...]`` (or ``-`` for
+stdin).  No jax import — this is the ``make ckpt-demo`` gate and runs
+anywhere.  Exit codes: 0 = valid, 1 = bound/structure violations,
+2 = SILENT LOSS (the alarm that must never be downgraded): a resume
+that diverged from the uninterrupted bits, a preemption that silently
+recomputed from scratch past a durable checkpoint, a preempt event the
+black box cannot pair with a resume or a typed refusal, a warm resume
+that recompiled, or a checkpoint ledger that does not add up.
+
+What a valid ckpt_demo report must prove (docs/RESILIENCE.md):
+
+  * **resumes are bit-exact** — every leg's resumed result fingerprint
+    equals the uninterrupted baseline's (the checker never re-runs the
+    sweep — it compares the report's own witnesses, so a doctored
+    fingerprint cannot pass);
+  * **no silent from-scratch** — a leg preempted AFTER a durable
+    checkpoint (``preempt_step >= 0``) must have ``resumed`` and
+    re-entered at exactly that superstep; recomputing from step 0 past
+    a durable token is the failure this tool exists to catch.
+    (Preempted BEFORE anything durable, ``preempt_step == -1``, a
+    from-scratch run is the CORRECT recovery — lost work is still
+    under one cadence window.);
+  * **lost work is bounded** — every re-executed segment spans at most
+    ``cadence`` supersteps, so a preemption can never cost more than
+    one cadence window;
+  * **every preemption pairs** — each ``ckpt_preempted`` event in the
+    embedded black box with a durable step is followed by a
+    ``ckpt_resumed`` event for the same run at the same step;
+  * **warm resumes are free** — zero segment compiles on every resume
+    (the segment executables are keyed on static bounds; re-entering
+    on the cadence grid reuses them);
+  * **the ledger adds up** — ``written == resumed + discarded + live``
+    re-derived from the reported counts, zero live tokens at demo end,
+    zero corruptions, and the black-box event counts agree with the
+    ledger (an event stream that drifts from its own ledger is how
+    silent loss hides).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+REQUIRED_LEGS = ("single_invert", "dist_solve", "lp_stream",
+                 "fleet_kill")
+
+
+def _check_leg(name: str, leg: dict, errs: list, loss: list) -> None:
+    if not leg.get("bit_match", False):
+        loss.append(
+            f"{name}: resumed fingerprint {leg.get('resume_fp')!r} "
+            f"diverged from the uninterrupted baseline "
+            f"{leg.get('baseline_fp')!r} — a resume must be bit-exact")
+    pre = leg.get("preempt_step", -1)
+    if pre is None:
+        pre = -1
+    if pre >= 0:
+        if not leg.get("resumed", False):
+            loss.append(
+                f"{name}: preempted with a durable checkpoint at "
+                f"superstep {pre} but the recovery did not resume — "
+                f"silent recompute-from-scratch")
+        elif int(leg.get("resume_start_step", -1)) != int(pre):
+            loss.append(
+                f"{name}: resume re-entered at superstep "
+                f"{leg.get('resume_start_step')} but the durable "
+                f"checkpoint was at {pre} — work silently lost or "
+                f"silently redone")
+    cadence = int(leg.get("cadence", 0))
+    if cadence < 1:
+        errs.append(f"{name}: missing/invalid cadence")
+    for seg in leg.get("resume_segments", []):
+        t0, t1 = int(seg[0]), int(seg[1])
+        if t1 - t0 > max(cadence, 1):
+            loss.append(
+                f"{name}: resumed segment ({t0}, {t1}) spans "
+                f"{t1 - t0} supersteps > cadence {cadence} — the "
+                f"lost-work bound is broken")
+    if leg.get("resume_compiles", 1) != 0:
+        loss.append(
+            f"{name}: {leg.get('resume_compiles')} segment compile(s) "
+            f"on the warm resume — the zero-compile pin broke")
+    if name == "lp_stream" and not leg.get("kkt_trail_match", False):
+        loss.append(
+            "lp_stream: the resumed run's kkt_hex iterate trail does "
+            "not bit-match the uninterrupted stream — the replay "
+            "silently diverged")
+    if name == "fleet_kill" and not leg.get("killed_replicas"):
+        errs.append(
+            "fleet_kill: no replica was killed mid-sweep — the "
+            "kill-path leg was vacuous")
+
+
+def _check_events(report: dict, errs: list, loss: list) -> None:
+    events = report.get("blackbox", {}).get("events", [])
+    if not events:
+        errs.append("no embedded black-box slice — preempt/resume "
+                    "pairing is unverifiable")
+        return
+    preempts = [e for e in events if e.get("kind") == "ckpt_preempted"]
+    resumes = [e for e in events if e.get("kind") == "ckpt_resumed"]
+    writes = [e for e in events if e.get("kind") == "ckpt_written"]
+    corrupts = [e for e in events if e.get("kind") == "ckpt_corrupt"]
+    if not preempts:
+        errs.append("no ckpt_preempted event in the black box — the "
+                    "demo never actually preempted anything")
+    for i, e in enumerate(events):
+        if e.get("kind") != "ckpt_preempted":
+            continue
+        step = int(e.get("step", -1))
+        if step < 0:
+            # Nothing durable: from-scratch recovery is correct.
+            continue
+        run = e.get("run_id")
+        paired = any(
+            r.get("run_id") == run and int(r.get("step", -2)) == step
+            and events.index(r) > i
+            for r in resumes)
+        if not paired:
+            loss.append(
+                f"preempt of run {run!r} at durable superstep {step} "
+                f"has no matching ckpt_resumed event — the checkpoint "
+                f"was silently ignored")
+    ledger = report.get("ledger", {})
+    for kind, evs in (("written", writes), ("resumed", resumes),
+                      ("corrupt", corrupts)):
+        if int(ledger.get(kind, -1)) != len(evs):
+            loss.append(
+                f"ledger counts {ledger.get(kind)} {kind} but the "
+                f"black box recorded {len(evs)} ckpt_{kind} event(s) "
+                f"— the ledger drifted from its own event stream")
+
+
+def check(report: dict) -> tuple[list[str], list[str]]:
+    """Return (violations, silent_loss_violations); both empty = OK."""
+    errs: list[str] = []
+    loss: list[str] = []
+    if report.get("metric") != "ckpt_demo":
+        return ([f"not a ckpt_demo report (metric="
+                 f"{report.get('metric')!r})"], [])
+    legs = report.get("legs", {})
+    for required in REQUIRED_LEGS:
+        if required not in legs:
+            errs.append(f"missing leg {required!r}")
+    for name, leg in legs.items():
+        _check_leg(name, leg, errs, loss)
+    _check_events(report, errs, loss)
+
+    ledger = report.get("ledger", {})
+    w = int(ledger.get("written", -1))
+    r = int(ledger.get("resumed", 0))
+    d = int(ledger.get("discarded", 0))
+    live = int(ledger.get("live", 0))
+    if w != r + d + live:
+        loss.append(f"checkpoint ledger does not add up: written {w} "
+                    f"!= resumed {r} + discarded {d} + live {live}")
+    if not ledger.get("invariant_holds", False):
+        loss.append("the store's own invariant flag is false")
+    if live != 0:
+        errs.append(f"{live} live checkpoint token(s) at demo end — "
+                    f"a run finished without consuming its token")
+    if int(ledger.get("corrupt", 0)) != 0:
+        errs.append(f"{ledger.get('corrupt')} corrupt checkpoint(s) "
+                    f"during the demo — quarantine fired unexpectedly")
+    if report.get("silent_loss", True):
+        loss.append("silent_loss flagged by the demo itself")
+    return errs, loss
+
+
+def main(argv) -> int:
+    if not argv:
+        print("usage: check_ckpt.py report.json [...]", file=sys.stderr)
+        return 1
+    rc = 0
+    for path in argv:
+        try:
+            if path == "-":
+                report = json.load(sys.stdin)
+            else:
+                with open(path) as f:
+                    report = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"FAIL {path}: unreadable report ({e})",
+                  file=sys.stderr)
+            rc = max(rc, 1)
+            continue
+        errs, loss = check(report)
+        for e in loss:
+            print(f"SILENT-LOSS {path}: {e}", file=sys.stderr)
+        for e in errs:
+            print(f"FAIL {path}: {e}", file=sys.stderr)
+        if loss:
+            rc = 2
+        elif errs:
+            rc = max(rc, 1)
+        else:
+            legs = report["legs"]
+            resumes = sum(1 for v in legs.values() if v.get("resumed"))
+            print(f"OK {path}: {len(legs)} legs bit-matched at n="
+                  f"{report['n']} cadence {report['cadence']} "
+                  f"({resumes} resume(s), 0 resume compiles), ledger "
+                  f"{report['ledger']['written']} written = "
+                  f"{report['ledger']['resumed']} resumed + "
+                  f"{report['ledger']['discarded']} discarded + 0 live")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

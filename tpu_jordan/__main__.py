@@ -448,6 +448,34 @@ def _main(argv, state) -> int:
                          "LP/QP dimension, m the block-size hint; "
                          "--chaos-seed seeds instances and faults; "
                          "requires --dtype float64")
+    ap.add_argument("--ckpt-demo", action="store_true",
+                    help="run the preemption-safety acceptance demo "
+                         "(tpu_jordan.resilience.ckpt_demo; ISSUE 20, "
+                         "docs/RESILIENCE.md): four legs over one "
+                         "checkpoint store — a single-device invert "
+                         "and a 1D sharded solve each preempted "
+                         "mid-sweep by the seeded preempt fault and "
+                         "resumed from the last durable superstep "
+                         "checkpoint, a resumable LP stream replayed "
+                         "to its identical kkt fingerprint trail, and "
+                         "a fleet leg whose serving replica is KILLED "
+                         "mid-sweep (the router re-queues with a "
+                         "ckpt_resume hop) — every resume must "
+                         "bit-match the uninterrupted run with zero "
+                         "segment compiles, lost work bounded by the "
+                         "cadence, and the store ledger must add up "
+                         "(written == resumed + discarded + live); "
+                         "prints ONE JSON line (exit 2 = silent loss; "
+                         "tools/check_ckpt.py validates).  n is the "
+                         "problem size, m the block size; --chaos-seed "
+                         "seeds fixtures and the preempt schedule; "
+                         "runs on a forced 8-device virtual CPU mesh "
+                         "when needed")
+    ap.add_argument("--ckpt-dir", default=None, metavar="PATH",
+                    help="--ckpt-demo: directory for the checkpoint "
+                         "store (default: a temp dir deleted after); "
+                         "pass a path to inspect the checkpoint files "
+                         "and ledger.json afterwards")
     ap.add_argument("--comm-report", default=None, metavar="PATH",
                     help="write the process-wide communication "
                          "snapshot (the last distributed solve's "
@@ -614,6 +642,10 @@ def _main(argv, state) -> int:
             raise UsageError("--rank/--updates apply to --update-demo "
                              "(the resident-inverse update acceptance "
                              "run)")
+        if args.ckpt_dir is not None and not args.ckpt_demo:
+            raise UsageError("--ckpt-dir applies to --ckpt-demo (the "
+                             "preemption-safety acceptance run's "
+                             "checkpoint store location)")
         if (args.generator == "crand"
                 and jnp.dtype(args.dtype).kind != "c"):
             raise UsageError("--generator crand is complex-valued; a "
@@ -907,6 +939,86 @@ def _main(argv, state) -> int:
                       f"xla_unreconciled={report['xla_unreconciled']}, "
                       f"verdict_wrong={report['verdict_wrong']}",
                       file=sys.stderr)
+                return 2
+            return 0
+        if args.ckpt_demo:
+            # Checkpoint demo (ISSUE 20): the work-demo restriction
+            # shape (fixed internal legs, deterministic fixtures and
+            # preempt schedules) and the same 0/1/2 taxonomy — exit 2
+            # IS the silent-loss alarm (a divergent resume, a durable
+            # checkpoint silently ignored, or a ledger that does not
+            # add up).
+            if (args.serve_demo or args.chaos_demo or args.fleet_demo
+                    or args.numerics_demo or args.update_demo
+                    or args.capacity_demo or args.comm_demo
+                    or args.work_demo or args.lp_demo):
+                raise UsageError("--ckpt-demo, --lp-demo, --work-demo, "
+                                 "--comm-demo, --capacity-demo, "
+                                 "--update-demo, --fleet-demo, "
+                                 "--chaos-demo, --serve-demo and "
+                                 "--numerics-demo are distinct modes; "
+                                 "pick one")
+            if args.file is not None or args.workers != 1 or not args.gather:
+                raise UsageError(
+                    "--ckpt-demo builds its own 1D mesh and fleet "
+                    "(forced virtual CPU devices when needed); file "
+                    "input, --workers and --no-gather do not apply")
+            if args.batch > 1 or args.tune or args.group != 0:
+                raise UsageError("--ckpt-demo takes no "
+                                 "--batch/--tune/--group")
+            if args.engine != "auto" or args.refine:
+                raise UsageError("--ckpt-demo runs a fixed engine-leg "
+                                 "set (fori single-device and 1D "
+                                 "sharded); --engine/--refine do not "
+                                 "apply")
+            if args.workload != "invert":
+                raise UsageError("--ckpt-demo checkpoints both "
+                                 "workloads on its own legs; "
+                                 "--workload does not apply")
+            if args.numerics != "off":
+                raise UsageError("--ckpt-demo's bit-match semantics "
+                                 "are pinned; --numerics does not "
+                                 "apply")
+            if args.slo_report or args.plan_cache is not None:
+                raise UsageError("--slo-report/--plan-cache do not "
+                                 "apply to --ckpt-demo")
+            if (args.serve_requests != 64 or args.batch_cap != 8
+                    or args.max_wait_ms != 2.0):
+                raise UsageError("--ckpt-demo runs checkpointed "
+                                 "sweeps, not the batched service; "
+                                 "--serve-requests/--batch-cap/"
+                                 "--max-wait-ms do not apply")
+            if (args.replicas != 3 or args.kills != 2
+                    or args.scaling_floor is not None):
+                raise UsageError("--replicas/--kills/--scaling-floor "
+                                 "are --fleet-demo/--update-demo "
+                                 "flags; --ckpt-demo's kill leg is "
+                                 "fixed at one kill on a 2-replica "
+                                 "fleet")
+            if jnp.dtype(args.dtype).kind == "c":
+                raise UsageError("--ckpt-demo checkpoints the "
+                                 "DISTRIBUTED engines and complex "
+                                 "dtypes run single-device; use a "
+                                 "real dtype")
+            import json as _json
+
+            from .resilience.ckpt_demo import ckpt_demo
+
+            report = ckpt_demo(n=args.n, block_size=args.m,
+                               seed=args.chaos_seed,
+                               ckpt_dir=args.ckpt_dir)
+            if args.quiet:
+                # The checker needs the legs, ledger and blackbox
+                # slice; nothing to trim beyond per-event noise.
+                report["blackbox"]["events"] = [
+                    e for e in report["blackbox"]["events"]
+                    if str(e.get("kind", "")).startswith(
+                        ("ckpt_", "fault_", "replica_"))]
+            print(_json.dumps(report))
+            if report["silent_loss"]:
+                print(f"silent checkpoint loss: legs="
+                      f"{ {k: v['bit_match'] for k, v in report['legs'].items()} }, "
+                      f"ledger={report['ledger']}", file=sys.stderr)
                 return 2
             return 0
         if args.capacity_demo:

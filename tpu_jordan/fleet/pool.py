@@ -487,26 +487,37 @@ class JordanFleet:
                 "resident state unchanged)")
         return res
 
-    def submit_solve(self, a, b, deadline_ms: float | None = None):
+    def submit_solve(self, a, b, deadline_ms: float | None = None,
+                     ckpt=None):
         """Route one solve request X = A⁻¹B through the fleet
         (ISSUE 17): same router front door as ``submit`` — bucket
         affinity, breaker shedding, death re-queue — resolving to an
         ``InvertResult`` with ``workload="solve"`` and ``solution`` =
         the (n, k) X (no inverse is ever formed).  This is the lane the
         LP/QP driver's per-iteration verification solves ride, so the
-        fleet sees the full correlated invert + update + solve mix."""
+        fleet sees the full correlated invert + update + solve mix.
+
+        ``ckpt`` (ISSUE 20): a checkpoint spec dict (``store``,
+        ``run_id``, ``cadence``, optional ``engine``/``mesh``/
+        ``block_size``) routing the request down the CHECKPOINTED
+        superstep path — a replica killed mid-sweep loses at most one
+        cadence window of supersteps; the re-queued hop resumes from
+        the last durable checkpoint (``ckpt_resume`` journey hop) and
+        bit-matches the uninterrupted run."""
         if deadline_ms is None:
             deadline_ms = self._svc_kw["default_deadline_ms"]
         return self.router.submit_solve(a, b, self._svc_kw["dtype"],
-                                        deadline_ms=deadline_ms)
+                                        deadline_ms=deadline_ms,
+                                        ckpt=ckpt)
 
     def solve_system(self, a, b, timeout: float | None = None,
-                     deadline_ms: float | None = None):
+                     deadline_ms: float | None = None, ckpt=None):
         """Synchronous ``submit_solve`` + wait; raises
         ``SingularMatrixError`` on a singular A (typed — the solve
-        lanes' per-element flag)."""
-        res = self.submit_solve(a, b,
-                                deadline_ms=deadline_ms).result(timeout)
+        lanes' per-element flag).  ``ckpt`` routes the checkpointed
+        superstep path (see :meth:`submit_solve`)."""
+        res = self.submit_solve(a, b, deadline_ms=deadline_ms,
+                                ckpt=ckpt).result(timeout)
         if res.singular:
             from ..driver import SingularMatrixError
 

@@ -176,6 +176,75 @@ class Replica:
         return self.service.submit(a, b, deadline_ms=deadline_ms,
                                    _ctx=ctx)
 
+    def submit_solve_ckpt(self, a, b, ckpt, resume_from=None, ctx=None):
+        """Route one CHECKPOINTED distributed solve (ISSUE 20) onto
+        this replica.  Unlike the batched lanes, the superstep sweep is
+        a long-lived multi-segment job, so it runs on a dedicated
+        per-request thread OUTSIDE the micro-batcher, with the runner's
+        ``abort=`` hook watching THIS replica's lifecycle: a kill
+        mid-sweep surfaces :class:`ReplicaKilledError` at the next
+        segment boundary — AFTER that boundary's checkpoint is durable
+        — so the router re-queues the request and the next replica
+        resumes from the store instead of recomputing (lost work is
+        bounded by the cadence).  ``ckpt`` is the fleet checkpoint spec
+        dict: ``store``, ``run_id``, ``cadence``, and optionally
+        ``engine`` / ``mesh`` / ``block_size``."""
+        self._admit(ctx)
+        from concurrent.futures import Future
+
+        import numpy as np
+
+        from ..resilience.checkpoint import checkpointed_solve
+
+        fut = Future()
+        fut.set_running_or_notify_cancel()
+
+        def abort():
+            if self.state != READY:
+                return ReplicaKilledError(
+                    f"replica {self.name} is {self.state}: died under "
+                    f"a checkpointed solve — resume from the last "
+                    f"durable superstep")
+            return None
+
+        def run():
+            try:
+                from ..serve.batcher import InvertResult
+
+                t0 = time.monotonic()
+                x, singular, info = checkpointed_solve(
+                    np.asarray(a), np.asarray(b),
+                    ckpt.get("block_size"),
+                    store=ckpt["store"], run_id=ckpt["run_id"],
+                    cadence=int(ckpt["cadence"]),
+                    engine=ckpt.get("engine", "unrolled"),
+                    mesh=ckpt.get("mesh"),
+                    resume_from=resume_from, abort=abort)
+                xh = np.asarray(x)
+                ah = np.asarray(a, xh.dtype)
+                bh = np.asarray(b, xh.dtype)
+                if bh.ndim == 1:
+                    bh = bh[:, None]
+                denom = float(np.linalg.norm(bh)) or 1.0
+                res = InvertResult(
+                    inverse=None, n=int(ah.shape[0]),
+                    bucket_n=int(ah.shape[0]),
+                    singular=bool(singular), kappa=float("nan"),
+                    rel_residual=float(
+                        np.linalg.norm(ah @ xh - bh)) / denom,
+                    queue_seconds=0.0,
+                    execute_seconds=time.monotonic() - t0,
+                    batch_occupancy=1, workload="solve", solution=x)
+                res.ckpt_info = info
+                fut.set_result(res)
+            except BaseException as e:  # noqa: BLE001 — future carries it
+                fut.set_exception(e)
+
+        threading.Thread(
+            target=run, daemon=True,
+            name=f"tpu-jordan-ckpt-{self.name}").start()
+        return fut
+
     def warmup(self, shapes, update_shapes=(), solve_shapes=()) -> dict:
         return self.service.warmup(shapes, update_shapes=update_shapes,
                                    solve_shapes=solve_shapes)

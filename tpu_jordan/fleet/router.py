@@ -97,6 +97,14 @@ class _FleetRequest:
     v: np.ndarray = None
     b: np.ndarray = None                 # (n, k) RHS block (solve kind)
     rhs: int = 0                         # solve lane k-bucket
+    #: ISSUE 20 — checkpoint spec for ``kind="ckpt_solve"``: a dict
+    #: with ``store`` (:class:`~..resilience.checkpoint.CheckpointStore`),
+    #: ``run_id``, ``cadence``, and optional ``engine``/``mesh``/
+    #: ``block_size``.  A death/preemption re-queue hop probes the
+    #: store: a live token means the next replica RESUMES from the
+    #: last durable superstep (``ckpt_resume`` journey hop) instead of
+    #: recomputing — lost work bounded by the cadence.
+    ckpt: object = None
 
     def remaining_ms(self, now: float) -> float | None:
         if self.t_deadline is None:
@@ -115,6 +123,11 @@ class _FleetRequest:
             return f"update:{self.bucket}:k{k_bucket_for(self.u.shape[1])}"
         if self.kind == "solve":
             return f"solve:{self.bucket}:k{self.rhs}"
+        if self.kind == "ckpt_solve":
+            # Checkpointed solves bypass the batched lanes (no lane
+            # breaker exists for them); the distinct key means an
+            # unknown breaker, which always allows.
+            return f"ckpt:{self.bucket}"
         return self.bucket
 
     @property
@@ -231,12 +244,21 @@ class Router:
         return outer
 
     def submit_solve(self, a, b, dtype,
-                     deadline_ms: float | None = None) -> Future:
+                     deadline_ms: float | None = None,
+                     ckpt=None) -> Future:
         """Route one solve request X = A⁻¹B (ISSUE 17): the same front
         door as ``submit`` — one fleet-level journey
         (``workload="solve"``), bucket-affinity candidate order, typed
         backpressure, death re-queue.  The replicas' solve lanes never
-        form an inverse (the ISSUE 11 contract)."""
+        form an inverse (the ISSUE 11 contract).
+
+        ``ckpt`` (ISSUE 20) switches the request to the CHECKPOINTED
+        superstep path: the serving replica runs the sweep with
+        cadence-boundary checkpoints into ``ckpt["store"]``, and a
+        replica death (or seeded preemption) mid-sweep re-queues here
+        with a RESUME — the next replica re-enters at the last durable
+        superstep (``ckpt_resume`` journey hop), never recomputing from
+        scratch."""
         from ..serve.executors import rhs_bucket_for
 
         a = np.asarray(a, dtype)
@@ -260,7 +282,8 @@ class Router:
                         else now + float(deadline_ms) / 1e3),
             t_submit=now,
             ctx=self.pool.journey.new(n, bucket, workload="solve"),
-            kind="solve", b=b, rhs=rhs_bucket_for(b.shape[1]))
+            kind=("ckpt_solve" if ckpt is not None else "solve"),
+            b=b, rhs=rhs_bucket_for(b.shape[1]), ckpt=ckpt)
         self.pool._record_bucket(req.bucket)
         self.pool._account_submitted()
         try:
@@ -337,6 +360,24 @@ class Router:
                             deadline_ms=req.remaining_ms(
                                 time.monotonic()),
                             ctx=req.ctx)
+                    elif req.kind == "ckpt_solve":
+                        # Resume probe (ISSUE 20): a live token in the
+                        # store means an earlier hop wrote a durable
+                        # checkpoint before dying — this replica
+                        # RESUMES it.  The hop is recorded before the
+                        # replica sees the request, so the journey
+                        # reads route -> ckpt_resume -> (segments).
+                        resume = None
+                        if req.ckpt["store"].has_live(
+                                req.ckpt["run_id"]):
+                            resume = req.ckpt["run_id"]
+                            req.hop("ckpt_resume",
+                                    replica=replica.name,
+                                    run_id=resume,
+                                    attempt=req.attempts)
+                        inner = replica.submit_solve_ckpt(
+                            req.a, req.b, req.ckpt,
+                            resume_from=resume, ctx=req.ctx)
                     else:
                         inner = replica.submit(
                             req.a,
@@ -419,7 +460,18 @@ class Router:
                     getattr(res, "singular", False)))
             req.outer.set_result(res)
             return
-        if (isinstance(exc, (ReplicaKilledError, ServiceClosedError))
+        if req.kind == "ckpt_solve":
+            # A seeded preemption mid checkpointed sweep is re-queue
+            # class too (ISSUE 20): the chip went away but the replica
+            # did not — the re-dispatch finds the live token and
+            # resumes from the last durable superstep.
+            from ..resilience.checkpoint import PreemptedError
+
+            death = (ReplicaKilledError, ServiceClosedError,
+                     PreemptedError)
+        else:
+            death = (ReplicaKilledError, ServiceClosedError)
+        if (isinstance(exc, death)
                 and not self.pool.closing
                 and req.attempts < self.max_reroutes):
             req.attempts += 1
@@ -435,7 +487,7 @@ class Router:
                     req.ctx.close("error", error=type(e).__name__)
                 req.outer.set_exception(e)
             return
-        if isinstance(exc, (ReplicaKilledError, ServiceClosedError)):
+        if isinstance(exc, death):
             # A death-class failure the router did NOT re-queue: the
             # journey must still explain why (the checker's no-causal-
             # gap rule) — budget spent, or the fleet is closing.

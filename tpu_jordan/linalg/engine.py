@@ -290,55 +290,141 @@ def block_jordan_solve_fori(
     N = Nr * m
     A0 = pad_with_identity(a, N)
     X0 = jnp.zeros((N, k), dtype).at[:n].set(b)
-    row_blocks = jnp.arange(N) // m
-    blk = jnp.arange(Nr)
 
     def body(t, carry):
         A, X, singular = carry
-        tt = jnp.asarray(t, jnp.int32)
-        z = jnp.int32(0)
-        lo = tt * m
-        if spd:
-            C = lax.dynamic_slice(A, (lo, lo), (m, m))
-            invs, sing = batched_block_inverse(C[None], None, eps)
-            singular = singular | sing[0]
-            H = invs[0]
-            rows_p_A = lax.dynamic_slice(A, (lo, z), (m, N))
-            rows_p_X = lax.dynamic_slice(X, (lo, z), (m, k))
-        else:
-            cands = lax.dynamic_slice(A, (z, lo), (N, m)).reshape(
-                Nr, m, m)
-            invs, sing = batched_block_inverse(cands, None, eps)
-            inv_norms = block_inf_norms(invs)
-            valid = (blk >= tt) & ~sing
-            key = jnp.where(valid, inv_norms,
-                            jnp.asarray(jnp.inf, inv_norms.dtype))
-            rel = jnp.asarray(jnp.argmin(key), jnp.int32)  # ABSOLUTE
-            singular = singular | ~jnp.any(valid)
-            H = jnp.take(invs, rel, axis=0).astype(dtype)
-            piv_row = rel * m
-            rows_t_A = lax.dynamic_slice(A, (lo, z), (m, N))
-            rows_t_X = lax.dynamic_slice(X, (lo, z), (m, k))
-            rows_p_A = lax.dynamic_slice(A, (piv_row, z), (m, N))
-            rows_p_X = lax.dynamic_slice(X, (piv_row, z), (m, k))
-            A = lax.dynamic_update_slice(A, rows_t_A, (piv_row, z))
-            X = lax.dynamic_update_slice(X, rows_t_X, (piv_row, z))
-
-        prow_A = jnp.matmul(H, rows_p_A, precision=precision)
-        prow_X = jnp.matmul(H, rows_p_X, precision=precision)
-
-        E = lax.dynamic_slice(A, (z, lo), (N, m))
-        E = jnp.where((row_blocks == tt)[:, None],
-                      jnp.asarray(0, dtype), E)
-        A = A - jnp.matmul(E, prow_A, precision=precision)
-        X = X - jnp.matmul(E, prow_X, precision=precision)
-        A = lax.dynamic_update_slice(A, prow_A, (lo, z))
-        X = lax.dynamic_update_slice(X, prow_X, (lo, z))
-        return A, X, singular
+        return _solve_fori_step(t, A, X, singular, Nr=Nr, m=m, k=k,
+                                eps=eps, precision=precision, spd=spd)
 
     _, X, singular = lax.fori_loop(0, Nr, body,
                                    (A0, X0, jnp.asarray(False)))
     return X[:n], singular
+
+
+def _solve_fori_step(t, A, X, singular, *, Nr: int, m: int, k: int,
+                     eps, precision, spd: bool):
+    """One traced-``t`` solve super-step on the full (N, N) + (N, k)
+    working set — the fori_loop body of :func:`block_jordan_solve_fori`,
+    factored to module level VERBATIM (same ops, same bits) so the
+    checkpointed segment runner (ISSUE 20, resilience/checkpoint.py)
+    re-enters the SAME arithmetic at an arbitrary step."""
+    N = Nr * m
+    dtype = A.dtype
+    row_blocks = jnp.arange(N) // m
+    blk = jnp.arange(Nr)
+    tt = jnp.asarray(t, jnp.int32)
+    z = jnp.int32(0)
+    lo = tt * m
+    if spd:
+        C = lax.dynamic_slice(A, (lo, lo), (m, m))
+        invs, sing = batched_block_inverse(C[None], None, eps)
+        singular = singular | sing[0]
+        H = invs[0]
+        rows_p_A = lax.dynamic_slice(A, (lo, z), (m, N))
+        rows_p_X = lax.dynamic_slice(X, (lo, z), (m, k))
+    else:
+        cands = lax.dynamic_slice(A, (z, lo), (N, m)).reshape(
+            Nr, m, m)
+        invs, sing = batched_block_inverse(cands, None, eps)
+        inv_norms = block_inf_norms(invs)
+        valid = (blk >= tt) & ~sing
+        key = jnp.where(valid, inv_norms,
+                        jnp.asarray(jnp.inf, inv_norms.dtype))
+        rel = jnp.asarray(jnp.argmin(key), jnp.int32)  # ABSOLUTE
+        singular = singular | ~jnp.any(valid)
+        H = jnp.take(invs, rel, axis=0).astype(dtype)
+        piv_row = rel * m
+        rows_t_A = lax.dynamic_slice(A, (lo, z), (m, N))
+        rows_t_X = lax.dynamic_slice(X, (lo, z), (m, k))
+        rows_p_A = lax.dynamic_slice(A, (piv_row, z), (m, N))
+        rows_p_X = lax.dynamic_slice(X, (piv_row, z), (m, k))
+        A = lax.dynamic_update_slice(A, rows_t_A, (piv_row, z))
+        X = lax.dynamic_update_slice(X, rows_t_X, (piv_row, z))
+
+    prow_A = jnp.matmul(H, rows_p_A, precision=precision)
+    prow_X = jnp.matmul(H, rows_p_X, precision=precision)
+
+    E = lax.dynamic_slice(A, (z, lo), (N, m))
+    E = jnp.where((row_blocks == tt)[:, None],
+                  jnp.asarray(0, dtype), E)
+    A = A - jnp.matmul(E, prow_A, precision=precision)
+    X = X - jnp.matmul(E, prow_X, precision=precision)
+    A = lax.dynamic_update_slice(A, prow_A, (lo, z))
+    X = lax.dynamic_update_slice(X, prow_X, (lo, z))
+    return A, X, singular
+
+
+# ---------------------------------------------------------------------
+# Checkpointed segment executables (ISSUE 20).  A checkpointed solve
+# runs supersteps [t0, t1) as ONE jitted executable per segment, with
+# the (A, X, singular) working set round-tripped to host between
+# segments (byte-exact — np.asarray of f32/f64 is lossless).  Each
+# segment runs the SAME per-step arithmetic as the monolithic engines
+# above, so the concatenation of segments bit-matches the
+# uninterrupted run (pinned by tests/test_checkpoint.py) — the
+# reordered-arithmetic discipline of the ISSUE 16 lookahead pin.
+# ---------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("t0", "t1", "Nr", "m", "k", "eps",
+                                   "precision"))
+def solve_segment(A, X, singular, *, t0: int, t1: int, Nr: int, m: int,
+                  k: int, eps, precision=lax.Precision.HIGHEST):
+    """Supersteps [t0, t1) of the UNROLLED solve on the identity-padded
+    (N, N) + zero-padded (N, k) working set: the exact loop body of
+    :func:`block_jordan_solve` (static shrinking live-column window),
+    restricted to a static step range.  Pivoting path only — the SPD
+    fast path is a typed checkpoint refusal (resilience/checkpoint.py:
+    no probe means no pivot record to snapshot, and the promise-based
+    contract has no singularity evidence to carry across a resume)."""
+    N = Nr * m
+    dtype = A.dtype
+    row_blocks = jnp.arange(N) // m
+    for t in range(t0, t1):
+        lo = t * m
+        cands = A[lo:, lo:lo + m].reshape(Nr - t, m, m)
+        invs, sing = batched_block_inverse(cands, None, eps)
+        inv_norms = block_inf_norms(invs)
+        valid = ~sing
+        key = jnp.where(valid, inv_norms,
+                        jnp.asarray(jnp.inf, inv_norms.dtype))
+        rel = jnp.argmin(key)
+        singular = singular | ~jnp.any(valid)
+        H = jnp.take(invs, rel, axis=0).astype(dtype)
+        piv_row = lo + rel * m
+        rows_t_A = A[lo:lo + m, lo:]
+        rows_t_X = X[lo:lo + m]
+        rows_p_A = lax.dynamic_slice(A, (piv_row, lo), (m, N - lo))
+        rows_p_X = lax.dynamic_slice(X, (piv_row, 0), (m, k))
+        A = lax.dynamic_update_slice(A, rows_t_A, (piv_row, lo))
+        X = lax.dynamic_update_slice(X, rows_t_X, (piv_row, 0))
+        prow_A = jnp.matmul(H, rows_p_A, precision=precision)
+        prow_X = jnp.matmul(H, rows_p_X, precision=precision)
+        E = A[:, lo:lo + m]
+        E = jnp.where((row_blocks == t)[:, None],
+                      jnp.asarray(0, dtype), E)
+        A = A.at[:, lo:].add(-jnp.matmul(E, prow_A, precision=precision))
+        X = X - jnp.matmul(E, prow_X, precision=precision)
+        A = A.at[lo:lo + m, lo:].set(prow_A)
+        X = X.at[lo:lo + m].set(prow_X)
+    return A, X, singular
+
+
+@partial(jax.jit, static_argnames=("t0", "t1", "Nr", "m", "k", "eps",
+                                   "precision"))
+def solve_segment_fori(A, X, singular, *, t0: int, t1: int, Nr: int,
+                       m: int, k: int, eps,
+                       precision=lax.Precision.HIGHEST):
+    """Supersteps [t0, t1) of the fori solve engine: a ``fori_loop``
+    over the shared :func:`_solve_fori_step` body — the same executable
+    shape for every segment length, the same bits as the monolithic
+    fori engine's steps."""
+    def body(t, carry):
+        A, X, singular = carry
+        return _solve_fori_step(t, A, X, singular, Nr=Nr, m=m, k=k,
+                                eps=eps, precision=precision, spd=False)
+
+    return lax.fori_loop(t0, t1, body, (A, X, singular))
 
 
 def solve_batch_metrics(a, x, b, n_real=None,

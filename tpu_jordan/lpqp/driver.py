@@ -38,6 +38,7 @@ chaos leg of the demo can bit-compare against a fault-free replay.
 from __future__ import annotations
 
 import hashlib
+import json
 from dataclasses import dataclass, field
 
 import numpy as np
@@ -205,30 +206,173 @@ class _FleetLane:
     def gate(self, n: int) -> float:
         return kkt_gate(self.policy, n, self.kappa, self.dtype)
 
+    # ---- checkpoint/resume (ISSUE 20) -------------------------------
+
+    def ckpt_arrays(self) -> tuple:
+        """The lane's exact resident-handle bytes + counters, the
+        stream-checkpoint payload: restoring these and replaying from
+        the same iteration reproduces every later iterate bit for bit
+        (the driver loops are pure functions of (instance, lane
+        state))."""
+        st = self.fleet.handles.get(self.handle.handle_id)
+        with st.lock:
+            arrays = {"handle_a": np.asarray(st.a).copy(),
+                      "handle_inverse": np.asarray(st.inverse).copy()}
+            meta = {"handle_id": st.handle_id, "n": st.n,
+                    "bucket_n": st.bucket_n, "dtype": st.dtype,
+                    "version": st.version, "drift": float(st.drift),
+                    "updates_applied": st.updates_applied,
+                    "reinverts": st.reinverts,
+                    "kappa": float(st.kappa),
+                    "rel_residual": float(st.rel_residual),
+                    "nbytes": int(st.nbytes),
+                    "pinned": bool(st.pinned)}
+        meta.update(updates=self.updates, solves=self.solves,
+                    ledger=dict(self.ledger))
+        return arrays, meta
+
+    @classmethod
+    def restore(cls, fleet, policy, arrays: dict, meta: dict):
+        """Re-install the checkpointed resident handle (same
+        handle_id — ``HandleStore.create`` replaces any survivor, so a
+        post-kill stale resident can never leak into the replay) and
+        rebuild the lane counters exactly as written."""
+        from ..serve.handles import HandleState
+
+        lane = cls.__new__(cls)
+        lane.fleet = fleet
+        lane.policy = (policy if policy is not None
+                       else getattr(fleet, "policy", None))
+        state = HandleState(
+            handle_id=meta["handle_id"], n=meta["n"],
+            bucket_n=meta["bucket_n"], dtype=meta["dtype"],
+            a=np.asarray(arrays["handle_a"]),
+            inverse=np.asarray(arrays["handle_inverse"]),
+            version=meta["version"], drift=meta["drift"],
+            updates_applied=meta["updates_applied"],
+            reinverts=meta["reinverts"], kappa=meta["kappa"],
+            rel_residual=meta["rel_residual"], nbytes=meta["nbytes"],
+            pinned=meta.get("pinned", False))
+        lane.handle = fleet.handles.create(state)
+        lane.dtype = np.dtype(meta["dtype"])
+        n = meta["n"]
+        lane.inv = np.asarray(arrays["handle_inverse"],
+                              np.float64)[:n, :n]
+        lane.kappa = max(float(meta["kappa"]), 1.0)
+        lane.updates = int(meta["updates"])
+        lane.solves = int(meta["solves"])
+        lane.ledger = {k: int(v) for k, v in meta["ledger"].items()}
+        return lane
+
+
+# ---------------------------------------------------------------------
+# Stream checkpointing (ISSUE 20): the optimizer loops persist the
+# resident-handle bytes + the iterate audit every ``ckpt_every``
+# iterations; ``resume=True`` re-enters at the stored iteration and
+# replays to an IDENTICAL kkt_hex trail and final fingerprint (the
+# loops are deterministic functions of (instance, lane state), so the
+# restored exact bytes pin everything downstream).
+# ---------------------------------------------------------------------
+
+
+def _opt_ckpt_key(kind: str, prob, run_id: str, lane_dtype,
+                  max_iters: int, cadence: int):
+    from ..resilience.checkpoint import CheckpointKey
+
+    n = getattr(prob, "n", 0)
+    m = getattr(prob, "m", n)
+    return CheckpointKey(
+        run_id=run_id, workload=kind,
+        engine="simplex" if kind == "lp" else "active-set",
+        topology="fleet", n=int(n), m=int(m), Nr=int(max_iters),
+        dtype=np.dtype(lane_dtype).name, nrhs=0, cadence=int(cadence))
+
+
+def _opt_ckpt_write(store, key, it: int, lane, report,
+                    extra: dict) -> None:
+    arrays, handle_meta = lane.ckpt_arrays()
+    meta = {"it": int(it), "handle": handle_meta,
+            "iterates": report.iterates,
+            "iterations": report.iterations}
+    meta.update(extra)
+    arrays["meta"] = np.frombuffer(
+        json.dumps(meta, sort_keys=True).encode(), np.uint8).copy()
+    store.write(key, it, arrays)
+
+
+def _opt_ckpt_resume(store, key, fleet, policy):
+    step, arrays = store.resume(key)
+    meta = json.loads(bytes(arrays["meta"].tobytes()).decode())
+    if int(meta["it"]) != step:
+        from ..resilience.checkpoint import CheckpointMismatchError
+
+        raise CheckpointMismatchError(
+            f"stream checkpoint step {step} disagrees with its own "
+            f"audit ({meta['it']}); refused")
+    lane = _FleetLane.restore(fleet, policy, arrays, meta["handle"])
+    return step, meta, lane
+
+
+def _opt_preempt(run_id: str, durable: int | None) -> None:
+    from ..resilience.checkpoint import _fire_preempt
+
+    _fire_preempt(run_id, durable)
+
 
 def solve_lp(prob: LPInstance, fleet, policy=None,
              max_iters: int | None = None,
-             solve_every: int = 1) -> OptimizeReport:
+             solve_every: int = 1, ckpt_store=None,
+             ckpt_every: int = 0, run_id: str | None = None,
+             resume: bool = False) -> OptimizeReport:
     """Revised simplex over the fleet (see module docstring).  The
     basis inverse lives in a resident fleet handle seeded from the
     slack basis (B = I); each Bland pivot is one rank-1
     ``fleet.update``; every ``solve_every``-th iteration cross-checks
     x_B = B⁻¹b against a fresh ``fleet.solve_system(B, b)``.
     Converged means: no entering column remains AND the (x, y) pair's
-    KKT residual passes the solver's own eps·n·κ gate."""
+    KKT residual passes the solver's own eps·n·κ gate.
+
+    ``ckpt_store``/``ckpt_every`` persist the resident-handle bytes +
+    iterate audit every k iterations (ISSUE 20); ``resume=True``
+    re-enters at the stored iteration and replays to an identical
+    ``kkt_hex`` trail and fingerprint.  The ``preempt`` fault point
+    fires at every iteration top when a plan is active."""
     m, a, b, c = prob.m, np.asarray(prob.a, np.float64), prob.b, prob.c
     b = np.asarray(b, np.float64)
     c = np.asarray(c, np.float64)
     if max_iters is None:
         max_iters = 6 * m
+    if run_id is None:
+        run_id = f"lp:{prob.name}"
     basis = list(prob.basis0)
-    lane = _FleetLane(fleet, np.eye(m, dtype=a.dtype), policy)
+    start_it, durable = 0, None
+    ckpt_key = None
+    stored_iterates: list = []
+    if resume:
+        if ckpt_store is None:
+            raise ValueError("resume=True needs ckpt_store")
+        dt = (np.dtype(fleet._svc_kw["dtype"])
+              if hasattr(fleet, "_svc_kw") else np.dtype(np.float32))
+        ckpt_key = _opt_ckpt_key("lp", prob, run_id, dt, max_iters,
+                                 max(1, ckpt_every))
+        start_it, meta, lane = _opt_ckpt_resume(ckpt_store, ckpt_key,
+                                                fleet, policy)
+        durable = start_it
+        basis = [int(i) for i in meta["basis"]]
+        stored_iterates = meta["iterates"]
+    else:
+        lane = _FleetLane(fleet, np.eye(m, dtype=a.dtype), policy)
+    if ckpt_store is not None and ckpt_key is None:
+        ckpt_key = _opt_ckpt_key("lp", prob, run_id, lane.dtype,
+                                 max_iters, max(1, ckpt_every))
     report = OptimizeReport(
-        kind="lp", name=prob.name, converged=False, iterations=0,
-        objective=float("nan"), objective_ref=prob.obj_star,
-        kkt_rel_final=float("nan"), kkt_threshold=float("nan"),
-        kappa=lane.kappa, updates=0, solves=0, ledger=lane.ledger,
+        kind="lp", name=prob.name, converged=False,
+        iterations=start_it, objective=float("nan"),
+        objective_ref=prob.obj_star, kkt_rel_final=float("nan"),
+        kkt_threshold=float("nan"), kappa=lane.kappa,
+        updates=lane.updates, solves=lane.solves, ledger=lane.ledger,
         handle_id=lane.handle.handle_id)
+    report.iterates = list(stored_iterates)
     # Dtype/κ-aware pricing tolerance: reduced costs computed through
     # the fleet inverse carry ~eps·m·κ relative noise, so Bland's
     # entering test must not chase signs below that floor.
@@ -236,7 +380,13 @@ def solve_lp(prob: LPInstance, fleet, policy=None,
     c_inf = float(np.max(np.abs(c)))
     x = np.zeros(prob.n)
     kkt_rel, thr, optimal = float("nan"), float("nan"), False
-    for it in range(max_iters):
+    for it in range(start_it, max_iters):
+        if (ckpt_store is not None and ckpt_every > 0 and it > start_it
+                and (it % ckpt_every) == 0):
+            _opt_ckpt_write(ckpt_store, ckpt_key, it, lane, report,
+                            {"basis": [int(i) for i in basis]})
+            durable = it
+        _opt_preempt(run_id, durable)
         red_tol = (1.0 + c_inf) * max(1e-9, 10.0 * eps * m * lane.kappa)
         report.iterations = it + 1
         x_b = lane.inv @ b
@@ -289,6 +439,8 @@ def solve_lp(prob: LPInstance, fleet, policy=None,
         raise OptimizeError(
             f"LP did not reach an optimal basis in {max_iters} "
             f"iterations", report)
+    if ckpt_store is not None:
+        ckpt_store.discard(run_id, reason="complete")
     return report
 
 
@@ -335,13 +487,18 @@ def _qp_toggle_factors(m_old: np.ndarray, m_new: np.ndarray,
 
 def solve_qp(prob: QPInstance, fleet, policy=None,
              max_iters: int | None = None,
-             solve_every: int = 2) -> OptimizeReport:
+             solve_every: int = 2, ckpt_store=None,
+             ckpt_every: int = 0, run_id: str | None = None,
+             resume: bool = False) -> OptimizeReport:
     """Primal active-set over the fleet (see module docstring).  The
     working-matrix inverse is a resident handle seeded from M = Q
     (empty active set, feasible start x = lo); every bound
     addition/release is one rank-2 ``fleet.update``; converged means
     the projected-gradient KKT residual passes the solver's own
-    eps·n·κ gate."""
+    eps·n·κ gate.  ``ckpt_store``/``ckpt_every``/``resume`` follow the
+    :func:`solve_lp` checkpoint contract (the extra state is the
+    iterate ``x`` and the free mask; ``m_work`` is re-derived from
+    them, so the restored stream replays bit-identically)."""
     n = prob.n
     q = np.asarray(prob.q, np.float64)
     c = np.asarray(prob.c, np.float64)
@@ -349,16 +506,41 @@ def solve_qp(prob: QPInstance, fleet, policy=None,
     hi = np.asarray(prob.hi, np.float64)
     if max_iters is None:
         max_iters = 6 * n
-    free = np.ones(n, dtype=bool)
-    m_work = _qp_working_matrix(q, free)
-    lane = _FleetLane(fleet, m_work.astype(prob.q.dtype), policy)
+    if run_id is None:
+        run_id = f"qp:{prob.name}"
+    start_it, durable = 0, None
+    ckpt_key = None
+    stored_iterates: list = []
+    if resume:
+        if ckpt_store is None:
+            raise ValueError("resume=True needs ckpt_store")
+        dt = (np.dtype(fleet._svc_kw["dtype"])
+              if hasattr(fleet, "_svc_kw") else np.dtype(np.float32))
+        ckpt_key = _opt_ckpt_key("qp", prob, run_id, dt, max_iters,
+                                 max(1, ckpt_every))
+        start_it, meta, lane = _opt_ckpt_resume(ckpt_store, ckpt_key,
+                                                fleet, policy)
+        durable = start_it
+        free = np.asarray(meta["free"], dtype=bool)
+        x = np.asarray(meta["x"], np.float64)
+        m_work = _qp_working_matrix(q, free)
+        stored_iterates = meta["iterates"]
+    else:
+        free = np.ones(n, dtype=bool)
+        m_work = _qp_working_matrix(q, free)
+        lane = _FleetLane(fleet, m_work.astype(prob.q.dtype), policy)
+        x = lo.copy()
+    if ckpt_store is not None and ckpt_key is None:
+        ckpt_key = _opt_ckpt_key("qp", prob, run_id, lane.dtype,
+                                 max_iters, max(1, ckpt_every))
     report = OptimizeReport(
-        kind="qp", name=prob.name, converged=False, iterations=0,
-        objective=float("nan"), objective_ref=prob.obj_star,
-        kkt_rel_final=float("nan"), kkt_threshold=float("nan"),
-        kappa=lane.kappa, updates=0, solves=0, ledger=lane.ledger,
+        kind="qp", name=prob.name, converged=False,
+        iterations=start_it, objective=float("nan"),
+        objective_ref=prob.obj_star, kkt_rel_final=float("nan"),
+        kkt_threshold=float("nan"), kappa=lane.kappa,
+        updates=lane.updates, solves=lane.solves, ledger=lane.ledger,
         handle_id=lane.handle.handle_id)
-    x = lo.copy()
+    report.iterates = list(stored_iterates)
     eps = float(np.finfo(lane.dtype).eps)
     c_inf = float(np.max(np.abs(c)))
     kkt_rel, thr = float("nan"), float("nan")
@@ -371,7 +553,14 @@ def solve_qp(prob: QPInstance, fleet, policy=None,
         rec.update(lane.update(u, v, report))
         m_work = m_new
 
-    for it in range(max_iters):
+    for it in range(start_it, max_iters):
+        if (ckpt_store is not None and ckpt_every > 0 and it > start_it
+                and (it % ckpt_every) == 0):
+            _opt_ckpt_write(ckpt_store, ckpt_key, it, lane, report,
+                            {"free": [bool(f) for f in free],
+                             "x": [float(v) for v in x]})
+            durable = it
+        _opt_preempt(run_id, durable)
         report.iterations = it + 1
         mul_tol = (1.0 + c_inf) * max(1e-9,
                                       10.0 * eps * n * lane.kappa)
@@ -433,4 +622,6 @@ def solve_qp(prob: QPInstance, fleet, policy=None,
     report.objective = float(0.5 * x @ q @ x + c @ x)
     report.fingerprint = _fingerprint(x, report.objective)
     report.converged = bool(kkt_converged(kkt_rel, thr))
+    if ckpt_store is not None:
+        ckpt_store.discard(run_id, reason="complete")
     return report

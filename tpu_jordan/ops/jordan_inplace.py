@@ -1054,40 +1054,11 @@ def block_jordan_invert_inplace_fori(
     V = pad_with_identity(a, N)
     if use_pallas is None:
         use_pallas = _use_pallas_default(dtype) and m % 8 == 0 and m >= 32
-    from .block_inverse import probe_blocks_quarter_masked
-
-    gidx = jnp.arange(Nr)
-    rowblk = jnp.arange(N) // m
-
     def body(t, carry):
         V, singular, swaps = carry
-        # --- PROBE (masked window, quarter ladder; main.cpp:1039).
-        col = lax.dynamic_slice(V, (0, t * m), (N, m)).reshape(Nr, m, m)
-        invs, sing = probe_blocks_quarter_masked(col, t, 1, eps,
-                                                 use_pallas)
-        valid = (gidx >= t) & ~sing
-        key = jnp.where(valid, block_inf_norms(invs),
-                        jnp.asarray(jnp.inf, dtype))
-        piv = jnp.argmin(key)                     # ties -> lowest row
-        singular = singular | ~jnp.isfinite(key[piv])
-        H = jnp.take(invs, piv, axis=0).astype(dtype)
-
-        # --- SWAP block rows t <-> piv (swap-by-copy, main.cpp:1093-1131).
-        rows_t = lax.dynamic_slice(V, (t * m, 0), (m, N))
-        rows_p = lax.dynamic_slice(V, (piv * m, 0), (m, N))
-        V = lax.dynamic_update_slice(V, rows_t, (piv * m, 0))
-
-        # --- NORMALIZE + ELIMINATE in place (same fold as the unrolled
-        # engine: V[:,t] zeroed so the one matmul writes −E·H there).
-        prow = jnp.matmul(H, rows_p, precision=precision)       # (m, N)
-        prow = lax.dynamic_update_slice(prow, H, (0, t * m))
-        E = lax.dynamic_slice(V, (0, t * m), (N, m))            # (N, m)
-        E = jnp.where((rowblk == t)[:, None], jnp.asarray(0, dtype), E)
-        V = lax.dynamic_update_slice(
-            V, jnp.zeros((N, m), dtype), (0, t * m))
-        V = V - jnp.matmul(E, prow, precision=precision)
-        V = lax.dynamic_update_slice(V, prow, (t * m, 0))
-        return V, singular, swaps.at[t].set(piv.astype(jnp.int32))
+        return _inplace_fori_step(t, V, singular, swaps, Nr=Nr, m=m,
+                                  eps=eps, precision=precision,
+                                  use_pallas=use_pallas)
 
     singular0 = jnp.asarray(False)
     swaps0 = jnp.zeros((Nr,), jnp.int32)
@@ -1098,3 +1069,218 @@ def block_jordan_invert_inplace_fori(
     x = unpad(V, n)
     x = newton_schulz(a, x, refine, lax.Precision.HIGHEST)
     return x, singular
+
+
+def _inplace_fori_step(t, V, singular, swaps, *, Nr: int, m: int, eps,
+                       precision, use_pallas: bool):
+    """One traced-``t`` in-place super-step on the full (N, N) working
+    set — the fori_loop body of :func:`block_jordan_invert_inplace_fori`,
+    factored to module level VERBATIM (same ops, same bits) so the
+    checkpointed segment runner (ISSUE 20, resilience/checkpoint.py)
+    re-enters the SAME arithmetic at an arbitrary step."""
+    from .block_inverse import probe_blocks_quarter_masked
+
+    N = Nr * m
+    dtype = V.dtype
+    gidx = jnp.arange(Nr)
+    rowblk = jnp.arange(N) // m
+
+    # --- PROBE (masked window, quarter ladder; main.cpp:1039).
+    col = lax.dynamic_slice(V, (0, t * m), (N, m)).reshape(Nr, m, m)
+    invs, sing = probe_blocks_quarter_masked(col, t, 1, eps,
+                                             use_pallas)
+    valid = (gidx >= t) & ~sing
+    key = jnp.where(valid, block_inf_norms(invs),
+                    jnp.asarray(jnp.inf, dtype))
+    piv = jnp.argmin(key)                     # ties -> lowest row
+    singular = singular | ~jnp.isfinite(key[piv])
+    H = jnp.take(invs, piv, axis=0).astype(dtype)
+
+    # --- SWAP block rows t <-> piv (swap-by-copy, main.cpp:1093-1131).
+    rows_t = lax.dynamic_slice(V, (t * m, 0), (m, N))
+    rows_p = lax.dynamic_slice(V, (piv * m, 0), (m, N))
+    V = lax.dynamic_update_slice(V, rows_t, (piv * m, 0))
+
+    # --- NORMALIZE + ELIMINATE in place (same fold as the unrolled
+    # engine: V[:,t] zeroed so the one matmul writes −E·H there).
+    prow = jnp.matmul(H, rows_p, precision=precision)       # (m, N)
+    prow = lax.dynamic_update_slice(prow, H, (0, t * m))
+    E = lax.dynamic_slice(V, (0, t * m), (N, m))            # (N, m)
+    E = jnp.where((rowblk == t)[:, None], jnp.asarray(0, dtype), E)
+    V = lax.dynamic_update_slice(
+        V, jnp.zeros((N, m), dtype), (0, t * m))
+    V = V - jnp.matmul(E, prow, precision=precision)
+    V = lax.dynamic_update_slice(V, prow, (t * m, 0))
+    return V, singular, swaps.at[t].set(piv.astype(jnp.int32))
+
+
+# ---------------------------------------------------------------------
+# Checkpointed segment executables (ISSUE 20).  A checkpointed invert
+# runs supersteps [t0, t1) as ONE jitted executable per segment, the
+# (V, swaps, singular) elimination state round-tripping to host between
+# segments (byte-exact).  The row-swap history rides as an (Nr,) int32
+# array in every flavor (the fori engines' own carry; the unrolled
+# engines' Python-list ``rswaps`` holds the same values), and the
+# unscramble + unpad move to :func:`invert_finalize` — applied ONCE
+# after the last segment, exactly where the monolithic engines apply
+# them.  Each segment runs the same per-step arithmetic as its
+# monolithic engine, so the concatenation bit-matches the uninterrupted
+# run (pinned by tests/test_checkpoint.py).
+# ---------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("t0", "t1", "Nr", "m", "eps",
+                                   "precision", "use_pallas"))
+def invert_segment(V, singular, swaps, *, t0: int, t1: int, Nr: int,
+                   m: int, eps, precision=lax.Precision.HIGHEST,
+                   use_pallas: bool = False):
+    """Supersteps [t0, t1) of the UNROLLED in-place invert: the exact
+    loop body of :func:`block_jordan_invert_inplace` (static offsets,
+    live-window probe), restricted to a static step range, with the
+    swap record written into the carried (Nr,) array instead of a
+    Python list."""
+    N = Nr * m
+    dtype = V.dtype
+    probe_dtype = dtype
+    for t in range(t0, t1):
+        nc = Nr - t
+        # --- PROBE the remaining candidate rows only (main.cpp:1039).
+        cands = lax.slice(V, (t * m, t * m), (N, (t + 1) * m))
+        cands = cands.reshape(nc, m, m).astype(probe_dtype)
+        if use_pallas:
+            from .pallas_block_inverse import pallas_batched_block_inverse
+
+            invs, sing = pallas_batched_block_inverse(cands, eps)
+        else:
+            invs, sing = batched_block_inverse(cands, None, eps)
+        key = jnp.where(sing, jnp.asarray(jnp.inf, probe_dtype),
+                        block_inf_norms(invs))
+        rel = jnp.argmin(key)                     # ties -> lowest row
+        singular = singular | jnp.all(sing)
+        H = jnp.take(invs, rel, axis=0).astype(dtype)
+        piv = t + rel
+
+        # --- SWAP block rows t <-> piv (swap-by-copy).
+        rows_t = lax.slice(V, (t * m, 0), ((t + 1) * m, N))
+        rows_p = lax.dynamic_slice(V, (piv * m, 0), (m, N))
+        V = lax.dynamic_update_slice(V, rows_t, (piv * m, 0))
+
+        # --- NORMALIZE + ELIMINATE, in place (the one-matmul fold of
+        # the monolithic engine).
+        prow = jnp.matmul(H, rows_p, precision=precision)       # (m, N)
+        prow = prow.at[:, t * m:(t + 1) * m].set(H)
+        E = lax.slice(V, (0, t * m), (N, (t + 1) * m))          # (N, m)
+        E = E.at[t * m:(t + 1) * m].set(jnp.asarray(0, dtype))
+        V = V.at[:, t * m:(t + 1) * m].set(jnp.asarray(0, dtype))
+        V = V - jnp.matmul(E, prow, precision=precision)
+        V = V.at[t * m:(t + 1) * m, :].set(prow)
+        swaps = swaps.at[t].set(jnp.asarray(piv, jnp.int32))
+    return V, singular, swaps
+
+
+@partial(jax.jit, static_argnames=("t0", "t1", "Nr", "m", "eps",
+                                   "precision", "use_pallas"))
+def invert_segment_fori(V, singular, swaps, *, t0: int, t1: int,
+                        Nr: int, m: int, eps,
+                        precision=lax.Precision.HIGHEST,
+                        use_pallas: bool = False):
+    """Supersteps [t0, t1) of the fori in-place invert: a ``fori_loop``
+    over the shared :func:`_inplace_fori_step` body — one executable
+    shape per segment length, the monolithic fori engine's bits."""
+    def body(t, carry):
+        V, singular, swaps = carry
+        return _inplace_fori_step(t, V, singular, swaps, Nr=Nr, m=m,
+                                  eps=eps, precision=precision,
+                                  use_pallas=use_pallas)
+
+    return lax.fori_loop(t0, t1, body, (V, singular, swaps))
+
+
+@partial(jax.jit, static_argnames=("t0", "t1", "Nr", "m", "group",
+                                   "eps", "precision", "use_pallas"))
+def invert_segment_grouped(V, singular, swaps, *, t0: int, t1: int,
+                           Nr: int, m: int, group: int, eps,
+                           precision=lax.Precision.HIGHEST,
+                           use_pallas: bool = False):
+    """Supersteps [t0, t1) of the GROUPED engine, where ``t0`` and
+    ``t1`` MUST sit on group boundaries (``t0 % group == 0``; ``t1``
+    a group multiple or Nr): the U/P panel accumulators are intra-group
+    temporaries — between groups the state is exactly (V, singular,
+    swaps), which is what makes group boundaries the only legal
+    checkpoint cadence for this flavor (resilience/checkpoint.py rounds
+    the cadence up and refuses a resume step off the grid)."""
+    from .block_inverse import probe_blocks
+
+    N = Nr * m
+    dtype = V.dtype
+    k = max(1, min(group, Nr))
+    if t0 % k or (t1 % k and t1 != Nr):
+        raise ValueError(
+            f"grouped segment bounds must sit on group boundaries: "
+            f"[{t0}, {t1}) with group={k}")
+    for g0 in range(t0, t1, k):
+        kg = min(k, Nr - g0)                   # this group's width
+        U = jnp.zeros((N, kg * m), dtype)
+        P = jnp.zeros((kg * m, N), dtype)
+        for j in range(kg):
+            t = g0 + j
+            nc = Nr - t
+            # --- EAGER CANDIDATE COLUMN: V[:, t] minus pending panels.
+            col = lax.slice(V, (0, t * m), (N, (t + 1) * m))
+            if j:
+                col = col - jnp.matmul(
+                    U[:, :j * m], P[:j * m, t * m:(t + 1) * m],
+                    precision=precision)
+            # --- PROBE the live window (main.cpp:1039).
+            cands = col[t * m:].reshape(nc, m, m)
+            invs, sing = probe_blocks(cands, eps, use_pallas)
+            key = jnp.where(sing, jnp.asarray(jnp.inf, dtype),
+                            block_inf_norms(invs))
+            rel = jnp.argmin(key)              # ties -> lowest row
+            singular = singular | jnp.all(sing)
+            H = jnp.take(invs, rel, axis=0).astype(dtype)
+            piv = t + rel
+
+            # --- SWAP rows t <-> piv in V and U.
+            rows_t = lax.slice(V, (t * m, 0), ((t + 1) * m, N))
+            rows_p = lax.dynamic_slice(V, (piv * m, 0), (m, N))
+            V = lax.dynamic_update_slice(V, rows_t, (piv * m, 0))
+            u_t = lax.slice(U, (t * m, 0), ((t + 1) * m, kg * m))
+            u_p = lax.dynamic_slice(U, (piv * m, 0), (m, kg * m))
+            U = lax.dynamic_update_slice(U, u_t, (piv * m, 0))
+
+            # --- EAGER PIVOT ROW: old piv row minus pending panels.
+            if j:
+                rows_p = rows_p - jnp.matmul(u_p[:, :j * m], P[:j * m],
+                                             precision=precision)
+            prow = jnp.matmul(H, rows_p, precision=precision)   # (m, N)
+            prow = prow.at[:, t * m:(t + 1) * m].set(H)
+
+            # --- RECORD the panel (the monolithic engine's invariants).
+            col_t_blk = col[t * m:(t + 1) * m]
+            col = lax.dynamic_update_slice(col, col_t_blk, (piv * m, 0))
+            col = col.at[t * m:(t + 1) * m].set(jnp.asarray(0, dtype))
+            V = V.at[:, t * m:(t + 1) * m].set(jnp.asarray(0, dtype))
+            if j:
+                P = P.at[:j * m, t * m:(t + 1) * m].set(
+                    jnp.asarray(0, dtype))
+            V = V.at[t * m:(t + 1) * m, :].set(prow)
+            U = U.at[t * m:(t + 1) * m, :].set(jnp.asarray(0, dtype))
+            U = U.at[:, j * m:(j + 1) * m].set(col)
+            P = P.at[j * m:(j + 1) * m, :].set(prow)
+            swaps = swaps.at[t].set(jnp.asarray(piv, jnp.int32))
+
+        # --- GROUP-END TRAILING UPDATE: one fat MXU matmul.
+        V = V - jnp.matmul(U, P, precision=precision)
+    return V, singular, swaps
+
+
+@partial(jax.jit, static_argnames=("n", "Nr", "m"))
+def invert_finalize(V, swaps, *, n: int, Nr: int, m: int):
+    """The monolithic engines' epilogue as its own executable: compose
+    the recorded swap permutation, apply it as one blocked column
+    gather, strip the identity padding.  Runs once, after the last
+    segment — exactly the ops the uninterrupted engines run after their
+    loops, on bit-identical inputs."""
+    V = apply_col_perm(V, compose_swap_perm(swaps, Nr), m)
+    return unpad(V, n)

@@ -1690,3 +1690,125 @@ def sharded_jordan_invert_inplace_2d(
                                             lookahead)
     out, singular = run(W)
     return gather_inverse_inplace_2d(out, lay, n), singular.any()
+
+
+# ---------------------------------------------------------------------
+# SEGMENT ENTRIES (ISSUE 20): supersteps [t0, t1) of the 2D engines as
+# their own jitted executables, carry in / carry out, so a checkpointed
+# runner can round-trip the carry through the host between segments.
+# Same discipline as the 1D entries in sharded_inplace.py: each segment
+# replays the monolithic per-step arithmetic and collective schedule
+# verbatim (``_solve_step_2d`` / ``_step2d`` / ``_step2d_fori``), the
+# unscramble epilogue moves to its own finalize executable, and the
+# swap record rides as a (pr, pc, Nr) int32 tensor — every worker's
+# slice is the same psum-broadcast pivot history, made shardable.
+# ---------------------------------------------------------------------
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "nrhs", "t0", "t1", "eps",
+                          "precision", "use_pallas", "unroll",
+                          "probe_cols"))
+def _sharded_jordan_solve_2d_segment(W, X, singular, mesh,
+                                     lay: CyclicLayout2D, nrhs: int,
+                                     t0: int, t1: int, eps, precision,
+                                     use_pallas, unroll: bool,
+                                     probe_cols: bool = True):
+    """Supersteps [t0, t1) of the 2D distributed solve.  Unlike the
+    monolithic entries this returns the A shard too — it is live carry
+    between segments.  ``singular`` is the (pr, pc) per-worker flag
+    grid the monolithic engines emit, in and out through the same
+    spec."""
+    def worker(Wloc, Xloc, sloc):
+        sing = sloc[0, 0]
+        if unroll:
+            for t in range(t0, t1):
+                Wloc, Xloc, sing = _solve_step_2d(
+                    t, Wloc, Xloc, sing, lay=lay, nrhs=nrhs, eps=eps,
+                    precision=precision, use_pallas=use_pallas,
+                    probe_cols=probe_cols)
+        else:
+            def body(t, carry):
+                Wl, Xl, s = carry
+                return _solve_step_2d(t, Wl, Xl, s, lay=lay, nrhs=nrhs,
+                                      eps=eps, precision=precision,
+                                      use_pallas=use_pallas,
+                                      probe_cols=probe_cols)
+
+            Wloc, Xloc, sing = lax.fori_loop(
+                t0, t1, body, (Wloc, Xloc, sing))
+        return Wloc, Xloc, sing[None, None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=(_SPEC_W, _SPEC_X2, PartitionSpec(AXIS_R, AXIS_C)),
+        out_specs=(_SPEC_W, _SPEC_X2, PartitionSpec(AXIS_R, AXIS_C)),
+    )(W, X, singular)
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "t0", "t1", "eps", "precision",
+                          "use_pallas", "unroll", "probe_cols"))
+def _sharded_jordan2d_inplace_segment(W, singular, swaps, mesh,
+                                      lay: CyclicLayout2D, t0: int,
+                                      t1: int, eps, precision,
+                                      use_pallas, unroll: bool,
+                                      probe_cols: bool = True):
+    """Supersteps [t0, t1) of the 2D in-place invert.  The unscramble
+    does NOT run here — it moves to
+    :func:`_sharded_jordan2d_inplace_finalize`, applied once after the
+    last segment exactly where the monolithic workers apply it."""
+    def worker(Wloc, sloc, swloc):
+        sing = sloc[0, 0]
+        sw = swloc[0, 0]
+        if unroll:
+            for t in range(t0, t1):
+                Wloc, sing, g_piv = _step2d(
+                    t, Wloc, sing, lay=lay, eps=eps, precision=precision,
+                    use_pallas=use_pallas, probe_cols=probe_cols)
+                sw = sw.at[t].set(g_piv.astype(jnp.int32))
+        else:
+            def body(t, carry):
+                Wl, s, sws = carry
+                return _step2d_fori(t, Wl, s, sws, lay=lay, eps=eps,
+                                    precision=precision,
+                                    use_pallas=use_pallas,
+                                    probe_cols=probe_cols)
+
+            Wloc, sing, sw = lax.fori_loop(t0, t1, body,
+                                           (Wloc, sing, sw))
+        return Wloc, sing[None, None], sw[None, None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=(_SPEC_W, PartitionSpec(AXIS_R, AXIS_C),
+                  PartitionSpec(AXIS_R, AXIS_C, None)),
+        out_specs=(_SPEC_W, PartitionSpec(AXIS_R, AXIS_C),
+                   PartitionSpec(AXIS_R, AXIS_C, None)),
+    )(W, singular, swaps)
+
+
+@partial(jax.jit, static_argnames=("mesh", "lay"))
+def _sharded_jordan2d_inplace_finalize(W, swaps, mesh,
+                                       lay: CyclicLayout2D):
+    """The 2D invert epilogue as its own executable: replay the swap
+    history in reverse through ``_unscramble_step_fori`` — pure data
+    movement across the column-sharded layout, the exact loop the
+    monolithic fori worker runs after its elimination sweep."""
+    def worker(Wloc, swloc):
+        sw = swloc[0, 0]
+
+        def unscramble(i, Wl):
+            t = jnp.asarray(lay.Nr - 1 - i, jnp.int32)
+            return _unscramble_step_fori(t, sw[t], Wl, lay=lay)
+
+        return lax.fori_loop(0, lay.Nr, unscramble, Wloc)
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=(_SPEC_W, PartitionSpec(AXIS_R, AXIS_C, None)),
+        out_specs=_SPEC_W,
+    )(W, swaps)

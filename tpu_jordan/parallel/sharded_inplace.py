@@ -1458,3 +1458,125 @@ def sharded_jordan_invert_inplace(
                                          lookahead)
     out, singular = run(blocks)
     return gather_inverse_inplace(out, lay, n), singular.any()
+
+
+# ---------------------------------------------------------------------
+# Checkpointed segment executables (ISSUE 20, resilience/checkpoint.py).
+# A checkpointed distributed run executes supersteps [t0, t1) as ONE
+# shard_map executable per segment; between segments the sharded
+# elimination state — the (Nr, m, N) W blocks, the (Nr, m, k) X blocks
+# or the (p, Nr) swap record, and the per-worker singular flags —
+# round-trips to host byte-exactly (np.asarray gathers, device_put
+# re-scatters).  Each segment runs the SAME ``_step``/``_solve_step``
+# arithmetic and the SAME collective schedule as the monolithic
+# engines, so the segment concatenation bit-matches the uninterrupted
+# run (pinned by tests/test_checkpoint.py — the ISSUE 16 lookahead
+# discipline: arithmetic may move between executables, none may
+# change).
+# ---------------------------------------------------------------------
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "nrhs", "t0", "t1", "eps",
+                          "precision", "use_pallas", "unroll"))
+def _sharded_jordan_solve_segment(W, X, singular, mesh,
+                                  lay: CyclicLayout, nrhs: int, t0: int,
+                                  t1: int, eps, precision, use_pallas,
+                                  unroll: bool):
+    """Supersteps [t0, t1) of the 1D distributed solve.  ``unroll=True``
+    replays ``_solve_step`` with static offsets (the shrinking
+    live-column window — eliminated columns of W are dead and carried
+    stale, exactly as the monolithic unrolled engine leaves them);
+    ``unroll=False`` runs the fori body over the same range.  The
+    carried ``singular`` is the (p,) per-worker flag vector the
+    monolithic engines emit — in and out through the same spec."""
+    def worker(Wloc, Xloc, sloc):
+        sing = sloc[0]
+        if unroll:
+            for t in range(t0, t1):
+                Wloc, Xloc, sing = _solve_step(
+                    t, Wloc, Xloc, sing, lay=lay, nrhs=nrhs, eps=eps,
+                    precision=precision, use_pallas=use_pallas)
+        else:
+            def body(t, carry):
+                Wl, Xl, s = carry
+                return _solve_step(t, Wl, Xl, s, lay=lay, nrhs=nrhs,
+                                   eps=eps, precision=precision,
+                                   use_pallas=use_pallas)
+
+            Wloc, Xloc, sing = lax.fori_loop(
+                t0, t1, body, (Wloc, Xloc, sing))
+        return Wloc, Xloc, sing[None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=(PartitionSpec(AXIS, None, None),
+                  PartitionSpec(AXIS, None, None), PartitionSpec(AXIS)),
+        out_specs=(PartitionSpec(AXIS, None, None),
+                   PartitionSpec(AXIS, None, None), PartitionSpec(AXIS)),
+    )(W, X, singular)
+
+
+@partial(jax.jit,
+         static_argnames=("mesh", "lay", "t0", "t1", "eps", "precision",
+                          "use_pallas", "unroll"))
+def _sharded_jordan_inplace_segment(W, singular, swaps, mesh,
+                                    lay: CyclicLayout, t0: int, t1: int,
+                                    eps, precision, use_pallas,
+                                    unroll: bool):
+    """Supersteps [t0, t1) of the 1D in-place invert.  The swap record
+    rides as a (p, Nr) int32 tensor (each worker's row is the same
+    psum-broadcast pivot history — the fori engine's own carry, made
+    shardable); the unscramble does NOT run here — it moves to
+    :func:`_sharded_inplace_finalize`, applied once after the last
+    segment exactly where the monolithic engines apply it."""
+    def worker(Wloc, sloc, swloc):
+        sing = sloc[0]
+        sw = swloc[0]
+        if unroll:
+            for t in range(t0, t1):
+                Wloc, sing, g_piv = _step(
+                    t, Wloc, sing, lay=lay, eps=eps,
+                    precision=precision, use_pallas=use_pallas)
+                sw = sw.at[t].set(g_piv.astype(jnp.int32))
+        else:
+            def body(t, carry):
+                Wl, s, sws = carry
+                return _step_fori(t, Wl, s, sws, lay=lay, eps=eps,
+                                  precision=precision,
+                                  use_pallas=use_pallas)
+
+            Wloc, sing, sw = lax.fori_loop(t0, t1, body,
+                                           (Wloc, sing, sw))
+        return Wloc, sing[None], sw[None]
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=(PartitionSpec(AXIS, None, None), PartitionSpec(AXIS),
+                  PartitionSpec(AXIS, None)),
+        out_specs=(PartitionSpec(AXIS, None, None), PartitionSpec(AXIS),
+                   PartitionSpec(AXIS, None)),
+    )(W, singular, swaps)
+
+
+@partial(jax.jit, static_argnames=("mesh", "lay"))
+def _sharded_inplace_finalize(W, swaps, mesh, lay: CyclicLayout):
+    """The 1D invert epilogue as its own executable: compose the swap
+    history into one block-column permutation and apply it worker-local
+    (columns are replicated in the 1D layout) — the exact unscramble
+    the monolithic workers run after their loops."""
+    def worker(Wloc, swloc):
+        from ..ops.jordan_inplace import apply_col_perm, compose_swap_perm
+
+        return apply_col_perm(
+            Wloc, compose_swap_perm(swloc[0], lay.Nr), lay.m)
+
+    return shard_map(
+        worker,
+        mesh=mesh,
+        in_specs=(PartitionSpec(AXIS, None, None),
+                  PartitionSpec(AXIS, None)),
+        out_specs=PartitionSpec(AXIS, None, None),
+    )(W, swaps)

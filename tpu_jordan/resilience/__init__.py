@@ -18,9 +18,21 @@ Three modules:
     (Newton-Schulz) then a higher-precision re-solve, each rung recorded
     on ``SolveResult.recovery`` and in the span tree; a wrong inverse is
     never returned silently (:class:`ResidualGateError`).
+  * ``checkpoint`` — preemption-safe execution (ISSUE 20): superstep
+    elimination snapshots to a host-side :class:`CheckpointStore` at a
+    configurable cadence, ``resume_from=`` re-entry that bit-matches
+    the uninterrupted run, and the typed refusal taxonomy
+    (missing/corrupt/mismatched/unsupported — never a silent
+    from-scratch recompute).
 """
 
 from . import faults
+from .checkpoint import (CheckpointCorruptError, CheckpointError,
+                         CheckpointKey, CheckpointMismatchError,
+                         CheckpointNotFoundError, CheckpointStore,
+                         CheckpointUnsupportedError, PreemptedError,
+                         checkpointed_invert, checkpointed_solve,
+                         fingerprint)
 from .faults import (FaultPlan, FaultSpec, InjectedFaultError,
                      InjectedTransientError, activate)
 from .policy import (DEFAULT_POLICY, CapacityExceededError,
@@ -36,4 +48,9 @@ __all__ = [
     "CircuitOpenError", "DeadlineExceededError", "ResidualGateError",
     "ResiliencePolicy", "ResultCorruptionError", "RetryPolicy",
     "is_transient", "retry_transient", "retryable",
+    "CheckpointError", "CheckpointNotFoundError",
+    "CheckpointCorruptError", "CheckpointMismatchError",
+    "CheckpointUnsupportedError", "PreemptedError", "CheckpointKey",
+    "CheckpointStore", "checkpointed_invert", "checkpointed_solve",
+    "fingerprint",
 ]

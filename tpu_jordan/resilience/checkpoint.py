@@ -1,0 +1,919 @@
+"""Preemption-safe execution: superstep checkpoint/resume (ISSUE 20).
+
+The paper's elimination is an all-or-nothing monolith: a worker lost at
+superstep 37 of 64 throws away 37 supersteps (``Jordan``,
+main.cpp:953-1204 has no recovery path at all — MPI aborts).  On
+preemptible pods that is THE availability gap.  This module adds the
+recover-without-recompute discipline:
+
+* The elimination state is **RNG-free and closed by construction**:
+  the padded working set ([A|I] for inverts, (A, X) for solves), the
+  ``singular`` evidence accumulated so far, the (Nr,) int32 row-swap
+  record, and the superstep index ``t`` fully determine every later
+  superstep.  Snapshotting exactly that tuple at a cadence boundary
+  and re-entering at step ``t`` replays the identical arithmetic.
+* The engines gained **segment executables** (``solve_segment*``,
+  ``invert_segment*`` in ops/linalg; ``*_segment`` entries in the 1D/2D
+  parallel modules): supersteps [t0, t1) as one jitted call, carry in /
+  carry out, the unscramble epilogue moved to its own finalize
+  executable.  Each segment replays the monolithic per-step arithmetic
+  and collective schedule verbatim, so the concatenation of segments
+  — and therefore a resume — **bit-matches the uninterrupted run**
+  (the ISSUE 16 reordered-arithmetic discipline, pinned by
+  tests/test_checkpoint.py and ``tools/check_ckpt.py``).
+* Snapshots go to a host-side :class:`CheckpointStore`: one
+  self-describing file per run (magic + JSON header + npz payload),
+  **content-checksummed** (sha256 over the payload) and **atomic**
+  (tmp + ``os.replace``, the plan-cache idiom) — a torn write can
+  never be mistaken for a checkpoint.  A corrupt, truncated, or
+  key-mismatched entry is a **typed refusal**
+  (:class:`CheckpointCorruptError` / :class:`CheckpointMismatchError`),
+  never a silent resume and never a silent from-scratch recompute.
+* The ledger invariant ``written == resumed + discarded + live`` is
+  maintained per store and persisted (``ledger.json``): every
+  checkpoint token is eventually consumed by exactly one of resume,
+  supersede/complete-discard, or corrupt-quarantine (which counts both
+  ``corrupt`` and ``discarded``), or it is still live on disk.
+
+Checkpoint lifecycle (docs/RESILIENCE.md has the operator table)::
+
+    write (cadence boundary) --> [live on disk] --+--> resumed
+                                                  +--> discarded
+                                                  |    (superseded /
+                                                  |     run complete)
+                                                  +--> corrupt
+                                                       (quarantined,
+                                                        typed refusal)
+
+Lost work is bounded by the cadence: a ``preempt`` fault (the seeded
+chaos point in :mod:`.faults`) fires at segment boundaries AFTER the
+previous boundary's checkpoint is durable, so at most ``cadence``
+supersteps are ever recomputed.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import tempfile
+import threading
+from dataclasses import asdict, dataclass
+
+import numpy as np
+
+from ..obs import metrics as _obs_metrics
+from ..obs import recorder as _recorder
+from . import faults as _faults
+
+_MAGIC = b"TJCKPT1\n"
+FORMAT_VERSION = 1
+
+#: Engine flavors the checkpoint runners accept, per topology.  The
+#: rest are typed refusals with the reason in the message:
+#:   - spd/cholesky-style fast paths have no pivot probe, so there is
+#:     no pivot record to snapshot and no singularity evidence to
+#:     carry across a resume;
+#:   - swapfree/lookahead carry engine-internal pipeline state (alive
+#:     masks, probe-ahead panels) that is not part of the closed
+#:     (state, swaps, t) tuple;
+#:   - pallas grouped flavors fuse across steps.
+SINGLE_ENGINES = ("unrolled", "fori", "grouped")
+DIST_ENGINES = ("unrolled", "fori")
+
+_M_WRITTEN = _obs_metrics.counter(
+    "tpu_jordan_ckpt_written_total",
+    "superstep checkpoints durably written (atomic rename complete)")
+_M_RESUMED = _obs_metrics.counter(
+    "tpu_jordan_ckpt_resumed_total",
+    "checkpoints consumed by a resume (key-matched, checksum-verified)")
+_M_CORRUPT = _obs_metrics.counter(
+    "tpu_jordan_ckpt_corrupt_total",
+    "checkpoint loads refused: bad magic/header/truncation/checksum")
+_M_DISCARDED = _obs_metrics.counter(
+    "tpu_jordan_ckpt_discarded_total",
+    "checkpoint tokens discarded (superseded, run complete, or "
+    "corrupt-quarantined)")
+
+
+# ---------------------------------------------------------------------
+# Typed failures
+# ---------------------------------------------------------------------
+
+
+class CheckpointError(RuntimeError):
+    """Base of the checkpoint/resume failure taxonomy."""
+
+
+class CheckpointNotFoundError(CheckpointError):
+    """``resume_from=`` named a run with no durable checkpoint — e.g.
+    cadence > Nr wrote none.  A resume NEVER silently degrades to a
+    from-scratch run; the caller must ask for one explicitly."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """The on-disk entry failed the magic/header/checksum gates.  The
+    file is quarantined (renamed ``*.corrupt``) and its token counted
+    discarded — resuming from it is refused, never attempted."""
+
+
+class CheckpointMismatchError(CheckpointError):
+    """The stored key does not describe this call: mismatched
+    (workload, engine, topology, n, m, Nr, dtype, nrhs) — resuming a
+    float64 2D solve from a float32 1D invert's bytes would be silent
+    corruption, so it is a typed refusal instead."""
+
+
+class CheckpointUnsupportedError(CheckpointError):
+    """This engine/dtype flavor has no checkpointable closed state
+    (SPD fast path, swapfree/lookahead pipelines, sub-fp32 storage,
+    complex distributed flavors that do not exist yet)."""
+
+
+class PreemptedError(CheckpointError):
+    """The chip went away mid-sweep (the seeded ``preempt`` fault, or
+    a real revocation surfaced by the abort hook).  Raised AFTER the
+    last cadence-boundary checkpoint is durable; ``step`` is that
+    boundary (None when nothing was written) — the caller resumes from
+    it instead of recomputing."""
+
+    def __init__(self, msg, *, run_id: str, step: int | None):
+        super().__init__(msg)
+        self.run_id = run_id
+        self.step = step
+
+
+# ---------------------------------------------------------------------
+# Key + store
+# ---------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class CheckpointKey:
+    """What a checkpoint IS a checkpoint of.  Every field except
+    ``cadence`` must match at resume time (``cadence`` may legitimately
+    change between legs — it only schedules future writes)."""
+
+    run_id: str
+    workload: str          # "invert" | "solve"
+    engine: str            # "unrolled" | "fori" | "grouped"
+    topology: str          # "single" | "1d:<p>" | "2d:<pr>x<pc>"
+    n: int
+    m: int
+    Nr: int                # padded block-row count (layout-dependent)
+    dtype: str
+    nrhs: int              # 0 for inverts
+    cadence: int
+
+    MATCH_FIELDS = ("workload", "engine", "topology", "n", "m", "Nr",
+                    "dtype", "nrhs")
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+    @classmethod
+    def from_json(cls, doc: dict) -> "CheckpointKey":
+        return cls(**{f: doc[f] for f in cls.__dataclass_fields__})
+
+    def require_match(self, stored: "CheckpointKey") -> None:
+        bad = [f for f in self.MATCH_FIELDS
+               if getattr(self, f) != getattr(stored, f)]
+        if bad:
+            detail = ", ".join(
+                f"{f}: stored {getattr(stored, f)!r} != requested "
+                f"{getattr(self, f)!r}" for f in bad)
+            raise CheckpointMismatchError(
+                f"checkpoint for run {self.run_id!r} does not describe "
+                f"this call ({detail}); resuming would be silent "
+                f"corruption — refused")
+
+
+class CheckpointStore:
+    """Host-side checkpoint files + the token ledger.
+
+    One file per ``run_id`` (a new write atomically supersedes the
+    previous one — only the LATEST boundary matters for resume), plus
+    ``ledger.json`` with the persistent counts.  Thread-safe: the fleet
+    writes from replica worker threads."""
+
+    def __init__(self, root: str):
+        self.root = os.path.abspath(root)
+        os.makedirs(self.root, exist_ok=True)
+        self._lock = threading.Lock()
+        self._counts = {"written": 0, "resumed": 0, "discarded": 0,
+                        "corrupt": 0}
+        self._live: dict[str, bool] = {}
+        self._load_ledger()
+
+    # ---- paths / ledger persistence ---------------------------------
+
+    def _path(self, run_id: str) -> str:
+        safe = "".join(c if c.isalnum() or c in "-_." else "_"
+                       for c in run_id)
+        return os.path.join(self.root, f"{safe}.ckpt")
+
+    @property
+    def _ledger_path(self) -> str:
+        return os.path.join(self.root, "ledger.json")
+
+    def _load_ledger(self) -> None:
+        try:
+            with open(self._ledger_path) as f:
+                doc = json.load(f)
+            self._counts.update({k: int(doc.get(k, 0))
+                                 for k in self._counts})
+            self._live = {r: True for r in doc.get("live_runs", [])}
+        except (OSError, ValueError):
+            pass
+
+    def _persist_ledger_locked(self) -> None:
+        doc = dict(self._counts)
+        doc["live_runs"] = sorted(self._live)
+        text = json.dumps(doc, indent=1, sort_keys=True) + "\n"
+        fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".ledger.tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(text)
+            os.replace(tmp, self._ledger_path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    # ---- write ------------------------------------------------------
+
+    def write(self, key: CheckpointKey, step: int,
+              arrays: dict[str, np.ndarray]) -> int:
+        """Durably persist ``arrays`` as run ``key.run_id``'s state at
+        superstep ``step``.  Returns the payload byte count.  Atomic:
+        readers see the old checkpoint or the new one, never a tear."""
+        buf = io.BytesIO()
+        np.savez(buf, **{k: np.asarray(v) for k, v in arrays.items()})
+        payload = buf.getvalue()
+        digest = hashlib.sha256(payload).hexdigest()
+        header = json.dumps({
+            "version": FORMAT_VERSION, "key": key.to_json(),
+            "step": int(step), "sha256": digest,
+            "payload_bytes": len(payload),
+        }, sort_keys=True).encode()
+        blob = (_MAGIC + len(header).to_bytes(4, "big") + header
+                + payload)
+        path = self._path(key.run_id)
+        fd, tmp = tempfile.mkstemp(dir=self.root, suffix=".ckpt.tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                f.write(blob)
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        with self._lock:
+            if self._live.get(key.run_id):
+                # Supersede: the previous boundary's token is consumed
+                # by this newer one.
+                self._counts["discarded"] += 1
+                _M_DISCARDED.inc()
+            self._counts["written"] += 1
+            self._live[key.run_id] = True
+            self._persist_ledger_locked()
+        _M_WRITTEN.inc()
+        _recorder.record("ckpt_written", run_id=key.run_id,
+                         step=int(step), bytes=len(payload),
+                         sha=digest[:12], workload=key.workload,
+                         topology=key.topology)
+        return len(payload)
+
+    # ---- load / resume ----------------------------------------------
+
+    def _quarantine(self, run_id: str, reason: str) -> None:
+        path = self._path(run_id)
+        try:
+            os.replace(path, path + ".corrupt")
+        except OSError:
+            pass
+        with self._lock:
+            self._counts["corrupt"] += 1
+            if self._live.pop(run_id, None):
+                self._counts["discarded"] += 1
+                _M_DISCARDED.inc()
+            self._persist_ledger_locked()
+        _M_CORRUPT.inc()
+        _recorder.record("ckpt_corrupt", run_id=run_id, reason=reason)
+
+    def _read(self, run_id: str):
+        path = self._path(run_id)
+        if not os.path.exists(path):
+            raise CheckpointNotFoundError(
+                f"no durable checkpoint for run {run_id!r} in "
+                f"{self.root} (a cadence larger than the superstep "
+                f"count writes none); a resume never silently degrades "
+                f"to a from-scratch run")
+        with open(path, "rb") as f:
+            blob = f.read()
+        if blob[:len(_MAGIC)] != _MAGIC:
+            self._quarantine(run_id, "bad magic")
+            raise CheckpointCorruptError(
+                f"checkpoint for run {run_id!r}: bad magic — not a "
+                f"checkpoint file (quarantined)")
+        try:
+            hlen = int.from_bytes(blob[len(_MAGIC):len(_MAGIC) + 4],
+                                  "big")
+            header = json.loads(
+                blob[len(_MAGIC) + 4:len(_MAGIC) + 4 + hlen])
+            payload = blob[len(_MAGIC) + 4 + hlen:]
+        except (ValueError, IndexError) as e:
+            self._quarantine(run_id, "unparseable header")
+            raise CheckpointCorruptError(
+                f"checkpoint for run {run_id!r}: unparseable header "
+                f"(quarantined)") from e
+        if len(payload) != header.get("payload_bytes"):
+            self._quarantine(run_id, "truncated payload")
+            raise CheckpointCorruptError(
+                f"checkpoint for run {run_id!r}: payload truncated "
+                f"({len(payload)} of {header.get('payload_bytes')} "
+                f"bytes; quarantined)")
+        if hashlib.sha256(payload).hexdigest() != header.get("sha256"):
+            self._quarantine(run_id, "checksum mismatch")
+            raise CheckpointCorruptError(
+                f"checkpoint for run {run_id!r}: payload checksum "
+                f"mismatch (quarantined) — a resume from corrupt bits "
+                f"is refused, never attempted")
+        key = CheckpointKey.from_json(header["key"])
+        with np.load(io.BytesIO(payload)) as z:
+            arrays = {k: z[k] for k in z.files}
+        return key, int(header["step"]), arrays
+
+    def peek(self, run_id: str):
+        """Read + verify WITHOUT consuming the token (inspection)."""
+        return self._read(run_id)
+
+    def has_live(self, run_id: str) -> bool:
+        """True while run ``run_id`` holds a live (unconsumed)
+        checkpoint token — the fleet router's resume probe on a
+        re-queue hop (no file I/O, nothing consumed)."""
+        with self._lock:
+            return bool(self._live.get(run_id))
+
+    def resume(self, key: CheckpointKey):
+        """Consume run ``key.run_id``'s live checkpoint for a resume:
+        verify integrity, require the stored key to describe this call,
+        and account the token.  Returns ``(step, arrays)``.
+
+        The token gates the file: a checkpoint already consumed by a
+        resume/discard is a typed miss even while its bytes linger on
+        disk — a second consumer double-counting ``resumed`` is exactly
+        the ledger drift the invariant exists to catch."""
+        with self._lock:
+            if not self._live.get(key.run_id):
+                raise CheckpointNotFoundError(
+                    f"no live checkpoint token for run "
+                    f"{key.run_id!r}: nothing durable was written, or "
+                    f"the checkpoint was already consumed by a "
+                    f"resume/discard; a resume never silently degrades "
+                    f"to a from-scratch run")
+        stored, step, arrays = self._read(key.run_id)
+        key.require_match(stored)
+        with self._lock:
+            if not self._live.pop(key.run_id, None):
+                raise CheckpointNotFoundError(
+                    f"checkpoint for run {key.run_id!r} was consumed "
+                    f"concurrently; a resume never silently degrades "
+                    f"to a from-scratch run")
+            self._counts["resumed"] += 1
+            self._persist_ledger_locked()
+        _M_RESUMED.inc()
+        _recorder.record("ckpt_resumed", run_id=key.run_id,
+                         step=int(step), workload=key.workload,
+                         topology=key.topology)
+        return step, arrays
+
+    def discard(self, run_id: str, reason: str = "complete") -> bool:
+        """Consume the live token (run finished, or the caller gave
+        up).  Idempotent — False when there was nothing live."""
+        with self._lock:
+            live = self._live.pop(run_id, None)
+            if live:
+                self._counts["discarded"] += 1
+                self._persist_ledger_locked()
+        if not live:
+            return False
+        _M_DISCARDED.inc()
+        try:
+            os.unlink(self._path(run_id))
+        except OSError:
+            pass
+        _recorder.record("ckpt_discarded", run_id=run_id, reason=reason)
+        return True
+
+    # ---- accounting -------------------------------------------------
+
+    def ledger(self) -> dict:
+        with self._lock:
+            c = dict(self._counts)
+            live = len(self._live)
+        c["live"] = live
+        c["invariant_holds"] = (
+            c["written"] == c["resumed"] + c["discarded"] + live)
+        return c
+
+
+# ---------------------------------------------------------------------
+# Segment-compile bookkeeping
+# ---------------------------------------------------------------------
+
+#: Process-wide signatures of segment executables already built.  This
+#: mirrors jax's jit cache over our static arguments (same repo idiom
+#: as the serve executors' compiles/cache_hits counters): a warm
+#: resume whose segment grid aligns with the original run's re-uses
+#: every executable, so ``info["segment_compiles"] == 0`` — the
+#: acceptance pin.
+_SEG_SIGNATURES: set = set()
+_SEG_LOCK = threading.Lock()
+
+
+def _note_segment(sig: tuple) -> bool:
+    """True when this signature is NEW (a compile happens)."""
+    with _SEG_LOCK:
+        if sig in _SEG_SIGNATURES:
+            return False
+        _SEG_SIGNATURES.add(sig)
+        return True
+
+
+def _segments(start: int, Nr: int, cadence: int):
+    t = start
+    while t < Nr:
+        t1 = min(t + cadence, Nr)
+        yield t, t1
+        t = t1
+
+
+def fingerprint(arr) -> str:
+    """sha256 of an array's bytes — the bit-identity witness the demo
+    report and check_ckpt compare."""
+    a = np.ascontiguousarray(np.asarray(arr))
+    return hashlib.sha256(a.tobytes()).hexdigest()
+
+
+# ---------------------------------------------------------------------
+# Runners
+# ---------------------------------------------------------------------
+
+
+def _derive_topology(mesh) -> str:
+    if mesh is None:
+        return "single"
+    shape = tuple(mesh.devices.shape)
+    if len(shape) == 1:
+        return f"1d:{shape[0]}"
+    if len(shape) == 2:
+        return f"2d:{shape[0]}x{shape[1]}"
+    raise CheckpointUnsupportedError(
+        f"no checkpointable engine for a {len(shape)}-axis mesh")
+
+
+def _check_flavor(workload: str, engine: str, mesh, dtype, spd: bool):
+    import jax.numpy as jnp
+
+    engines = SINGLE_ENGINES if mesh is None else DIST_ENGINES
+    if engine not in engines:
+        raise CheckpointUnsupportedError(
+            f"engine {engine!r} is not checkpointable on "
+            f"{'single-device' if mesh is None else 'distributed'} "
+            f"topologies (supported: {'/'.join(engines)}): swapfree/"
+            f"lookahead flavors carry pipeline state outside the "
+            f"closed (state, swaps, t) tuple, and pallas grouped "
+            f"flavors fuse across steps")
+    if spd:
+        raise CheckpointUnsupportedError(
+            "the SPD fast path has no pivot probe — no pivot record "
+            "to snapshot and no singularity evidence to carry across "
+            "a resume; checkpointing it is refused")
+    jdt = jnp.dtype(dtype)
+    if jdt.kind == "c" and mesh is not None:
+        raise CheckpointUnsupportedError(
+            "complex distributed flavors do not exist yet "
+            "(ROADMAP); checkpointing one cannot be meaningful — "
+            "refused rather than invented")
+    if jdt.kind == "f" and jdt.itemsize < 4:
+        raise CheckpointUnsupportedError(
+            f"sub-fp32 storage dtype {jdt.name}: the engines compute "
+            f"in fp32 with one final rounding, so there is no "
+            f"byte-exact {jdt.name} elimination state to snapshot")
+
+
+def _fire_preempt(run_id: str, durable_step: int | None):
+    """The preempt injection point: one segment boundary.  A scheduled
+    hit converts to the typed PreemptedError AFTER the last boundary's
+    checkpoint is durable (it is — writes happen before this fires)."""
+    try:
+        _faults.fire("preempt")
+    except (_faults.InjectedFaultError,
+            _faults.InjectedTransientError) as e:
+        _recorder.record("ckpt_preempted", run_id=run_id,
+                         step=-1 if durable_step is None
+                         else int(durable_step))
+        raise PreemptedError(
+            f"preempted mid-sweep (run {run_id!r}); last durable "
+            f"checkpoint at superstep {durable_step} — resume from it "
+            f"instead of recomputing", run_id=run_id,
+            step=durable_step) from e
+
+
+def _check_abort(abort, run_id: str, durable_step: int | None):
+    """The real-revocation twin of the preempt fault: the fleet's
+    replica kill path hands the runner an ``abort`` callable returning
+    an exception factory when the hosting replica died.  Checked at
+    segment boundaries only — mid-segment device work is never torn."""
+    if abort is None:
+        return
+    exc = abort()
+    if exc is not None:
+        _recorder.record("ckpt_preempted", run_id=run_id,
+                         step=-1 if durable_step is None
+                         else int(durable_step), cause="abort")
+        raise exc
+
+
+def checkpointed_invert(a, block_size=None, *, store: CheckpointStore,
+                        run_id: str, cadence: int,
+                        engine: str = "unrolled", group: int = 4,
+                        mesh=None, eps=None, precision=None,
+                        use_pallas: bool = False, resume_from=None,
+                        abort=None):
+    """Invert ``a`` with superstep checkpointing.  Returns
+    ``(inv, singular, info)`` where the inverse **bit-matches** the
+    monolithic engine of the same flavor.  ``resume_from=run_id``
+    re-enters at the last durable boundary (typed refusals for
+    missing/corrupt/mismatched checkpoints — never a silent
+    from-scratch run)."""
+    return _run_checkpointed(
+        "invert", a, None, block_size, store=store, run_id=run_id,
+        cadence=cadence, engine=engine, group=group, mesh=mesh,
+        eps=eps, precision=precision, use_pallas=use_pallas,
+        resume_from=resume_from, abort=abort, spd=False)
+
+
+def checkpointed_solve(a, b, block_size=None, *,
+                       store: CheckpointStore, run_id: str,
+                       cadence: int, engine: str = "unrolled",
+                       mesh=None, eps=None, precision=None,
+                       use_pallas: bool = False, resume_from=None,
+                       abort=None, spd: bool = False):
+    """Solve ``a @ x = b`` with superstep checkpointing; the
+    ``checkpointed_invert`` contract, for the solve working set
+    (A, X, singular)."""
+    return _run_checkpointed(
+        "solve", a, b, block_size, store=store, run_id=run_id,
+        cadence=cadence, engine=engine, group=0, mesh=mesh, eps=eps,
+        precision=precision, use_pallas=use_pallas,
+        resume_from=resume_from, abort=abort, spd=spd)
+
+
+def _run_checkpointed(workload, a, b, block_size, *, store, run_id,
+                      cadence, engine, group, mesh, eps, precision,
+                      use_pallas, resume_from, abort, spd):
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..config import default_block_size, eps_for
+
+    if cadence < 1:
+        raise ValueError(f"cadence must be >= 1, got {cadence}")
+    if resume_from is not None and resume_from != run_id:
+        raise CheckpointMismatchError(
+            f"resume_from={resume_from!r} does not name this run "
+            f"({run_id!r}); a resume consumes exactly its own run's "
+            f"checkpoint")
+
+    a = jnp.asarray(a)
+    dtype = a.dtype
+    _check_flavor(workload, engine, mesh, dtype, spd)
+    n = a.shape[-1]
+    m = min(block_size or default_block_size(n), n)
+    if precision is None:
+        precision = lax.Precision.HIGHEST
+    if eps is None:
+        eps = eps_for(dtype)
+    nrhs = 0
+    b2 = None
+    if workload == "solve":
+        b = jnp.asarray(b)
+        b2 = b if b.ndim == 2 else b[:, None]
+        nrhs = b2.shape[1]
+
+    topology = _derive_topology(mesh)
+
+    # --- layout + cadence grid (grouped cadence rounds UP to group
+    # multiples: U/P panels are intra-group temporaries, so group
+    # boundaries are the only points where (V, swaps, t) is closed).
+    if mesh is None:
+        Nr = -(-n // m)
+        grid = max(1, min(group, Nr)) if engine == "grouped" else 1
+    else:
+        if topology.startswith("1d"):
+            from ..parallel.layout import CyclicLayout
+            lay = CyclicLayout.create(n, m, mesh.devices.shape[0])
+        else:
+            from ..parallel.layout import CyclicLayout2D
+            pr, pc = mesh.devices.shape
+            lay = CyclicLayout2D.create(n, m, pr, pc)
+        Nr = lay.Nr
+        grid = 1
+    cad = -(-cadence // grid) * grid
+
+    key = CheckpointKey(run_id=run_id, workload=workload, engine=engine,
+                        topology=topology, n=int(n), m=int(m),
+                        Nr=int(Nr), dtype=jnp.dtype(dtype).name,
+                        nrhs=int(nrhs), cadence=int(cad))
+
+    # --- initial state (host-side numpy: byte-exact round-trips)
+    state, start = _init_state(workload, a, b2 if workload == "solve"
+                               else None, key, mesh)
+    durable: int | None = None
+    resumed = False
+    if resume_from is not None:
+        step, arrays = store.resume(key)
+        if step % grid:
+            raise CheckpointMismatchError(
+                f"resume superstep {step} is off the grouped engine's "
+                f"group-{grid} boundary grid — the stored entry cannot "
+                f"have come from this engine flavor; refused")
+        if not (0 <= step < Nr):
+            raise CheckpointMismatchError(
+                f"resume superstep {step} outside [0, {Nr}) for this "
+                f"layout; refused")
+        missing = set(state) - set(arrays)
+        if missing:
+            raise CheckpointMismatchError(
+                f"checkpoint for run {run_id!r} lacks state arrays "
+                f"{sorted(missing)}; refused")
+        for name in state:
+            if (arrays[name].shape != state[name].shape
+                    or arrays[name].dtype != state[name].dtype):
+                raise CheckpointMismatchError(
+                    f"checkpoint array {name!r} is "
+                    f"{arrays[name].dtype}{arrays[name].shape}, this "
+                    f"call needs "
+                    f"{state[name].dtype}{state[name].shape}; refused")
+        state = {name: arrays[name] for name in state}
+        start = step
+        durable = step
+        resumed = True
+
+    info = {"run_id": run_id, "workload": workload, "engine": engine,
+            "topology": topology, "n": int(n), "m": int(m),
+            "Nr": int(Nr), "cadence": int(cad), "start_step": start,
+            "resumed": resumed, "segments_run": [],
+            "segment_compiles": 0, "ckpt_written": 0,
+            "ckpt_bytes_last": 0}
+
+    # --- the segmented sweep
+    for t0, t1 in _segments(start, Nr, cad):
+        _check_abort(abort, run_id, durable)
+        _fire_preempt(run_id, durable)
+        sig = ("seg", workload, engine, topology, int(n), int(m),
+               int(Nr), key.dtype, int(nrhs), t0, t1, bool(use_pallas))
+        if _note_segment(sig):
+            info["segment_compiles"] += 1
+        state = _run_segment(workload, engine, state, t0, t1, key,
+                             mesh, eps, precision, use_pallas, group)
+        info["segments_run"].append((t0, t1))
+        if t1 < Nr:
+            info["ckpt_bytes_last"] = store.write(key, t1, state)
+            info["ckpt_written"] += 1
+            durable = t1
+
+    _check_abort(abort, run_id, durable)
+    fsig = ("fin", workload, engine, topology, int(n), int(m), int(Nr),
+            key.dtype, int(nrhs))
+    if _note_segment(fsig):
+        info["segment_compiles"] += 1
+    out, singular = _finalize(workload, state, key, mesh)
+    store.discard(run_id, reason="complete")
+    return out, singular, info
+
+
+# ---- state init / segment dispatch / finalize, per topology ---------
+
+
+def _spec1d():
+    from jax.sharding import PartitionSpec
+
+    from ..parallel.mesh import AXIS
+    return (PartitionSpec(AXIS, None, None), PartitionSpec(AXIS),
+            PartitionSpec(AXIS, None))
+
+
+def _spec2d():
+    from jax.sharding import PartitionSpec
+
+    from ..parallel.mesh import AXIS_C, AXIS_R
+    return (PartitionSpec(AXIS_R, None, AXIS_C),
+            PartitionSpec(AXIS_R, None, None),
+            PartitionSpec(AXIS_R, AXIS_C),
+            PartitionSpec(AXIS_R, AXIS_C, None))
+
+
+def _init_state(workload, a, b2, key: CheckpointKey, mesh):
+    import jax.numpy as jnp
+
+    from ..ops.padding import pad_with_identity
+
+    n, m, Nr = key.n, key.m, key.Nr
+    N = Nr * m
+    if mesh is None:
+        if workload == "invert":
+            state = {"V": np.asarray(pad_with_identity(a, N)),
+                     "singular": np.asarray(False),
+                     "swaps": np.zeros((Nr,), np.int32)}
+        else:
+            X = jnp.zeros((N, key.nrhs), a.dtype).at[:n].set(b2)
+            state = {"A": np.asarray(pad_with_identity(a, N)),
+                     "X": np.asarray(X),
+                     "singular": np.asarray(False)}
+        return state, 0
+    if key.topology.startswith("1d"):
+        from ..parallel.layout import CyclicLayout
+        from ..parallel.ring_gemm import _to_identity_padded_blocks
+        from ..parallel.sharded_inplace import scatter_rhs_1d
+
+        p = mesh.devices.shape[0]
+        lay = CyclicLayout.create(n, m, p)
+        W = np.asarray(_to_identity_padded_blocks(a, lay, mesh))
+        if workload == "invert":
+            state = {"W": W, "singular": np.zeros((p,), bool),
+                     "swaps": np.zeros((p, lay.Nr), np.int32)}
+        else:
+            state = {"W": W,
+                     "X": np.asarray(scatter_rhs_1d(b2, lay, mesh)),
+                     "singular": np.zeros((p,), bool)}
+        return state, 0
+    from ..parallel.jordan2d import scatter_matrix_2d
+    from ..parallel.jordan2d_inplace import scatter_rhs_2d
+    from ..parallel.layout import CyclicLayout2D
+
+    pr, pc = mesh.devices.shape
+    lay = CyclicLayout2D.create(n, m, pr, pc)
+    W = np.asarray(scatter_matrix_2d(a, lay, mesh))
+    if workload == "invert":
+        state = {"W": W, "singular": np.zeros((pr, pc), bool),
+                 "swaps": np.zeros((pr, pc, lay.Nr), np.int32)}
+    else:
+        state = {"W": W, "X": np.asarray(scatter_rhs_2d(b2, lay, mesh)),
+                 "singular": np.zeros((pr, pc), bool)}
+    return state, 0
+
+
+def _run_segment(workload, engine, state, t0, t1, key: CheckpointKey,
+                 mesh, eps, precision, use_pallas, group):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    n, m, Nr, nrhs = key.n, key.m, key.Nr, key.nrhs
+    if mesh is None:
+        if workload == "solve":
+            from ..linalg.engine import solve_segment, solve_segment_fori
+
+            fn = solve_segment if engine == "unrolled" \
+                else solve_segment_fori
+            A, X, s = fn(jnp.asarray(state["A"]),
+                         jnp.asarray(state["X"]),
+                         jnp.asarray(state["singular"]), t0=t0, t1=t1,
+                         Nr=Nr, m=m, k=nrhs, eps=eps,
+                         precision=precision)
+            return {"A": np.asarray(A), "X": np.asarray(X),
+                    "singular": np.asarray(s)}
+        from ..ops.jordan_inplace import (invert_segment,
+                                          invert_segment_fori,
+                                          invert_segment_grouped)
+
+        if engine == "grouped":
+            V, s, sw = invert_segment_grouped(
+                jnp.asarray(state["V"]), jnp.asarray(state["singular"]),
+                jnp.asarray(state["swaps"]), t0=t0, t1=t1, Nr=Nr, m=m,
+                group=group, eps=eps, precision=precision,
+                use_pallas=use_pallas)
+        else:
+            fn = invert_segment if engine == "unrolled" \
+                else invert_segment_fori
+            V, s, sw = fn(jnp.asarray(state["V"]),
+                          jnp.asarray(state["singular"]),
+                          jnp.asarray(state["swaps"]), t0=t0, t1=t1,
+                          Nr=Nr, m=m, eps=eps, precision=precision,
+                          use_pallas=use_pallas)
+        return {"V": np.asarray(V), "singular": np.asarray(s),
+                "swaps": np.asarray(sw)}
+
+    unroll = engine == "unrolled"
+    if key.topology.startswith("1d"):
+        from ..parallel.layout import CyclicLayout
+        from ..parallel.sharded_inplace import (
+            _sharded_jordan_inplace_segment,
+            _sharded_jordan_solve_segment)
+
+        lay = CyclicLayout.create(n, m, mesh.devices.shape[0])
+        sW, sS, sSw = _spec1d()
+
+        def put(arr, spec):
+            return jax.device_put(np.asarray(arr),
+                                  NamedSharding(mesh, spec))
+
+        if workload == "solve":
+            W, X, s = _sharded_jordan_solve_segment(
+                put(state["W"], sW), put(state["X"], sW),
+                put(state["singular"], sS), mesh, lay, nrhs, t0, t1,
+                eps, precision, use_pallas, unroll)
+            return {"W": np.asarray(W), "X": np.asarray(X),
+                    "singular": np.asarray(s)}
+        W, s, sw = _sharded_jordan_inplace_segment(
+            put(state["W"], sW), put(state["singular"], sS),
+            put(state["swaps"], sSw), mesh, lay, t0, t1, eps,
+            precision, use_pallas, unroll)
+        return {"W": np.asarray(W), "singular": np.asarray(s),
+                "swaps": np.asarray(sw)}
+
+    from ..parallel.jordan2d_inplace import (
+        _sharded_jordan2d_inplace_segment,
+        _sharded_jordan_solve_2d_segment)
+    from ..parallel.layout import CyclicLayout2D
+
+    pr, pc = mesh.devices.shape
+    lay = CyclicLayout2D.create(n, m, pr, pc)
+    sW, sX, sS, sSw = _spec2d()
+
+    def put2(arr, spec):
+        return jax.device_put(np.asarray(arr),
+                              NamedSharding(mesh, spec))
+
+    if workload == "solve":
+        W, X, s = _sharded_jordan_solve_2d_segment(
+            put2(state["W"], sW), put2(state["X"], sX),
+            put2(state["singular"], sS), mesh, lay, nrhs, t0, t1, eps,
+            precision, use_pallas, unroll)
+        return {"W": np.asarray(W), "X": np.asarray(X),
+                "singular": np.asarray(s)}
+    W, s, sw = _sharded_jordan2d_inplace_segment(
+        put2(state["W"], sW), put2(state["singular"], sS),
+        put2(state["swaps"], sSw), mesh, lay, t0, t1, eps, precision,
+        use_pallas, unroll)
+    return {"W": np.asarray(W), "singular": np.asarray(s),
+            "swaps": np.asarray(sw)}
+
+
+def _finalize(workload, state, key: CheckpointKey, mesh):
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+
+    n, m, Nr = key.n, key.m, key.Nr
+    if mesh is None:
+        singular = bool(np.asarray(state["singular"]))
+        if workload == "solve":
+            return np.asarray(state["X"])[:n], singular
+        from ..ops.jordan_inplace import invert_finalize
+
+        inv = invert_finalize(jnp.asarray(state["V"]),
+                              jnp.asarray(state["swaps"]), n=n, Nr=Nr,
+                              m=m)
+        return np.asarray(inv), singular
+    singular = bool(np.asarray(state["singular"]).any())
+    if key.topology.startswith("1d"):
+        from ..parallel.layout import CyclicLayout
+        from ..parallel.sharded_inplace import (
+            _sharded_inplace_finalize, gather_inverse_inplace,
+            gather_solution_1d)
+
+        lay = CyclicLayout.create(n, m, mesh.devices.shape[0])
+        sW, sS, sSw = _spec1d()
+        if workload == "solve":
+            return np.asarray(gather_solution_1d(
+                jnp.asarray(state["X"]), lay, n)), singular
+        W = _sharded_inplace_finalize(
+            jax.device_put(state["W"], NamedSharding(mesh, sW)),
+            jax.device_put(state["swaps"], NamedSharding(mesh, sSw)),
+            mesh, lay)
+        return np.asarray(gather_inverse_inplace(W, lay, n)), singular
+    from ..parallel.jordan2d_inplace import (
+        _sharded_jordan2d_inplace_finalize, gather_inverse_inplace_2d,
+        gather_solution_2d)
+    from ..parallel.layout import CyclicLayout2D
+
+    pr, pc = mesh.devices.shape
+    lay = CyclicLayout2D.create(n, m, pr, pc)
+    sW, sX, sS, sSw = _spec2d()
+    if workload == "solve":
+        return np.asarray(gather_solution_2d(
+            jnp.asarray(state["X"]), lay, n)), singular
+    W = _sharded_jordan2d_inplace_finalize(
+        jax.device_put(state["W"], NamedSharding(mesh, sW)),
+        jax.device_put(state["swaps"], NamedSharding(mesh, sSw)),
+        mesh, lay)
+    return np.asarray(gather_inverse_inplace_2d(W, lay, n)), singular

@@ -34,6 +34,14 @@ PRs 1-4:
                       failed with the typed ``ReplicaKilledError`` (the
                       router re-queues it), the supervisor warm-replaces
                       the worker (ISSUE 7)
+  preempt             the checkpointed runners' segment boundaries
+                      (``resilience/checkpoint.py``) and the LP/QP
+                      drivers' iteration tops: a scheduled hit is the
+                      chip going away mid-sweep — the runner converts
+                      it to the typed ``PreemptedError`` AFTER the last
+                      cadence-boundary checkpoint is durable, so lost
+                      work is bounded by the cadence and the retry
+                      resumes instead of recomputing (ISSUE 20)
   ==================  ====================================================
 
 A point with no active plan costs one module-global ``is None`` check —
@@ -58,7 +66,7 @@ from ..obs import recorder as _recorder
 #: The named injection points.  ``fire()`` on an unknown point raises —
 #: a typo'd point would otherwise be chaos that never happens.
 POINTS = ("compile", "execute", "plan_cache_write", "measure",
-          "result_corrupt_nan", "dispatch", "replica_kill")
+          "result_corrupt_nan", "dispatch", "replica_kill", "preempt")
 
 #: Injection modes: how a scheduled hit manifests at the call site.
 #:   transient — raises :class:`InjectedTransientError` (classified
@@ -149,9 +157,12 @@ class FaultPlan:
         ISSUE 5 acceptance set).  Seeded modes: ``plan_cache_write`` ->
         oserror, ``result_corrupt_nan`` -> corrupt, ``replica_kill`` ->
         permanent (a process crash is not transient — the replica dies
-        and the supervisor replaces it, ISSUE 7), everything else
-        transient (other permanent faults are a deliberate hand-built
-        choice, never a seeded surprise).
+        and the supervisor replaces it, ISSUE 7), ``preempt`` ->
+        permanent (a preempted chip does not come back for a retry in
+        place — the checkpointed runner types it and the caller
+        resumes, ISSUE 20), everything else transient (other permanent
+        faults are a deliberate hand-built choice, never a seeded
+        surprise).
         """
         if points is None:
             points = {"compile": 1, "execute": 3,
@@ -171,7 +182,8 @@ class FaultPlan:
                 for c in rng.choice(h, size=count, replace=False)))
             mode = ("oserror" if point == "plan_cache_write"
                     else "corrupt" if point == "result_corrupt_nan"
-                    else "permanent" if point == "replica_kill"
+                    else "permanent" if point in ("replica_kill",
+                                                  "preempt")
                     else "transient")
             specs.append(FaultSpec(point, calls, mode))
         return cls(specs)

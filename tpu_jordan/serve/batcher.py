@@ -361,6 +361,39 @@ class MicroBatcher:
                 # (the loop exits once closing and empty).
                 self._loop()
 
+    def reap(self, join_timeout_s: float | None = 0.0) -> bool:
+        """Retry the join of a dispatcher thread a bounded kill-path
+        ``close`` abandoned (ISSUE 20 satellite).  A dispatcher wedged
+        mid-execute that eventually unsticks observes ``_closing`` and
+        exits — but the abandonment left its Thread reference parked in
+        ``_thread`` forever.  ``reap`` joins it again (bounded by
+        ``join_timeout_s``, default an instant poll) and drops the
+        reference once the thread is really gone, counting the recovery
+        in ``tpu_jordan_serve_dispatcher_reaped_total``.  Returns True
+        when no abandoned thread remains (reaped now, or none was ever
+        abandoned); False while it is still alive (try again later).
+        Never blocks a live service: before ``close`` there is nothing
+        abandoned to reap."""
+        with self._close_lock:
+            t = self._thread
+            if t is None:
+                return True
+            if not self._closing:
+                # Still serving — the dispatcher is working, not
+                # abandoned.
+                return False
+            t.join(join_timeout_s)
+            if t.is_alive():
+                return False
+            self._thread = None
+        _obs_metrics.counter(
+            "tpu_jordan_serve_dispatcher_reaped_total",
+            "abandoned dispatcher threads successfully joined by a "
+            "later reap() retry — the bounded kill-path abandonment, "
+            "undone once the wedge cleared",
+        ).inc()
+        return True
+
     @property
     def queued(self) -> int:
         with self._cv:

@@ -696,12 +696,24 @@ class JordanService:
         re-queues them instead of reporting a plain closed service.
         ``join_timeout_s`` bounds the dispatcher join (the kill path:
         abandoning a wedged dispatcher beats freezing the supervisor —
-        ``serve/batcher.py``); None joins until drained."""
+        ``serve/batcher.py``); None joins until drained.
+
+        Closing an ALREADY-closed service retries the reap of any
+        dispatcher thread a previous bounded close abandoned (ISSUE 20
+        satellite): a wedge that cleared after the abandonment is
+        joined now and counted in
+        ``tpu_jordan_serve_dispatcher_reaped_total`` — the second
+        close is how the caller (a fleet teardown sweeping dead
+        replicas) reclaims the thread without ever blocking on a still-
+        wedged one."""
         with self._close_lock:
             if not self._closed:
                 self._batcher.close(drain=drain, error=error,
                                     join_timeout_s=join_timeout_s)
                 self._closed = True
+            else:
+                self._batcher.reap(join_timeout_s=(
+                    0.0 if join_timeout_s is None else join_timeout_s))
 
     def __enter__(self) -> "JordanService":
         return self
